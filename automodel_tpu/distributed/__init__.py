@@ -1,0 +1,17 @@
+from automodel_tpu.distributed.mesh import MeshAxisName, MeshConfig, MeshContext
+from automodel_tpu.distributed.init_utils import (
+    get_rank_safe,
+    get_world_size_safe,
+    initialize_distributed,
+    is_main_process,
+)
+
+__all__ = [
+    "MeshAxisName",
+    "MeshConfig",
+    "MeshContext",
+    "initialize_distributed",
+    "get_rank_safe",
+    "get_world_size_safe",
+    "is_main_process",
+]

@@ -1,0 +1,70 @@
+"""Multi-host bootstrap — the analog of `initialize_distributed`.
+
+The reference initializes NCCL process groups from torchrun env vars
+(reference: nemo_automodel/components/distributed/init_utils.py:1-176).
+On TPU there are no process groups to manage: `jax.distributed.initialize`
+joins the pod's coordination service (one process per host) and every XLA
+collective rides ICI/DCN after that. Single-process runs skip it entirely.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+_INITIALIZED = False
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Join the multi-host JAX runtime if the environment asks for it.
+
+    Env detection mirrors the reference's rank/world discovery: we honor
+    JAX's own vars plus the common launcher ones. No-op when single-host.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    num_processes = num_processes or _int_env("JAX_NUM_PROCESSES")
+    process_id = process_id if process_id is not None else _int_env("JAX_PROCESS_ID")
+
+    tpu_autodetect = os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
+    if coordinator_address or tpu_autodetect:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        logger.info(
+            "jax.distributed initialized: process %d/%d, %d local / %d global devices",
+            jax.process_index(),
+            jax.process_count(),
+            jax.local_device_count(),
+            jax.device_count(),
+        )
+    _INITIALIZED = True
+
+
+def _int_env(name: str) -> int | None:
+    v = os.environ.get(name)
+    return int(v) if v is not None else None
+
+
+def get_world_size_safe() -> int:
+    return jax.process_count()
+
+
+def get_rank_safe() -> int:
+    return jax.process_index()
+
+
+def is_main_process() -> bool:
+    return jax.process_index() == 0

@@ -1,0 +1,13 @@
+from automodel_tpu.parallel.sharding import (
+    AxisRules,
+    DEFAULT_RULES,
+    logical_to_shardings,
+    with_logical_constraint,
+)
+
+__all__ = [
+    "AxisRules",
+    "DEFAULT_RULES",
+    "logical_to_shardings",
+    "with_logical_constraint",
+]

@@ -1,0 +1,163 @@
+"""Logical-axis sharding rules — the TP-plan / FSDP2 analog.
+
+The reference expresses parallelism as per-module DTensor plans
+(reference: nemo_automodel/components/distributed/optimized_tp_plans.py,
+parallelizer.py:2188 `fsdp2_strategy_parallelize`, :1058
+`apply_fsdp2_sharding_recursively`). The TPU-native equivalent: every
+parameter and activation carries a tuple of LOGICAL axis names, and a rule
+table maps logical axes → mesh axes. One table change re-lays-out the whole
+model — "parallelism is configuration" with zero model-code changes.
+
+FSDP2's `fully_shard` ≙ mapping the designated fsdp logical axes onto
+`dp_shard`; TP plans ≙ mapping `heads`/`mlp`/`vocab` onto `tp`; expert
+parallelism ≙ mapping `expert` onto `ep`. XLA's GSPMD inserts the
+all-gathers/reduce-scatters that FSDP2 performs imperatively.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import re
+from typing import Any, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from automodel_tpu.distributed.mesh import MeshAxisName, MeshContext
+
+logger = logging.getLogger(__name__)
+
+# A logical spec is a tuple of logical axis names (or None), one per dim.
+LogicalSpec = tuple
+
+#: Default rule table. First match wins per logical axis. Mesh axis entries
+#: may be a single axis, a tuple, an alias from MeshAxisName.ALIASES, or None.
+DEFAULT_RULES: tuple[tuple[str, Any], ...] = (
+    # activations
+    ("act_batch", "batch"),          # (dp_replicate, dp_shard, ep)
+    ("act_seq", "cp"),               # context parallel shards the seq dim
+    ("act_embed", None),
+    ("act_heads", "tp"),             # attention activations shard on heads
+    ("act_kv_heads", "tp"),
+    ("act_mlp", "tp"),
+    ("act_vocab", "tp"),
+    ("act_expert", "ep"),
+    # parameters — 2-D sharding: fsdp axis x tp axis
+    ("vocab", "tp"),
+    ("embed", "dp_shard"),           # the FSDP ("fully_shard") dim
+    ("heads", "tp"),
+    ("kv_heads", "tp"),
+    ("head_dim", None),
+    ("mlp", "tp"),
+    ("expert", "ep"),
+    ("expert_embed", "dp_shard"),    # FSDP dim inside expert weights
+    ("expert_mlp", "tp"),
+    ("layers", None),                # stacked-layer leading dim (scanned)
+    ("norm", None),
+    ("stage", "pp"),                 # pipeline-stage-stacked params
+)
+
+
+@dataclasses.dataclass
+class AxisRules:
+    """Ordered (logical_axis → mesh axes) table with override support."""
+
+    rules: tuple[tuple[str, Any], ...] = DEFAULT_RULES
+
+    def with_overrides(self, *overrides: tuple[str, Any]) -> "AxisRules":
+        return AxisRules(rules=tuple(overrides) + self.rules)
+
+    def lookup(self, logical: str) -> Any:
+        for name, mesh_axes in self.rules:
+            if name == logical:
+                return mesh_axes
+        raise KeyError(f"No sharding rule for logical axis '{logical}'")
+
+    def spec(self, logical_axes: Sequence[Any], mesh_ctx: MeshContext) -> PartitionSpec:
+        """Logical spec → PartitionSpec, resolving aliases via the mesh.
+
+        A mesh axis may be claimed by at most one dim of a given array;
+        duplicates (e.g. `embed` and `mlp` both on `tp`) keep the first.
+        """
+        used: set[str] = set()
+        parts: list = []
+        for ax in logical_axes:
+            if ax is None:
+                parts.append(None)
+                continue
+            mesh_axes = mesh_ctx.resolve_axes(self.lookup(ax))
+            mesh_axes = tuple(a for a in mesh_axes if a not in used)
+            used.update(mesh_axes)
+            if not mesh_axes:
+                parts.append(None)
+            elif len(mesh_axes) == 1:
+                parts.append(mesh_axes[0])
+            else:
+                parts.append(tuple(mesh_axes))
+        return PartitionSpec(*parts)
+
+
+def logical_to_shardings(
+    logical_specs: Any,
+    mesh_ctx: MeshContext,
+    rules: AxisRules | None = None,
+    shapes: Any = None,
+) -> Any:
+    """Map a pytree of logical specs to NamedShardings.
+
+    When `shapes` (matching pytree of array shapes) is given, dims whose size
+    is not divisible by their assigned mesh-axes product fall back to
+    replicated on that dim with a warning — the analog of the reference's
+    head-count divisibility validation (parallelizer.py:1486).
+    """
+    rules = rules or AxisRules()
+    mesh = mesh_ctx.mesh
+
+    def one(spec, shape=None):
+        pspec = rules.spec(spec, mesh_ctx)
+        if shape is not None:
+            pspec = _validate_divisibility(pspec, shape, mesh)
+        return NamedSharding(mesh, pspec)
+
+    if shapes is None:
+        return jax.tree.map(one, logical_specs, is_leaf=_is_logical_spec)
+    return jax.tree.map(one, logical_specs, shapes, is_leaf=_is_logical_spec)
+
+
+def _validate_divisibility(pspec: PartitionSpec, shape, mesh: Mesh) -> PartitionSpec:
+    parts = list(pspec)
+    parts += [None] * (len(shape) - len(parts))
+    out = []
+    for dim, axes in zip(shape, parts):
+        if axes is None:
+            out.append(None)
+            continue
+        axes_t = (axes,) if isinstance(axes, str) else tuple(axes)
+        prod = math.prod(mesh.shape[a] for a in axes_t)
+        if dim % prod != 0:
+            logger.warning(
+                "dim of size %d not divisible by mesh axes %s (=%d); replicating",
+                dim, axes_t, prod,
+            )
+            out.append(None)
+        else:
+            out.append(axes)
+    return PartitionSpec(*out)
+
+
+def _is_logical_spec(x: Any) -> bool:
+    return isinstance(x, tuple) and all(isinstance(a, (str, type(None))) for a in x)
+
+
+def with_logical_constraint(x, logical_axes: Sequence[Any], mesh_ctx: MeshContext, rules: AxisRules | None = None):
+    """`jax.lax.with_sharding_constraint` via logical axis names.
+
+    The activation-sharding analog of DTensor's redistribute: used inside
+    model code to pin intermediate layouts (e.g. after attention, re-shard
+    tokens back to (batch, cp, None)).
+    """
+    rules = rules or AxisRules()
+    spec = rules.spec(logical_axes, mesh_ctx)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh_ctx.mesh, spec))

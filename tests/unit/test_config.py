@@ -1,0 +1,82 @@
+import os
+
+import pytest
+
+from automodel_tpu.config import (
+    ConfigError,
+    ConfigNode,
+    apply_overrides,
+    load_yaml,
+    parse_override,
+)
+
+
+def test_attr_and_dotted_access():
+    cfg = ConfigNode({"model": {"hidden_size": 128, "rope": {"theta": 10000.0}}})
+    assert cfg.model.hidden_size == 128
+    assert cfg.get("model.rope.theta") == 10000.0
+    assert cfg.get("model.missing", "d") == "d"
+    cfg.set("model.rope.theta", 500000.0)
+    assert cfg.model.rope.theta == 500000.0
+    assert "model.rope.theta" in cfg
+    assert cfg.to_dict()["model"]["rope"]["theta"] == 500000.0
+
+
+def test_env_interpolation(monkeypatch):
+    monkeypatch.setenv("AM_TEST_VAR", "hello")
+    cfg = ConfigNode({"a": "${AM_TEST_VAR}", "b": "${MISSING_VAR:fallback}"})
+    assert cfg.a == "hello"
+    assert cfg.b == "fallback"
+    with pytest.raises(ConfigError):
+        ConfigNode({"c": "${DEFINITELY_MISSING_VAR}"})
+
+
+def test_instantiate_target():
+    cfg = ConfigNode(
+        {"_target_": "automodel_tpu.distributed.mesh.MeshConfig", "tp": 2, "dp_shard": 4}
+    )
+    mc = cfg.instantiate()
+    assert mc.tp == 2 and mc.dp_shard == 4
+    mc2 = cfg.instantiate(tp=1)
+    assert mc2.tp == 1
+
+
+def test_instantiate_allowlist():
+    cfg = ConfigNode({"_target_": "os.system", "command": "true"})
+    with pytest.raises(ConfigError):
+        cfg.instantiate()
+
+
+def test_nested_instantiate():
+    cfg = ConfigNode(
+        {
+            "_target_": "builtins.dict",
+            "inner": {"_target_": "automodel_tpu.distributed.mesh.MeshConfig", "tp": 2},
+        }
+    )
+    out = cfg.instantiate()
+    assert out["inner"].tp == 2
+
+
+def test_secret_redaction():
+    cfg = ConfigNode({"wandb_api_key": "abc123", "lr": 0.1})
+    d = cfg.to_dict(redact=True)
+    assert d["wandb_api_key"] == "***"
+    assert "abc123" not in repr(cfg)
+
+
+def test_overrides():
+    cfg = ConfigNode({"optim": {"lr": 1e-4}})
+    key, val = parse_override("--optim.lr=3e-4")
+    assert key == "optim.lr" and val == pytest.approx(3e-4)
+    apply_overrides(cfg, ["--optim.lr=5e-4", "--new.flag=[1,2]"])
+    assert cfg.optim.lr == pytest.approx(5e-4)
+    assert cfg.get("new.flag") == [1, 2]
+
+
+def test_load_yaml(tmp_path):
+    p = tmp_path / "c.yaml"
+    p.write_text("model:\n  n_layers: 4\noptim:\n  lr: 1.0e-3\n")
+    cfg = load_yaml(str(p))
+    assert cfg.model.n_layers == 4
+    assert cfg.optim.lr == pytest.approx(1e-3)
