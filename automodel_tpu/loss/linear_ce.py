@@ -1,0 +1,64 @@
+"""Fused linear + cross-entropy: the lm_head loss without materialized logits.
+
+The analog of `FusedLinearCrossEntropy` (reference: nemo_automodel/
+components/loss/linear_ce.py:130, Triton cut-cross-entropy): the model
+returns hidden states (`logits_to_keep=1` trick, train_ft.py:1031) and the
+loss projects CHUNKS of the sequence through the lm_head inside a
+rematerialized `lax.scan`, so peak memory holds one (chunk, vocab) logits
+block instead of (batch*seq, vocab). XLA keeps the chunk matmul on the MXU;
+backward recomputes each chunk's logits (flops-for-memory, the same trade
+the Triton kernel makes).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.loss.masked_ce import IGNORE_INDEX
+
+
+def fused_linear_cross_entropy(
+    hidden: jnp.ndarray,          # (B, S, H)
+    lm_head_kernel: jnp.ndarray,  # (H, V)
+    labels: jnp.ndarray,          # (B, S)
+    *,
+    chunk_size: int = 1024,
+    ignore_index: int = IGNORE_INDEX,
+    logits_soft_cap: float | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (sum_ce_fp32, num_valid_tokens_fp32)."""
+    B, S, H = hidden.shape
+    flat_h = hidden.reshape(B * S, H)
+    flat_l = labels.reshape(B * S)
+    N = B * S
+    chunk_size = min(chunk_size, N)
+    pad = (-N) % chunk_size
+    if pad:
+        flat_h = jnp.pad(flat_h, ((0, pad), (0, 0)))
+        flat_l = jnp.pad(flat_l, (0, pad), constant_values=ignore_index)
+    n_chunks = flat_h.shape[0] // chunk_size
+    flat_h = flat_h.reshape(n_chunks, chunk_size, H)
+    flat_l = flat_l.reshape(n_chunks, chunk_size)
+
+    @partial(jax.checkpoint, policy=jax.checkpoint_policies.nothing_saveable)
+    def chunk_loss(carry, xs):
+        h, l = xs
+        logits = jnp.einsum(
+            "ch,hv->cv", h, lm_head_kernel.astype(h.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        if logits_soft_cap is not None:
+            logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
+        mask = l != ignore_index
+        safe = jnp.where(mask, l, 0)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
+        ce = jnp.where(mask, lse - picked, 0.0)
+        ce_sum, n = carry
+        return (ce_sum + jnp.sum(ce), n + jnp.sum(mask).astype(jnp.float32)), None
+
+    (ce_sum, n), _ = jax.lax.scan(chunk_loss, (jnp.float32(0.0), jnp.float32(0.0)), (flat_h, flat_l))
+    return ce_sum, n

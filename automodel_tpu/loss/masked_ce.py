@@ -1,0 +1,46 @@
+"""Masked cross-entropy losses.
+
+Analogs of the reference loss zoo (reference: nemo_automodel/components/
+loss/masked_ce.py:22 `MaskedCrossEntropy`, chunked_ce.py:128
+`ChunkedCrossEntropy`). Losses return an UN-normalized sum plus the valid
+token count so the recipe can normalize by the GLOBAL number of label
+tokens across dp/cp ranks (reference: recipes/llm/train_ft.py:1093-1125) —
+under GSPMD the sums are already global, so the division is a no-op shard-wise.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+IGNORE_INDEX = -100
+
+
+def cross_entropy_sum(
+    logits: jnp.ndarray,  # (..., V) any float dtype; upcast to fp32 inside
+    labels: jnp.ndarray,  # (...,) int, IGNORE_INDEX masked out
+    ignore_index: int = IGNORE_INDEX,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (sum_ce_fp32, num_valid_tokens_fp32)."""
+    mask = labels != ignore_index
+    safe_labels = jnp.where(mask, labels, 0)
+    logits = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
+    ce = jnp.where(mask, lse - picked, 0.0)
+    return jnp.sum(ce), jnp.sum(mask).astype(jnp.float32)
+
+
+def masked_cross_entropy(
+    logits: jnp.ndarray,
+    labels: jnp.ndarray,
+    *,
+    ignore_index: int = IGNORE_INDEX,
+    reduction: str = "sum",
+) -> jnp.ndarray:
+    ce_sum, n = cross_entropy_sum(logits, labels, ignore_index)
+    if reduction == "sum":
+        return ce_sum
+    if reduction == "mean":
+        return ce_sum / jnp.maximum(n, 1.0)
+    raise ValueError(f"Unknown reduction '{reduction}'")

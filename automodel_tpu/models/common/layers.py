@@ -1,0 +1,84 @@
+"""Shared model-building blocks: params-as-pytrees, stacked-layer scan, remat.
+
+Design (TPU-first, not a port): a model is
+
+- an `init(rng, cfg) -> params` building a nested dict of jnp arrays whose
+  per-layer weights are STACKED along a leading `layers` dim,
+- a pure `forward(params, cfg, batch) -> output`, scanning over the stacked
+  layer weights with `jax.lax.scan` + `jax.checkpoint` (one compiled layer
+  body regardless of depth — fast XLA compiles and natural rematerialization),
+- a `param_specs(cfg)` pytree of LOGICAL axis names consumed by
+  parallel/sharding.py.
+
+This replaces the reference's nn.Module trees + per-module FSDP wrapping +
+activation-checkpoint wrapping (reference: components/distributed/
+parallelizer.py:1058, activation_checkpointing.py) with compiler-native
+equivalents.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+# -- remat policies (the analog of full/selective activation checkpointing,
+#    reference: distributed/activation_checkpointing.py) ---------------------
+REMAT_POLICIES: dict[str, Any] = {
+    "none": None,  # save everything (no remat)
+    "full": jax.checkpoint_policies.nothing_saveable,
+    "dots": jax.checkpoint_policies.dots_saveable,
+    "dots_with_no_batch_dims": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    "checkpoint_dots": jax.checkpoint_policies.checkpoint_dots,
+}
+
+
+def maybe_remat(fn: Callable, policy_name: str | None) -> Callable:
+    if policy_name is None or policy_name == "none":
+        return fn
+    policy = REMAT_POLICIES[policy_name]
+    return jax.checkpoint(fn, policy=policy, prevent_cse=False)
+
+
+def scan_layers(
+    layer_fn: Callable,
+    carry,
+    stacked_params,
+    *,
+    remat_policy: str | None = "full",
+    unroll: int = 1,
+):
+    """Scan `layer_fn(carry, layer_params) -> carry` over stacked weights."""
+    fn = maybe_remat(lambda c, p: (layer_fn(c, p), None), remat_policy)
+    carry, _ = jax.lax.scan(fn, carry, stacked_params, unroll=unroll)
+    return carry
+
+
+# -- initializers ------------------------------------------------------------
+def dense_init(rng, shape, dtype=jnp.float32, scale: float | None = None):
+    """Truncated-normal fan-in init (matches the reference models' defaults)."""
+    fan_in = shape[-2] if len(shape) >= 2 else shape[-1]
+    std = scale if scale is not None else fan_in ** -0.5
+    return (std * jax.random.truncated_normal(rng, -3.0, 3.0, shape)).astype(dtype)
+
+
+def embed_init(rng, shape, dtype=jnp.float32, std: float = 0.02):
+    return (std * jax.random.truncated_normal(rng, -3.0, 3.0, shape)).astype(dtype)
+
+
+def split_rngs(rng, names):
+    keys = jax.random.split(rng, len(names))
+    return dict(zip(names, keys))
+
+
+def cast_params(params, dtype):
+    """Compute-dtype cast (mixed precision: fp32 master, bf16 compute)."""
+    return jax.tree.map(
+        lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p,
+        params,
+    )
+
+
+def count_params(params) -> int:
+    return sum(int(p.size) for p in jax.tree.leaves(params))

@@ -1,0 +1,321 @@
+"""Generic dense decoder LM — the shared engine behind the Llama/Qwen/Mistral/
+Gemma model families.
+
+The reference hand-writes one model.py per family
+(reference: nemo_automodel/components/models/llama/model.py:71-265,
+qwen2, qwen3, mistral3, gemma …); on TPU those families differ only by
+config knobs (GQA ratio, qkv bias, qk-norm, sliding windows, soft caps,
+tied embeddings), so one functional decoder with a `TransformerConfig`
+covers them, and each family module is a thin HF-config adapter
+(see models/llm/families.py + models/registry.py, the analog of
+_transformers/registry.py:30 MODEL_ARCH_MAPPING).
+
+Architecture is params-as-pytree + stacked-layer `lax.scan` (see
+models/common/layers.py). All parallelism is logical-axis annotations
+resolved by parallel/sharding.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.layers import (
+    dense_init,
+    embed_init,
+    scan_layers,
+)
+from automodel_tpu.ops.attention import dot_product_attention
+from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.rope import RopeScalingConfig, apply_rope, rope_frequencies
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    intermediate_size: int = 5632
+    num_layers: int = 22
+    num_heads: int = 32
+    num_kv_heads: int = 4
+    head_dim: Optional[int] = None  # defaults to hidden_size // num_heads
+    max_position_embeddings: int = 4096
+    rope_theta: float = 10000.0
+    rope_scaling: RopeScalingConfig = dataclasses.field(default_factory=RopeScalingConfig)
+    rms_norm_eps: float = 1e-5
+    attention_bias: bool = False
+    qk_norm: bool = False  # qwen3-style per-head-dim RMSNorm on q/k
+    attn_scale: Optional[float] = None  # None → head_dim**-0.5 (gemma2 overrides)
+    sliding_window: Optional[int] = None
+    # per-layer "sliding"/"global" types; None → sliding_window on all layers
+    layer_types: Optional[tuple] = None
+    use_post_norms: bool = False  # gemma2-style norms on the attn/mlp branches
+    logits_soft_cap: Optional[float] = None
+    attn_soft_cap: Optional[float] = None
+    embed_scale: float = 1.0  # gemma multiplies embeddings by sqrt(hidden)
+    tie_word_embeddings: bool = False
+    activation: str = "silu"
+    zero_centered_norm: bool = False  # gemma stores scale-1
+    # execution knobs
+    dtype: Any = jnp.bfloat16
+    remat_policy: str = "full"
+    scan_unroll: int = 1
+    attn_impl: str = "auto"
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Training FLOPs/token (fwd+bwd ≈ 6*N + attention term) for MFU."""
+        D = self.resolved_head_dim
+        n_params = (
+            self.vocab_size * self.hidden_size * (1 if self.tie_word_embeddings else 2)
+            + self.num_layers
+            * (
+                self.hidden_size * (self.num_heads + 2 * self.num_kv_heads) * D
+                + self.num_heads * D * self.hidden_size
+                + 3 * self.hidden_size * self.intermediate_size
+            )
+        )
+        attn_flops = 6 * self.num_layers * self.num_heads * D * seq_len  # 2*2*1.5 causal
+        return 6.0 * n_params + attn_flops
+
+
+ACTIVATIONS = {
+    "silu": jax.nn.silu,
+    "gelu": jax.nn.gelu,
+    "gelu_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+    "relu2": lambda x: jnp.square(jax.nn.relu(x)),
+}
+
+
+# ---------------------------------------------------------------------------
+# init / specs
+# ---------------------------------------------------------------------------
+def init(cfg: TransformerConfig, rng: jax.Array) -> dict:
+    """Build fp32 master params with per-layer weights stacked on dim 0."""
+    D = cfg.resolved_head_dim
+    H, I, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    ks = jax.random.split(rng, 8)
+
+    def stack(init_fn, key, shape):
+        keys = jax.random.split(key, L)
+        return jnp.stack([init_fn(k, shape) for k in keys])
+
+    layers = {
+        "input_norm": {"scale": jnp.ones((L, H))},
+        "q_proj": {"kernel": stack(dense_init, ks[0], (H, cfg.num_heads * D))},
+        "k_proj": {"kernel": stack(dense_init, ks[1], (H, cfg.num_kv_heads * D))},
+        "v_proj": {"kernel": stack(dense_init, ks[2], (H, cfg.num_kv_heads * D))},
+        "o_proj": {"kernel": stack(dense_init, ks[3], (cfg.num_heads * D, H))},
+        "post_attn_norm": {"scale": jnp.ones((L, H))},
+        "gate_proj": {"kernel": stack(dense_init, ks[4], (H, I))},
+        "up_proj": {"kernel": stack(dense_init, ks[5], (H, I))},
+        "down_proj": {"kernel": stack(dense_init, ks[6], (I, H))},
+    }
+    if cfg.attention_bias:
+        layers["q_proj"]["bias"] = jnp.zeros((L, cfg.num_heads * D))
+        layers["k_proj"]["bias"] = jnp.zeros((L, cfg.num_kv_heads * D))
+        layers["v_proj"]["bias"] = jnp.zeros((L, cfg.num_kv_heads * D))
+    if cfg.qk_norm:
+        layers["q_norm"] = {"scale": jnp.ones((L, D))}
+        layers["k_norm"] = {"scale": jnp.ones((L, D))}
+    if cfg.use_post_norms:
+        layers["post_attn_out_norm"] = {"scale": jnp.ones((L, H))}
+        layers["post_mlp_norm"] = {"scale": jnp.ones((L, H))}
+
+    params = {
+        "embed": {"embedding": embed_init(ks[7], (cfg.vocab_size, H))},
+        "layers": layers,
+        "final_norm": {"scale": jnp.ones((H,))},
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = {"kernel": dense_init(jax.random.fold_in(rng, 99), (H, cfg.vocab_size))}
+    return params
+
+
+def param_specs(cfg: TransformerConfig) -> dict:
+    """Logical axis names per param (consumed by parallel/sharding.py)."""
+    layers = {
+        "input_norm": {"scale": ("layers", "norm")},
+        "q_proj": {"kernel": ("layers", "embed", "heads")},
+        "k_proj": {"kernel": ("layers", "embed", "kv_heads")},
+        "v_proj": {"kernel": ("layers", "embed", "kv_heads")},
+        "o_proj": {"kernel": ("layers", "heads", "embed")},
+        "post_attn_norm": {"scale": ("layers", "norm")},
+        "gate_proj": {"kernel": ("layers", "embed", "mlp")},
+        "up_proj": {"kernel": ("layers", "embed", "mlp")},
+        "down_proj": {"kernel": ("layers", "mlp", "embed")},
+    }
+    if cfg.attention_bias:
+        layers["q_proj"]["bias"] = ("layers", "heads")
+        layers["k_proj"]["bias"] = ("layers", "kv_heads")
+        layers["v_proj"]["bias"] = ("layers", "kv_heads")
+    if cfg.qk_norm:
+        layers["q_norm"] = {"scale": ("layers", "norm")}
+        layers["k_norm"] = {"scale": ("layers", "norm")}
+    if cfg.use_post_norms:
+        layers["post_attn_out_norm"] = {"scale": ("layers", "norm")}
+        layers["post_mlp_norm"] = {"scale": ("layers", "norm")}
+    specs = {
+        "embed": {"embedding": ("vocab", "embed")},
+        "layers": layers,
+        "final_norm": {"scale": ("norm",)},
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = {"kernel": ("embed", "vocab")}
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def _dense(x, p):
+    y = x @ p["kernel"]
+    if "bias" in p:
+        y = y + p["bias"]
+    return y
+
+
+def forward(
+    params: dict,
+    cfg: TransformerConfig,
+    input_ids: jnp.ndarray,  # (B, S) int32
+    *,
+    positions: jnp.ndarray | None = None,
+    segment_ids: jnp.ndarray | None = None,
+    mesh_ctx=None,
+    rules=None,
+    return_hidden: bool = False,
+) -> jnp.ndarray:
+    """Run the decoder. Returns logits (B,S,V) fp32, or hidden (B,S,H) when
+    `return_hidden` (pair with loss/linear_ce.py to avoid materializing
+    logits — the FusedLinearCrossEntropy analog)."""
+    cfg_dtype = cfg.dtype
+    B, S = input_ids.shape
+    if positions is None:
+        positions = jnp.arange(S, dtype=jnp.int32)[None, :].astype(jnp.int32) * jnp.ones((B, 1), jnp.int32)
+
+    constrain = _make_constrain(mesh_ctx, rules)
+
+    h = jnp.take(params["embed"]["embedding"], input_ids, axis=0).astype(cfg_dtype)
+    if cfg.embed_scale != 1.0:
+        h = h * jnp.asarray(cfg.embed_scale, cfg_dtype)
+    h = constrain(h, ("act_batch", "act_seq", "act_embed"))
+
+    inv_freq = rope_frequencies(cfg.resolved_head_dim, cfg.rope_theta, cfg.rope_scaling)
+
+    # Per-layer sliding windows ride the scan as data: non-sliding layers get
+    # an effectively-infinite window (gemma2/qwen2 alternate layer types).
+    xs = params["layers"]
+    if cfg.sliding_window is not None and cfg.layer_types is not None:
+        windows = jnp.asarray(
+            [
+                cfg.sliding_window if t == "sliding" else (1 << 30)
+                for t in cfg.layer_types
+            ],
+            jnp.int32,
+        )
+        xs = (params["layers"], windows)
+
+        def layer(h, x):
+            lp, window = x
+            return _decoder_layer(
+                h, lp, cfg, positions, segment_ids, inv_freq, constrain, window
+            )
+    else:
+
+        def layer(h, lp):
+            return _decoder_layer(
+                h, lp, cfg, positions, segment_ids, inv_freq, constrain, cfg.sliding_window
+            )
+
+    h = scan_layers(
+        layer, h, xs, remat_policy=cfg.remat_policy, unroll=cfg.scan_unroll
+    )
+
+    h = rms_norm(h, params["final_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
+    if return_hidden:
+        return h
+    return unembed(params, cfg, h)
+
+
+def unembed(params: dict, cfg: TransformerConfig, h: jnp.ndarray) -> jnp.ndarray:
+    """hidden → fp32 logits (with optional tied embeddings / soft cap)."""
+    if cfg.tie_word_embeddings:
+        kernel = params["embed"]["embedding"].T
+    else:
+        kernel = params["lm_head"]["kernel"]
+    logits = jnp.einsum("bsh,hv->bsv", h, kernel.astype(h.dtype), preferred_element_type=jnp.float32)
+    if cfg.logits_soft_cap is not None:
+        logits = cfg.logits_soft_cap * jnp.tanh(logits / cfg.logits_soft_cap)
+    return logits
+
+
+def _decoder_layer(h, lp, cfg: TransformerConfig, positions, segment_ids, inv_freq, constrain, sliding_window):
+    D = cfg.resolved_head_dim
+    B, S, _ = h.shape
+
+    # -- attention ----------------------------------------------------------
+    x = rms_norm(h, lp["input_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
+    q = _dense(x, lp["q_proj"]).reshape(B, S, cfg.num_heads, D)
+    k = _dense(x, lp["k_proj"]).reshape(B, S, cfg.num_kv_heads, D)
+    v = _dense(x, lp["v_proj"]).reshape(B, S, cfg.num_kv_heads, D)
+    q = constrain(q, ("act_batch", "act_seq", "act_heads", None))
+    k = constrain(k, ("act_batch", "act_seq", "act_kv_heads", None))
+    v = constrain(v, ("act_batch", "act_seq", "act_kv_heads", None))
+    if cfg.qk_norm:
+        q = rms_norm(q, lp["q_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
+        k = rms_norm(k, lp["k_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
+    q = apply_rope(q, positions, inv_freq)
+    k = apply_rope(k, positions, inv_freq)
+
+    attn = dot_product_attention(
+        q, k, v,
+        causal=True,
+        segment_ids=segment_ids,
+        positions=positions,
+        sliding_window=sliding_window,
+        logits_soft_cap=cfg.attn_soft_cap,
+        scale=cfg.attn_scale,
+        impl=cfg.attn_impl,
+    )
+    attn = attn.reshape(B, S, cfg.num_heads * D)
+    attn_out = _dense(attn, lp["o_proj"])
+    if cfg.use_post_norms:
+        attn_out = rms_norm(
+            attn_out, lp["post_attn_out_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm
+        )
+    h = h + attn_out
+    h = constrain(h, ("act_batch", "act_seq", "act_embed"))
+
+    # -- mlp ----------------------------------------------------------------
+    x = rms_norm(h, lp["post_attn_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
+    act = ACTIVATIONS[cfg.activation]
+    gate = act(x @ lp["gate_proj"]["kernel"])
+    up = x @ lp["up_proj"]["kernel"]
+    mlp = constrain(gate * up, ("act_batch", "act_seq", "act_mlp"))
+    mlp_out = mlp @ lp["down_proj"]["kernel"]
+    if cfg.use_post_norms:
+        mlp_out = rms_norm(
+            mlp_out, lp["post_mlp_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm
+        )
+    h = h + mlp_out
+    return constrain(h, ("act_batch", "act_seq", "act_embed"))
+
+
+def _make_constrain(mesh_ctx, rules):
+    if mesh_ctx is None:
+        return lambda x, axes: x
+    from automodel_tpu.parallel.sharding import AxisRules, with_logical_constraint
+
+    rules = rules or AxisRules()
+
+    def constrain(x, axes):
+        return with_logical_constraint(x, axes, mesh_ctx, rules)
+
+    return constrain

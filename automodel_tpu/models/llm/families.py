@@ -1,0 +1,108 @@
+"""Model-family adapters: HF config dict → TransformerConfig.
+
+The analog of the reference's per-family model modules + registry
+(reference: nemo_automodel/components/models/{llama,qwen2,qwen3,mistral3,
+gemma…}/model.py and _transformers/registry.py:30 MODEL_ARCH_MAPPING).
+Dense families differ only by config; MoE families live in models/moe_lm/.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+
+from automodel_tpu.models.llm.decoder import TransformerConfig
+from automodel_tpu.ops.rope import RopeScalingConfig
+
+
+def _base_kwargs(hf: Mapping[str, Any]) -> dict:
+    hidden = int(hf["hidden_size"])
+    heads = int(hf["num_attention_heads"])
+    return dict(
+        vocab_size=int(hf["vocab_size"]),
+        hidden_size=hidden,
+        intermediate_size=int(hf["intermediate_size"]),
+        num_layers=int(hf["num_hidden_layers"]),
+        num_heads=heads,
+        num_kv_heads=int(hf.get("num_key_value_heads", heads)),
+        head_dim=int(hf["head_dim"]) if hf.get("head_dim") else None,
+        max_position_embeddings=int(hf.get("max_position_embeddings", 4096)),
+        rope_theta=float(hf.get("rope_theta", 10000.0)),
+        rope_scaling=RopeScalingConfig.from_hf(hf.get("rope_scaling")),
+        rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
+        tie_word_embeddings=bool(hf.get("tie_word_embeddings", False)),
+    )
+
+
+def llama_config(hf: Mapping[str, Any], **overrides) -> TransformerConfig:
+    """LlamaForCausalLM (Llama 2/3/3.x; reference: models/llama/model.py)."""
+    kw = _base_kwargs(hf)
+    kw["attention_bias"] = bool(hf.get("attention_bias", False))
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def mistral_config(hf: Mapping[str, Any], **overrides) -> TransformerConfig:
+    """MistralForCausalLM (reference: models/mistral3)."""
+    kw = _base_kwargs(hf)
+    if hf.get("sliding_window"):
+        kw["sliding_window"] = int(hf["sliding_window"])
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def qwen2_config(hf: Mapping[str, Any], **overrides) -> TransformerConfig:
+    """Qwen2ForCausalLM — qkv bias (reference: models/qwen2/model.py)."""
+    kw = _base_kwargs(hf)
+    kw["attention_bias"] = True
+    if hf.get("use_sliding_window") and hf.get("sliding_window"):
+        kw["sliding_window"] = int(hf["sliding_window"])
+        # HF Qwen2 windows only layers >= max_window_layers
+        mwl = int(hf.get("max_window_layers", 0))
+        kw["layer_types"] = tuple(
+            "sliding" if i >= mwl else "global" for i in range(kw["num_layers"])
+        )
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def qwen3_config(hf: Mapping[str, Any], **overrides) -> TransformerConfig:
+    """Qwen3ForCausalLM — qk-norm, no bias (reference: models/qwen3_5)."""
+    kw = _base_kwargs(hf)
+    kw["qk_norm"] = True
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def gemma2_config(hf: Mapping[str, Any], **overrides) -> TransformerConfig:
+    """Gemma2: zero-centered 4-norm layers, embed scaling, soft caps,
+    query_pre_attn_scalar attention scale, alternating sliding/global."""
+    kw = _base_kwargs(hf)
+    kw["activation"] = "gelu_tanh"
+    kw["zero_centered_norm"] = True
+    kw["use_post_norms"] = True
+    kw["embed_scale"] = float(kw["hidden_size"]) ** 0.5
+    if hf.get("final_logit_softcapping"):
+        kw["logits_soft_cap"] = float(hf["final_logit_softcapping"])
+    if hf.get("attn_logit_softcapping"):
+        kw["attn_soft_cap"] = float(hf["attn_logit_softcapping"])
+    if hf.get("query_pre_attn_scalar"):
+        kw["attn_scale"] = float(hf["query_pre_attn_scalar"]) ** -0.5
+    if hf.get("sliding_window"):
+        kw["sliding_window"] = int(hf["sliding_window"])
+        n_layers = kw["num_layers"]
+        if hf.get("layer_types"):
+            kw["layer_types"] = tuple(
+                "sliding" if t == "sliding_attention" else "global"
+                for t in hf["layer_types"]
+            )
+        else:
+            # gemma2 alternates: even layers sliding, odd layers global
+            kw["layer_types"] = tuple(
+                "sliding" if i % 2 == 0 else "global" for i in range(n_layers)
+            )
+    # gemma HF configs rely on the class default of tie_word_embeddings=True
+    kw["tie_word_embeddings"] = bool(hf.get("tie_word_embeddings", True))
+    kw.update(overrides)
+    return TransformerConfig(**kw)

@@ -1,0 +1,52 @@
+"""Architecture registry: HF `architectures[0]` → TPU-native implementation.
+
+The analog of the reference's `MODEL_ARCH_MAPPING` + `_ModelRegistry.get`
+(reference: nemo_automodel/_transformers/registry.py:30-490). Each entry
+yields a `ModelSpec` bundling config-adapter, init/forward/param_specs, and
+the HF state-dict adapter used for zero-conversion checkpoint I/O.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Mapping
+
+from automodel_tpu.models.llm import decoder, families
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    """Everything the framework needs to drive one architecture."""
+
+    name: str
+    config_from_hf: Callable[..., Any]
+    module: Any  # provides init / forward / param_specs / (unembed)
+    adapter_name: str = "dense_decoder"  # state-dict adapter key
+
+
+MODEL_ARCH_MAPPING: dict[str, ModelSpec] = {
+    "LlamaForCausalLM": ModelSpec("llama", families.llama_config, decoder),
+    "MistralForCausalLM": ModelSpec("mistral", families.mistral_config, decoder),
+    "Qwen2ForCausalLM": ModelSpec("qwen2", families.qwen2_config, decoder),
+    "Qwen3ForCausalLM": ModelSpec("qwen3", families.qwen3_config, decoder),
+    "Gemma2ForCausalLM": ModelSpec("gemma2", families.gemma2_config, decoder),
+}
+
+
+def register_model(arch: str, spec: ModelSpec) -> None:
+    MODEL_ARCH_MAPPING[arch] = spec
+
+
+def get_model_spec(arch_or_hf_config: "str | Mapping") -> ModelSpec:
+    if isinstance(arch_or_hf_config, str):
+        arch = arch_or_hf_config
+    else:
+        archs = arch_or_hf_config.get("architectures") or []
+        arch = archs[0] if archs else ""
+    try:
+        return MODEL_ARCH_MAPPING[arch]
+    except KeyError:
+        raise KeyError(
+            f"Architecture '{arch}' is not registered; known: "
+            f"{sorted(MODEL_ARCH_MAPPING)}"
+        ) from None

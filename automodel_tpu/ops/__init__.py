@@ -1,0 +1,13 @@
+from automodel_tpu.ops.attention import dot_product_attention, make_attention_mask, xla_attention
+from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.rope import RopeScalingConfig, apply_rope, rope_frequencies
+
+__all__ = [
+    "dot_product_attention",
+    "make_attention_mask",
+    "xla_attention",
+    "rms_norm",
+    "RopeScalingConfig",
+    "apply_rope",
+    "rope_frequencies",
+]

@@ -1,0 +1,140 @@
+"""Attention entry point with pluggable backends.
+
+The analog of the reference's attention backend dispatch
+(reference: nemo_automodel/components/models/common/utils.py BackendConfig
+attn = te/sdpa/flex/eager; components/attention/flex_attention.py:32).
+TPU backends:
+
+- "xla":    einsum + masked softmax reference path (CPU-testable, and the
+            correctness oracle for the Pallas kernels).
+- "flash":  Pallas flash-attention kernel (ops/pallas/flash_attention.py).
+- "auto":   flash on TPU, xla elsewhere.
+
+Supports GQA (num_q_heads a multiple of num_kv_heads), causal and
+bidirectional masks, packed-sequence segment ids (the THD/cu_seqlens analog,
+reference: components/distributed/thd_utils.py), sliding windows, and
+logit soft-capping (gemma-style).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+
+AttnImpl = Literal["auto", "xla", "flash"]
+
+NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def make_attention_mask(
+    q_len: int,
+    kv_len: int,
+    *,
+    causal: bool = True,
+    q_segment_ids: jnp.ndarray | None = None,
+    kv_segment_ids: jnp.ndarray | None = None,
+    q_positions: jnp.ndarray | None = None,
+    kv_positions: jnp.ndarray | None = None,
+    sliding_window: int | None = None,
+) -> jnp.ndarray | None:
+    """Boolean mask (B?, q_len, kv_len); True = attend."""
+    masks = []
+    if causal:
+        qp = q_positions if q_positions is not None else jnp.arange(q_len)
+        kp = kv_positions if kv_positions is not None else jnp.arange(kv_len)
+        masks.append(qp[..., :, None] >= kp[..., None, :])
+        if sliding_window is not None:
+            masks.append(qp[..., :, None] - kp[..., None, :] < sliding_window)
+    if q_segment_ids is not None and kv_segment_ids is not None:
+        masks.append(q_segment_ids[..., :, None] == kv_segment_ids[..., None, :])
+    if not masks:
+        return None
+    out = masks[0]
+    for m in masks[1:]:
+        out = jnp.logical_and(out, m)
+    return out
+
+
+def xla_attention(
+    q: jnp.ndarray,  # (B, S, Hq, D)
+    k: jnp.ndarray,  # (B, T, Hkv, D)
+    v: jnp.ndarray,  # (B, T, Hkv, D)
+    *,
+    mask: jnp.ndarray | None,
+    scale: float | None = None,
+    logits_soft_cap: float | None = None,
+) -> jnp.ndarray:
+    """Reference einsum attention; softmax in fp32."""
+    B, S, Hq, D = q.shape
+    _, T, Hkv, _ = k.shape
+    assert Hq % Hkv == 0, f"GQA requires Hq % Hkv == 0, got {Hq} % {Hkv}"
+    G = Hq // Hkv
+    scale = scale if scale is not None else D ** -0.5
+
+    qg = q.reshape(B, S, Hkv, G, D)
+    logits = jnp.einsum("bskgd,btkd->bkgst", qg, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    if logits_soft_cap is not None:
+        logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
+    if mask is not None:
+        if mask.ndim == 2:
+            mask = mask[None]
+        logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs.astype(v.dtype), v)
+    return out.reshape(B, S, Hq, D)
+
+
+def dot_product_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    segment_ids: jnp.ndarray | None = None,
+    positions: jnp.ndarray | None = None,
+    sliding_window: int | None = None,
+    logits_soft_cap: float | None = None,
+    scale: float | None = None,
+    impl: AttnImpl = "auto",
+) -> jnp.ndarray:
+    """Main attention entry. Shapes: q (B,S,Hq,D); k,v (B,T,Hkv,D)."""
+    resolved = impl
+    if impl == "auto":
+        resolved = "flash" if _on_tpu() else "xla"
+    if resolved == "flash":
+        from automodel_tpu.ops.pallas.flash_attention import flash_attention
+
+        try:
+            return flash_attention(
+                q, k, v,
+                causal=causal,
+                segment_ids=segment_ids,
+                positions=positions,
+                sliding_window=sliding_window,
+                logits_soft_cap=logits_soft_cap,
+                scale=scale,
+            )
+        except NotImplementedError:
+            resolved = "xla"
+    if resolved == "xla":
+        mask = make_attention_mask(
+            q.shape[1], k.shape[1],
+            causal=causal,
+            q_segment_ids=segment_ids,
+            kv_segment_ids=segment_ids,
+            q_positions=positions,
+            kv_positions=positions,
+            sliding_window=sliding_window,
+        )
+        return xla_attention(
+            q, k, v, mask=mask, scale=scale, logits_soft_cap=logits_soft_cap
+        )
+    raise ValueError(f"Unknown attention impl '{impl}'")

@@ -1,0 +1,27 @@
+"""Normalization ops.
+
+Analog of the reference's RMSNorm backends (torch / TE / quack — reference:
+nemo_automodel/components/models/common/utils.py:200-205). XLA fuses the
+fp32 upcast + rsqrt + scale into neighbors, so the default is plain jnp;
+a Pallas variant lives in ops/pallas for cases where fusion falls short.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-6, zero_centered: bool = False) -> jnp.ndarray:
+    """RMSNorm in fp32, output in x.dtype. scale shape: (hidden,).
+
+    `zero_centered` follows the gemma convention (weight stored as scale-1).
+    """
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    y = xf * lax.rsqrt(var + eps)
+    w = scale.astype(jnp.float32)
+    if zero_centered:
+        w = w + 1.0
+    return (y * w).astype(dtype)

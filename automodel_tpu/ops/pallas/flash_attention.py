@@ -1,0 +1,15 @@
+"""Pallas flash-attention kernel (TPU).
+
+The analog of the reference's TE `DotProductAttention`/FlexAttention paths
+(reference: nemo_automodel/_transformers/te_attention.py,
+components/attention/flex_attention.py:32). Implemented in the kernels
+milestone; until then the dispatcher in ops/attention.py falls back to the
+XLA reference path.
+"""
+
+from __future__ import annotations
+
+
+def flash_attention(q, k, v, *, causal=True, segment_ids=None, positions=None,
+                    sliding_window=None, logits_soft_cap=None, scale=None):
+    raise NotImplementedError("pallas flash attention lands with the kernels milestone")

@@ -1,0 +1,93 @@
+"""Rotary position embeddings.
+
+TPU-native analog of the reference RoPE variants
+(reference: nemo_automodel/components/models/llama/rope_utils.py — torch /
+fused / quack backends). On TPU a single jnp implementation fuses into the
+surrounding matmuls under XLA; no custom kernel is needed for the default
+path. Supports llama3-style frequency scaling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class RopeScalingConfig:
+    """llama3-style NTK/frequency scaling (HF `rope_scaling`)."""
+
+    rope_type: str = "default"  # "default" | "llama3" | "linear"
+    factor: float = 1.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position_embeddings: int = 8192
+
+    @classmethod
+    def from_hf(cls, d: dict | None) -> "RopeScalingConfig":
+        if not d:
+            return cls()
+        return cls(
+            rope_type=d.get("rope_type", d.get("type", "default")),
+            factor=float(d.get("factor", 1.0)),
+            low_freq_factor=float(d.get("low_freq_factor", 1.0)),
+            high_freq_factor=float(d.get("high_freq_factor", 4.0)),
+            original_max_position_embeddings=int(
+                d.get("original_max_position_embeddings", 8192)
+            ),
+        )
+
+
+def rope_frequencies(
+    head_dim: int,
+    theta: float = 10000.0,
+    scaling: RopeScalingConfig | None = None,
+) -> jnp.ndarray:
+    """Inverse frequencies, shape (head_dim // 2,), float32."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    if scaling is None or scaling.rope_type == "default":
+        return inv_freq
+    if scaling.rope_type == "linear":
+        return inv_freq / scaling.factor
+    if scaling.rope_type == "llama3":
+        low_wavelen = scaling.original_max_position_embeddings / scaling.low_freq_factor
+        high_wavelen = scaling.original_max_position_embeddings / scaling.high_freq_factor
+        wavelen = 2.0 * math.pi / inv_freq
+        # smooth interpolation between scaled and unscaled bands
+        smooth = (scaling.original_max_position_embeddings / wavelen - scaling.low_freq_factor) / (
+            scaling.high_freq_factor - scaling.low_freq_factor
+        )
+        smooth = jnp.clip(smooth, 0.0, 1.0)
+        scaled = inv_freq / scaling.factor
+        blended = (1.0 - smooth) * scaled + smooth * inv_freq
+        return jnp.where(
+            wavelen < high_wavelen,
+            inv_freq,
+            jnp.where(wavelen > low_wavelen, scaled, blended),
+        )
+    raise ValueError(f"Unknown rope_type '{scaling.rope_type}'")
+
+
+def apply_rope(
+    x: jnp.ndarray,
+    positions: jnp.ndarray,
+    inv_freq: jnp.ndarray,
+) -> jnp.ndarray:
+    """Rotate (..., seq, heads, head_dim) by per-token positions.
+
+    Uses the HF "half-split" convention: the head_dim is split into two
+    halves rotated against each other (matches llama/qwen checkpoints).
+    positions: (..., seq) int32.
+    """
+    orig_dtype = x.dtype
+    angles = positions[..., :, None].astype(jnp.float32) * inv_freq  # (..., S, D/2)
+    cos = jnp.cos(angles)[..., :, None, :]  # (..., S, 1, D/2)
+    sin = jnp.sin(angles)[..., :, None, :]
+    x = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(orig_dtype)

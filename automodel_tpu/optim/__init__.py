@@ -1,0 +1,3 @@
+from automodel_tpu.optim.optimizer import LRSchedulerConfig, OptimizerConfig, default_weight_decay_mask
+
+__all__ = ["LRSchedulerConfig", "OptimizerConfig", "default_weight_decay_mask"]

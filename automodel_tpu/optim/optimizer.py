@@ -1,0 +1,89 @@
+"""Optimizer configs → optax transforms.
+
+The analog of the reference's typed optimizer configs
+(reference: nemo_automodel/components/optim/optimizer.py:179-338 —
+Adam/AdamW/FusedAdam/FlashAdamW). On TPU, "fused" is what XLA does by
+default; the knobs that matter are kept: betas/eps/weight-decay, a
+no-decay mask for 1-D params (norm scales, biases), and param-group
+style overrides via a predicate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import optax
+
+
+def default_weight_decay_mask(params) -> Any:
+    """Decay matrices only — norm scales / biases (ndim < 2) are excluded."""
+    return jax.tree.map(lambda p: p.ndim >= 2, params)
+
+
+@dataclasses.dataclass
+class OptimizerConfig:
+    name: str = "adamw"
+    lr: float = 3e-4
+    betas: tuple = (0.9, 0.95)
+    eps: float = 1e-8
+    weight_decay: float = 0.1
+    momentum: float = 0.9  # sgd only
+    decay_mask: Optional[Callable] = dataclasses.field(default=None, repr=False)
+
+    def build(self, lr_schedule: "float | Callable" = None) -> optax.GradientTransformation:
+        lr = lr_schedule if lr_schedule is not None else self.lr
+        mask = self.decay_mask or default_weight_decay_mask
+        if self.name in ("adamw", "fused_adamw", "flash_adamw"):
+            return optax.adamw(
+                lr, b1=self.betas[0], b2=self.betas[1], eps=self.eps,
+                weight_decay=self.weight_decay, mask=mask,
+            )
+        if self.name in ("adam", "fused_adam"):
+            return optax.adam(lr, b1=self.betas[0], b2=self.betas[1], eps=self.eps)
+        if self.name == "sgd":
+            return optax.sgd(lr, momentum=self.momentum)
+        if self.name == "adafactor":
+            return optax.adafactor(lr)
+        if self.name == "lion":
+            return optax.lion(lr, b1=self.betas[0], b2=self.betas[1], weight_decay=self.weight_decay)
+        raise ValueError(f"Unknown optimizer '{self.name}'")
+
+
+@dataclasses.dataclass
+class LRSchedulerConfig:
+    """Warmup + decay schedule (reference: optim/scheduler.py:18
+    `OptimizerParamScheduler` — cosine / linear / wsd)."""
+
+    warmup_steps: int = 0
+    decay_steps: int = 1000
+    style: str = "cosine"  # cosine | linear | constant | wsd
+    min_lr_ratio: float = 0.0
+    stable_steps: int = 0  # wsd only
+
+    def build(self, peak_lr: float) -> Callable:
+        floor = peak_lr * self.min_lr_ratio
+        if self.style == "constant":
+            sched = optax.constant_schedule(peak_lr)
+        elif self.style == "cosine":
+            sched = optax.cosine_decay_schedule(
+                peak_lr, max(self.decay_steps, 1), alpha=self.min_lr_ratio
+            )
+        elif self.style == "linear":
+            sched = optax.linear_schedule(peak_lr, floor, max(self.decay_steps, 1))
+        elif self.style == "wsd":
+            # warmup handled below; stable then linear decay to floor
+            sched = optax.join_schedules(
+                [
+                    optax.constant_schedule(peak_lr),
+                    optax.linear_schedule(peak_lr, floor, max(self.decay_steps, 1)),
+                ],
+                [self.stable_steps],
+            )
+        else:
+            raise ValueError(f"Unknown LR style '{self.style}'")
+        if self.warmup_steps > 0:
+            warmup = optax.linear_schedule(0.0, peak_lr, self.warmup_steps)
+            return optax.join_schedules([warmup, sched], [self.warmup_steps])
+        return sched

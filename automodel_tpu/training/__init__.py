@@ -1,0 +1,15 @@
+from automodel_tpu.training.train_step import (
+    TrainState,
+    TrainStepConfig,
+    init_train_state,
+    jit_train_step,
+    make_train_step,
+)
+
+__all__ = [
+    "TrainState",
+    "TrainStepConfig",
+    "init_train_state",
+    "jit_train_step",
+    "make_train_step",
+]

@@ -1,0 +1,156 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu.distributed import MeshConfig
+from automodel_tpu.models.llm import decoder
+from automodel_tpu.models.llm.decoder import TransformerConfig
+from automodel_tpu.models.registry import get_model_spec
+from automodel_tpu.parallel import logical_to_shardings
+
+TINY = TransformerConfig(
+    vocab_size=128,
+    hidden_size=32,
+    intermediate_size=64,
+    num_layers=2,
+    num_heads=4,
+    num_kv_heads=2,
+    max_position_embeddings=64,
+    dtype=jnp.float32,
+    remat_policy="none",
+)
+
+
+def test_init_and_forward_shapes():
+    params = decoder.init(TINY, jax.random.key(0))
+    ids = jnp.zeros((2, 16), jnp.int32)
+    logits = decoder.forward(params, TINY, ids)
+    assert logits.shape == (2, 16, 128)
+    assert logits.dtype == jnp.float32
+    hidden = decoder.forward(params, TINY, ids, return_hidden=True)
+    assert hidden.shape == (2, 16, 32)
+
+
+def test_param_specs_tree_matches_params():
+    params = decoder.init(TINY, jax.random.key(0))
+    specs = decoder.param_specs(TINY)
+    # same tree structure
+    jax.tree.map(lambda p, s: None, params, specs, is_leaf=lambda x: isinstance(x, tuple))
+    flat_p = jax.tree.leaves(params)
+    flat_s = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, tuple))
+    assert len(flat_p) == len(flat_s)
+    for p, s in zip(flat_p, flat_s):
+        assert p.ndim == len(s), f"{p.shape} vs {s}"
+
+
+def test_causality():
+    """Changing a future token must not affect earlier logits."""
+    params = decoder.init(TINY, jax.random.key(1))
+    ids1 = jnp.array([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+    ids2 = ids1.at[0, 6].set(99)
+    l1 = decoder.forward(params, TINY, ids1)
+    l2 = decoder.forward(params, TINY, ids2)
+    np.testing.assert_allclose(l1[0, :6], l2[0, :6], rtol=2e-5, atol=2e-5)
+    assert not np.allclose(l1[0, 6:], l2[0, 6:])
+
+
+def test_segment_ids_isolate_documents():
+    """Packed sequences: doc 2 must be unaffected by doc 1's contents."""
+    params = decoder.init(TINY, jax.random.key(2))
+    seg = jnp.array([[0, 0, 0, 0, 1, 1, 1, 1]], jnp.int32)
+    pos = jnp.array([[0, 1, 2, 3, 0, 1, 2, 3]], jnp.int32)
+    ids1 = jnp.array([[1, 2, 3, 4, 10, 11, 12, 13]], jnp.int32)
+    ids2 = jnp.array([[5, 6, 7, 8, 10, 11, 12, 13]], jnp.int32)
+    l1 = decoder.forward(params, TINY, ids1, positions=pos, segment_ids=seg)
+    l2 = decoder.forward(params, TINY, ids2, positions=pos, segment_ids=seg)
+    np.testing.assert_allclose(l1[0, 4:], l2[0, 4:], rtol=2e-5, atol=2e-5)
+
+
+def test_feature_variants_forward():
+    for kw in (
+        dict(attention_bias=True),
+        dict(qk_norm=True),
+        dict(tie_word_embeddings=True),
+        dict(sliding_window=4),
+        dict(sliding_window=4, layer_types=("sliding", "global")),
+        dict(logits_soft_cap=30.0, attn_soft_cap=50.0),
+        dict(zero_centered_norm=True, embed_scale=5.65, use_post_norms=True),
+        dict(attn_scale=0.25),
+    ):
+        cfg = TransformerConfig(**{**TINY.__dict__, **kw})
+        params = decoder.init(cfg, jax.random.key(3))
+        out = decoder.forward(params, cfg, jnp.zeros((1, 8), jnp.int32))
+        assert np.isfinite(np.asarray(out)).all(), kw
+
+
+def test_registry_from_hf():
+    hf = {
+        "architectures": ["Qwen2ForCausalLM"],
+        "vocab_size": 128,
+        "hidden_size": 32,
+        "intermediate_size": 64,
+        "num_hidden_layers": 2,
+        "num_attention_heads": 4,
+        "num_key_value_heads": 2,
+    }
+    spec = get_model_spec(hf)
+    cfg = spec.config_from_hf(hf, dtype=jnp.float32, remat_policy="none")
+    assert cfg.attention_bias  # qwen2 uses qkv bias
+    params = spec.module.init(cfg, jax.random.key(0))
+    out = spec.module.forward(params, cfg, jnp.zeros((1, 4), jnp.int32))
+    assert out.shape == (1, 4, 128)
+
+
+def test_sharded_forward_matches_single_device():
+    ctx = MeshConfig(dp_shard=2, tp=2, cp=2).build()
+    params = decoder.init(TINY, jax.random.key(0))
+    shardings = logical_to_shardings(
+        decoder.param_specs(TINY), ctx, shapes=jax.tree.map(lambda p: p.shape, params)
+    )
+    sharded = jax.device_put(params, shardings)
+    ids = jax.random.randint(jax.random.key(5), (4, 16), 0, 128)
+    ref = decoder.forward(params, TINY, ids)
+
+    @jax.jit
+    def f(p, i):
+        return decoder.forward(p, TINY, i, mesh_ctx=ctx)
+
+    ids_sharded = jax.device_put(ids, ctx.sharding("batch", "cp"))
+    out = f(sharded, ids_sharded)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=2e-4, atol=2e-4)
+
+
+def test_per_layer_sliding_windows_differ_from_global():
+    """A 'global' layer in the pattern must see beyond the window."""
+    base = dict(TINY.__dict__)
+    cfg_all = TransformerConfig(**{**base, "sliding_window": 2})
+    cfg_mix = TransformerConfig(
+        **{**base, "sliding_window": 2, "layer_types": ("sliding", "global")}
+    )
+    params = decoder.init(cfg_all, jax.random.key(4))
+    ids = jnp.arange(12, dtype=jnp.int32)[None, :] % 64
+    l_all = decoder.forward(params, cfg_all, ids)
+    l_mix = decoder.forward(params, cfg_mix, ids)
+    assert not np.allclose(np.asarray(l_all), np.asarray(l_mix))
+
+
+def test_gemma2_adapter():
+    from automodel_tpu.models.llm.families import gemma2_config
+
+    hf = {
+        "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+        "num_hidden_layers": 4, "num_attention_heads": 4, "num_key_value_heads": 2,
+        "head_dim": 8, "query_pre_attn_scalar": 16, "sliding_window": 4,
+        "final_logit_softcapping": 30.0, "attn_logit_softcapping": 50.0,
+    }
+    cfg = gemma2_config(hf, dtype=jnp.float32, remat_policy="none")
+    assert cfg.tie_word_embeddings  # gemma default
+    assert cfg.use_post_norms and cfg.zero_centered_norm
+    assert cfg.attn_scale == pytest.approx(16 ** -0.5)
+    assert cfg.layer_types == ("sliding", "global", "sliding", "global")
+    params = decoder.init(cfg, jax.random.key(0))
+    assert "lm_head" not in params
+    assert "post_mlp_norm" in params["layers"]
+    out = decoder.forward(params, cfg, jnp.zeros((1, 8), jnp.int32))
+    assert np.isfinite(np.asarray(out)).all()
