@@ -1,0 +1,29 @@
+"""Loss composition helpers.
+
+The analog of the reference's `calculate_loss` dispatch + aux-loss scaling
+(reference: nemo_automodel/components/loss/utils.py:74 and moe/megatron/
+moe_utils.py:569 `MoEAuxLossAutoScaler`).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def combine_losses(
+    ce_sum: jnp.ndarray,
+    num_label_tokens: jnp.ndarray,
+    aux_loss: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Fold an O(1) auxiliary loss into a SUM loss that will later be divided
+    by the global label-token count.
+
+    The train step normalizes gradients by num_label_tokens (the reference's
+    dp all-reduce of n_tokens, train_ft.py:1093); multiplying the aux term by
+    the same count first keeps its effective coefficient scale-invariant —
+    exactly what MoEAuxLossAutoScaler's backward-scale does in the reference.
+    """
+    total = ce_sum
+    if aux_loss is not None:
+        total = total + aux_loss * num_label_tokens
+    return total, num_label_tokens

@@ -55,6 +55,70 @@ def scan_layers(
     return carry
 
 
+def window_plan(windows: tuple) -> "tuple[str, Any]":
+    """Plan static-window execution of a per-layer window tuple.
+
+    Per-layer sliding windows must stay STATIC Python ints so the Pallas
+    flash kernel can specialize (a traced window forces the XLA fallback and
+    its S×S logits). Returns one of:
+      ("uniform", w)                 — all layers share one window
+      ("periodic", p, pattern)       — pattern of period p repeats (gemma2)
+      ("segments", [(start, end, w)])— few contiguous runs (qwen2 SWA split)
+    """
+    L = len(windows)
+    if all(w == windows[0] for w in windows):
+        return ("uniform", windows[0])
+    for p in (2, 3, 4):
+        if L % p == 0 and windows == windows[:p] * (L // p):
+            return ("periodic", p, windows[:p])
+    segs = []
+    start = 0
+    for i in range(1, L + 1):
+        if i == L or windows[i] != windows[start]:
+            segs.append((start, i, windows[start]))
+            start = i
+    return ("segments", segs)
+
+
+def scan_layers_windowed(
+    layer_fn: Callable,  # (carry, layer_params, window) -> carry
+    carry,
+    stacked_params,
+    windows: tuple,      # per-layer static window (int | None), len == L
+    *,
+    remat_policy: str | None = "full",
+    unroll: int = 1,
+):
+    """Scan over stacked layers whose sliding windows differ per layer,
+    keeping every window a static Python value (see window_plan)."""
+    plan = window_plan(windows)
+    if plan[0] == "uniform":
+        w = plan[1]
+        fn = maybe_remat(lambda c, p: (layer_fn(c, p, w), None), remat_policy)
+        carry, _ = jax.lax.scan(fn, carry, stacked_params, unroll=unroll)
+        return carry
+    if plan[0] == "periodic":
+        p, pattern = plan[1], plan[2]
+
+        def superlayer(c, lp):
+            for j, w in enumerate(pattern):
+                c = layer_fn(c, jax.tree.map(lambda x: x[j], lp), w)
+            return c, None
+
+        grouped = jax.tree.map(
+            lambda x: x.reshape((x.shape[0] // p, p) + x.shape[1:]), stacked_params
+        )
+        fn = maybe_remat(superlayer, remat_policy)
+        carry, _ = jax.lax.scan(fn, carry, grouped, unroll=unroll)
+        return carry
+    # contiguous segments: one scan per run
+    for start, end, w in plan[1]:
+        seg = jax.tree.map(lambda x: x[start:end], stacked_params)
+        fn = maybe_remat(lambda c, p, w=w: (layer_fn(c, p, w), None), remat_policy)
+        carry, _ = jax.lax.scan(fn, carry, seg, unroll=unroll)
+    return carry
+
+
 # -- initializers ------------------------------------------------------------
 def dense_init(rng, shape, dtype=jnp.float32, scale: float | None = None):
     """Truncated-normal fan-in init (matches the reference models' defaults)."""

@@ -27,6 +27,7 @@ from automodel_tpu.models.common.layers import (
     dense_init,
     embed_init,
     scan_layers,
+    scan_layers_windowed,
 )
 from automodel_tpu.ops.attention import dot_product_attention
 from automodel_tpu.ops.norms import rms_norm
@@ -85,6 +86,19 @@ class TransformerConfig:
         return 6.0 * n_params + attn_flops
 
 
+def layer_windows(cfg: "TransformerConfig", num_layers: int | None = None) -> tuple:
+    """Per-layer static sliding windows (None = global attention)."""
+    L = num_layers if num_layers is not None else cfg.num_layers
+    if cfg.sliding_window is None:
+        return (None,) * L
+    if cfg.layer_types is None:
+        return (cfg.sliding_window,) * L
+    assert len(cfg.layer_types) == L, (len(cfg.layer_types), L)
+    return tuple(
+        cfg.sliding_window if t == "sliding" else None for t in cfg.layer_types
+    )
+
+
 ACTIVATIONS = {
     "silu": jax.nn.silu,
     "gelu": jax.nn.gelu,
@@ -96,26 +110,23 @@ ACTIVATIONS = {
 # ---------------------------------------------------------------------------
 # init / specs
 # ---------------------------------------------------------------------------
-def init(cfg: TransformerConfig, rng: jax.Array) -> dict:
-    """Build fp32 master params with per-layer weights stacked on dim 0."""
+def _stack(init_fn, key, shape, L):
+    keys = jax.random.split(key, L)
+    return jnp.stack([init_fn(k, shape) for k in keys])
+
+
+def init_attention_layers(cfg: TransformerConfig, rng: jax.Array, L: int) -> dict:
+    """Attention + norms portion of a layer stack (shared with MoE models)."""
     D = cfg.resolved_head_dim
-    H, I, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
-    ks = jax.random.split(rng, 8)
-
-    def stack(init_fn, key, shape):
-        keys = jax.random.split(key, L)
-        return jnp.stack([init_fn(k, shape) for k in keys])
-
+    H = cfg.hidden_size
+    ks = jax.random.split(rng, 4)
     layers = {
         "input_norm": {"scale": jnp.ones((L, H))},
-        "q_proj": {"kernel": stack(dense_init, ks[0], (H, cfg.num_heads * D))},
-        "k_proj": {"kernel": stack(dense_init, ks[1], (H, cfg.num_kv_heads * D))},
-        "v_proj": {"kernel": stack(dense_init, ks[2], (H, cfg.num_kv_heads * D))},
-        "o_proj": {"kernel": stack(dense_init, ks[3], (cfg.num_heads * D, H))},
+        "q_proj": {"kernel": _stack(dense_init, ks[0], (H, cfg.num_heads * D), L)},
+        "k_proj": {"kernel": _stack(dense_init, ks[1], (H, cfg.num_kv_heads * D), L)},
+        "v_proj": {"kernel": _stack(dense_init, ks[2], (H, cfg.num_kv_heads * D), L)},
+        "o_proj": {"kernel": _stack(dense_init, ks[3], (cfg.num_heads * D, H), L)},
         "post_attn_norm": {"scale": jnp.ones((L, H))},
-        "gate_proj": {"kernel": stack(dense_init, ks[4], (H, I))},
-        "up_proj": {"kernel": stack(dense_init, ks[5], (H, I))},
-        "down_proj": {"kernel": stack(dense_init, ks[6], (I, H))},
     }
     if cfg.attention_bias:
         layers["q_proj"]["bias"] = jnp.zeros((L, cfg.num_heads * D))
@@ -127,7 +138,44 @@ def init(cfg: TransformerConfig, rng: jax.Array) -> dict:
     if cfg.use_post_norms:
         layers["post_attn_out_norm"] = {"scale": jnp.ones((L, H))}
         layers["post_mlp_norm"] = {"scale": jnp.ones((L, H))}
+    return layers
 
+
+def attention_layer_specs(cfg: TransformerConfig) -> dict:
+    layers = {
+        "input_norm": {"scale": ("layers", "norm")},
+        "q_proj": {"kernel": ("layers", "embed", "heads")},
+        "k_proj": {"kernel": ("layers", "embed", "kv_heads")},
+        "v_proj": {"kernel": ("layers", "embed", "kv_heads")},
+        "o_proj": {"kernel": ("layers", "heads", "embed")},
+        "post_attn_norm": {"scale": ("layers", "norm")},
+    }
+    if cfg.attention_bias:
+        layers["q_proj"]["bias"] = ("layers", "heads")
+        layers["k_proj"]["bias"] = ("layers", "kv_heads")
+        layers["v_proj"]["bias"] = ("layers", "kv_heads")
+    if cfg.qk_norm:
+        layers["q_norm"] = {"scale": ("layers", "norm")}
+        layers["k_norm"] = {"scale": ("layers", "norm")}
+    if cfg.use_post_norms:
+        layers["post_attn_out_norm"] = {"scale": ("layers", "norm")}
+        layers["post_mlp_norm"] = {"scale": ("layers", "norm")}
+    return layers
+
+
+def init(cfg: TransformerConfig, rng: jax.Array) -> dict:
+    """Build fp32 master params with per-layer weights stacked on dim 0."""
+    H, I, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    ks = jax.random.split(rng, 8)
+
+    layers = init_attention_layers(cfg, ks[0], L)
+    layers.update(
+        {
+            "gate_proj": {"kernel": _stack(dense_init, ks[4], (H, I), L)},
+            "up_proj": {"kernel": _stack(dense_init, ks[5], (H, I), L)},
+            "down_proj": {"kernel": _stack(dense_init, ks[6], (I, H), L)},
+        }
+    )
     params = {
         "embed": {"embedding": embed_init(ks[7], (cfg.vocab_size, H))},
         "layers": layers,
@@ -140,27 +188,14 @@ def init(cfg: TransformerConfig, rng: jax.Array) -> dict:
 
 def param_specs(cfg: TransformerConfig) -> dict:
     """Logical axis names per param (consumed by parallel/sharding.py)."""
-    layers = {
-        "input_norm": {"scale": ("layers", "norm")},
-        "q_proj": {"kernel": ("layers", "embed", "heads")},
-        "k_proj": {"kernel": ("layers", "embed", "kv_heads")},
-        "v_proj": {"kernel": ("layers", "embed", "kv_heads")},
-        "o_proj": {"kernel": ("layers", "heads", "embed")},
-        "post_attn_norm": {"scale": ("layers", "norm")},
-        "gate_proj": {"kernel": ("layers", "embed", "mlp")},
-        "up_proj": {"kernel": ("layers", "embed", "mlp")},
-        "down_proj": {"kernel": ("layers", "mlp", "embed")},
-    }
-    if cfg.attention_bias:
-        layers["q_proj"]["bias"] = ("layers", "heads")
-        layers["k_proj"]["bias"] = ("layers", "kv_heads")
-        layers["v_proj"]["bias"] = ("layers", "kv_heads")
-    if cfg.qk_norm:
-        layers["q_norm"] = {"scale": ("layers", "norm")}
-        layers["k_norm"] = {"scale": ("layers", "norm")}
-    if cfg.use_post_norms:
-        layers["post_attn_out_norm"] = {"scale": ("layers", "norm")}
-        layers["post_mlp_norm"] = {"scale": ("layers", "norm")}
+    layers = attention_layer_specs(cfg)
+    layers.update(
+        {
+            "gate_proj": {"kernel": ("layers", "embed", "mlp")},
+            "up_proj": {"kernel": ("layers", "embed", "mlp")},
+            "down_proj": {"kernel": ("layers", "mlp", "embed")},
+        }
+    )
     specs = {
         "embed": {"embedding": ("vocab", "embed")},
         "layers": layers,
@@ -209,33 +244,14 @@ def forward(
 
     inv_freq = rope_frequencies(cfg.resolved_head_dim, cfg.rope_theta, cfg.rope_scaling)
 
-    # Per-layer sliding windows ride the scan as data: non-sliding layers get
-    # an effectively-infinite window (gemma2/qwen2 alternate layer types).
-    xs = params["layers"]
-    if cfg.sliding_window is not None and cfg.layer_types is not None:
-        windows = jnp.asarray(
-            [
-                cfg.sliding_window if t == "sliding" else (1 << 30)
-                for t in cfg.layer_types
-            ],
-            jnp.int32,
+    def layer(h, lp, window):
+        return _decoder_layer(
+            h, lp, cfg, positions, segment_ids, inv_freq, constrain, window, mesh_ctx
         )
-        xs = (params["layers"], windows)
 
-        def layer(h, x):
-            lp, window = x
-            return _decoder_layer(
-                h, lp, cfg, positions, segment_ids, inv_freq, constrain, window
-            )
-    else:
-
-        def layer(h, lp):
-            return _decoder_layer(
-                h, lp, cfg, positions, segment_ids, inv_freq, constrain, cfg.sliding_window
-            )
-
-    h = scan_layers(
-        layer, h, xs, remat_policy=cfg.remat_policy, unroll=cfg.scan_unroll
+    h = scan_layers_windowed(
+        layer, h, params["layers"], layer_windows(cfg),
+        remat_policy=cfg.remat_policy, unroll=cfg.scan_unroll,
     )
 
     h = rms_norm(h, params["final_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
@@ -256,7 +272,13 @@ def unembed(params: dict, cfg: TransformerConfig, h: jnp.ndarray) -> jnp.ndarray
     return logits
 
 
-def _decoder_layer(h, lp, cfg: TransformerConfig, positions, segment_ids, inv_freq, constrain, sliding_window):
+def attention_block(h, lp, cfg: TransformerConfig, positions, segment_ids, inv_freq, constrain, sliding_window, mesh_ctx=None):
+    """Pre-norm attention with residual; shared by dense and MoE decoders.
+
+    When the mesh has cp > 1 the sequence dim is sharded and attention runs
+    as ring attention over the cp axis (parallel/cp.py); otherwise the
+    backend dispatcher in ops/attention.py picks flash (TPU) or XLA.
+    """
     D = cfg.resolved_head_dim
     B, S, _ = h.shape
 
@@ -274,16 +296,27 @@ def _decoder_layer(h, lp, cfg: TransformerConfig, positions, segment_ids, inv_fr
     q = apply_rope(q, positions, inv_freq)
     k = apply_rope(k, positions, inv_freq)
 
-    attn = dot_product_attention(
-        q, k, v,
-        causal=True,
-        segment_ids=segment_ids,
-        positions=positions,
-        sliding_window=sliding_window,
-        logits_soft_cap=cfg.attn_soft_cap,
-        scale=cfg.attn_scale,
-        impl=cfg.attn_impl,
-    )
+    if mesh_ctx is not None and mesh_ctx.sizes["cp"] > 1:
+        from automodel_tpu.parallel.cp import ring_dot_product_attention
+
+        attn = ring_dot_product_attention(
+            q, k, v, positions, segment_ids, mesh_ctx,
+            causal=True,
+            sliding_window=sliding_window,
+            logits_soft_cap=cfg.attn_soft_cap,
+            scale=cfg.attn_scale,
+        )
+    else:
+        attn = dot_product_attention(
+            q, k, v,
+            causal=True,
+            segment_ids=segment_ids,
+            positions=positions,
+            sliding_window=sliding_window,
+            logits_soft_cap=cfg.attn_soft_cap,
+            scale=cfg.attn_scale,
+            impl=cfg.attn_impl,
+        )
     attn = attn.reshape(B, S, cfg.num_heads * D)
     attn_out = _dense(attn, lp["o_proj"])
     if cfg.use_post_norms:
@@ -291,9 +324,11 @@ def _decoder_layer(h, lp, cfg: TransformerConfig, positions, segment_ids, inv_fr
             attn_out, lp["post_attn_out_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm
         )
     h = h + attn_out
-    h = constrain(h, ("act_batch", "act_seq", "act_embed"))
+    return constrain(h, ("act_batch", "act_seq", "act_embed"))
 
-    # -- mlp ----------------------------------------------------------------
+
+def mlp_block(h, lp, cfg: TransformerConfig, constrain):
+    """Pre-norm gated MLP with residual."""
     x = rms_norm(h, lp["post_attn_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
     act = ACTIVATIONS[cfg.activation]
     gate = act(x @ lp["gate_proj"]["kernel"])
@@ -306,6 +341,11 @@ def _decoder_layer(h, lp, cfg: TransformerConfig, positions, segment_ids, inv_fr
         )
     h = h + mlp_out
     return constrain(h, ("act_batch", "act_seq", "act_embed"))
+
+
+def _decoder_layer(h, lp, cfg: TransformerConfig, positions, segment_ids, inv_freq, constrain, sliding_window, mesh_ctx=None):
+    h = attention_block(h, lp, cfg, positions, segment_ids, inv_freq, constrain, sliding_window, mesh_ctx)
+    return mlp_block(h, lp, cfg, constrain)
 
 
 def _make_constrain(mesh_ctx, rules):

@@ -1,0 +1,222 @@
+"""MoE decoder LM — the engine behind Qwen3-MoE / Mixtral / DeepSeek-style
+sparse models.
+
+The analog of the reference's MoE model zoo (reference: nemo_automodel/
+components/models/deepseek_v3/model.py:45-263 `DeepseekV3Model`,
+qwen3_moe, glm4_moe …). Structure: the first `first_k_dense` layers are
+dense decoder layers, the rest replace the gated MLP with the MoE block —
+two stacked-layer scans, each rematerialized. Aux (load-balance) loss rides
+the scan carry and is returned next to the logits; the recipe adds it to
+the CE loss (the `MoEAuxLossAutoScaler` role, reference: moe/megatron/
+moe_utils.py:569, without autograd-function tricks).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.layers import (
+    dense_init,
+    embed_init,
+    scan_layers_windowed,
+)
+from automodel_tpu.models.llm.decoder import (
+    TransformerConfig,
+    _stack,
+    attention_block,
+    attention_layer_specs,
+    init_attention_layers,
+    layer_windows,
+    mlp_block,
+    unembed,
+    _make_constrain,
+)
+from automodel_tpu.moe.config import MoEConfig
+from automodel_tpu.moe.layer import init_moe, moe_forward, moe_param_specs
+from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.rope import rope_frequencies
+
+
+@dataclasses.dataclass(frozen=True)
+class MoETransformerConfig(TransformerConfig):
+    moe: MoEConfig = dataclasses.field(default_factory=MoEConfig)
+    first_k_dense: int = 0  # deepseek first_k_dense_replace
+
+    @property
+    def num_moe_layers(self) -> int:
+        return self.num_layers - self.first_k_dense
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Activated-params FLOPs/token for MFU (routed experts count k/E)."""
+        D = self.resolved_head_dim
+        H = self.hidden_size
+        attn_params = H * (self.num_heads + 2 * self.num_kv_heads) * D + self.num_heads * D * H
+        dense_mlp = 3 * H * self.intermediate_size
+        moe_mlp = (
+            3 * H * self.moe.moe_intermediate_size * self.moe.experts_per_token
+            + 3 * H * self.moe.shared_intermediate * (1 if self.moe.n_shared_experts else 0)
+            + H * self.moe.n_routed_experts  # router
+        )
+        n_active = (
+            self.vocab_size * H * (1 if self.tie_word_embeddings else 2)
+            + self.num_layers * attn_params
+            + self.first_k_dense * dense_mlp
+            + self.num_moe_layers * moe_mlp
+        )
+        attn_flops = 6 * self.num_layers * self.num_heads * D * seq_len
+        return 6.0 * n_active + attn_flops
+
+
+def init(cfg: MoETransformerConfig, rng: jax.Array) -> dict:
+    H, I = cfg.hidden_size, cfg.intermediate_size
+    ks = jax.random.split(rng, 6)
+    params: dict = {
+        "embed": {"embedding": embed_init(ks[0], (cfg.vocab_size, H))},
+        "final_norm": {"scale": jnp.ones((H,))},
+    }
+    if cfg.first_k_dense > 0:
+        L = cfg.first_k_dense
+        kg, ku, kd = jax.random.split(ks[2], 3)
+        dense_layers = init_attention_layers(cfg, ks[1], L)
+        dense_layers.update(
+            {
+                "gate_proj": {"kernel": _stack(dense_init, kg, (H, I), L)},
+                "up_proj": {"kernel": _stack(dense_init, ku, (H, I), L)},
+                "down_proj": {"kernel": _stack(dense_init, kd, (I, H), L)},
+            }
+        )
+        params["dense_layers"] = dense_layers
+    Lm = cfg.num_moe_layers
+    moe_layers = init_attention_layers(cfg, ks[3], Lm)
+    moe_keys = jax.random.split(ks[4], Lm)
+    moe_stacked = jax.tree.map(
+        lambda *xs: jnp.stack(xs), *[init_moe(cfg.moe, H, k) for k in moe_keys]
+    )
+    moe_layers["moe"] = moe_stacked
+    params["moe_layers"] = moe_layers
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = {"kernel": dense_init(ks[5], (H, cfg.vocab_size))}
+    return params
+
+
+def param_specs(cfg: MoETransformerConfig) -> dict:
+    specs: dict = {
+        "embed": {"embedding": ("vocab", "embed")},
+        "final_norm": {"scale": ("norm",)},
+    }
+    mlp_specs = {
+        "gate_proj": {"kernel": ("layers", "embed", "mlp")},
+        "up_proj": {"kernel": ("layers", "embed", "mlp")},
+        "down_proj": {"kernel": ("layers", "mlp", "embed")},
+    }
+    if cfg.first_k_dense > 0:
+        d = attention_layer_specs(cfg)
+        d.update(mlp_specs)
+        specs["dense_layers"] = d
+    m = attention_layer_specs(cfg)
+    # prepend the stacked-layers axis to every moe param spec
+    m["moe"] = jax.tree.map(
+        lambda s: ("layers",) + s,
+        moe_param_specs(cfg.moe),
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+    specs["moe_layers"] = m
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = {"kernel": ("embed", "vocab")}
+    return specs
+
+
+def forward(
+    params: dict,
+    cfg: MoETransformerConfig,
+    input_ids: jnp.ndarray,
+    *,
+    positions: jnp.ndarray | None = None,
+    segment_ids: jnp.ndarray | None = None,
+    mesh_ctx=None,
+    rules=None,
+    return_hidden: bool = False,
+    token_mask: jnp.ndarray | None = None,  # (B,S) bool; False = pad tokens
+    return_stats: bool = False,
+) -> tuple:
+    """Returns (logits-or-hidden, aux_loss[, stats]).
+
+    stats["tokens_per_expert"] is (num_moe_layers, E) — feed it to
+    `apply_gate_bias_update` after the optimizer step for DeepSeek aux-free
+    balancing (reference: train_ft.py:1164 `update_moe_gate_bias`) and to
+    moe load-balance metrics.
+    """
+    B, S = input_ids.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+    constrain = _make_constrain(mesh_ctx, rules)
+
+    h = jnp.take(params["embed"]["embedding"], input_ids, axis=0).astype(cfg.dtype)
+    if cfg.embed_scale != 1.0:
+        h = h * jnp.asarray(cfg.embed_scale, cfg.dtype)
+    h = constrain(h, ("act_batch", "act_seq", "act_embed"))
+
+    inv_freq = rope_frequencies(cfg.resolved_head_dim, cfg.rope_theta, cfg.rope_scaling)
+    windows = layer_windows(cfg)
+    Lm, E = cfg.num_moe_layers, cfg.moe.n_routed_experts
+
+    def dense_layer(carry, lp, window):
+        h, aux, stats = carry
+        h = attention_block(h, lp, cfg, positions, segment_ids, inv_freq, constrain, window, mesh_ctx)
+        h = mlp_block(h, lp, cfg, constrain)
+        return (h, aux, stats)
+
+    def moe_layer(carry, xs, window):
+        h, aux, stats = carry
+        lp, idx = xs
+        h = attention_block(h, lp, cfg, positions, segment_ids, inv_freq, constrain, window, mesh_ctx)
+        x = rms_norm(h, lp["post_attn_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
+        moe_out, layer_aux, layer_stats = moe_forward(
+            lp["moe"], cfg.moe, x, constrain, token_mask=token_mask
+        )
+        h = constrain(h + moe_out, ("act_batch", "act_seq", "act_embed"))
+        stats = jax.lax.dynamic_update_index_in_dim(
+            stats, layer_stats["tokens_per_expert"], idx, 0
+        )
+        return (h, aux + layer_aux, stats)
+
+    stats0 = jnp.zeros((Lm, E), jnp.float32)
+    carry = (h, jnp.float32(0.0), stats0)
+    if cfg.first_k_dense > 0:
+        carry = scan_layers_windowed(
+            dense_layer, carry, params["dense_layers"], windows[: cfg.first_k_dense],
+            remat_policy=cfg.remat_policy, unroll=cfg.scan_unroll,
+        )
+    carry = scan_layers_windowed(
+        moe_layer, carry,
+        (params["moe_layers"], jnp.arange(Lm)),
+        windows[cfg.first_k_dense :],
+        remat_policy=cfg.remat_policy, unroll=cfg.scan_unroll,
+    )
+    h, aux_loss, tokens_per_expert = carry
+
+    h = rms_norm(h, params["final_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
+    out = h if return_hidden else unembed(params, cfg, h)
+    if return_stats:
+        return out, aux_loss, {"tokens_per_expert": tokens_per_expert}
+    return out, aux_loss
+
+
+def apply_gate_bias_update(params: dict, cfg: MoETransformerConfig, tokens_per_expert) -> dict:
+    """DeepSeek aux-free balancing across all MoE layers at once
+    (reference: layers.py:463 update_bias + train_ft.py:1164).
+    tokens_per_expert: (num_moe_layers, E) from forward(..., return_stats=True).
+    """
+    gate = params["moe_layers"]["moe"]["gate"]
+    if "e_score_bias" not in gate:
+        return params
+    err = tokens_per_expert.mean(-1, keepdims=True) - tokens_per_expert
+    new_bias = gate["e_score_bias"] + cfg.moe.gate_bias_update_speed * jnp.sign(err)
+    new_gate = {**gate, "e_score_bias": new_bias}
+    new_moe = {**params["moe_layers"]["moe"], "gate": new_gate}
+    new_layers = {**params["moe_layers"], "moe": new_moe}
+    return {**params, "moe_layers": new_layers}

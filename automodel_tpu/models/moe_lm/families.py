@@ -1,0 +1,73 @@
+"""MoE model-family adapters: HF config dict → MoETransformerConfig.
+
+The analog of the reference's MoE families (reference: nemo_automodel/
+components/models/{qwen3_moe,deepseek_v3,glm4_moe}/model.py + registry).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from automodel_tpu.models.llm.families import _base_kwargs
+from automodel_tpu.models.moe_lm.decoder import MoETransformerConfig
+from automodel_tpu.moe.config import MoEConfig
+
+
+def qwen3_moe_config(hf: Mapping[str, Any], **overrides) -> MoETransformerConfig:
+    """Qwen3MoeForCausalLM (reference: models/qwen3_moe, 838 LoC)."""
+    kw = _base_kwargs(hf)
+    kw["qk_norm"] = True
+    moe = MoEConfig(
+        n_routed_experts=int(hf["num_experts"]),
+        experts_per_token=int(hf["num_experts_per_tok"]),
+        moe_intermediate_size=int(hf["moe_intermediate_size"]),
+        norm_topk_prob=bool(hf.get("norm_topk_prob", True)),
+        score_func="softmax",
+        aux_loss_coeff=float(hf.get("router_aux_loss_coef", 0.0)),
+    )
+    moe_overrides = overrides.pop("moe", None)
+    kw.update(overrides)
+    return MoETransformerConfig(moe=moe_overrides or moe, first_k_dense=0, **kw)
+
+
+def mixtral_config(hf: Mapping[str, Any], **overrides) -> MoETransformerConfig:
+    """MixtralForCausalLM — softmax top-k with renormalization (equivalent to
+    softmax over the selected logits)."""
+    kw = _base_kwargs(hf)
+    moe = MoEConfig(
+        n_routed_experts=int(hf["num_local_experts"]),
+        experts_per_token=int(hf["num_experts_per_tok"]),
+        moe_intermediate_size=int(hf["intermediate_size"]),
+        norm_topk_prob=True,
+        score_func="softmax",
+        aux_loss_coeff=float(hf.get("router_aux_loss_coef", 0.02)),
+    )
+    moe_overrides = overrides.pop("moe", None)
+    kw.update(overrides)
+    return MoETransformerConfig(moe=moe_overrides or moe, first_k_dense=0, **kw)
+
+
+def deepseek_v3_moe_config(hf: Mapping[str, Any], **overrides) -> MoETransformerConfig:
+    """DeepSeek-V3-style MoE body: sigmoid scores, group-limited routing,
+    shared experts, aux-free gate-bias balancing, first-k-dense layers.
+    NOTE: uses GQA attention until the MLA attention module lands; register
+    under DeepseekV3ForCausalLM only once MLA is in (checkpoint shapes differ).
+    """
+    kw = _base_kwargs(hf)
+    moe = MoEConfig(
+        n_routed_experts=int(hf["n_routed_experts"]),
+        n_shared_experts=int(hf.get("n_shared_experts", 0)),
+        experts_per_token=int(hf["num_experts_per_tok"]),
+        n_groups=int(hf.get("n_group", 1)),
+        topk_groups=int(hf.get("topk_group", 1)),
+        moe_intermediate_size=int(hf["moe_intermediate_size"]),
+        score_func="sigmoid" if hf.get("scoring_func", "sigmoid") == "sigmoid" else "softmax",
+        norm_topk_prob=bool(hf.get("norm_topk_prob", True)),
+        route_scale=float(hf.get("routed_scaling_factor", 1.0)),
+        aux_loss_coeff=float(hf.get("aux_loss_alpha", 0.0)),
+        gate_bias_update_speed=float(hf.get("bias_update_speed", 0.001)),
+    )
+    first_k = int(hf.get("first_k_dense_replace", 0))
+    moe_overrides = overrides.pop("moe", None)
+    kw.update(overrides)
+    return MoETransformerConfig(moe=moe_overrides or moe, first_k_dense=first_k, **kw)

@@ -12,6 +12,8 @@ import dataclasses
 from typing import Any, Callable, Mapping
 
 from automodel_tpu.models.llm import decoder, families
+from automodel_tpu.models.moe_lm import decoder as moe_decoder
+from automodel_tpu.models.moe_lm import families as moe_families
 
 
 @dataclasses.dataclass(frozen=True)
@@ -30,6 +32,12 @@ MODEL_ARCH_MAPPING: dict[str, ModelSpec] = {
     "Qwen2ForCausalLM": ModelSpec("qwen2", families.qwen2_config, decoder),
     "Qwen3ForCausalLM": ModelSpec("qwen3", families.qwen3_config, decoder),
     "Gemma2ForCausalLM": ModelSpec("gemma2", families.gemma2_config, decoder),
+    "Qwen3MoeForCausalLM": ModelSpec(
+        "qwen3_moe", moe_families.qwen3_moe_config, moe_decoder, adapter_name="moe_decoder"
+    ),
+    "MixtralForCausalLM": ModelSpec(
+        "mixtral", moe_families.mixtral_config, moe_decoder, adapter_name="moe_decoder"
+    ),
 }
 
 

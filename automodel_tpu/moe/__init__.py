@@ -1,0 +1,13 @@
+from automodel_tpu.moe.config import MoEConfig
+from automodel_tpu.moe.gate import gate_forward, init_gate, update_gate_bias
+from automodel_tpu.moe.layer import init_moe, moe_forward, moe_param_specs
+
+__all__ = [
+    "MoEConfig",
+    "gate_forward",
+    "init_gate",
+    "update_gate_bias",
+    "init_moe",
+    "moe_forward",
+    "moe_param_specs",
+]

@@ -1,0 +1,41 @@
+"""MoE configuration.
+
+The analog of the reference `MoEConfig`
+(reference: nemo_automodel/components/moe/config.py:26-93): routed/shared
+expert counts, top-k, grouped routing, score function, aux-loss coeff,
+DeepSeek-style gate-bias update, expert activation. TPU-specific addition:
+`capacity_factor` — the einsum-dispatch path pads each expert to a fixed
+capacity so shapes stay static under jit (the XLA-native replacement for
+DeepEP's dynamic all-to-all; dropped tokens ≙ capacity overflow).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    n_routed_experts: int = 8
+    n_shared_experts: int = 0
+    experts_per_token: int = 2  # top-k
+    n_groups: int = 1           # deepseek group-limited routing
+    topk_groups: int = 1
+    score_func: str = "softmax"  # "softmax" | "sigmoid"
+    norm_topk_prob: bool = True
+    route_scale: float = 1.0
+    aux_loss_coeff: float = 0.0
+    gate_bias_update_speed: float = 0.0  # deepseek aux-free balancing
+    expert_activation: str = "silu"   # silu | geglu | quick_geglu | relu2
+    moe_intermediate_size: int = 512
+    shared_expert_intermediate_size: Optional[int] = None
+    capacity_factor: float = 1.25    # static-shape dispatch headroom
+    router_dtype: str = "float32"
+    fake_balanced_gate: bool = False  # perf benchmarking (reference layers.py:126)
+
+    @property
+    def shared_intermediate(self) -> int:
+        if self.shared_expert_intermediate_size is not None:
+            return self.shared_expert_intermediate_size
+        return self.moe_intermediate_size * self.n_shared_experts

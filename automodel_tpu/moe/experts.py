@@ -1,0 +1,110 @@
+"""Expert compute + token dispatch.
+
+The TPU-native replacement for the reference's experts/dispatcher stack
+(reference: nemo_automodel/components/moe/experts.py:202 `GroupedExperts`,
+:651 `GroupedExpertsDeepEP`; megatron/token_dispatcher.py:504
+`MoEFlexTokenDispatcher`; megatron/fused_a2a.py DeepEP NVSHMEM all-to-all).
+
+Design: capacity-based einsum dispatch — the GSPMD-native MoE pattern.
+Routing produces a (tokens, experts, capacity) dispatch tensor; two einsums
+move tokens to expert-major layout and back. When the expert dim is sharded
+on the `ep` mesh axis and tokens on `batch`, XLA lowers the einsums to
+exactly the all-to-all pair DeepEP implements by hand, riding ICI. Static
+shapes (capacity padding) keep everything jit-compatible; overflow tokens
+are dropped (capacity_factor controls headroom), matching Megatron-style
+capacity dispatch semantics.
+
+A sort-based dropless path (ragged grouped GEMM ≙ megablox gmm) is the
+planned second dispatcher; this module keeps the dispatcher abstraction so
+both share the gate and expert weights.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.moe.config import MoEConfig
+
+_EXPERT_ACT = {
+    "silu": jax.nn.silu,
+    "geglu": jax.nn.gelu,
+    "quick_geglu": lambda x: x * jax.nn.sigmoid(1.702 * x),
+    "relu2": lambda x: jnp.square(jax.nn.relu(x)),
+}
+
+
+def init_experts(cfg: MoEConfig, hidden_size: int, rng: jax.Array) -> dict:
+    E, H, I = cfg.n_routed_experts, hidden_size, cfg.moe_intermediate_size
+    k1, k2, k3 = jax.random.split(rng, 3)
+    std_in, std_out = H ** -0.5, I ** -0.5
+    return {
+        "gate_proj": {"kernel": std_in * jax.random.truncated_normal(k1, -3, 3, (E, H, I))},
+        "up_proj": {"kernel": std_in * jax.random.truncated_normal(k2, -3, 3, (E, H, I))},
+        "down_proj": {"kernel": std_out * jax.random.truncated_normal(k3, -3, 3, (E, I, H))},
+    }
+
+
+def expert_param_specs(cfg: MoEConfig) -> dict:
+    return {
+        "gate_proj": {"kernel": ("expert", "expert_embed", "expert_mlp")},
+        "up_proj": {"kernel": ("expert", "expert_embed", "expert_mlp")},
+        "down_proj": {"kernel": ("expert", "expert_mlp", "expert_embed")},
+    }
+
+
+def compute_capacity(cfg: MoEConfig, num_tokens: int) -> int:
+    per_expert = num_tokens * cfg.experts_per_token / cfg.n_routed_experts
+    cap = int(per_expert * cfg.capacity_factor)
+    return max(8, ((cap + 7) // 8) * 8)  # sublane-align
+
+
+def dispatch_tensors(
+    cfg: MoEConfig,
+    indices: jnp.ndarray,  # (T, K) int32
+    weights: jnp.ndarray,  # (T, K) f32
+    capacity: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Build dispatch (T,E,C) bool-ish and combine (T,E,C) f32 tensors.
+
+    Position of token t within expert e's buffer = number of earlier
+    (token, slot) pairs routed to e — a cumsum over the flattened (T*K)
+    routing order, matching Megatron's capacity dispatcher semantics.
+    """
+    T, K = indices.shape
+    E = cfg.n_routed_experts
+    flat = indices.reshape(T * K)
+    onehot = jax.nn.one_hot(flat, E, dtype=jnp.int32)          # (T*K, E)
+    pos = jnp.cumsum(onehot, axis=0) - onehot                   # (T*K, E)
+    pos_in_expert = jnp.sum(pos * onehot, axis=-1)              # (T*K,)
+    keep = pos_in_expert < capacity
+    cap_onehot = jax.nn.one_hot(pos_in_expert, capacity, dtype=jnp.float32)
+    disp = (onehot.astype(jnp.float32)[:, :, None] * cap_onehot[:, None, :])
+    disp = disp * keep[:, None, None]
+    disp = disp.reshape(T, K, E, capacity)
+    dispatch = disp.sum(1)                                      # (T, E, C)
+    combine = (disp * weights.reshape(T, K, 1, 1)).sum(1)       # (T, E, C)
+    return dispatch, combine
+
+
+def experts_forward(
+    params: dict,
+    cfg: MoEConfig,
+    x: jnp.ndarray,        # (T, H)
+    dispatch: jnp.ndarray, # (T, E, C)
+    combine: jnp.ndarray,  # (T, E, C)
+    constrain=None,
+) -> jnp.ndarray:
+    """Dispatch → batched expert MLP → weighted combine. Returns (T, H)."""
+    act = _EXPERT_ACT[cfg.expert_activation]
+    c = constrain or (lambda a, axes: a)
+    dtype = x.dtype
+    # tokens → expert-major: XLA inserts the A2A here when ep-sharded
+    xe = jnp.einsum("tec,th->ech", dispatch.astype(dtype), x)
+    xe = c(xe, ("act_expert", None, "act_embed"))
+    g = act(jnp.einsum("ech,ehi->eci", xe, params["gate_proj"]["kernel"].astype(dtype)))
+    u = jnp.einsum("ech,ehi->eci", xe, params["up_proj"]["kernel"].astype(dtype))
+    y = jnp.einsum("eci,eih->ech", g * u, params["down_proj"]["kernel"].astype(dtype))
+    y = c(y, ("act_expert", None, "act_embed"))
+    # expert-major → tokens (the A2A back), weighted by routing probs
+    return jnp.einsum("tec,ech->th", combine.astype(dtype), y)

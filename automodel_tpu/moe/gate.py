@@ -1,0 +1,134 @@
+"""MoE router / gate.
+
+The analog of the reference `Gate`
+(reference: nemo_automodel/components/moe/layers.py:212-610): softmax or
+sigmoid scoring, DeepSeek group-limited top-k, aux loss (`_compute_aux_loss`
+layers.py:548), aux-free bias balancing (`update_bias` layers.py:463), and
+the deterministic `FakeBalancedGate` (layers.py:126) used by the benchmark
+recipes so routing cost is measured without load-imbalance noise.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.moe.config import MoEConfig
+
+
+def init_gate(cfg: MoEConfig, hidden_size: int, rng: jax.Array) -> dict:
+    std = hidden_size ** -0.5
+    params = {
+        "weight": std * jax.random.truncated_normal(
+            rng, -3.0, 3.0, (hidden_size, cfg.n_routed_experts)
+        )
+    }
+    if cfg.gate_bias_update_speed > 0:
+        # selection-only bias (not part of the autodiff graph semantics)
+        params["e_score_bias"] = jnp.zeros((cfg.n_routed_experts,))
+    return params
+
+
+def gate_param_specs(cfg: MoEConfig) -> dict:
+    specs = {"weight": ("embed", None)}
+    if cfg.gate_bias_update_speed > 0:
+        specs["e_score_bias"] = (None,)
+    return specs
+
+
+def _group_limited_mask(scores: jnp.ndarray, cfg: MoEConfig) -> jnp.ndarray:
+    """DeepSeek group-limited routing: keep only experts inside the
+    topk_groups best groups (group score = sum of its top-2 experts)."""
+    T = scores.shape[0]
+    E, G = cfg.n_routed_experts, cfg.n_groups
+    grouped = scores.reshape(T, G, E // G)
+    top2 = jax.lax.top_k(grouped, min(2, E // G))[0].sum(-1)  # (T, G)
+    _, top_groups = jax.lax.top_k(top2, cfg.topk_groups)       # (T, topk_groups)
+    group_mask = jnp.zeros((T, G), scores.dtype).at[
+        jnp.arange(T)[:, None], top_groups
+    ].set(1.0)
+    return jnp.repeat(group_mask, E // G, axis=-1)  # (T, E)
+
+
+def gate_forward(
+    params: dict,
+    cfg: MoEConfig,
+    x: jnp.ndarray,  # (T, H)
+    token_mask: jnp.ndarray | None = None,  # (T,) bool; False = pad/ignored
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, dict]:
+    """Route tokens. Returns (weights (T,K), indices (T,K), aux_loss, stats).
+
+    aux_loss is the switch-style load-balancing loss
+    E * sum_e(fraction_tokens_e * mean_prob_e), matching the reference's
+    `_compute_aux_loss` (layers.py:548); it is 0 when aux_loss_coeff == 0.
+    NOTE: aux_loss is O(1) per layer — when combining with a sum-CE loss that
+    is later divided by the global token count, multiply by that count first
+    (see loss/utils.py `combine_losses`, the MoEAuxLossAutoScaler analog).
+
+    Masked tokens (padding / ignored labels) are routed to the invalid
+    expert index E, so they consume no capacity and are excluded from the
+    aux-loss statistics (the reference threads token_mask the same way).
+    """
+    T, H = x.shape
+    E, K = cfg.n_routed_experts, cfg.experts_per_token
+
+    if cfg.fake_balanced_gate:
+        # deterministic round-robin: token t → experts (tK, tK+1, …) mod E.
+        # Same input ⇒ same routing, so remat recompute is consistent
+        # (reference: models/common/utils.py:185-191).
+        base = (jnp.arange(T)[:, None] * K + jnp.arange(K)[None, :]) % E
+        weights = jnp.full((T, K), 1.0 / K, jnp.float32)
+        return weights, base.astype(jnp.int32), jnp.float32(0.0), {}
+
+    logits = x.astype(jnp.float32) @ params["weight"].astype(jnp.float32)  # (T, E)
+    if cfg.score_func == "softmax":
+        scores = jax.nn.softmax(logits, axis=-1)
+    elif cfg.score_func == "sigmoid":
+        scores = jax.nn.sigmoid(logits)
+    else:
+        raise ValueError(f"Unknown score_func '{cfg.score_func}'")
+
+    select_scores = scores
+    if "e_score_bias" in params:
+        select_scores = scores + jax.lax.stop_gradient(params["e_score_bias"])
+    if cfg.n_groups > 1:
+        gmask = _group_limited_mask(select_scores, cfg)
+        select_scores = jnp.where(gmask > 0, select_scores, -jnp.inf)
+
+    _, indices = jax.lax.top_k(select_scores, K)          # (T, K)
+    weights = jnp.take_along_axis(scores, indices, axis=-1)  # weight by raw score
+    if cfg.norm_topk_prob:
+        weights = weights / jnp.maximum(weights.sum(-1, keepdims=True), 1e-20)
+    weights = weights * cfg.route_scale
+
+    if token_mask is not None:
+        tm = token_mask.astype(jnp.float32)
+        indices = jnp.where(token_mask[:, None], indices, E)  # E = invalid slot
+        weights = weights * tm[:, None]
+        n_valid = jnp.maximum(tm.sum(), 1.0)
+    else:
+        tm = None
+        n_valid = jnp.float32(T)
+
+    # load-balance statistics (also feeds moe/metrics.py); one_hot of the
+    # invalid index E is all-zero, so masked tokens drop out everywhere.
+    one_hot = jax.nn.one_hot(indices, E, dtype=jnp.float32)  # (T, K, E)
+    tokens_per_expert = one_hot.sum((0, 1))                  # (E,)
+    fraction = tokens_per_expert / (n_valid * K)
+    if tm is None:
+        mean_prob = scores.mean(0)
+    else:
+        mean_prob = (scores * tm[:, None]).sum(0) / n_valid
+    aux_loss = jnp.float32(cfg.aux_loss_coeff) * E * jnp.sum(fraction * mean_prob)
+    stats = {"tokens_per_expert": tokens_per_expert, "mean_prob": mean_prob}
+    return weights.astype(jnp.float32), indices.astype(jnp.int32), aux_loss, stats
+
+
+def update_gate_bias(params: dict, cfg: MoEConfig, tokens_per_expert: jnp.ndarray) -> dict:
+    """DeepSeek aux-free balancing (reference: layers.py:463 `update_bias`):
+    raise the selection bias of under-loaded experts, lower over-loaded."""
+    if "e_score_bias" not in params:
+        return params
+    err = tokens_per_expert.mean() - tokens_per_expert  # >0 → under-loaded
+    new_bias = params["e_score_bias"] + cfg.gate_bias_update_speed * jnp.sign(err)
+    return {**params, "e_score_bias": new_bias}

@@ -1,15 +1,496 @@
-"""Pallas flash-attention kernel (TPU).
+"""Pallas TPU flash attention — forward + custom-VJP backward.
 
-The analog of the reference's TE `DotProductAttention`/FlexAttention paths
-(reference: nemo_automodel/_transformers/te_attention.py,
-components/attention/flex_attention.py:32). Implemented in the kernels
-milestone; until then the dispatcher in ops/attention.py falls back to the
-XLA reference path.
+The TPU-native replacement for the reference's attention kernel stack
+(reference: TE `DotProductAttention` injection, nemo_automodel/_transformers/
+te_attention.py; FlexAttention block-mask wrapper, components/attention/
+flex_attention.py:32). One kernel family covers the mask zoo the reference
+spreads across TE/flex/FFPA backends:
+
+- causal (by global token index — valid for packed per-document positions,
+  since within a segment document order == global order and cross-segment
+  pairs are killed by the segment mask),
+- packed-sequence segment ids (the THD/cu_seqlens analog),
+- sliding windows (by position, gemma/qwen style),
+- attention logit soft-capping (gemma style),
+- GQA (kv-head sharing via block index maps, no KV repeat materialized).
+
+Implementation notes:
+- Internally (B, H, S, D) layout so blocks satisfy the TPU (8,128) tiling
+  rule; per-token int arrays carry an 8-wide trailing/leading broadcast dim
+  (compact in HBM, padded only in VMEM).
+- Online-softmax forward on a (batch, q_head, q_block, kv_block) grid; the
+  kv dimension is innermost so VMEM scratch carries (m, l, acc) across kv
+  steps; blocks above the causal diagonal are predicated off with pl.when.
+- Backward splits dq (grid over q blocks, scan kv) and dk/dv (grid over kv
+  blocks, scan q-heads-in-group × q blocks) — each output is written by
+  exactly one grid cell, the standard TPU flash backward decomposition.
+- Saves (out, logsumexp) from forward; backward recomputes p block-wise
+  (flops-for-memory, same trade as the reference's Triton kernels).
+- Runs on CPU via interpret mode for unit-test parity against the XLA
+  oracle in ops/attention.py.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import functools
 
-def flash_attention(q, k, v, *, causal=True, segment_ids=None, positions=None,
-                    sliding_window=None, logits_soft_cap=None, scale=None):
-    raise NotImplementedError("pallas flash attention lands with the kernels milestone")
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+LANE = 128
+SUBLANE = 8
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockSizes:
+    block_q: int = 512
+    block_kv: int = 512
+    block_q_dq: int = 512
+    block_kv_dkv: int = 512
+
+
+def _pick_block(seq: int, want: int) -> int:
+    """Largest multiple of LANE that divides seq, capped at `want`."""
+    best = 0
+    b = LANE
+    while b <= min(seq, want):
+        if seq % b == 0:
+            best = b
+        b += LANE
+    return best
+
+
+def _supported(q, k) -> bool:
+    B, S, Hq, D = q.shape
+    _, T, Hkv, _ = k.shape
+    if D % LANE != 0:
+        return False
+    if _pick_block(S, 512) == 0 or _pick_block(T, 512) == 0:
+        return False
+    if Hq % Hkv != 0:
+        return False
+    return True
+
+
+def _block_mask(iq, ik, qpos_col, kpos_row, qseg_col, kseg_row,
+                *, causal, window, block_q, block_kv):
+    """(BQ, BK) boolean mask from column/row-shaped aux vectors."""
+    mask = jnp.full((block_q, block_kv), True)
+    if causal:
+        qi = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
+        ki = ik * block_kv + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
+        mask = jnp.logical_and(mask, qi >= ki)
+    if window is not None:
+        mask = jnp.logical_and(mask, qpos_col - kpos_row < window)
+    return jnp.logical_and(mask, qseg_col == kseg_row)
+
+
+def _run_predicate(iq, ik, *, causal, window, monotonic, block_q, block_kv):
+    """Whether this (q_block, kv_block) cell can contain any unmasked pair."""
+    run = jnp.bool_(True)
+    if causal:
+        run = jnp.logical_and(run, (iq + 1) * block_q - 1 >= ik * block_kv)
+    if window is not None and monotonic:
+        run = jnp.logical_and(run, (ik + 1) * block_kv - 1 >= iq * block_q - window)
+    return run
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+def _fwd_kernel(
+    qpos_ref,  # (1, BQ, 8)
+    kpos_ref,  # (1, 8, BK)
+    qseg_ref,  # (1, BQ, 8)
+    kseg_ref,  # (1, 8, BK)
+    q_ref,     # (1, 1, BQ, D)
+    k_ref,     # (1, 1, BK, D)
+    v_ref,
+    out_ref,   # (1, 1, BQ, D)
+    lse_ref,   # (1, 1, BQ, 8)
+    m_scr, l_scr, acc_scr,
+    *,
+    scale, causal, window, soft_cap, block_q, block_kv, monotonic,
+):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    run = _run_predicate(iq, ik, causal=causal, window=window,
+                         monotonic=monotonic, block_q=block_q, block_kv=block_kv)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if soft_cap is not None:
+            s = soft_cap * jnp.tanh(s / soft_cap)
+        mask = _block_mask(
+            iq, ik,
+            qpos_ref[0, :, :1], kpos_ref[0, :1, :],
+            qseg_ref[0, :, :1], kseg_ref[0, :1, :],
+            causal=causal, window=window, block_q=block_q, block_kv=block_kv,
+        )
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]  # (BQ, 1)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_scr[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        m = m_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        out = acc_scr[:] / l_safe
+        out = jnp.where(l == 0.0, 0.0, out)
+        out_ref[0, 0, :, :] = out.astype(out_ref.dtype)
+        lse = jnp.where(l == 0.0, -NEG_INF, m + jnp.log(l_safe))
+        lse_ref[0, 0, :, :] = jnp.broadcast_to(lse, lse_ref.shape[2:])
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+def _recompute_p_ds(q, k, v, do, lse_col, delta_col, mask, *, scale, soft_cap):
+    """Shared bwd math: p (softmax probs) and grad wrt the pre-scale scores."""
+    s_raw = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if soft_cap is not None:
+        t = jnp.tanh(s_raw / soft_cap)
+        s = soft_cap * t
+    else:
+        s = s_raw
+    s = jnp.where(mask, s, NEG_INF)
+    p = jnp.exp(s - lse_col)  # (BQ, BK); masked → 0
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta_col)
+    if soft_cap is not None:
+        ds = ds * (1.0 - t * t)
+    ds = jnp.where(mask, ds, 0.0)
+    return p, ds * scale
+
+
+def _dq_kernel(
+    qpos_ref, kpos_ref, qseg_ref, kseg_ref,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dq_ref,
+    dq_scr,
+    *,
+    scale, causal, window, soft_cap, block_q, block_kv, monotonic,
+):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    run = _run_predicate(iq, ik, causal=causal, window=window,
+                         monotonic=monotonic, block_q=block_q, block_kv=block_kv)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        mask = _block_mask(
+            iq, ik,
+            qpos_ref[0, :, :1], kpos_ref[0, :1, :],
+            qseg_ref[0, :, :1], kseg_ref[0, :1, :],
+            causal=causal, window=window, block_q=block_q, block_kv=block_kv,
+        )
+        _, ds = _recompute_p_ds(
+            q, k, v, do, lse_ref[0, 0, :, :1], delta_ref[0, 0, :, :1], mask,
+            scale=scale, soft_cap=soft_cap,
+        )
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0, 0, :, :] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    qpos_ref, kpos_ref, qseg_ref, kseg_ref,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref,
+    dk_scr, dv_scr,
+    *,
+    scale, causal, window, soft_cap, block_q, block_kv, monotonic,
+):
+    # grid: (B, Hkv, nk, G, nq) — accumulate over group members and q blocks
+    ik, g, iq = pl.program_id(2), pl.program_id(3), pl.program_id(4)
+    ng, nq = pl.num_programs(3), pl.num_programs(4)
+
+    @pl.when(jnp.logical_and(g == 0, iq == 0))
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run = _run_predicate(iq, ik, causal=causal, window=window,
+                         monotonic=monotonic, block_q=block_q, block_kv=block_kv)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        mask = _block_mask(
+            iq, ik,
+            qpos_ref[0, :, :1], kpos_ref[0, :1, :],
+            qseg_ref[0, :, :1], kseg_ref[0, :1, :],
+            causal=causal, window=window, block_q=block_q, block_kv=block_kv,
+        )
+        p, ds = _recompute_p_ds(
+            q, k, v, do, lse_ref[0, 0, :, :1], delta_ref[0, 0, :, :1], mask,
+            scale=scale, soft_cap=soft_cap,
+        )
+        # dv += p^T @ do ; dk += ds^T @ q
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(jnp.logical_and(g == ng - 1, iq == nq - 1))
+    def _finalize():
+        dk_ref[0, 0, :, :] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_scr[:].astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# host-side wrappers (public layout: B, S, H, D)
+# ---------------------------------------------------------------------------
+def _prep_aux(B, S, positions, segment_ids):
+    """Build q-side (B,S,8) and kv-side (B,8,S) broadcast aux arrays."""
+    monotonic = positions is None
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+    else:
+        positions = jnp.broadcast_to(positions.astype(jnp.int32), (B, S))
+    if segment_ids is None:
+        segment_ids = jnp.zeros((B, S), jnp.int32)
+    else:
+        segment_ids = jnp.broadcast_to(segment_ids.astype(jnp.int32), (B, S))
+    q_side = lambda a: jnp.broadcast_to(a[:, :, None], (B, S, SUBLANE))
+    kv_side = lambda a: jnp.broadcast_to(a[:, None, :], (B, SUBLANE, S))
+    return (q_side(positions), kv_side(positions),
+            q_side(segment_ids), kv_side(segment_ids), monotonic)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11, 12))
+def _flash(q, k, v, qpos, kpos, qseg, kseg,
+           causal, window, soft_cap, scale, block_sizes, monotonic):
+    out, _ = _flash_fwd_impl(
+        q, k, v, qpos, kpos, qseg, kseg,
+        causal, window, soft_cap, scale, block_sizes, monotonic,
+    )
+    return out
+
+
+def _flash_fwd_impl(q, k, v, qpos, kpos, qseg, kseg,
+                    causal, window, soft_cap, scale, block_sizes, monotonic):
+    B, Hq, S, D = q.shape
+    _, Hkv, T, _ = k.shape
+    G = Hq // Hkv
+    BQ = _pick_block(S, block_sizes.block_q)
+    BK = _pick_block(T, block_sizes.block_kv)
+    nq, nk = S // BQ, T // BK
+
+    kernel = functools.partial(
+        _fwd_kernel,
+        scale=scale, causal=causal, window=window, soft_cap=soft_cap,
+        block_q=BQ, block_kv=BK, monotonic=monotonic,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(B, Hq, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, BQ, SUBLANE), lambda b, h, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, SUBLANE, BK), lambda b, h, iq, ik: (b, 0, ik)),
+            pl.BlockSpec((1, BQ, SUBLANE), lambda b, h, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, SUBLANE, BK), lambda b, h, iq, ik: (b, 0, ik)),
+            pl.BlockSpec((1, 1, BQ, D), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, BK, D), lambda b, h, iq, ik: (b, h // G, ik, 0)),
+            pl.BlockSpec((1, 1, BK, D), lambda b, h, iq, ik: (b, h // G, ik, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, BQ, D), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, BQ, SUBLANE), lambda b, h, iq, ik: (b, h, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((B, Hq, S, SUBLANE), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((BQ, LANE), jnp.float32),
+            pltpu.VMEM((BQ, LANE), jnp.float32),
+            pltpu.VMEM((BQ, D), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qpos, kpos, qseg, kseg, q, k, v)
+    return out, lse
+
+
+def _flash_fwd(q, k, v, qpos, kpos, qseg, kseg,
+               causal, window, soft_cap, scale, block_sizes, monotonic):
+    out, lse = _flash_fwd_impl(
+        q, k, v, qpos, kpos, qseg, kseg,
+        causal, window, soft_cap, scale, block_sizes, monotonic,
+    )
+    return out, (q, k, v, qpos, kpos, qseg, kseg, out, lse)
+
+
+def _flash_bwd(causal, window, soft_cap, scale, block_sizes, monotonic, res, dout):
+    q, k, v, qpos, kpos, qseg, kseg, out, lse = res
+    B, Hq, S, D = q.shape
+    _, Hkv, T, _ = k.shape
+    G = Hq // Hkv
+    BQ = _pick_block(S, block_sizes.block_q_dq)
+    BK = _pick_block(T, block_sizes.block_kv_dkv)
+    nq, nk = S // BQ, T // BK
+
+    # delta = rowsum(dout * out) replicated into the 8-wide aux dim
+    delta = jnp.einsum(
+        "bhsd,bhsd->bhs", dout.astype(jnp.float32), out.astype(jnp.float32)
+    )
+    delta = jnp.broadcast_to(delta[..., None], (B, Hq, S, SUBLANE))
+
+    common = dict(
+        scale=scale, causal=causal, window=window, soft_cap=soft_cap,
+        block_q=BQ, block_kv=BK, monotonic=monotonic,
+    )
+    aux_specs_q = [
+        pl.BlockSpec((1, BQ, SUBLANE), lambda b, h, iq, ik: (b, iq, 0)),
+        pl.BlockSpec((1, SUBLANE, BK), lambda b, h, iq, ik: (b, 0, ik)),
+        pl.BlockSpec((1, BQ, SUBLANE), lambda b, h, iq, ik: (b, iq, 0)),
+        pl.BlockSpec((1, SUBLANE, BK), lambda b, h, iq, ik: (b, 0, ik)),
+    ]
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, **common),
+        grid=(B, Hq, nq, nk),
+        in_specs=aux_specs_q + [
+            pl.BlockSpec((1, 1, BQ, D), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, BK, D), lambda b, h, iq, ik: (b, h // G, ik, 0)),
+            pl.BlockSpec((1, 1, BK, D), lambda b, h, iq, ik: (b, h // G, ik, 0)),
+            pl.BlockSpec((1, 1, BQ, D), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, BQ, SUBLANE), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, BQ, SUBLANE), lambda b, h, iq, ik: (b, h, iq, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, BQ, D), lambda b, h, iq, ik: (b, h, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((BQ, D), jnp.float32)],
+        interpret=_interpret(),
+    )(qpos, kpos, qseg, kseg, q, k, v, dout, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, **common),
+        grid=(B, Hkv, nk, G, nq),
+        in_specs=[
+            pl.BlockSpec((1, BQ, SUBLANE), lambda b, hk, ik, g, iq: (b, iq, 0)),
+            pl.BlockSpec((1, SUBLANE, BK), lambda b, hk, ik, g, iq: (b, 0, ik)),
+            pl.BlockSpec((1, BQ, SUBLANE), lambda b, hk, ik, g, iq: (b, iq, 0)),
+            pl.BlockSpec((1, SUBLANE, BK), lambda b, hk, ik, g, iq: (b, 0, ik)),
+            pl.BlockSpec((1, 1, BQ, D), lambda b, hk, ik, g, iq: (b, hk * G + g, iq, 0)),
+            pl.BlockSpec((1, 1, BK, D), lambda b, hk, ik, g, iq: (b, hk, ik, 0)),
+            pl.BlockSpec((1, 1, BK, D), lambda b, hk, ik, g, iq: (b, hk, ik, 0)),
+            pl.BlockSpec((1, 1, BQ, D), lambda b, hk, ik, g, iq: (b, hk * G + g, iq, 0)),
+            pl.BlockSpec((1, 1, BQ, SUBLANE), lambda b, hk, ik, g, iq: (b, hk * G + g, iq, 0)),
+            pl.BlockSpec((1, 1, BQ, SUBLANE), lambda b, hk, ik, g, iq: (b, hk * G + g, iq, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, BK, D), lambda b, hk, ik, g, iq: (b, hk, ik, 0)),
+            pl.BlockSpec((1, 1, BK, D), lambda b, hk, ik, g, iq: (b, hk, ik, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((BK, D), jnp.float32),
+            pltpu.VMEM((BK, D), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qpos, kpos, qseg, kseg, q, k, v, dout, lse, delta)
+
+    return dq, dk, dv, None, None, None, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q, k, v,
+    *,
+    causal: bool = True,
+    segment_ids=None,
+    positions=None,
+    sliding_window: int | None = None,
+    logits_soft_cap: float | None = None,
+    scale: float | None = None,
+    block_sizes: BlockSizes | None = None,
+):
+    """Flash attention; shapes q (B,S,Hq,D), k/v (B,T,Hkv,D) → (B,S,Hq,D).
+
+    Raises NotImplementedError for unsupported shapes so the dispatcher in
+    ops/attention.py can fall back to the XLA path.
+    """
+    if not _supported(q, k):
+        raise NotImplementedError(
+            f"flash_attention: unsupported shapes q={q.shape} k={k.shape} "
+            "(need head_dim % 128 == 0 and seq divisible by a 128-multiple block)"
+        )
+    if sliding_window is not None and not isinstance(sliding_window, int):
+        # per-layer traced windows (layer_types scan) not yet supported here
+        raise NotImplementedError("flash_attention: traced sliding_window")
+    B, S, Hq, D = q.shape
+    scale = scale if scale is not None else float(D) ** -0.5
+    qpos, kpos, qseg, kseg, monotonic = _prep_aux(B, S, positions, segment_ids)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out = _flash(
+        qt, kt, vt, qpos, kpos, qseg, kseg,
+        causal, sliding_window, logits_soft_cap, float(scale),
+        block_sizes or BlockSizes(), monotonic,
+    )
+    return jnp.swapaxes(out, 1, 2)

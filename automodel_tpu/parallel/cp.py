@@ -1,0 +1,214 @@
+"""Context parallelism: ring attention + load-balanced sequence sharding.
+
+The analog of the reference CP stack (reference: nemo_automodel/components/
+distributed/context_parallel/sharder.py:15-49 `ContextParallelSharder`
+closed-verb contract, :116 round-robin head/tail load balancing; TE ring
+attention wiring moe/parallelizer.py:749-800). TPU-native design:
+
+- The sequence dim of activations is sharded on the `cp` mesh axis (GSPMD).
+- Attention runs inside a `shard_map` over the mesh: each cp rank holds its
+  local q and rotates k/v blocks around the ring with `lax.ppermute`
+  (ICI-neighbor traffic, the XLA analog of TE's p2p ring), merging partial
+  results with a running online softmax — differentiable end-to-end, so the
+  backward pass is the reverse ring for free.
+- Causality is evaluated by POSITION, so any sequence layout works. The
+  load-balanced layout is the reference's head/tail round-robin: the global
+  sequence is permuted so cp rank r owns chunks (r, 2*cp-1-r), equalizing
+  causal work across ranks; positions ride the permutation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from automodel_tpu.distributed.mesh import MeshContext
+from automodel_tpu.ops.attention import NEG_INF
+
+
+# ---------------------------------------------------------------------------
+# load-balanced layout (reference: sharder.py:116-143)
+# ---------------------------------------------------------------------------
+def load_balanced_permutation(seq_len: int, cp_size: int) -> np.ndarray:
+    """perm[i] = global index of the token placed at layout slot i.
+
+    Rank r's contiguous slice [r*S/cp, (r+1)*S/cp) holds global chunks
+    (r, 2*cp-1-r), so every rank sees an equal mix of early (cheap) and late
+    (expensive) causal positions.
+    """
+    assert seq_len % (2 * cp_size) == 0, (seq_len, cp_size)
+    chunk = seq_len // (2 * cp_size)
+    order = []
+    for r in range(cp_size):
+        order.append(np.arange(r * chunk, (r + 1) * chunk))
+        hi = 2 * cp_size - 1 - r
+        order.append(np.arange(hi * chunk, (hi + 1) * chunk))
+    return np.concatenate(order)
+
+
+@dataclasses.dataclass
+class ContextParallelSharder:
+    """Permutes packed batches into the load-balanced CP layout.
+
+    Closed-verb contract mirroring the reference (sharder.py:15-49):
+    `shard_batch` reorders the sequence dim and attaches positions;
+    `local_token_global_indices` exposes the layout coordinate.
+    """
+
+    cp_size: int
+    load_balanced: bool = True
+    seq_keys: tuple = ("input_ids", "labels", "positions", "segment_ids", "loss_mask")
+
+    def permutation(self, seq_len: int) -> np.ndarray:
+        if self.cp_size == 1 or not self.load_balanced:
+            return np.arange(seq_len)
+        return load_balanced_permutation(seq_len, self.cp_size)
+
+    def shard_batch(self, batch: dict) -> dict:
+        seq_len = batch["input_ids"].shape[-1]
+        perm = self.permutation(seq_len)
+        if "positions" not in batch:
+            batch = {**batch, "positions": np.broadcast_to(
+                np.arange(seq_len, dtype=np.int32), batch["input_ids"].shape
+            )}
+        out = {}
+        for k, v in batch.items():
+            if k in self.seq_keys and getattr(v, "ndim", 0) >= 2 and v.shape[-1] == seq_len:
+                out[k] = np.asarray(v)[..., perm]
+            else:
+                out[k] = v
+        return out
+
+    def local_token_global_indices(self, seq_len: int, rank: int) -> np.ndarray:
+        perm = self.permutation(seq_len)
+        local = seq_len // self.cp_size
+        return perm[rank * local : (rank + 1) * local]
+
+
+# ---------------------------------------------------------------------------
+# ring attention (inside shard_map)
+# ---------------------------------------------------------------------------
+def _partial_attention(q, k, v, qpos, kpos, qseg, kseg, *, scale, soft_cap, window, causal):
+    """One ring step: masked scores of local q vs a visiting kv block.
+
+    Returns (m (B,Hq,S,1), l (B,Hq,S,1), o (B,S,Hq,D) un-normalized).
+    Shapes: q (B,S,Hq,D); k,v (B,T,Hkv,D).
+    """
+    B, S, Hq, D = q.shape
+    T, Hkv = k.shape[1], k.shape[2]
+    G = Hq // Hkv
+    qg = q.reshape(B, S, Hkv, G, D)
+    s = jnp.einsum("bskgd,btkd->bkgst", qg, k, preferred_element_type=jnp.float32) * scale
+    if soft_cap is not None:
+        s = soft_cap * jnp.tanh(s / soft_cap)
+    mask = jnp.ones((B, S, T), bool)
+    if causal:
+        mask = jnp.logical_and(mask, qpos[:, :, None] >= kpos[:, None, :])
+    if window is not None:
+        mask = jnp.logical_and(mask, qpos[:, :, None] - kpos[:, None, :] < window)
+    mask = jnp.logical_and(mask, qseg[:, :, None] == kseg[:, None, :])
+    s = jnp.where(mask[:, None, None, :, :], s, NEG_INF)
+    m = jnp.max(s, axis=-1)                      # (B,Hkv,G,S)
+    p = jnp.exp(s - m[..., None])
+    p = jnp.where(mask[:, None, None, :, :], p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bkgst,btkd->bskgd", p.astype(v.dtype), v)
+    return m, l, o.reshape(B, S, Hq, D)
+
+
+def ring_attention(
+    q, k, v,
+    positions, segment_ids,
+    *,
+    axis_name: str = "cp",
+    causal: bool = True,
+    sliding_window: int | None = None,
+    logits_soft_cap: float | None = None,
+    scale: float | None = None,
+):
+    """Ring attention over `axis_name`; call INSIDE shard_map.
+
+    All inputs are local shards: q/k/v (B, S_loc, H, D); positions and
+    segment_ids (B, S_loc) in GLOBAL coordinates (survive any layout).
+    """
+    B, S, Hq, D = q.shape
+    Hkv = k.shape[2]
+    G = Hq // Hkv
+    scale = scale if scale is not None else D ** -0.5
+    cp = lax.axis_size(axis_name)
+
+    if segment_ids is None:
+        segment_ids = jnp.zeros((B, S), jnp.int32)
+
+    def step(carry, _):
+        m_acc, l_acc, o_acc, kv = carry
+        k_blk, v_blk, kpos, kseg = kv
+        m_i, l_i, o_i = _partial_attention(
+            q, k_blk, v_blk, positions, kpos, segment_ids, kseg,
+            scale=scale, soft_cap=logits_soft_cap, window=sliding_window, causal=causal,
+        )
+        m_new = jnp.maximum(m_acc, m_i)
+        a_old = jnp.exp(m_acc - m_new)
+        a_new = jnp.exp(m_i - m_new)
+        l_acc = l_acc * a_old + l_i * a_new
+        # scale factors broadcast (B,Hkv,G,S) → (B,S,Hq,1)
+        def to_bshd(x):
+            return jnp.moveaxis(x, -1, 1).reshape(B, S, Hq)[..., None]
+        o_acc = o_acc * to_bshd(a_old) + o_i * to_bshd(a_new)
+        kv = lax.ppermute(
+            kv, axis_name, [(i, (i + 1) % cp) for i in range(cp)]
+        )
+        return (m_new, l_acc, o_acc, kv), None
+
+    m0 = jnp.full((B, Hkv, G, S), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, Hkv, G, S), jnp.float32)
+    o0 = jnp.zeros((B, S, Hq, D), jnp.float32)
+    kv0 = (k, v, positions, segment_ids)
+    (m_f, l_f, o_f, _), _ = lax.scan(step, (m0, l0, o0, kv0), None, length=cp)
+
+    l_bshd = jnp.moveaxis(l_f, -1, 1).reshape(B, S, Hq)[..., None]
+    l_safe = jnp.where(l_bshd == 0.0, 1.0, l_bshd)
+    out = jnp.where(l_bshd == 0.0, 0.0, o_f / l_safe)
+    return out.astype(q.dtype)
+
+
+def ring_dot_product_attention(
+    q, k, v,
+    positions, segment_ids,
+    mesh_ctx: MeshContext,
+    *,
+    causal: bool = True,
+    sliding_window: int | None = None,
+    logits_soft_cap: float | None = None,
+    scale: float | None = None,
+):
+    """shard_map wrapper: GSPMD everywhere else, explicit ring on `cp`."""
+    batch = ("dp_replicate", "dp_shard", "ep")
+    qkv_spec = P(batch, "cp", "tp", None)
+    tok_spec = P(batch, "cp")
+
+    if segment_ids is None:
+        segment_ids = jnp.zeros(positions.shape, jnp.int32)
+
+    fn = functools.partial(
+        ring_attention,
+        axis_name="cp",
+        causal=causal,
+        sliding_window=sliding_window,
+        logits_soft_cap=logits_soft_cap,
+        scale=scale,
+    )
+    return jax.shard_map(
+        fn,
+        mesh=mesh_ctx.mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, tok_spec, tok_spec),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )(q, k, v, positions, segment_ids)

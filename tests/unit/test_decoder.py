@@ -154,3 +154,28 @@ def test_gemma2_adapter():
     assert "post_mlp_norm" in params["layers"]
     out = decoder.forward(params, cfg, jnp.zeros((1, 8), jnp.int32))
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_window_plan_paths():
+    from automodel_tpu.models.common.layers import window_plan
+
+    assert window_plan((4, 4, 4)) == ("uniform", 4)
+    assert window_plan((4, None, 4, None)) == ("periodic", 2, (4, None))
+    kind, segs = window_plan((None, None, 4, 4, 4))
+    assert kind == "segments" and segs == [(0, 2, None), (2, 5, 4)]
+
+
+def test_qwen2_swa_segments_forward():
+    """max_window_layers split: first layer global, second sliding."""
+    from automodel_tpu.models.llm.families import qwen2_config
+
+    hf = {
+        "vocab_size": 64, "hidden_size": 32, "intermediate_size": 64,
+        "num_hidden_layers": 2, "num_attention_heads": 4, "num_key_value_heads": 2,
+        "use_sliding_window": True, "sliding_window": 4, "max_window_layers": 1,
+    }
+    cfg = qwen2_config(hf, dtype=jnp.float32, remat_policy="none")
+    assert cfg.layer_types == ("global", "sliding")
+    params = decoder.init(cfg, jax.random.key(0))
+    out = decoder.forward(params, cfg, jnp.arange(12, dtype=jnp.int32)[None, :] % 64)
+    assert np.isfinite(np.asarray(out)).all()
