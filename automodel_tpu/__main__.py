@@ -1,0 +1,3 @@
+from automodel_tpu.cli.app import main
+
+main()

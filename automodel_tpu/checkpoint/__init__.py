@@ -1,0 +1,23 @@
+from automodel_tpu.checkpoint.checkpointer import (
+    CheckpointingConfig,
+    Checkpointer,
+    abstract_state_like,
+)
+from automodel_tpu.checkpoint.hf_adapter import (
+    DenseDecoderAdapter,
+    HFCheckpointReader,
+    MoEDecoderAdapter,
+    get_adapter,
+    save_hf_checkpoint,
+)
+
+__all__ = [
+    "CheckpointingConfig",
+    "Checkpointer",
+    "abstract_state_like",
+    "DenseDecoderAdapter",
+    "MoEDecoderAdapter",
+    "HFCheckpointReader",
+    "get_adapter",
+    "save_hf_checkpoint",
+]

@@ -1,0 +1,377 @@
+"""HF-checkpoint ↔ stacked-pytree state-dict adapters.
+
+The analog of the reference's per-model `StateDictAdapter`
+(reference: nemo_automodel/components/checkpoint/state_dict_adapter.py:20
+abstract to_hf/from_hf; models/*/state_dict_adapter.py; MoE split/merge
+moe/state_dict_mixin.py): zero-conversion I/O between Hugging Face
+safetensors checkpoints and this framework's stacked-layer parameter
+pytrees. Key transforms:
+
+- HF `nn.Linear.weight` is (out, in); our kernels are (in, out) → transpose.
+- Per-layer HF tensors `model.layers.{i}.…` ↔ one stacked array dim 0.
+- Per-expert HF tensors `…experts.{e}.…` ↔ the (L, E, …) grouped arrays
+  (the MoESplitExpertsStateDictMixin analog).
+- Loading streams tensor-by-tensor from safetensors shards (lazy
+  `safe_open`), assembling each stacked param then placing it directly into
+  its target sharding — host memory peaks at one parameter, mirroring the
+  reference's streamed `load_base_model` (checkpointing.py:722).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Callable, Iterator, Mapping
+
+import jax
+import numpy as np
+
+from automodel_tpu.models.llm.decoder import TransformerConfig
+
+Reader = Callable[[str], np.ndarray]
+
+
+def _t(x: np.ndarray) -> np.ndarray:
+    return np.asarray(x).T
+
+
+@dataclasses.dataclass
+class DenseDecoderAdapter:
+    """llama/mistral/qwen2/qwen3/gemma2 ↔ models/llm/decoder params."""
+
+    cfg: TransformerConfig
+
+    # -- name tables ---------------------------------------------------------
+    def _layer_entries(self) -> list[tuple[str, tuple, str, bool]]:
+        """(hf_suffix, param_path, kind, transpose) per layer."""
+        cfg = self.cfg
+        e = [
+            ("self_attn.q_proj.weight", ("q_proj", "kernel"), True),
+            ("self_attn.k_proj.weight", ("k_proj", "kernel"), True),
+            ("self_attn.v_proj.weight", ("v_proj", "kernel"), True),
+            ("self_attn.o_proj.weight", ("o_proj", "kernel"), True),
+            ("mlp.gate_proj.weight", ("gate_proj", "kernel"), True),
+            ("mlp.up_proj.weight", ("up_proj", "kernel"), True),
+            ("mlp.down_proj.weight", ("down_proj", "kernel"), True),
+            ("input_layernorm.weight", ("input_norm", "scale"), False),
+        ]
+        if cfg.use_post_norms:
+            # gemma2 4-norm naming
+            e += [
+                ("post_attention_layernorm.weight", ("post_attn_out_norm", "scale"), False),
+                ("pre_feedforward_layernorm.weight", ("post_attn_norm", "scale"), False),
+                ("post_feedforward_layernorm.weight", ("post_mlp_norm", "scale"), False),
+            ]
+        else:
+            e.append(("post_attention_layernorm.weight", ("post_attn_norm", "scale"), False))
+        if cfg.attention_bias:
+            e += [
+                ("self_attn.q_proj.bias", ("q_proj", "bias"), False),
+                ("self_attn.k_proj.bias", ("k_proj", "bias"), False),
+                ("self_attn.v_proj.bias", ("v_proj", "bias"), False),
+            ]
+        if cfg.qk_norm:
+            e += [
+                ("self_attn.q_norm.weight", ("q_norm", "scale"), False),
+                ("self_attn.k_norm.weight", ("k_norm", "scale"), False),
+            ]
+        return [(s, p, t) for (s, p, t) in e]
+
+    def _top_entries(self) -> list[tuple[str, tuple, bool]]:
+        e = [
+            ("model.embed_tokens.weight", ("embed", "embedding"), False),
+            ("model.norm.weight", ("final_norm", "scale"), False),
+        ]
+        if not self.cfg.tie_word_embeddings:
+            e.append(("lm_head.weight", ("lm_head", "kernel"), True))
+        return e
+
+    # -- export --------------------------------------------------------------
+    def to_hf(self, params: Mapping) -> Iterator[tuple[str, np.ndarray]]:
+        """Yield (hf_name, tensor) — layer-stacked params are unstacked."""
+        for name, path, transpose in self._top_entries():
+            x = np.asarray(_get(params, path))
+            yield name, (_t(x) if transpose else x)
+        layers = params["layers"]
+        for i in range(self.cfg.num_layers):
+            for suffix, path, transpose in self._layer_entries():
+                x = np.asarray(_get(layers, path)[i])
+                yield f"model.layers.{i}.{suffix}", (_t(x) if transpose else x)
+
+    # -- import --------------------------------------------------------------
+    def from_hf(self, read: Reader, shardings: Any = None) -> dict:
+        """Assemble the params pytree; `shardings` (same tree) places each
+        param directly into its target layout as it is built."""
+        out: dict = {}
+
+        def put(path, value):
+            sh = _get(shardings, path) if shardings is not None else None
+            _set(out, path, jax.device_put(value, sh) if sh is not None else value)
+
+        for name, path, transpose in self._top_entries():
+            x = read(name)
+            put(path, _t(x) if transpose else np.asarray(x))
+        for suffix, path, transpose in self._layer_entries():
+            stacked = np.stack(
+                [
+                    _t(read(f"model.layers.{i}.{suffix}"))
+                    if transpose
+                    else np.asarray(read(f"model.layers.{i}.{suffix}"))
+                    for i in range(self.cfg.num_layers)
+                ]
+            )
+            put(("layers",) + path, stacked)
+        return out
+
+
+@dataclasses.dataclass
+class MoEDecoderAdapter:
+    """qwen3_moe / mixtral ↔ models/moe_lm/decoder params.
+
+    Per-expert HF weights split/merge into the grouped (L, E, H, I) arrays
+    (reference: moe/state_dict_mixin.py MoESplitExpertsStateDictMixin).
+    """
+
+    cfg: Any  # MoETransformerConfig
+    style: str = "qwen3_moe"  # or "mixtral"
+
+    def _expert_names(self, i: int, e: int) -> dict:
+        if self.style == "mixtral":
+            base = f"model.layers.{i}.block_sparse_moe.experts.{e}"
+            return {
+                "gate_proj": f"{base}.w1.weight",
+                "up_proj": f"{base}.w3.weight",
+                "down_proj": f"{base}.w2.weight",
+            }
+        base = f"model.layers.{i}.mlp.experts.{e}"
+        return {k: f"{base}.{k}.weight" for k in ("gate_proj", "up_proj", "down_proj")}
+
+    def _gate_name(self, i: int) -> str:
+        if self.style == "mixtral":
+            return f"model.layers.{i}.block_sparse_moe.gate.weight"
+        return f"model.layers.{i}.mlp.gate.weight"
+
+    def _dense(self) -> DenseDecoderAdapter:
+        return DenseDecoderAdapter(self.cfg)
+
+    def _attn_entries(self):
+        return [
+            (s, p, t)
+            for (s, p, t) in self._dense()._layer_entries()
+            if not p[0].endswith("_proj") or p[0] in ("q_proj", "k_proj", "v_proj", "o_proj")
+        ]
+
+    def to_hf(self, params: Mapping) -> Iterator[tuple[str, np.ndarray]]:
+        cfg = self.cfg
+        for name, path, transpose in self._dense()._top_entries():
+            x = np.asarray(_get(params, path))
+            yield name, (_t(x) if transpose else x)
+        fk = cfg.first_k_dense
+        if fk:
+            for i in range(fk):
+                for suffix, path, transpose in self._dense()._layer_entries():
+                    x = np.asarray(_get(params["dense_layers"], path)[i])
+                    yield f"model.layers.{i}.{suffix}", (_t(x) if transpose else x)
+        moe_layers = params["moe_layers"]
+        for li in range(cfg.num_moe_layers):
+            i = fk + li
+            for suffix, path, transpose in self._attn_entries():
+                x = np.asarray(_get(moe_layers, path)[li])
+                yield f"model.layers.{i}.{suffix}", (_t(x) if transpose else x)
+            moe = moe_layers["moe"]
+            yield self._gate_name(i), _t(np.asarray(moe["gate"]["weight"][li]))
+            if "e_score_bias" in moe["gate"]:
+                yield f"model.layers.{i}.mlp.gate.e_score_correction_bias", np.asarray(
+                    moe["gate"]["e_score_bias"][li]
+                )
+            for e in range(cfg.moe.n_routed_experts):
+                names = self._expert_names(i, e)
+                for proj in ("gate_proj", "up_proj", "down_proj"):
+                    yield names[proj], _t(np.asarray(moe["experts"][proj]["kernel"][li, e]))
+            if cfg.moe.n_shared_experts > 0:
+                base = f"model.layers.{i}.mlp.shared_experts"
+                for proj in ("gate_proj", "up_proj", "down_proj"):
+                    yield f"{base}.{proj}.weight", _t(np.asarray(moe["shared"][proj]["kernel"][li]))
+
+    def from_hf(self, read: Reader, shardings: Any = None) -> dict:
+        cfg = self.cfg
+        out: dict = {}
+
+        def put(path, value):
+            sh = _get(shardings, path) if shardings is not None else None
+            _set(out, path, jax.device_put(value, sh) if sh is not None else value)
+
+        for name, path, transpose in self._dense()._top_entries():
+            x = read(name)
+            put(path, _t(x) if transpose else np.asarray(x))
+        fk = cfg.first_k_dense
+        if fk:
+            for suffix, path, transpose in self._dense()._layer_entries():
+                stacked = np.stack(
+                    [
+                        _t(read(f"model.layers.{i}.{suffix}")) if transpose
+                        else np.asarray(read(f"model.layers.{i}.{suffix}"))
+                        for i in range(fk)
+                    ]
+                )
+                put(("dense_layers",) + path, stacked)
+        for suffix, path, transpose in self._attn_entries():
+            stacked = np.stack(
+                [
+                    _t(read(f"model.layers.{fk + li}.{suffix}")) if transpose
+                    else np.asarray(read(f"model.layers.{fk + li}.{suffix}"))
+                    for li in range(cfg.num_moe_layers)
+                ]
+            )
+            put(("moe_layers",) + path, stacked)
+        put(
+            ("moe_layers", "moe", "gate", "weight"),
+            np.stack([_t(read(self._gate_name(fk + li))) for li in range(cfg.num_moe_layers)]),
+        )
+        if cfg.moe.gate_bias_update_speed > 0:
+            def read_bias(li):
+                try:
+                    return np.asarray(
+                        read(f"model.layers.{fk + li}.mlp.gate.e_score_correction_bias")
+                    )
+                except KeyError:
+                    return np.zeros((cfg.moe.n_routed_experts,), np.float32)
+
+            put(
+                ("moe_layers", "moe", "gate", "e_score_bias"),
+                np.stack([read_bias(li) for li in range(cfg.num_moe_layers)]),
+            )
+        for proj in ("gate_proj", "up_proj", "down_proj"):
+            stacked = np.stack(
+                [
+                    np.stack(
+                        [
+                            _t(read(self._expert_names(fk + li, e)[proj]))
+                            for e in range(cfg.moe.n_routed_experts)
+                        ]
+                    )
+                    for li in range(cfg.num_moe_layers)
+                ]
+            )
+            put(("moe_layers", "moe", "experts", proj, "kernel"), stacked)
+        if cfg.moe.n_shared_experts > 0:
+            for proj in ("gate_proj", "up_proj", "down_proj"):
+                stacked = np.stack(
+                    [
+                        _t(read(f"model.layers.{fk + li}.mlp.shared_experts.{proj}.weight"))
+                        for li in range(cfg.num_moe_layers)
+                    ]
+                )
+                put(("moe_layers", "moe", "shared", proj, "kernel"), stacked)
+        return out
+
+
+ADAPTERS = {
+    "dense_decoder": DenseDecoderAdapter,
+    "moe_decoder": MoEDecoderAdapter,
+}
+
+
+def get_adapter(adapter_name: str, cfg, **kw):
+    return ADAPTERS[adapter_name](cfg, **kw)
+
+
+# ---------------------------------------------------------------------------
+# safetensors shard I/O
+# ---------------------------------------------------------------------------
+def save_hf_checkpoint(
+    named_tensors: Iterator[tuple[str, np.ndarray]],
+    out_dir: str,
+    hf_config: dict | None = None,
+    max_shard_bytes: int = 4 << 30,
+) -> None:
+    """Write sharded `model-XXXXX-of-YYYYY.safetensors` + index + config.json
+    (the consolidated-HF-export analog, reference: checkpointing.py
+    consolidate_safetensors_files_on_every_rank)."""
+    from safetensors.numpy import save_file
+
+    os.makedirs(out_dir, exist_ok=True)
+    shards: list[dict] = [{}]
+    sizes = [0]
+    for name, tensor in named_tensors:
+        nbytes = tensor.nbytes
+        if sizes[-1] + nbytes > max_shard_bytes and shards[-1]:
+            shards.append({})
+            sizes.append(0)
+        shards[-1][name] = np.ascontiguousarray(tensor)
+        sizes[-1] += nbytes
+
+    n = len(shards)
+    weight_map = {}
+    for idx, shard in enumerate(shards, 1):
+        fname = (
+            "model.safetensors" if n == 1
+            else f"model-{idx:05d}-of-{n:05d}.safetensors"
+        )
+        save_file(shard, os.path.join(out_dir, fname))
+        for k in shard:
+            weight_map[k] = fname
+    if n > 1:
+        index = {"metadata": {"total_size": int(sum(sizes))}, "weight_map": weight_map}
+        with open(os.path.join(out_dir, "model.safetensors.index.json"), "w") as f:
+            json.dump(index, f, indent=2)
+    if hf_config is not None:
+        with open(os.path.join(out_dir, "config.json"), "w") as f:
+            json.dump(hf_config, f, indent=2)
+
+
+class HFCheckpointReader:
+    """Lazy per-tensor reader over a local HF checkpoint directory."""
+
+    def __init__(self, ckpt_dir: str):
+        from safetensors import safe_open
+
+        self._dir = ckpt_dir
+        self._handles: dict[str, Any] = {}
+        index_path = os.path.join(ckpt_dir, "model.safetensors.index.json")
+        if os.path.exists(index_path):
+            with open(index_path) as f:
+                self._weight_map = json.load(f)["weight_map"]
+        else:
+            single = os.path.join(ckpt_dir, "model.safetensors")
+            h = safe_open(single, framework="numpy")
+            self._weight_map = {k: "model.safetensors" for k in h.keys()}
+            self._handles["model.safetensors"] = h
+
+    def _handle(self, fname: str):
+        from safetensors import safe_open
+
+        if fname not in self._handles:
+            self._handles[fname] = safe_open(os.path.join(self._dir, fname), framework="numpy")
+        return self._handles[fname]
+
+    def keys(self):
+        return self._weight_map.keys()
+
+    def __call__(self, name: str) -> np.ndarray:
+        if name not in self._weight_map:
+            raise KeyError(name)
+        return self._handle(self._weight_map[name]).get_tensor(name)
+
+    def hf_config(self) -> dict | None:
+        p = os.path.join(self._dir, "config.json")
+        if os.path.exists(p):
+            with open(p) as f:
+                return json.load(f)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# pytree path helpers
+# ---------------------------------------------------------------------------
+def _get(tree, path: tuple):
+    for p in path:
+        tree = tree[p]
+    return tree
+
+
+def _set(tree: dict, path: tuple, value) -> None:
+    for p in path[:-1]:
+        tree = tree.setdefault(p, {})
+    tree[path[-1]] = value

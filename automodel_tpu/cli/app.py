@@ -1,0 +1,52 @@
+"""CLI entry: ``automodel_tpu <cfg.yaml> [--key.path=value ...]``.
+
+The analog of the reference CLI (reference: nemo_automodel/cli/app.py:95
+`main`, cli/utils.py resolve_recipe_name). The recipe class resolves from,
+in priority order: the ``recipe._target_`` field, a bare ``recipe:`` name
+from RECIPE_ALIASES, or the default next-token-prediction trainer.
+
+The launcher story differs from torchrun by design: a TPU pod runs ONE
+process per host, each executing this same command; multi-host rendezvous
+is `jax.distributed.initialize` inside the recipe (distributed/init_utils),
+driven by env (GKE/XPK set it up). There is no process-spawning launcher to
+re-exec through.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from automodel_tpu.config import ConfigNode, parse_args_and_load_config
+from automodel_tpu.config.loader import _resolve_target
+
+RECIPE_ALIASES = {
+    "llm_train_ft": "automodel_tpu.recipes.llm.train_ft.TrainFinetuneRecipeForNextTokenPrediction",
+    "llm_finetune": "automodel_tpu.recipes.llm.train_ft.TrainFinetuneRecipeForNextTokenPrediction",
+    "llm_pretrain": "automodel_tpu.recipes.llm.train_ft.TrainFinetuneRecipeForNextTokenPrediction",
+    "llm_benchmark": "automodel_tpu.recipes.llm.benchmark.BenchmarkRecipe",
+}
+
+
+def resolve_recipe_class(cfg: ConfigNode):
+    node = cfg.get("recipe")
+    if node is None:
+        path = RECIPE_ALIASES["llm_train_ft"]
+    elif isinstance(node, str):
+        path = RECIPE_ALIASES.get(node, node)
+    elif "_target_" in node:
+        path = node.get("_target_")
+    else:
+        path = RECIPE_ALIASES["llm_train_ft"]
+    return _resolve_target(path)
+
+
+def main(argv=None) -> None:
+    cfg = parse_args_and_load_config(argv)
+    recipe_cls = resolve_recipe_class(cfg)
+    recipe = recipe_cls(cfg)
+    recipe.setup()
+    recipe.run_train_validation_loop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+"""Column-mapped instruction dataset: local json/jsonl → tokenized SFT rows.
+
+The analog of the reference `ColumnMappedTextInstructionDataset`
+(reference: nemo_automodel/components/datasets/llm/column_mapped_dataset.py):
+a generic SFT dataset where YAML maps dataset columns onto
+context/question/answer roles; loss is masked to the answer tokens
+(prompt tokens → IGNORE_INDEX), matching `answer_only_loss_mask`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Mapping, Optional
+
+import numpy as np
+
+IGNORE_INDEX = -100
+
+
+@dataclasses.dataclass
+class ColumnMappedTextInstructionDatasetConfig:
+    path_or_dataset: str = ""
+    column_mapping: Optional[dict] = None  # {context: ..., question: ..., answer: ...}
+    seq_len: int = 512
+    answer_only_loss_mask: bool = True
+    prompt_template: str = "{context}\n{question}\n"
+
+    def build(self, tokenizer) -> "ColumnMappedTextInstructionDataset":
+        return ColumnMappedTextInstructionDataset(self, tokenizer)
+
+
+def _load_rows(path: str) -> list[dict]:
+    if path.endswith(".jsonl"):
+        with open(path) as f:
+            return [json.loads(line) for line in f if line.strip()]
+    if path.endswith(".json"):
+        with open(path) as f:
+            data = json.load(f)
+        return data if isinstance(data, list) else data["data"]
+    # fall back to HF datasets for hub names / dataset dirs (offline cache)
+    import datasets as hf_datasets
+
+    ds = hf_datasets.load_dataset(path, split="train")
+    return ds
+
+
+class ColumnMappedTextInstructionDataset:
+    def __init__(self, config: ColumnMappedTextInstructionDatasetConfig, tokenizer):
+        self.config = config
+        self.tokenizer = tokenizer
+        self.rows = _load_rows(config.path_or_dataset)
+        self.mapping = config.column_mapping or {
+            "context": "context", "question": "question", "answer": "answer"
+        }
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def _fields(self, row: Mapping) -> tuple[str, str]:
+        parts = {
+            role: str(row.get(col, "")) for role, col in self.mapping.items()
+        }
+        answer = parts.pop("answer", "")
+        prompt = self.config.prompt_template.format(
+            context=parts.get("context", ""), question=parts.get("question", "")
+        )
+        return prompt, answer
+
+    def __getitem__(self, idx: int) -> dict:
+        prompt, answer = self._fields(self.rows[idx])
+        tok = self.tokenizer
+        prompt_ids = tok(prompt, add_special_tokens=False)["input_ids"]
+        answer_ids = tok(answer, add_special_tokens=False)["input_ids"]
+        bos = [tok.bos_token_id] if getattr(tok, "bos_token_id", None) is not None else []
+        eos = [tok.eos_token_id] if getattr(tok, "eos_token_id", None) is not None else []
+        ids = bos + prompt_ids + answer_ids + eos
+        labels = list(ids[1:]) + [IGNORE_INDEX]
+        if self.config.answer_only_loss_mask:
+            n_prompt = len(bos) + len(prompt_ids)
+            for i in range(min(n_prompt - 1, len(labels))):
+                labels[i] = IGNORE_INDEX
+
+        ids = ids[: self.config.seq_len]
+        labels = labels[: self.config.seq_len]
+        pad = self.config.seq_len - len(ids)
+        pad_id = getattr(tok, "pad_token_id", None)
+        pad_id = pad_id if pad_id is not None else 0
+        return {
+            "input_ids": np.asarray(ids + [pad_id] * pad, np.int32),
+            "labels": np.asarray(labels + [IGNORE_INDEX] * pad, np.int32),
+        }

@@ -1,0 +1,107 @@
+"""Dataloader: map-style dataset → (grad_accum, microbatch, seq) batches.
+
+The analog of the reference `DataloaderConfig` → StatefulDataLoader
+(reference: nemo_automodel/components/datasets/loader.py:563): shuffling
+with epoch-dependent seed, DP-rank sharding (each process reads only its
+slice of the global batch; `jax.make_array_from_process_local_data`
+assembles the global array on multi-host), and checkpointable position
+(the StatefulDataLoader resume analog).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterator, Optional
+
+import jax
+import numpy as np
+
+
+@dataclasses.dataclass
+class DataloaderConfig:
+    microbatch_size: int = 8       # per GLOBAL step, per grad-accum slice
+    grad_acc_steps: int = 1
+    shuffle: bool = True
+    seed: int = 0
+    drop_last: bool = True
+
+    def build(self, dataset) -> "Dataloader":
+        return Dataloader(self, dataset)
+
+
+class Dataloader:
+    def __init__(self, config: DataloaderConfig, dataset):
+        self.config = config
+        self.dataset = dataset
+        self.epoch = 0
+        self.batch_index = 0  # resumable position within the epoch
+
+    @property
+    def samples_per_step(self) -> int:
+        return self.config.microbatch_size * self.config.grad_acc_steps
+
+    def __len__(self) -> int:
+        return len(self.dataset) // self.samples_per_step
+
+    def set_epoch(self, epoch: int) -> None:
+        # keep a checkpoint-restored batch_index when re-entering the SAME
+        # epoch (mid-epoch resume); only an actual epoch change rewinds
+        if epoch != self.epoch:
+            self.epoch = epoch
+            self.batch_index = 0
+
+    def _order(self) -> np.ndarray:
+        n = len(self.dataset)
+        if not self.config.shuffle:
+            return np.arange(n)
+        rng = np.random.default_rng(self.config.seed * 1000003 + self.epoch)
+        return rng.permutation(n)
+
+    def __iter__(self) -> Iterator[dict]:
+        """Yields microbatches: dict of (microbatch_size, ...) arrays.
+
+        On multi-host, each process materializes only its rows; callers
+        assemble global arrays with make_global_batch().
+        """
+        order = self._order()
+        per = self.config.microbatch_size
+        n_micro = len(order) // per
+        start = self.batch_index
+        proc, nproc = jax.process_index(), jax.process_count()
+        assert per % nproc == 0 or nproc == 1, (per, nproc)
+        for b in range(start, n_micro):
+            self.batch_index = b + 1
+            idx = order[b * per : (b + 1) * per]
+            if nproc > 1:
+                local = per // nproc
+                idx = idx[proc * local : (proc + 1) * local]
+            samples = [self.dataset[int(i)] for i in idx]
+            yield {
+                k: np.stack([s[k] for s in samples]) for k in samples[0]
+            }
+        self.batch_index = 0
+
+    # -- checkpointable position (StatefulDataLoader analog) ---------------
+    def state_dict(self) -> dict:
+        return {"epoch": self.epoch, "batch_index": self.batch_index}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.epoch = int(state["epoch"])
+        self.batch_index = int(state["batch_index"])
+
+
+def stack_microbatches(microbatches: list) -> dict:
+    """List of grad-accum microbatch dicts → (accum, micro, ...) arrays."""
+    keys = microbatches[0].keys()
+    return {k: np.stack([m[k] for m in microbatches]) for k in keys}
+
+
+def make_global_batch(batch: dict, mesh_ctx, spec) -> dict:
+    """Place host batches into the sharded global layout. Single-host: a
+    device_put; multi-host: assemble from process-local rows."""
+    sharding = mesh_ctx.sharding(*spec) if isinstance(spec, tuple) else spec
+    if jax.process_count() == 1:
+        return jax.device_put(batch, sharding)
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, x), batch
+    )

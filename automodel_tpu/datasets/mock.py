@@ -1,0 +1,62 @@
+"""Hermetic mock datasets for CI and benchmarking.
+
+The analog of the reference's mock dataset configs
+(reference: nemo_automodel/components/datasets/llm/mock.py:102
+`MockUnpackedDatasetConfig`, mock_packed, mock_iterable) — deterministic
+synthetic token streams so recipe runs need no network or disk corpus
+(the benchmark recipe's "mock data" condition, docs/performance-summary).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class MockDatasetConfig:
+    num_samples: int = 1024
+    seq_len: int = 512
+    vocab_size: int = 32000
+    seed: int = 0
+    packed: bool = False
+    docs_per_sample: int = 4  # packed only
+
+    def build(self) -> "MockDataset":
+        return MockDataset(self)
+
+
+class MockDataset:
+    """Deterministic random next-token-prediction samples."""
+
+    def __init__(self, config: MockDatasetConfig):
+        self.config = config
+
+    def __len__(self) -> int:
+        return self.config.num_samples
+
+    def __getitem__(self, idx: int) -> dict:
+        c = self.config
+        rng = np.random.default_rng(c.seed * 100003 + idx)
+        tokens = rng.integers(1, c.vocab_size, c.seq_len + 1, dtype=np.int32)
+        sample = {
+            "input_ids": tokens[:-1],
+            "labels": tokens[1:].copy(),
+        }
+        if c.packed:
+            # synthetic document boundaries → segment ids + per-doc positions
+            cuts = np.sort(rng.choice(np.arange(1, c.seq_len), c.docs_per_sample - 1, replace=False))
+            seg = np.zeros(c.seq_len, np.int32)
+            pos = np.zeros(c.seq_len, np.int32)
+            prev = 0
+            for d, cut in enumerate(list(cuts) + [c.seq_len]):
+                seg[prev:cut] = d
+                pos[prev:cut] = np.arange(cut - prev)
+                prev = cut
+            sample["segment_ids"] = seg
+            sample["positions"] = pos
+            # no cross-document next-token supervision
+            labels = sample["labels"]
+            labels[np.flatnonzero(np.diff(seg))] = -100
+        return sample

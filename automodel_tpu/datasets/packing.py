@@ -1,0 +1,80 @@
+"""Sequence packing: variable-length documents → fixed (seq_len,) rows with
+segment ids and per-document positions.
+
+The analog of the reference's packed-sequence path (reference:
+nemo_automodel/components/datasets/llm/packed_sequence.py `_pad_pack` /
+THD format + distributed/thd_utils.py). On TPU the THD/cu_seqlens format
+becomes (segment_ids, positions) pairs — the layout the flash kernel and
+ring attention consume directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Iterator
+
+import numpy as np
+
+IGNORE_INDEX = -100
+
+
+@dataclasses.dataclass
+class PackedSequenceConfig:
+    seq_len: int = 2048
+    pad_id: int = 0
+    drop_last_incomplete: bool = False
+
+
+def pack_documents(
+    docs: Iterable[dict],  # each: {"input_ids": (n,), "labels": (n,)}
+    config: PackedSequenceConfig,
+) -> Iterator[dict]:
+    """Greedy first-fit packing; emits rows with segment_ids/positions.
+
+    Documents longer than seq_len are truncated. The first token of each
+    document keeps its label masked only if the doc provided it masked —
+    cross-document supervision never occurs because labels come from within
+    each document.
+    """
+    S = config.seq_len
+    buf_ids = np.full(S, config.pad_id, np.int32)
+    buf_labels = np.full(S, IGNORE_INDEX, np.int32)
+    buf_seg = np.zeros(S, np.int32)
+    buf_pos = np.zeros(S, np.int32)
+    offset = 0
+    seg = 0
+
+    def flush():
+        nonlocal buf_ids, buf_labels, buf_seg, buf_pos, offset, seg
+        row = {
+            "input_ids": buf_ids,
+            "labels": buf_labels,
+            "segment_ids": buf_seg,
+            "positions": buf_pos,
+        }
+        buf_ids = np.full(S, config.pad_id, np.int32)
+        buf_labels = np.full(S, IGNORE_INDEX, np.int32)
+        buf_seg = np.zeros(S, np.int32)
+        buf_pos = np.zeros(S, np.int32)
+        offset = 0
+        seg = 0
+        return row
+
+    for doc in docs:
+        ids = np.asarray(doc["input_ids"], np.int32)[:S]
+        labels = np.asarray(doc["labels"], np.int32)[: len(ids)]
+        n = len(ids)
+        if offset + n > S:
+            yield flush()
+        buf_ids[offset : offset + n] = ids
+        buf_labels[offset : offset + n] = labels
+        # pad slots keep segment id 0? no — use seg+1 so padding (seg 0 after
+        # flush) never matches a real document when rows are partially filled
+        buf_seg[offset : offset + n] = seg + 1
+        buf_pos[offset : offset + n] = np.arange(n)
+        offset += n
+        seg += 1
+        if offset == S:
+            yield flush()
+    if offset > 0 and not config.drop_last_incomplete:
+        yield flush()

@@ -36,13 +36,26 @@ def initialize_distributed(
     num_processes = num_processes or _int_env("JAX_NUM_PROCESSES")
     process_id = process_id if process_id is not None else _int_env("JAX_PROCESS_ID")
 
-    tpu_autodetect = os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
-    if coordinator_address or tpu_autodetect:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
+    # single-slice multi-host pods advertise their peers via
+    # TPU_WORKER_HOSTNAMES; >1 entry → argless autodetect rendezvous
+    tpu_hosts = [
+        h for h in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if h
+    ]
+    multihost_hinted = (
+        coordinator_address is not None
+        or (num_processes is not None and num_processes > 1)
+        or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
+        or len(tpu_hosts) > 1
+    )
+    if multihost_hinted:
+        if coordinator_address is None and num_processes is None:
+            jax.distributed.initialize()  # TPU runtime autodetection
+        else:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
         logger.info(
             "jax.distributed initialized: process %d/%d, %d local / %d global devices",
             jax.process_index(),

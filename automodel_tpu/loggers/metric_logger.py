@@ -1,0 +1,79 @@
+"""JSONL per-step metric logging + rank-filtered stdlib logging.
+
+The analog of the reference's `MetricLogger`/`MetricLoggerDist` and
+`setup_logging` (reference: nemo_automodel/components/loggers/
+metric_logger.py:88-178, log_utils.py). The JSONL schema mirrors the
+reference's CI golden values (tests/ci_tests/golden_values/**/training.jsonl
+— per-step loss/grad_norm/lr/tps/mfu records), which is exactly what loss-
+curve parity checks consume.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+from typing import Any, IO
+
+import jax
+
+
+class MetricLogger:
+    """Append one JSON object per step to a .jsonl file (rank 0 only)."""
+
+    def __init__(self, path: str | None, also_stdout: bool = True):
+        self.path = path
+        self.also_stdout = also_stdout
+        self._f: IO | None = None
+        if path and jax.process_index() == 0:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._f = open(path, "a")
+
+    def log(self, record: dict) -> None:
+        rec = {k: _to_scalar(v) for k, v in record.items()}
+        rec.setdefault("ts", time.time())
+        if self._f is not None:
+            self._f.write(json.dumps(rec) + "\n")
+            self._f.flush()
+        if self.also_stdout and jax.process_index() == 0:
+            step = rec.get("step", "?")
+            body = " ".join(
+                f"{k}={v:.5g}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in rec.items()
+                if k not in ("ts", "step")
+            )
+            logging.getLogger("metrics").info("step %s | %s", step, body)
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+def _to_scalar(v: Any):
+    if hasattr(v, "item"):
+        try:
+            return v.item()
+        except Exception:
+            return str(v)
+    return v
+
+
+class RankFilter(logging.Filter):
+    """Only rank 0 emits (reference: loggers/log_utils.py RankFilter)."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        return jax.process_index() == 0
+
+
+def setup_logging(level: int = logging.INFO) -> None:
+    handler = logging.StreamHandler(sys.stdout)
+    handler.setFormatter(
+        logging.Formatter("%(asctime)s %(levelname)s [%(name)s] %(message)s")
+    )
+    handler.addFilter(RankFilter())
+    root = logging.getLogger()
+    root.handlers = [handler]
+    root.setLevel(level)

@@ -230,6 +230,9 @@ def forward(
     """Run the decoder. Returns logits (B,S,V) fp32, or hidden (B,S,H) when
     `return_hidden` (pair with loss/linear_ce.py to avoid materializing
     logits — the FusedLinearCrossEntropy analog)."""
+    from automodel_tpu.models.common.layers import cast_params
+
+    params = cast_params(params, cfg.dtype)  # fp32 master → compute dtype
     cfg_dtype = cfg.dtype
     B, S = input_ids.shape
     if positions is None:

@@ -150,6 +150,9 @@ def forward(
     balancing (reference: train_ft.py:1164 `update_moe_gate_bias`) and to
     moe load-balance metrics.
     """
+    from automodel_tpu.models.common.layers import cast_params
+
+    params = cast_params(params, cfg.dtype)  # fp32 master → compute dtype
     B, S = input_ids.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))

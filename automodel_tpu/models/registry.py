@@ -24,6 +24,7 @@ class ModelSpec:
     config_from_hf: Callable[..., Any]
     module: Any  # provides init / forward / param_specs / (unembed)
     adapter_name: str = "dense_decoder"  # state-dict adapter key
+    adapter_kwargs: dict = dataclasses.field(default_factory=dict)
 
 
 MODEL_ARCH_MAPPING: dict[str, ModelSpec] = {
@@ -36,7 +37,8 @@ MODEL_ARCH_MAPPING: dict[str, ModelSpec] = {
         "qwen3_moe", moe_families.qwen3_moe_config, moe_decoder, adapter_name="moe_decoder"
     ),
     "MixtralForCausalLM": ModelSpec(
-        "mixtral", moe_families.mixtral_config, moe_decoder, adapter_name="moe_decoder"
+        "mixtral", moe_families.mixtral_config, moe_decoder,
+        adapter_name="moe_decoder", adapter_kwargs={"style": "mixtral"},
     ),
 }
 

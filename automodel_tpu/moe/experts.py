@@ -76,14 +76,20 @@ def dispatch_tensors(
     flat = indices.reshape(T * K)
     onehot = jax.nn.one_hot(flat, E, dtype=jnp.int32)          # (T*K, E)
     pos = jnp.cumsum(onehot, axis=0) - onehot                   # (T*K, E)
-    pos_in_expert = jnp.sum(pos * onehot, axis=-1)              # (T*K,)
-    keep = pos_in_expert < capacity
-    cap_onehot = jax.nn.one_hot(pos_in_expert, capacity, dtype=jnp.float32)
-    disp = (onehot.astype(jnp.float32)[:, :, None] * cap_onehot[:, None, :])
-    disp = disp * keep[:, None, None]
-    disp = disp.reshape(T, K, E, capacity)
-    dispatch = disp.sum(1)                                      # (T, E, C)
-    combine = (disp * weights.reshape(T, K, 1, 1)).sum(1)       # (T, E, C)
+    pos_in_expert = jnp.sum(pos * onehot, axis=-1).reshape(T, K)
+    keep = (pos_in_expert < capacity).astype(jnp.float32)       # (T, K)
+
+    # Accumulate per top-k slot so peak memory stays at one (T, E, C) tensor
+    # (a (T*K, E, C) intermediate would be K× larger).
+    dispatch = jnp.zeros((T, E, capacity), jnp.float32)
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    idx_tk = indices.reshape(T, K)
+    for k in range(K):
+        eh = jax.nn.one_hot(idx_tk[:, k], E, dtype=jnp.float32)          # (T, E)
+        ch = jax.nn.one_hot(pos_in_expert[:, k], capacity, dtype=jnp.float32)
+        contrib = (eh * keep[:, k : k + 1])[:, :, None] * ch[:, None, :]  # (T, E, C)
+        dispatch = dispatch + contrib
+        combine = combine + contrib * weights[:, k][:, None, None]
     return dispatch, combine
 
 

@@ -1,0 +1,83 @@
+"""Recipe base class with automatic state tracking.
+
+The analog of the reference `BaseRecipe`
+(reference: nemo_automodel/recipes/base_recipe.py:165): any attribute
+assigned to the recipe that exposes state_dict/load_state_dict is
+auto-registered (reference __setattr__ hook :186-224) and rides the
+checkpoint's JSON side-car; the sharded train state goes through the orbax
+Checkpointer. LATEST/retention/best tracking live in the Checkpointer.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+from automodel_tpu.checkpoint import Checkpointer, abstract_state_like
+from automodel_tpu.config import ConfigNode
+
+logger = logging.getLogger(__name__)
+
+
+class BaseRecipe:
+    def __init__(self, cfg: ConfigNode):
+        object.__setattr__(self, "_state_tracked", {})
+        self.cfg = cfg
+        self.checkpointer: Optional[Checkpointer] = None
+        self.train_state = None  # TrainState pytree (sharded)
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if (
+            not name.startswith("_")
+            and hasattr(value, "state_dict")
+            and hasattr(value, "load_state_dict")
+        ):
+            self._state_tracked[name] = value
+        object.__setattr__(self, name, value)
+
+    # -- checkpoint orchestration (reference: base_recipe.py:233-745) -------
+    def save_checkpoint(self, step: int, metrics: dict | None = None, force: bool = False) -> bool:
+        if self.checkpointer is None or self.train_state is None:
+            return False
+        extra = {name: obj.state_dict() for name, obj in self._state_tracked.items()}
+        return self.checkpointer.save(
+            step, self.train_state, extra=extra, metrics=metrics, force=force
+        )
+
+    def load_checkpoint(self, step: int | None = None) -> bool:
+        if self.checkpointer is None or self.train_state is None:
+            return False
+        if self.checkpointer.latest_step() is None:
+            return False
+        state, extra = self.checkpointer.restore(
+            abstract_state_like(self.train_state), step=step, with_extra=True
+        )
+        self.train_state = state
+        for name, st in (extra or {}).items():
+            if name in self._state_tracked:
+                self._state_tracked[name].load_state_dict(st)
+            else:
+                logger.warning("checkpoint extra state '%s' has no consumer", name)
+        logger.info("resumed from checkpoint step %s", self.checkpointer.latest_step())
+        return True
+
+    def restore_from(self, checkpoint_dir: str, step: int | None = None) -> None:
+        """Restore from an EXPLICIT checkpoint directory (reference:
+        restore_from config, base_recipe.py:649) — distinct from auto-resume,
+        which reads the recipe's own checkpoint_dir."""
+        from automodel_tpu.checkpoint import CheckpointingConfig
+
+        src = CheckpointingConfig(
+            checkpoint_dir=checkpoint_dir, async_save=False
+        ).build()
+        if src.latest_step() is None:
+            raise FileNotFoundError(f"no checkpoint under {checkpoint_dir}")
+        state, extra = src.restore(
+            abstract_state_like(self.train_state), step=step, with_extra=True
+        )
+        self.train_state = state
+        for name, st in (extra or {}).items():
+            if name in self._state_tracked:
+                self._state_tracked[name].load_state_dict(st)
+        src.close()
+        logger.info("restored from %s step %s", checkpoint_dir, step or src.latest_step())

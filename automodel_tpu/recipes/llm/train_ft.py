@@ -1,0 +1,367 @@
+"""The flagship recipe: next-token-prediction finetune / pretrain.
+
+The analog of `TrainFinetuneRecipeForNextTokenPrediction`
+(reference: nemo_automodel/recipes/llm/train_ft.py:400): YAML-driven setup
+of mesh → model → optimizer → data → schedulers → checkpointing, then the
+train/validation loop. The reference's imperative hot loop
+(_run_train_optim_step :1085) is one jitted function here
+(training/train_step.py); everything around it matches: global-token loss
+normalization, grad clip, MoE gate-bias update after the step (:1164),
+per-step JSONL metrics with tps/MFU (:1193-1239), checkpoint cadence,
+SIGTERM checkpoint-and-exit.
+
+YAML shape (see examples/):
+
+    model:
+      hf_config: {architectures: [LlamaForCausalLM], hidden_size: …}
+      # or: pretrained_path: /path/to/hf/checkpoint (config.json + safetensors)
+      dtype: bfloat16
+      remat_policy: full
+    distributed: {dp_shard: -1, tp: 1, cp: 1, ep: 1}
+    dataset: {_target_: automodel_tpu.datasets.mock.MockDatasetConfig, …}
+    dataloader: {microbatch_size: 8, grad_acc_steps: 1}
+    optimizer: {name: adamw, lr: 3e-4, weight_decay: 0.1}
+    lr_scheduler: {warmup_steps: 100, decay_steps: 1000, style: cosine}
+    step_scheduler: {max_steps: 100, ckpt_every_steps: 50, num_epochs: 1}
+    checkpoint: {enabled: true, checkpoint_dir: ckpts}
+    loss: {chunk_size: 1024}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from automodel_tpu.checkpoint import (
+    CheckpointingConfig,
+    HFCheckpointReader,
+    get_adapter,
+    save_hf_checkpoint,
+)
+from automodel_tpu.config import ConfigNode, parse_args_and_load_config
+from automodel_tpu.datasets.loader import DataloaderConfig, make_global_batch, stack_microbatches
+from automodel_tpu.distributed import MeshConfig, initialize_distributed
+from automodel_tpu.loggers.metric_logger import MetricLogger, setup_logging
+from automodel_tpu.loss import fused_linear_cross_entropy
+from automodel_tpu.loss.utils import combine_losses
+from automodel_tpu.models.registry import get_model_spec
+from automodel_tpu.optim import LRSchedulerConfig, OptimizerConfig
+from automodel_tpu.parallel import logical_to_shardings
+from automodel_tpu.recipes.base_recipe import BaseRecipe
+from automodel_tpu.training import (
+    TrainStepConfig,
+    init_train_state,
+    make_train_step,
+)
+from automodel_tpu.training.rng import StatefulRNG
+from automodel_tpu.training.step_scheduler import StepScheduler, StepSchedulerConfig
+from automodel_tpu.utils.flops import MFUCalculator
+
+logger = logging.getLogger(__name__)
+
+_DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32, "float16": jnp.float16}
+
+
+def _dataclass_from_cfg(cls, node, **extra):
+    kwargs = dict(extra)
+    if node is not None:
+        for f in dataclasses.fields(cls):
+            if f.name in node:
+                kwargs[f.name] = node.get(f.name)
+    return cls(**kwargs)
+
+
+class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
+    def __init__(self, cfg: ConfigNode):
+        super().__init__(cfg)
+        self.is_moe = False
+
+    # ------------------------------------------------------------------
+    def setup(self) -> None:
+        cfg = self.cfg
+        setup_logging()
+        initialize_distributed()
+
+        self.rng = StatefulRNG(seed=int(cfg.get("seed", 42)), ranked=False)
+        self.mesh_ctx = MeshConfig.from_config(cfg.get("distributed")).build()
+        logger.info("mesh: %s", self.mesh_ctx.sizes)
+
+        self._build_model()
+        self._build_optimizer()
+        self._build_data()
+
+        ckpt_cfg = _dataclass_from_cfg(CheckpointingConfig, cfg.get("checkpoint"))
+        ckpt_cfg.save_every_steps = self.step_scheduler.config.ckpt_every_steps
+        self.checkpointer = ckpt_cfg.build() if ckpt_cfg.enabled else None
+
+        run_dir = cfg.get("run_dir", ".")
+        self.metric_logger = MetricLogger(os.path.join(run_dir, "training.jsonl"))
+        self.val_logger = MetricLogger(os.path.join(run_dir, "validation.jsonl"))
+
+        seq_len = int(cfg.get("dataset.seq_len", 512))
+        self.mfu = MFUCalculator(
+            flops_per_token=self.model_cfg.flops_per_token(seq_len),
+            num_devices=self.mesh_ctx.num_devices,
+        )
+
+        restore_from = cfg.get("checkpoint.restore_from", None)
+        if restore_from:
+            self.restore_from(restore_from, step=cfg.get("checkpoint.restore_step"))
+        elif cfg.get("auto_resume", True):
+            try:
+                self.load_checkpoint()
+            except FileNotFoundError:
+                pass
+
+        self.step_scheduler.install_sigterm_handler()
+
+    # ------------------------------------------------------------------
+    def _build_model(self) -> None:
+        cfg = self.cfg
+        mcfg = cfg.get("model")
+        dtype = _DTYPES[mcfg.get("dtype", "bfloat16")]
+        overrides = dict(
+            dtype=dtype,
+            remat_policy=mcfg.get("remat_policy", "full"),
+            attn_impl=mcfg.get("attn_impl", "auto"),
+        )
+
+        pretrained = mcfg.get("pretrained_path", None)
+        if pretrained:
+            self._hf_reader = HFCheckpointReader(pretrained)
+            hf_config = self._hf_reader.hf_config()
+        else:
+            self._hf_reader = None
+            hf_config = mcfg.get("hf_config")
+            hf_config = hf_config.to_dict() if isinstance(hf_config, ConfigNode) else dict(hf_config)
+
+        self.model_spec = get_model_spec(hf_config)
+        self.is_moe = self.model_spec.adapter_name == "moe_decoder"
+        self.model_cfg = self.model_spec.config_from_hf(hf_config, **overrides)
+        self._hf_config = dict(hf_config)
+
+        module = self.model_spec.module
+        specs = module.param_specs(self.model_cfg)
+        shapes = jax.eval_shape(lambda: module.init(self.model_cfg, jax.random.key(0)))
+        self.param_shardings = logical_to_shardings(
+            specs, self.mesh_ctx, shapes=jax.tree.map(lambda p: p.shape, shapes)
+        )
+
+        if self._hf_reader is not None:
+            adapter = get_adapter(
+                self.model_spec.adapter_name, self.model_cfg,
+                **self.model_spec.adapter_kwargs,
+            )
+            params = adapter.from_hf(self._hf_reader, shardings=self.param_shardings)
+            params = jax.tree.map(lambda p: jnp.asarray(p, jnp.float32), params)
+            logger.info("loaded pretrained weights from %s", self._hf_reader._dir)
+        else:
+            init_fn = jax.jit(
+                lambda key: module.init(self.model_cfg, key),
+                out_shardings=self.param_shardings,
+            )
+            params = init_fn(self.rng.next_key())
+        self._init_params = params
+
+    # ------------------------------------------------------------------
+    def _build_optimizer(self) -> None:
+        cfg = self.cfg
+        opt_cfg = _dataclass_from_cfg(OptimizerConfig, cfg.get("optimizer"))
+        sched_cfg = _dataclass_from_cfg(LRSchedulerConfig, cfg.get("lr_scheduler"))
+        self.lr_schedule = sched_cfg.build(opt_cfg.lr)
+        self.tx = opt_cfg.build(self.lr_schedule)
+        state = init_train_state(self._init_params, self.tx)
+        del self._init_params
+        # normalize every leaf onto the mesh: params keep their NamedShardings,
+        # scalars (step, adam counts) become mesh-replicated — so checkpoint
+        # restore and jit see one consistent device set
+        rep = self.mesh_ctx.replicated()
+
+        def _sh(x):
+            s = getattr(x, "sharding", None)
+            return s if isinstance(s, jax.sharding.NamedSharding) else rep
+
+        self.train_state = jax.device_put(state, jax.tree.map(_sh, state))
+
+        module = self.model_spec.module
+        model_cfg = self.model_cfg
+        mesh_ctx = self.mesh_ctx
+        chunk = int(cfg.get("loss.chunk_size", 1024))
+        is_moe = self.is_moe
+
+        def loss_fn(params, batch, rng):
+            kw = {}
+            for k in ("positions", "segment_ids"):
+                if k in batch:
+                    kw[k] = batch[k]
+            extra = {}
+            if is_moe:
+                kw["token_mask"] = batch["labels"] != -100
+                hidden, aux, stats = module.forward(
+                    params, model_cfg, batch["input_ids"],
+                    return_hidden=True, return_stats=True, mesh_ctx=mesh_ctx, **kw,
+                )
+                extra["tokens_per_expert"] = stats["tokens_per_expert"]
+            else:
+                hidden = module.forward(
+                    params, model_cfg, batch["input_ids"],
+                    return_hidden=True, mesh_ctx=mesh_ctx, **kw,
+                )
+                aux = None
+            kernel = (
+                params["embed"]["embedding"].T
+                if model_cfg.tie_word_embeddings
+                else params["lm_head"]["kernel"]
+            )
+            ce_sum, n = fused_linear_cross_entropy(
+                hidden, kernel, batch["labels"], chunk_size=chunk,
+                logits_soft_cap=model_cfg.logits_soft_cap,
+            )
+            total, n = combine_losses(ce_sum, n, aux)
+            return total, {"num_label_tokens": n, **extra}
+
+        step_cfg = TrainStepConfig(max_grad_norm=cfg.get("max_grad_norm", 1.0))
+        self._train_step = jax.jit(
+            make_train_step(loss_fn, self.tx, self.lr_schedule, step_cfg),
+            donate_argnums=0,
+        )
+
+        def eval_loss(params, batch):
+            loss_sum, aux = loss_fn(params, batch, jax.random.key(0))
+            return loss_sum, aux["num_label_tokens"]
+
+        self._eval_step = jax.jit(eval_loss)
+
+    # ------------------------------------------------------------------
+    def _build_data(self) -> None:
+        cfg = self.cfg
+        dataset = cfg.get("dataset").instantiate().build()
+        dl_cfg = _dataclass_from_cfg(DataloaderConfig, cfg.get("dataloader"))
+        div = self.mesh_ctx.batch_size_divisor
+        if dl_cfg.microbatch_size % div != 0:
+            raise ValueError(
+                f"dataloader.microbatch_size={dl_cfg.microbatch_size} must be "
+                f"divisible by dp_replicate*dp_shard*ep={div} (the token-"
+                "sharding axes of the mesh)"
+            )
+        self.dataloader = dl_cfg.build(dataset)
+        ss_cfg = _dataclass_from_cfg(StepSchedulerConfig, cfg.get("step_scheduler"))
+        ss_cfg.grad_acc_steps = dl_cfg.grad_acc_steps
+        self.step_scheduler = StepScheduler(ss_cfg, self.dataloader)
+
+        val_node = cfg.get("validation_dataset")
+        self.val_dataloader = None
+        if val_node is not None:
+            val_ds = val_node.instantiate().build()
+            self.val_dataloader = dl_cfg.build(val_ds)
+
+    # ------------------------------------------------------------------
+    def _batch_spec(self) -> tuple:
+        return (None, "batch", "cp")  # (accum, batch, seq)
+
+    def run_train_validation_loop(self) -> None:
+        t_last = time.perf_counter()
+        for microbatches in self.step_scheduler:
+            batch_np = stack_microbatches(microbatches)
+            batch = make_global_batch(batch_np, self.mesh_ctx, self.mesh_ctx.sharding(*self._batch_spec()))
+            self.train_state, metrics = self._train_step(
+                self.train_state, batch, self.rng.next_key()
+            )
+            step = self.step_scheduler.step
+
+            if self.is_moe and self.model_cfg.moe.gate_bias_update_speed > 0:
+                self._update_gate_bias(metrics["tokens_per_expert"])
+
+            now = time.perf_counter()
+            n_tokens = float(metrics["num_label_tokens"])
+            perf = self.mfu.metrics(int(batch_np["input_ids"].size), now - t_last)
+            t_last = now
+            record = {
+                "step": step,
+                "epoch": self.step_scheduler.epoch,
+                "loss": metrics["loss"],
+                "grad_norm": metrics["grad_norm"],
+                "lr": metrics.get("lr", 0.0),
+                "num_label_tokens": n_tokens,
+                **{k: round(v, 4) for k, v in perf.items()},
+            }
+            if "tokens_per_expert" in metrics:
+                tpe = np.asarray(metrics["tokens_per_expert"])
+                record["moe_load_imbalance"] = float(
+                    tpe.max(-1).mean() / max(tpe.mean(), 1e-9)
+                )
+            self.metric_logger.log(record)
+
+            if self.step_scheduler.is_val_step and self.val_dataloader is not None:
+                self._run_validation(step)
+            if (self.step_scheduler.is_ckpt_step or self.step_scheduler.sigterm_received):
+                self.save_checkpoint(step, force=self.step_scheduler.sigterm_received)
+            if self.step_scheduler.sigterm_received:
+                logger.info("SIGTERM received — checkpointed and exiting")
+                break
+
+        if self.checkpointer is not None:
+            self.save_checkpoint(self.step_scheduler.step, force=True)
+            self.checkpointer.wait()
+        if self.cfg.get("checkpoint.save_consolidated", False):
+            self.save_consolidated_hf()
+        self.metric_logger.close()
+        self.val_logger.close()
+
+    # ------------------------------------------------------------------
+    def _update_gate_bias(self, tokens_per_expert) -> None:
+        """DeepSeek aux-free balancing after the optimizer step
+        (reference: train_ft.py:1164 update_moe_gate_bias). Stats come out
+        of the train step's aux, so this costs one elementwise update."""
+        from automodel_tpu.models.moe_lm.decoder import apply_gate_bias_update
+
+        new_params = apply_gate_bias_update(
+            self.train_state.params, self.model_cfg, tokens_per_expert
+        )
+        self.train_state = self.train_state._replace(params=new_params)
+
+    def _run_validation(self, step: int) -> None:
+        total, count = 0.0, 0.0
+        for mb in self.val_dataloader:
+            batch = make_global_batch(
+                mb, self.mesh_ctx, self.mesh_ctx.sharding("batch", "cp")
+            )
+            loss_sum, n = self._eval_step(self.train_state.params, batch)
+            total += float(loss_sum)
+            count += float(n)
+        val_loss = total / max(count, 1.0)
+        self.val_logger.log({"step": step, "val_loss": val_loss})
+
+    def save_consolidated_hf(self, out_dir: str | None = None) -> str:
+        """Consolidated HF safetensors export (reference: checkpointing.py
+        consolidation path)."""
+        out_dir = out_dir or os.path.join(
+            self.cfg.get("checkpoint.checkpoint_dir", "checkpoints"), "hf"
+        )
+        adapter = get_adapter(
+            self.model_spec.adapter_name, self.model_cfg,
+            **self.model_spec.adapter_kwargs,
+        )
+        params = jax.device_get(self.train_state.params)
+        save_hf_checkpoint(adapter.to_hf(params), out_dir, hf_config=self._hf_config)
+        logger.info("consolidated HF checkpoint written to %s", out_dir)
+        return out_dir
+
+
+def main(argv=None) -> None:
+    cfg = parse_args_and_load_config(argv)
+    recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg)
+    recipe.setup()
+    recipe.run_train_validation_loop()
+
+
+if __name__ == "__main__":
+    main()

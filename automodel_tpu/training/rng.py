@@ -1,0 +1,33 @@
+"""Checkpointable, rank-aware RNG.
+
+The analog of the reference `StatefulRNG` / `ScopedRNG`
+(reference: nemo_automodel/components/training/rng.py:85,117). JAX keys are
+functional, so "stateful" here means a counter-based key stream that
+serializes into the recipe checkpoint and replays identically on resume.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class StatefulRNG:
+    def __init__(self, seed: int = 0, ranked: bool = True):
+        self.seed = int(seed)
+        self.ranked = bool(ranked)
+        self.counter = 0
+        base = jax.random.key(self.seed)
+        if ranked:
+            base = jax.random.fold_in(base, jax.process_index())
+        self._base = base
+
+    def next_key(self) -> jax.Array:
+        self.counter += 1
+        return jax.random.fold_in(self._base, self.counter)
+
+    def state_dict(self) -> dict:
+        return {"seed": self.seed, "ranked": self.ranked, "counter": self.counter}
+
+    def load_state_dict(self, state: dict) -> None:
+        assert int(state["seed"]) == self.seed, "resume with a different seed"
+        self.counter = int(state["counter"])

@@ -45,7 +45,7 @@ class TrainStepConfig:
 
 
 def make_train_step(
-    loss_fn: Callable,  # (params, batch_slice, rng) -> (ce_sum, num_label_tokens)
+    loss_fn: Callable,  # (params, batch_slice, rng) -> (loss_sum, aux)
     tx: optax.GradientTransformation,
     lr_schedule: Callable | None = None,
     config: TrainStepConfig | None = None,
@@ -53,32 +53,47 @@ def make_train_step(
     """Build `train_step(state, batch, rng) -> (state, metrics)`.
 
     `batch` leaves are (accum_steps, microbatch, ...); accumulation runs as a
-    scan over dim 0. Loss functions return SUM cross-entropy plus valid-token
-    counts; normalization by total tokens happens here, once.
+    scan over dim 0. Loss functions return a SUM loss plus `aux` — either the
+    valid-token count directly, or a dict containing "num_label_tokens" and
+    any extra per-step arrays (e.g. MoE tokens_per_expert), which are summed
+    across microbatches and surfaced in metrics. Normalization by total
+    tokens happens here, once.
     """
     config = config or TrainStepConfig()
 
     def grad_one(params, mb, rng):
-        (ce, n), grads = jax.value_and_grad(
+        (ce, aux), grads = jax.value_and_grad(
             lambda p: loss_fn(p, mb, rng), has_aux=True
         )(params)
-        return grads, ce, n
+        if not isinstance(aux, dict):
+            aux = {"num_label_tokens": aux}
+        return grads, ce, aux
 
     def train_step(state: TrainState, batch, rng):
         accum = jax.tree.leaves(batch)[0].shape[0]
 
         def micro(carry, xs):
             idx, mb = xs
-            g_acc, ce_acc, n_acc = carry
-            g, ce, n = grad_one(state.params, mb, jax.random.fold_in(rng, idx))
-            return (jax.tree.map(jnp.add, g_acc, g), ce_acc + ce, n_acc + n), None
+            g_acc, ce_acc, aux_acc = carry
+            g, ce, aux = grad_one(state.params, mb, jax.random.fold_in(rng, idx))
+            return (
+                jax.tree.map(jnp.add, g_acc, g),
+                ce_acc + ce,
+                jax.tree.map(jnp.add, aux_acc, aux),
+            ), None
 
         zero_grads = jax.tree.map(jnp.zeros_like, state.params)
-        (grads, ce_sum, n_tokens), _ = jax.lax.scan(
+        # shape-only probe for the aux accumulator structure (no compute)
+        _, _, aux_shapes = jax.eval_shape(
+            grad_one, state.params, jax.tree.map(lambda x: x[0], batch), rng
+        )
+        aux0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), aux_shapes)
+        (grads, ce_sum, aux_sum), _ = jax.lax.scan(
             micro,
-            (zero_grads, jnp.float32(0.0), jnp.float32(0.0)),
+            (zero_grads, jnp.float32(0.0), aux0),
             (jnp.arange(accum), batch),
         )
+        n_tokens = aux_sum["num_label_tokens"]
 
         # normalize by the global number of label tokens
         denom = jnp.maximum(n_tokens, 1.0)
@@ -96,7 +111,7 @@ def make_train_step(
         metrics = {
             "loss": ce_sum / denom,
             "grad_norm": grad_norm,
-            "num_label_tokens": n_tokens,
+            **aux_sum,
         }
         if lr_schedule is not None:
             metrics["lr"] = lr_schedule(state.step)

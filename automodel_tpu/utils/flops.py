@@ -1,0 +1,61 @@
+"""MFU / FLOPs accounting.
+
+The analog of the reference `AutoMFU` + flops_utils (reference:
+nemo_automodel/_transformers/mfu.py:110, components/utils/flops_utils.py):
+per-architecture FLOPs formulas live on the model configs
+(`flops_per_token`); this module adds the device peak-FLOPs table and the
+MFU/TPS computation used by recipes and bench.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+#: bf16 peak TFLOP/s per chip (dense). Sources: public TPU/GPU spec sheets.
+PEAK_TFLOPS = {
+    "tpu v4": 275.0,
+    "tpu v5 lite": 197.0,   # v5e
+    "tpu v5e": 197.0,
+    "tpu v5p": 459.0,
+    "tpu v5": 459.0,
+    "tpu v6 lite": 918.0,   # trillium
+    "tpu v6e": 918.0,
+    "h100": 989.0,
+    "a100": 312.0,
+    "cpu": 1.0,
+}
+
+
+def device_peak_tflops(device=None) -> float:
+    device = device or jax.devices()[0]
+    kind = device.device_kind.lower()
+    for name, peak in PEAK_TFLOPS.items():
+        if name in kind:
+            return peak
+    return 100.0  # unknown accelerator — report *something* deterministic
+
+
+@dataclasses.dataclass
+class MFUCalculator:
+    """tokens/sec + MFU from a model config's flops_per_token."""
+
+    flops_per_token: float
+    num_devices: int = 1
+    peak_tflops_per_device: float | None = None
+
+    def __post_init__(self):
+        if self.peak_tflops_per_device is None:
+            self.peak_tflops_per_device = device_peak_tflops()
+
+    def metrics(self, num_tokens: int, seconds: float) -> dict:
+        tps = num_tokens / seconds
+        achieved = tps * self.flops_per_token
+        peak = self.peak_tflops_per_device * 1e12 * self.num_devices
+        return {
+            "tps": tps,
+            "tps_per_device": tps / self.num_devices,
+            "tflops_per_device": achieved / self.num_devices / 1e12,
+            "mfu_pct": 100.0 * achieved / peak,
+        }

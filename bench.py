@@ -1,0 +1,131 @@
+"""Benchmark: decoder-LM pretrain step throughput + MFU on the local chip(s).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline: the reference framework's H100 MFU on Llama3-class workloads —
+402/989 TFLOPs ≈ 40.6% (BASELINE.md, docs/performance-summary.mdx:35).
+vs_baseline therefore compares hardware utilization (MFU/MFU), the only
+apples-to-apples number across a single H100 and a single TPU chip.
+
+Run: python bench.py [--steps N] [--preset small|medium]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+H100_BASELINE_MFU_PCT = 40.6  # reference Llama3-8B single-GPU, BASELINE.md
+
+
+def build(preset: str):
+    from automodel_tpu.models.llm.decoder import TransformerConfig
+
+    if preset == "small":  # fits v5e (16 GB) with adam fp32 states
+        return TransformerConfig(
+            vocab_size=32768, hidden_size=1024, intermediate_size=4096,
+            num_layers=16, num_heads=16, num_kv_heads=8,
+            rope_theta=500000.0, dtype=jnp.bfloat16, remat_policy="full",
+            attn_impl="xla",  # pallas compile hangs on the axon tunnel (round 1)
+        ), 8, 2048
+    # medium: ~1.1B
+    return TransformerConfig(
+        vocab_size=32768, hidden_size=2048, intermediate_size=5632,
+        num_layers=22, num_heads=16, num_kv_heads=8,
+        rope_theta=500000.0, dtype=jnp.bfloat16, remat_policy="full",
+        attn_impl="xla",
+    ), 4, 2048
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--preset", default="small")
+    args = ap.parse_args()
+
+    from automodel_tpu.distributed import MeshConfig
+    from automodel_tpu.loss import fused_linear_cross_entropy
+    from automodel_tpu.models.llm import decoder
+    from automodel_tpu.optim import OptimizerConfig
+    from automodel_tpu.parallel import logical_to_shardings
+    from automodel_tpu.training import init_train_state, make_train_step
+    from automodel_tpu.utils.flops import MFUCalculator, device_peak_tflops
+
+    cfg, batch, seq = build(args.preset)
+    ctx = MeshConfig().build()
+    n_dev = ctx.num_devices
+
+    params = jax.jit(
+        lambda k: decoder.init(cfg, k),
+        out_shardings=logical_to_shardings(
+            decoder.param_specs(cfg), ctx,
+            shapes=jax.tree.map(
+                lambda p: p.shape,
+                jax.eval_shape(lambda: decoder.init(cfg, jax.random.key(0))),
+            ),
+        ),
+    )(jax.random.key(0))
+
+    def loss_fn(p, b, rng):
+        hidden = decoder.forward(
+            p, cfg, b["input_ids"], return_hidden=True, mesh_ctx=ctx
+        )
+        return fused_linear_cross_entropy(
+            hidden, p["lm_head"]["kernel"], b["labels"], chunk_size=2048
+        )
+
+    tx = OptimizerConfig(lr=1e-4, weight_decay=0.1).build()
+    state = init_train_state(params, tx)
+    step_fn = jax.jit(make_train_step(loss_fn, tx), donate_argnums=0)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, cfg.vocab_size, (1, batch, seq + 1), dtype=np.int64)
+    b = {
+        "input_ids": jnp.asarray(ids[..., :-1], jnp.int32),
+        "labels": jnp.asarray(ids[..., 1:], jnp.int32),
+    }
+    b = jax.device_put(b, ctx.sharding(None, "batch", None))
+
+    # warmup / compile
+    state, m = step_fn(state, b, jax.random.key(0))
+    jax.block_until_ready(m["loss"])
+
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        state, m = step_fn(state, b, jax.random.key(i))
+    jax.block_until_ready(m["loss"])
+    dt = (time.perf_counter() - t0) / args.steps
+
+    tokens = batch * seq
+    mfu = MFUCalculator(
+        flops_per_token=cfg.flops_per_token(seq), num_devices=n_dev
+    ).metrics(tokens, dt)
+
+    result = {
+        "metric": "llama_pretrain_mfu_pct",
+        "value": round(mfu["mfu_pct"], 2),
+        "unit": "% MFU",
+        "vs_baseline": round(mfu["mfu_pct"] / H100_BASELINE_MFU_PCT, 3),
+        "detail": {
+            "preset": args.preset,
+            "devices": n_dev,
+            "device_kind": jax.devices()[0].device_kind,
+            "peak_tflops": device_peak_tflops(),
+            "step_seconds": round(dt, 4),
+            "tokens_per_sec_per_device": round(mfu["tps_per_device"], 1),
+            "tflops_per_device": round(mfu["tflops_per_device"], 1),
+            "loss": float(m["loss"]),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,164 @@
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu.checkpoint import (
+    CheckpointingConfig,
+    DenseDecoderAdapter,
+    HFCheckpointReader,
+    MoEDecoderAdapter,
+    abstract_state_like,
+    save_hf_checkpoint,
+)
+from automodel_tpu.distributed import MeshConfig
+from automodel_tpu.models.llm import decoder
+from automodel_tpu.models.llm.decoder import TransformerConfig
+from automodel_tpu.models.moe_lm import decoder as moe_decoder
+from automodel_tpu.moe.config import MoEConfig
+from automodel_tpu.optim import OptimizerConfig
+from automodel_tpu.parallel import logical_to_shardings
+from automodel_tpu.training import init_train_state
+
+CFG = TransformerConfig(
+    vocab_size=64, hidden_size=32, intermediate_size=48, num_layers=2,
+    num_heads=4, num_kv_heads=2, attention_bias=True, qk_norm=True,
+    dtype=jnp.float32, remat_policy="none",
+)
+
+
+def _trees_equal(a, b, rtol=0):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=rtol)
+
+
+def test_dense_hf_roundtrip(tmp_path):
+    params = decoder.init(CFG, jax.random.key(0))
+    adapter = DenseDecoderAdapter(CFG)
+    save_hf_checkpoint(adapter.to_hf(params), str(tmp_path), hf_config={"architectures": ["X"]})
+    reader = HFCheckpointReader(str(tmp_path))
+    assert reader.hf_config() == {"architectures": ["X"]}
+    # HF naming present
+    assert "model.layers.0.self_attn.q_proj.weight" in reader.keys()
+    assert "model.layers.1.self_attn.q_norm.weight" in reader.keys()
+    restored = adapter.from_hf(reader)
+    _trees_equal(params, restored)
+
+
+def test_dense_hf_roundtrip_sharded(tmp_path):
+    ctx = MeshConfig(dp_shard=4, tp=2).build()
+    params = decoder.init(CFG, jax.random.key(0))
+    shardings = logical_to_shardings(
+        decoder.param_specs(CFG), ctx, shapes=jax.tree.map(lambda p: p.shape, params)
+    )
+    adapter = DenseDecoderAdapter(CFG)
+    save_hf_checkpoint(adapter.to_hf(params), str(tmp_path))
+    restored = adapter.from_hf(HFCheckpointReader(str(tmp_path)), shardings=shardings)
+    # placed directly into the sharded layout
+    leaf = restored["layers"]["q_proj"]["kernel"]
+    assert len(leaf.sharding.device_set) == 8
+    _trees_equal(params, restored)
+
+
+def test_hf_sharding_splits_files(tmp_path):
+    params = decoder.init(CFG, jax.random.key(0))
+    adapter = DenseDecoderAdapter(CFG)
+    save_hf_checkpoint(adapter.to_hf(params), str(tmp_path), max_shard_bytes=40_000)
+    files = os.listdir(tmp_path)
+    assert "model.safetensors.index.json" in files
+    assert sum(f.endswith(".safetensors") for f in files) > 1
+    restored = adapter.from_hf(HFCheckpointReader(str(tmp_path)))
+    _trees_equal(params, restored)
+
+
+MOE_CFG_T = None
+
+
+def _moe_cfg():
+    from automodel_tpu.models.moe_lm.decoder import MoETransformerConfig
+
+    return MoETransformerConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=48, num_layers=3,
+        num_heads=4, num_kv_heads=2, first_k_dense=1,
+        moe=MoEConfig(
+            n_routed_experts=4, n_shared_experts=1, experts_per_token=2,
+            moe_intermediate_size=16, shared_expert_intermediate_size=16,
+            gate_bias_update_speed=0.01,
+        ),
+        dtype=jnp.float32, remat_policy="none",
+    )
+
+
+def test_moe_hf_roundtrip(tmp_path):
+    cfg = _moe_cfg()
+    params = moe_decoder.init(cfg, jax.random.key(0))
+    adapter = MoEDecoderAdapter(cfg)
+    save_hf_checkpoint(adapter.to_hf(params), str(tmp_path))
+    reader = HFCheckpointReader(str(tmp_path))
+    assert "model.layers.1.mlp.experts.0.gate_proj.weight" in reader.keys()
+    assert "model.layers.0.mlp.gate_proj.weight" in reader.keys()  # dense layer 0
+    assert "model.layers.2.mlp.shared_experts.up_proj.weight" in reader.keys()
+    restored = adapter.from_hf(reader)
+    _trees_equal(params, restored)
+
+
+def test_orbax_save_restore_roundtrip(tmp_path):
+    params = decoder.init(CFG, jax.random.key(0))
+    tx = OptimizerConfig(lr=1e-3).build()
+    state = init_train_state(params, tx)
+    ckpt = CheckpointingConfig(
+        checkpoint_dir=str(tmp_path / "ckpt"), async_save=False, save_every_steps=2
+    ).build()
+    assert not ckpt.should_save(1)
+    assert ckpt.should_save(2)
+    ok = ckpt.save(2, state, extra={"epoch": 1, "data_step": 17})
+    assert ok
+    ckpt.wait()
+    assert ckpt.latest_step() == 2
+
+    restored, extra = ckpt.restore(abstract_state_like(state), with_extra=True)
+    assert extra == {"epoch": 1, "data_step": 17}
+    _trees_equal(state.params, restored.params)
+    _trees_equal(state.opt_state, restored.opt_state)
+    ckpt.close()
+
+
+def test_orbax_restore_across_topology(tmp_path):
+    """Save unsharded, restore into an FSDP+TP layout (DCP-reshard analog)."""
+    params = decoder.init(CFG, jax.random.key(0))
+    tx = OptimizerConfig(lr=1e-3).build()
+    state = init_train_state(params, tx)
+    ckpt = CheckpointingConfig(checkpoint_dir=str(tmp_path / "c"), async_save=False).build()
+    ckpt.save(0, state, force=True)
+    ckpt.wait()
+
+    ctx = MeshConfig(dp_shard=4, tp=2).build()
+    shardings = logical_to_shardings(
+        decoder.param_specs(CFG), ctx, shapes=jax.tree.map(lambda p: p.shape, params)
+    )
+    sharded_params = jax.device_put(params, shardings)
+    target = init_train_state(sharded_params, tx)
+    restored = ckpt.restore(abstract_state_like(target))
+    assert len(restored.params["layers"]["q_proj"]["kernel"].sharding.device_set) == 8
+    _trees_equal(state.params, restored.params)
+    ckpt.close()
+
+
+def test_retention(tmp_path):
+    params = {"w": jnp.zeros((4,))}
+    tx = OptimizerConfig(lr=1e-3).build()
+    state = init_train_state(params, tx)
+    ckpt = CheckpointingConfig(
+        checkpoint_dir=str(tmp_path / "r"), async_save=False, max_recent_checkpoints=2
+    ).build()
+    for s in (1, 2, 3, 4):
+        ckpt.save(s, state, force=True)
+    ckpt.wait()
+    steps = sorted(int(d) for d in os.listdir(tmp_path / "r") if d.isdigit())
+    assert steps == [3, 4]
+    ckpt.close()

@@ -1,0 +1,175 @@
+"""End-to-end recipe smoke tests (the CI recipe-test tier analog,
+reference: tests/ci_tests/ — mock datasets, per-step JSONL assertions)."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from automodel_tpu.cli.app import main, resolve_recipe_class
+from automodel_tpu.config import ConfigNode
+
+
+def _smoke_cfg(tmp_path, **over):
+    cfg = {
+        "seed": 7,
+        "run_dir": str(tmp_path),
+        "auto_resume": True,
+        "model": {
+            "hf_config": {
+                "architectures": ["LlamaForCausalLM"],
+                "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+                "num_hidden_layers": 2, "num_attention_heads": 4,
+                "num_key_value_heads": 2,
+            },
+            "dtype": "float32",
+            "remat_policy": "none",
+        },
+        "distributed": {"dp_shard": -1},
+        "dataset": {
+            "_target_": "automodel_tpu.datasets.mock.MockDatasetConfig",
+            "num_samples": 128, "seq_len": 32, "vocab_size": 128,
+        },
+        "dataloader": {"microbatch_size": 8, "grad_acc_steps": 2},
+        "optimizer": {"name": "adamw", "lr": 1e-3, "weight_decay": 0.0},
+        "lr_scheduler": {"warmup_steps": 1, "decay_steps": 10, "style": "cosine"},
+        "step_scheduler": {"max_steps": 4, "ckpt_every_steps": 2, "num_epochs": 2},
+        "checkpoint": {
+            "enabled": True,
+            "checkpoint_dir": str(tmp_path / "ckpt"),
+            "async_save": False,
+        },
+        "loss": {"chunk_size": 32},
+    }
+    node = ConfigNode(cfg)
+    for k, v in over.items():
+        node.set(k, v)
+    return node
+
+
+def test_recipe_train_checkpoints_and_metrics(tmp_path):
+    recipe_cls = resolve_recipe_class(_smoke_cfg(tmp_path))
+    recipe = recipe_cls(_smoke_cfg(tmp_path))
+    recipe.setup()
+    recipe.run_train_validation_loop()
+
+    records = [
+        json.loads(l) for l in open(tmp_path / "training.jsonl") if l.strip()
+    ]
+    assert [r["step"] for r in records] == [1, 2, 3, 4]
+    for r in records:
+        assert np.isfinite(r["loss"]) and np.isfinite(r["grad_norm"])
+        assert "tps" in r and "mfu_pct" in r
+    assert sorted(
+        int(d) for d in os.listdir(tmp_path / "ckpt") if d.isdigit()
+    ) == [2, 4]
+
+
+def test_recipe_resume_continues_steps(tmp_path):
+    recipe_cls = resolve_recipe_class(_smoke_cfg(tmp_path))
+    r1 = recipe_cls(_smoke_cfg(tmp_path))
+    r1.setup()
+    r1.run_train_validation_loop()
+
+    r2 = recipe_cls(_smoke_cfg(tmp_path, **{"step_scheduler.max_steps": 6}))
+    r2.setup()
+    assert r2.step_scheduler.step == 4  # resumed
+    assert int(r2.train_state.step) == 4
+    r2.run_train_validation_loop()
+    records = [
+        json.loads(l) for l in open(tmp_path / "training.jsonl") if l.strip()
+    ]
+    assert records[-1]["step"] == 6
+
+
+def test_recipe_consolidated_hf_export(tmp_path):
+    cfg = _smoke_cfg(tmp_path, **{"checkpoint.save_consolidated": True})
+    recipe = resolve_recipe_class(cfg)(cfg)
+    recipe.setup()
+    recipe.run_train_validation_loop()
+    hf_dir = tmp_path / "ckpt" / "hf"
+    assert (hf_dir / "model.safetensors").exists()
+    assert (hf_dir / "config.json").exists()
+
+    # reload the export as a pretrained_path → same params
+    cfg2 = _smoke_cfg(tmp_path / "second")
+    cfg2.set("model.pretrained_path", str(hf_dir))
+    cfg2.set("checkpoint.enabled", False)
+    cfg2.set("auto_resume", False)
+    r2 = resolve_recipe_class(cfg2)(cfg2)
+    r2.setup()
+    a = jax.tree.leaves(recipe.train_state.params)
+    b = jax.tree.leaves(r2.train_state.params)
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=1e-6)
+
+
+def test_recipe_moe_smoke(tmp_path):
+    cfg = _smoke_cfg(tmp_path)
+    cfg.set("model.hf_config", {
+        "architectures": ["Qwen3MoeForCausalLM"],
+        "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "num_key_value_heads": 2, "num_experts": 4, "num_experts_per_tok": 2,
+        "moe_intermediate_size": 16, "router_aux_loss_coef": 0.01,
+    })
+    cfg.set("distributed", {"dp_shard": -1, "ep": 2})
+    recipe = resolve_recipe_class(cfg)(cfg)
+    recipe.setup()
+    recipe.run_train_validation_loop()
+    records = [
+        json.loads(l) for l in open(tmp_path / "training.jsonl") if l.strip()
+    ]
+    assert len(records) == 4
+    assert all("moe_load_imbalance" in r for r in records)
+
+
+def test_recipe_restore_from_explicit_dir(tmp_path):
+    cfg1 = _smoke_cfg(tmp_path / "a")
+    r1 = resolve_recipe_class(cfg1)(cfg1)
+    r1.setup()
+    r1.run_train_validation_loop()
+
+    cfg2 = _smoke_cfg(tmp_path / "b")
+    cfg2.set("checkpoint.restore_from", str(tmp_path / "a" / "ckpt"))
+    r2 = resolve_recipe_class(cfg2)(cfg2)
+    r2.setup()
+    assert int(r2.train_state.step) == 4
+    a = jax.tree.leaves(r1.train_state.params)
+    b = jax.tree.leaves(r2.train_state.params)
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y))
+
+
+def test_benchmark_recipe_alias(tmp_path):
+    cfg = _smoke_cfg(tmp_path, recipe="llm_benchmark")
+    cfg.set("benchmark.warmup_steps", 1)
+    recipe_cls = resolve_recipe_class(cfg)
+    assert recipe_cls.__name__ == "BenchmarkRecipe"
+    r = recipe_cls(cfg)
+    r.setup()
+    r.run_train_validation_loop()
+    import json as _json
+
+    recs = [_json.loads(l) for l in open(tmp_path / "training.jsonl")]
+    assert recs[-1]["metric"] == "benchmark_step_seconds"
+
+
+def test_dataloader_mid_epoch_resume_no_replay(tmp_path):
+    from automodel_tpu.datasets.loader import DataloaderConfig
+    from automodel_tpu.datasets.mock import MockDatasetConfig
+
+    ds = MockDatasetConfig(num_samples=32, seq_len=8, vocab_size=64).build()
+    dl = DataloaderConfig(microbatch_size=4, shuffle=False).build(ds)
+    it = iter(dl)
+    first = next(it)["input_ids"]
+    state = dl.state_dict()
+    assert state == {"epoch": 0, "batch_index": 1}
+
+    dl2 = DataloaderConfig(microbatch_size=4, shuffle=False).build(ds)
+    dl2.load_state_dict(state)
+    dl2.set_epoch(0)  # what StepScheduler does on resume — must NOT rewind
+    second = next(iter(dl2))["input_ids"]
+    assert not np.array_equal(first, second)
