@@ -43,8 +43,8 @@ class DenseDecoderAdapter:
     cfg: TransformerConfig
 
     # -- name tables ---------------------------------------------------------
-    def _layer_entries(self) -> list[tuple[str, tuple, str, bool]]:
-        """(hf_suffix, param_path, kind, transpose) per layer."""
+    def _layer_entries(self) -> list[tuple[str, tuple, bool]]:
+        """(hf_suffix, param_path, transpose) per layer."""
         cfg = self.cfg
         e = [
             ("self_attn.q_proj.weight", ("q_proj", "kernel"), True),
@@ -76,7 +76,7 @@ class DenseDecoderAdapter:
                 ("self_attn.q_norm.weight", ("q_norm", "scale"), False),
                 ("self_attn.k_norm.weight", ("k_norm", "scale"), False),
             ]
-        return [(s, p, t) for (s, p, t) in e]
+        return e
 
     def _top_entries(self) -> list[tuple[str, tuple, bool]]:
         e = [
@@ -292,28 +292,46 @@ def save_hf_checkpoint(
     from safetensors.numpy import save_file
 
     os.makedirs(out_dir, exist_ok=True)
-    shards: list[dict] = [{}]
-    sizes = [0]
+    # Stream: flush each shard to a temp-named file as soon as it fills so
+    # host memory peaks at ONE shard, then rename once the count is known.
+    tmp_files: list[str] = []
+    shard_keys: list[list[str]] = []
+    shard: dict = {}
+    size = 0
+    total = 0
+
+    def flush():
+        nonlocal shard, size
+        if not shard:
+            return
+        tmp = os.path.join(out_dir, f"__tmp_shard_{len(tmp_files):05d}")
+        save_file(shard, tmp)
+        tmp_files.append(tmp)
+        shard_keys.append(list(shard))
+        shard = {}
+        size = 0
+
     for name, tensor in named_tensors:
         nbytes = tensor.nbytes
-        if sizes[-1] + nbytes > max_shard_bytes and shards[-1]:
-            shards.append({})
-            sizes.append(0)
-        shards[-1][name] = np.ascontiguousarray(tensor)
-        sizes[-1] += nbytes
+        if size + nbytes > max_shard_bytes and shard:
+            flush()
+        shard[name] = np.ascontiguousarray(tensor)
+        size += nbytes
+        total += nbytes
+    flush()
 
-    n = len(shards)
+    n = len(tmp_files)
     weight_map = {}
-    for idx, shard in enumerate(shards, 1):
+    for idx, (tmp, keys) in enumerate(zip(tmp_files, shard_keys), 1):
         fname = (
             "model.safetensors" if n == 1
             else f"model-{idx:05d}-of-{n:05d}.safetensors"
         )
-        save_file(shard, os.path.join(out_dir, fname))
-        for k in shard:
+        os.replace(tmp, os.path.join(out_dir, fname))
+        for k in keys:
             weight_map[k] = fname
     if n > 1:
-        index = {"metadata": {"total_size": int(sum(sizes))}, "weight_map": weight_map}
+        index = {"metadata": {"total_size": int(total)}, "weight_map": weight_map}
         with open(os.path.join(out_dir, "model.safetensors.index.json"), "w") as f:
             json.dump(index, f, indent=2)
     if hf_config is not None:

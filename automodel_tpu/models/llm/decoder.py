@@ -65,6 +65,7 @@ class TransformerConfig:
     remat_policy: str = "full"
     scan_unroll: int = 1
     attn_impl: str = "auto"
+    pipeline_microbatches: int = 2  # used when the mesh has pp > 1
 
     @property
     def resolved_head_dim(self) -> int:
@@ -247,15 +248,34 @@ def forward(
 
     inv_freq = rope_frequencies(cfg.resolved_head_dim, cfg.rope_theta, cfg.rope_scaling)
 
-    def layer(h, lp, window):
-        return _decoder_layer(
-            h, lp, cfg, positions, segment_ids, inv_freq, constrain, window, mesh_ctx
-        )
+    if mesh_ctx is not None and mesh_ctx.sizes["pp"] > 1:
+        from automodel_tpu.parallel.pp import pipeline_layers
 
-    h = scan_layers_windowed(
-        layer, h, params["layers"], layer_windows(cfg),
-        remat_policy=cfg.remat_policy, unroll=cfg.scan_unroll,
-    )
+        windows = layer_windows(cfg)
+        if len(set(windows)) != 1:
+            raise NotImplementedError("pp with per-layer window types")
+        seg = segment_ids if segment_ids is not None else jnp.zeros_like(positions)
+
+        def pl_layer(hh, lp, pos, sg):
+            return _decoder_layer(
+                hh, lp, cfg, pos, sg, inv_freq, lambda x, axes: x, windows[0], None
+            )
+
+        h = pipeline_layers(
+            h, positions, seg, params["layers"], pl_layer, mesh_ctx,
+            cfg.pipeline_microbatches, remat_policy=cfg.remat_policy,
+        )
+    else:
+
+        def layer(h, lp, window):
+            return _decoder_layer(
+                h, lp, cfg, positions, segment_ids, inv_freq, constrain, window, mesh_ctx
+            )
+
+        h = scan_layers_windowed(
+            layer, h, params["layers"], layer_windows(cfg),
+            remat_policy=cfg.remat_policy, unroll=cfg.scan_unroll,
+        )
 
     h = rms_norm(h, params["final_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
     if return_hidden:

@@ -1,0 +1,136 @@
+"""Pipeline parallelism: GPipe-schedule microbatch streaming over the `pp`
+mesh axis.
+
+The analog of the reference's `AutoPipeline` on torch.distributed.pipelining
+(reference: nemo_automodel/components/distributed/pipelining/
+autopipeline.py:49, functional.py:98 layer-FQN splitting, :777 schedule
+builder). TPU-native design — there is no runtime pipelining framework to
+call; the schedule is compiled:
+
+- Layer weights stay STACKED (L, ...) and shard dim 0 over `pp` (the
+  logical `layers` axis maps to the pp mesh axis), so "splitting the model
+  into stages" is a sharding annotation, not a graph surgery.
+- The whole pipeline is one `shard_map`: each stage scans its local layer
+  stack; activations hop stage→stage with `lax.ppermute` (ICI neighbor
+  traffic, the p2p `send/recv` analog); a `lax.scan` over
+  (num_microbatches + num_stages - 1) ticks realizes the GPipe schedule.
+- Backward is the transposed program — autodiff of ppermute/scan gives the
+  reverse schedule for free, with weight-grad psums over the data axes
+  inserted by shard_map's transpose.
+- Embedding / final-norm / loss run OUTSIDE the shard_map under plain GSPMD
+  (they are dp/cp-sharded elementwise-ish work).
+
+Round-1 scope: pure pp × dp (tp=1, cp=1 inside the pipeline); interleaved /
+1F1B schedules and tp-in-pipeline come later. The bubble fraction is the
+GPipe (P-1)/(M+P-1).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from automodel_tpu.distributed.mesh import MeshContext
+
+
+def pipeline_layers(
+    h: jnp.ndarray,            # (B, S, H) embedded activations (global)
+    positions: jnp.ndarray,    # (B, S) int32
+    segment_ids: jnp.ndarray,  # (B, S) int32
+    stacked_params: Any,       # layer stack, leaves (L, ...), L % pp == 0
+    layer_fn: Callable,        # (h, layer_params, positions, segment_ids) -> h
+    mesh_ctx: MeshContext,
+    num_microbatches: int,
+    batch_axes: tuple = ("dp_replicate", "dp_shard", "ep"),
+    remat_policy: str | None = "full",
+) -> jnp.ndarray:
+    """Run the stacked layers as a pp-staged pipeline; returns (B, S, H).
+
+    positions/segment_ids travel with their microbatch through the ring so
+    every stage masks with the right coordinates.
+    """
+    pp = mesh_ctx.sizes["pp"]
+    if mesh_ctx.sizes["tp"] != 1 or mesh_ctx.sizes["cp"] != 1:
+        raise NotImplementedError(
+            "pipeline parallelism currently composes with dp/ep only "
+            f"(got tp={mesh_ctx.sizes['tp']} cp={mesh_ctx.sizes['cp']})"
+        )
+    B, S, H = h.shape
+    M = num_microbatches
+    assert B % M == 0, f"batch {B} must divide into {M} microbatches"
+    L = jax.tree.leaves(stacked_params)[0].shape[0]
+    assert L % pp == 0, f"{L} layers not divisible by pp={pp}"
+
+    h_mb = h.reshape(M, B // M, S, H)
+    pos_mb = positions.reshape(M, B // M, S)
+    seg_mb = segment_ids.reshape(M, B // M, S)
+
+    def run(h_mb, pos_mb, seg_mb, params_local):
+        # inside shard_map: h_mb (M, B_loc, S, H); params leaves (L/pp, ...)
+        p_idx = lax.axis_index("pp")
+        n_stage = lax.axis_size("pp")
+        T = M + n_stage - 1
+
+        def apply_stage(x, pos, seg):
+            from automodel_tpu.models.common.layers import maybe_remat
+
+            def body(c, lp):
+                return layer_fn(c, lp, pos, seg), None
+
+            y, _ = lax.scan(maybe_remat(body, remat_policy), x, params_local)
+            return y
+
+        def tick(carry, t):
+            (act, pos, seg), outputs = carry
+            m = jnp.clip(t, 0, M - 1)
+            is_first = p_idx == 0
+            x = jnp.where(is_first, h_mb[m], act)
+            pos = jnp.where(is_first, pos_mb[m], pos)
+            seg = jnp.where(is_first, seg_mb[m], seg)
+            y = apply_stage(x, pos, seg)
+            out_idx = t - (n_stage - 1)
+            write = jnp.logical_and(out_idx >= 0, p_idx == n_stage - 1)
+            outputs = lax.cond(
+                write,
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, y, jnp.clip(out_idx, 0, M - 1), 0
+                ),
+                lambda o: o,
+                outputs,
+            )
+            perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+            stream = lax.ppermute((y, pos, seg), "pp", perm)
+            return (stream, outputs), None
+
+        init_stream = (jnp.zeros_like(h_mb[0]), pos_mb[0], seg_mb[0])
+        (_, outputs), _ = lax.scan(
+            tick, (init_stream, jnp.zeros_like(h_mb)), jnp.arange(T)
+        )
+        # only the last stage's buffer is real; make it consistent everywhere
+        outputs = lax.psum(
+            jnp.where(p_idx == n_stage - 1, outputs, jnp.zeros_like(outputs)), "pp"
+        )
+        return outputs
+
+    act_spec = P(None, batch_axes, None, None)  # (M, B, S, H)
+    tok_spec = P(None, batch_axes, None)
+    out = jax.shard_map(
+        run,
+        mesh=mesh_ctx.mesh,
+        in_specs=(act_spec, tok_spec, tok_spec, _param_specs_pp(stacked_params)),
+        out_specs=act_spec,
+        check_vma=False,
+    )(h_mb, pos_mb, seg_mb, stacked_params)
+    return out.reshape(B, S, H)
+
+
+def _param_specs_pp(stacked_params):
+    """Every stacked leaf: dim 0 on pp, everything else replicated in-map."""
+    def one(x):
+        return P(*(["pp"] + [None] * (x.ndim - 1)))
+
+    return jax.tree.map(one, stacked_params)

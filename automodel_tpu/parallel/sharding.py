@@ -54,9 +54,10 @@ DEFAULT_RULES: tuple[tuple[str, Any], ...] = (
     ("expert", "ep"),
     ("expert_embed", "dp_shard"),    # FSDP dim inside expert weights
     ("expert_mlp", "tp"),
-    ("layers", None),                # stacked-layer leading dim (scanned)
+    # stacked-layer leading dim: sharded over pp = pipeline stage splitting
+    # (a sharding annotation, not graph surgery — see parallel/pp.py)
+    ("layers", "pp"),
     ("norm", None),
-    ("stage", "pp"),                 # pipeline-stage-stacked params
 )
 
 

@@ -1,0 +1,15 @@
+from automodel_tpu.peft.lora import (
+    LoRAConfig,
+    init_lora,
+    lora_param_shardings,
+    merge_lora,
+    merged_state_dict,
+)
+
+__all__ = [
+    "LoRAConfig",
+    "init_lora",
+    "lora_param_shardings",
+    "merge_lora",
+    "merged_state_dict",
+]

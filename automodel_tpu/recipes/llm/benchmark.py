@@ -8,7 +8,6 @@ recipe's setup; the loop only times steps and reports a perf summary.
 
 from __future__ import annotations
 
-import dataclasses
 import json
 import logging
 import time
@@ -28,13 +27,9 @@ class BenchmarkRecipe(TrainFinetuneRecipeForNextTokenPrediction):
         self.cfg.set("auto_resume", False)
         if self.cfg.get("max_grad_norm", None) is None:
             self.cfg.set("max_grad_norm", None)
+        if self.cfg.get("fake_balanced_gate", True):
+            self.cfg.set("model.fake_balanced_gate", True)
         super().setup()
-        if self.is_moe and self.cfg.get("fake_balanced_gate", True):
-            self.model_cfg = dataclasses.replace(
-                self.model_cfg,
-                moe=dataclasses.replace(self.model_cfg.moe, fake_balanced_gate=True),
-            )
-            self._build_optimizer()  # rebuild jitted step with the fake gate
 
     def run_train_validation_loop(self) -> None:
         from automodel_tpu.datasets.loader import make_global_batch, stack_microbatches
@@ -53,7 +48,7 @@ class BenchmarkRecipe(TrainFinetuneRecipeForNextTokenPrediction):
             jax.block_until_ready(metrics["loss"])
             dt = time.perf_counter() - t0
             if self.step_scheduler.step > warmup:
-                times.append((dt, int(batch_np["input_ids"].size)))
+                times.append((dt, int(batch_np["input_ids"].size) * jax.process_count()))
 
         if not times:
             logger.warning("benchmark ran no timed steps")

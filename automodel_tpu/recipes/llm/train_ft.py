@@ -146,6 +146,12 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         self.model_spec = get_model_spec(hf_config)
         self.is_moe = self.model_spec.adapter_name == "moe_decoder"
         self.model_cfg = self.model_spec.config_from_hf(hf_config, **overrides)
+        if self.is_moe and cfg.get("model.fake_balanced_gate", False):
+            # benchmark conditions (reference: FakeBalancedGate, layers.py:126)
+            self.model_cfg = dataclasses.replace(
+                self.model_cfg,
+                moe=dataclasses.replace(self.model_cfg.moe, fake_balanced_gate=True),
+            )
         self._hf_config = dict(hf_config)
 
         module = self.model_spec.module
@@ -169,6 +175,29 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                 out_shardings=self.param_shardings,
             )
             params = init_fn(self.rng.next_key())
+
+        # -- PEFT / LoRA (reference: _peft/lora.py; PEFT-only checkpoints) --
+        peft_node = cfg.get("peft")
+        self.peft_cfg = None
+        self.base_params = None
+        if peft_node is not None:
+            from automodel_tpu.peft.lora import (
+                LoRAConfig,
+                init_lora,
+                lora_param_shardings,
+            )
+
+            self.peft_cfg = _dataclass_from_cfg(LoRAConfig, peft_node)
+            if "target_modules" in peft_node:
+                self.peft_cfg = dataclasses.replace(
+                    self.peft_cfg, target_modules=tuple(peft_node.get("target_modules"))
+                )
+            self.base_params = params  # frozen, outside the optimizer
+            lora = init_lora(params, self.peft_cfg, self.rng.next_key())
+            lora_sh = lora_param_shardings(lora, self.param_shardings, self.mesh_ctx)
+            params = jax.device_put(lora, lora_sh)
+            n_lora = sum(p.size for p in jax.tree.leaves(params))
+            logger.info("LoRA enabled: %d trainable adapter params", n_lora)
         self._init_params = params
 
     # ------------------------------------------------------------------
@@ -196,8 +225,14 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         mesh_ctx = self.mesh_ctx
         chunk = int(cfg.get("loss.chunk_size", 1024))
         is_moe = self.is_moe
+        peft_cfg = self.peft_cfg
 
-        def loss_fn(params, batch, rng):
+        def loss_fn(params, batch, rng, *extra):
+            if peft_cfg is not None:
+                from automodel_tpu.peft.lora import merge_lora
+
+                (base_params,) = extra
+                params = merge_lora(base_params, params, peft_cfg)
             kw = {}
             for k in ("positions", "segment_ids"):
                 if k in batch:
@@ -234,8 +269,8 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             donate_argnums=0,
         )
 
-        def eval_loss(params, batch):
-            loss_sum, aux = loss_fn(params, batch, jax.random.key(0))
+        def eval_loss(params, batch, *extra):
+            loss_sum, aux = loss_fn(params, batch, jax.random.key(0), *extra)
             return loss_sum, aux["num_label_tokens"]
 
         self._eval_step = jax.jit(eval_loss)
@@ -264,6 +299,9 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             self.val_dataloader = dl_cfg.build(val_ds)
 
     # ------------------------------------------------------------------
+    def _step_extra(self) -> tuple:
+        return (self.base_params,) if self.peft_cfg is not None else ()
+
     def _batch_spec(self) -> tuple:
         return (None, "batch", "cp")  # (accum, batch, seq)
 
@@ -273,7 +311,7 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             batch_np = stack_microbatches(microbatches)
             batch = make_global_batch(batch_np, self.mesh_ctx, self.mesh_ctx.sharding(*self._batch_spec()))
             self.train_state, metrics = self._train_step(
-                self.train_state, batch, self.rng.next_key()
+                self.train_state, batch, self.rng.next_key(), *self._step_extra()
             )
             step = self.step_scheduler.step
 
@@ -282,7 +320,8 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
 
             now = time.perf_counter()
             n_tokens = float(metrics["num_label_tokens"])
-            perf = self.mfu.metrics(int(batch_np["input_ids"].size), now - t_last)
+            global_tokens = int(batch_np["input_ids"].size) * jax.process_count()
+            perf = self.mfu.metrics(global_tokens, now - t_last)
             t_last = now
             record = {
                 "step": step,
@@ -334,7 +373,9 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             batch = make_global_batch(
                 mb, self.mesh_ctx, self.mesh_ctx.sharding("batch", "cp")
             )
-            loss_sum, n = self._eval_step(self.train_state.params, batch)
+            loss_sum, n = self._eval_step(
+                self.train_state.params, batch, *self._step_extra()
+            )
             total += float(loss_sum)
             count += float(n)
         val_loss = total / max(count, 1.0)
@@ -350,7 +391,14 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             self.model_spec.adapter_name, self.model_cfg,
             **self.model_spec.adapter_kwargs,
         )
-        params = jax.device_get(self.train_state.params)
+        if self.peft_cfg is not None:
+            from automodel_tpu.peft.lora import merged_state_dict
+
+            params = merged_state_dict(
+                self.base_params, self.train_state.params, self.peft_cfg
+            )
+        else:
+            params = jax.device_get(self.train_state.params)
         save_hf_checkpoint(adapter.to_hf(params), out_dir, hf_config=self._hf_config)
         logger.info("consolidated HF checkpoint written to %s", out_dir)
         return out_dir

@@ -61,21 +61,21 @@ def make_train_step(
     """
     config = config or TrainStepConfig()
 
-    def grad_one(params, mb, rng):
+    def grad_one(params, mb, rng, *extra):
         (ce, aux), grads = jax.value_and_grad(
-            lambda p: loss_fn(p, mb, rng), has_aux=True
+            lambda p: loss_fn(p, mb, rng, *extra), has_aux=True
         )(params)
         if not isinstance(aux, dict):
             aux = {"num_label_tokens": aux}
         return grads, ce, aux
 
-    def train_step(state: TrainState, batch, rng):
+    def train_step(state: TrainState, batch, rng, *extra):
         accum = jax.tree.leaves(batch)[0].shape[0]
 
         def micro(carry, xs):
             idx, mb = xs
             g_acc, ce_acc, aux_acc = carry
-            g, ce, aux = grad_one(state.params, mb, jax.random.fold_in(rng, idx))
+            g, ce, aux = grad_one(state.params, mb, jax.random.fold_in(rng, idx), *extra)
             return (
                 jax.tree.map(jnp.add, g_acc, g),
                 ce_acc + ce,
@@ -85,7 +85,7 @@ def make_train_step(
         zero_grads = jax.tree.map(jnp.zeros_like, state.params)
         # shape-only probe for the aux accumulator structure (no compute)
         _, _, aux_shapes = jax.eval_shape(
-            grad_one, state.params, jax.tree.map(lambda x: x[0], batch), rng
+            grad_one, state.params, jax.tree.map(lambda x: x[0], batch), rng, *extra
         )
         aux0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), aux_shapes)
         (grads, ce_sum, aux_sum), _ = jax.lax.scan(

@@ -90,3 +90,31 @@ def test_optimizer_no_decay_on_norms():
     up2, _ = tx2.update(grads, st2, params)
     assert float(jnp.abs(up2["w"]).sum()) > 0
     assert float(jnp.abs(up2["norm"]["scale"]).sum()) == 0
+
+
+def test_lora_merge_math():
+    import jax
+    import jax.numpy as jnp
+    from automodel_tpu.peft.lora import LoRAConfig, init_lora, merge_lora
+
+    base = {"layers": {"q_proj": {"kernel": jnp.ones((2, 8, 4))},
+                       "down_proj": {"kernel": jnp.ones((2, 4, 8))}}}
+    cfg = LoRAConfig(r=2, alpha=4.0, target_modules=("q_proj",))
+    lora = init_lora(base, cfg, jax.random.key(0))
+    assert list(lora) == ["layers/q_proj/kernel"]
+    # b starts zero → merged == base
+    merged = merge_lora(base, lora, cfg)
+    np.testing.assert_array_equal(
+        np.asarray(merged["layers"]["q_proj"]["kernel"]), 1.0
+    )
+    # nonzero b → delta = scale * a@b
+    lora["layers/q_proj/kernel"]["b"] = jnp.ones((2, 2, 4))
+    merged = merge_lora(base, lora, cfg)
+    a = lora["layers/q_proj/kernel"]["a"]
+    expect = 1.0 + 2.0 * np.asarray(a).sum(-1, keepdims=True).repeat(4, -1)
+    np.testing.assert_allclose(
+        np.asarray(merged["layers"]["q_proj"]["kernel"]), expect, rtol=1e-5
+    )
+    np.testing.assert_array_equal(
+        np.asarray(merged["layers"]["down_proj"]["kernel"]), 1.0
+    )

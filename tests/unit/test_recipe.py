@@ -173,3 +173,47 @@ def test_dataloader_mid_epoch_resume_no_replay(tmp_path):
     dl2.set_epoch(0)  # what StepScheduler does on resume — must NOT rewind
     second = next(iter(dl2))["input_ids"]
     assert not np.array_equal(first, second)
+
+
+def test_recipe_lora_peft(tmp_path):
+    cfg = _smoke_cfg(tmp_path)
+    cfg.set("peft", {"r": 4, "alpha": 8.0, "target_modules": ["q_proj", "v_proj"]})
+    recipe = resolve_recipe_class(cfg)(cfg)
+    recipe.setup()
+    # trainable = lora only; base frozen outside optimizer
+    n_train = sum(p.size for p in jax.tree.leaves(recipe.train_state.params))
+    n_base = sum(p.size for p in jax.tree.leaves(recipe.base_params))
+    assert n_train < n_base / 10
+    base_before = jax.tree.map(lambda x: np.asarray(x).copy(), recipe.base_params)
+    recipe.run_train_validation_loop()
+    # base untouched, adapters moved
+    for a, b in zip(jax.tree.leaves(base_before), jax.tree.leaves(recipe.base_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    b_leaves = [
+        v["b"] for v in recipe.train_state.params.values()
+    ]
+    assert any(float(np.abs(np.asarray(b)).sum()) > 0 for b in b_leaves)
+    import json as _json
+
+    recs = [_json.loads(l) for l in open(tmp_path / "training.jsonl")]
+    assert recs[-1]["step"] == 4 and np.isfinite(recs[-1]["loss"])
+
+
+def test_benchmark_recipe_moe_fake_gate(tmp_path):
+    cfg = _smoke_cfg(tmp_path, recipe="llm_benchmark")
+    cfg.set("model.hf_config", {
+        "architectures": ["Qwen3MoeForCausalLM"],
+        "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "num_key_value_heads": 2, "num_experts": 4, "num_experts_per_tok": 2,
+        "moe_intermediate_size": 16,
+    })
+    cfg.set("benchmark.warmup_steps", 1)
+    r = resolve_recipe_class(cfg)(cfg)
+    r.setup()
+    assert r.model_cfg.moe.fake_balanced_gate  # benchmark conditions active
+    r.run_train_validation_loop()
+    import json as _json
+
+    recs = [_json.loads(l) for l in open(tmp_path / "training.jsonl")]
+    assert recs[-1]["metric"] == "benchmark_step_seconds"
