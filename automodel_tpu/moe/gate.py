@@ -78,7 +78,11 @@ def gate_forward(
         # (reference: models/common/utils.py:185-191).
         base = (jnp.arange(T)[:, None] * K + jnp.arange(K)[None, :]) % E
         weights = jnp.full((T, K), 1.0 / K, jnp.float32)
-        return weights, base.astype(jnp.int32), jnp.float32(0.0), {}
+        stats = {
+            "tokens_per_expert": jax.nn.one_hot(base, E, dtype=jnp.float32).sum((0, 1)),
+            "mean_prob": jnp.full((E,), 1.0 / E, jnp.float32),
+        }
+        return weights, base.astype(jnp.int32), jnp.float32(0.0), stats
 
     logits = x.astype(jnp.float32) @ params["weight"].astype(jnp.float32)  # (T, E)
     if cfg.score_func == "softmax":
