@@ -36,6 +36,37 @@ def _t(x: np.ndarray) -> np.ndarray:
     return np.asarray(x).T
 
 
+def _rope_perm(dr: int, inverse: bool) -> np.ndarray:
+    """DeepSeek checkpoints store rope dims in interleaved pair order
+    ((0,1),(2,3),…) while this framework rotates the llama half-split way
+    ([evens…, odds…]); permute the weight COLUMNS once at load/export so
+    runtime rotation needs no de-interleave (the vLLM approach)."""
+    half = dr // 2
+    deinter = np.concatenate([np.arange(0, dr, 2), np.arange(1, dr, 2)])
+    if not inverse:
+        return deinter
+    inv = np.empty(dr, np.int64)
+    inv[deinter] = np.arange(dr)
+    return inv
+
+
+def _permute_q_rope(kernel: np.ndarray, n_heads: int, dn: int, dr: int, inverse: bool) -> np.ndarray:
+    """kernel (…, in, n_heads*(dn+dr)): permute each head's rope columns."""
+    *lead, fan_in, out = kernel.shape
+    k = kernel.reshape(*lead, fan_in, n_heads, dn + dr)
+    perm = _rope_perm(dr, inverse)
+    rope = k[..., dn:][..., perm]
+    k = np.concatenate([k[..., :dn], rope], axis=-1)
+    return k.reshape(*lead, fan_in, out)
+
+
+def _permute_k_rope(kernel: np.ndarray, kv_rank: int, dr: int, inverse: bool) -> np.ndarray:
+    """kv_down kernel (…, in, kv_rank+dr): permute the trailing rope cols."""
+    perm = _rope_perm(dr, inverse)
+    rope = kernel[..., kv_rank:][..., perm]
+    return np.concatenate([kernel[..., :kv_rank], rope], axis=-1)
+
+
 @dataclasses.dataclass
 class DenseDecoderAdapter:
     """llama/mistral/qwen2/qwen3/gemma2 ↔ models/llm/decoder params."""
@@ -46,6 +77,8 @@ class DenseDecoderAdapter:
     def _layer_entries(self) -> list[tuple[str, tuple, bool]]:
         """(hf_suffix, param_path, transpose) per layer."""
         cfg = self.cfg
+        if getattr(cfg, "attention_type", "gqa") == "mla":
+            return self._mla_layer_entries()
         e = [
             ("self_attn.q_proj.weight", ("q_proj", "kernel"), True),
             ("self_attn.k_proj.weight", ("k_proj", "kernel"), True),
@@ -76,7 +109,34 @@ class DenseDecoderAdapter:
                 ("self_attn.q_norm.weight", ("q_norm", "scale"), False),
                 ("self_attn.k_norm.weight", ("k_norm", "scale"), False),
             ]
-        return e
+        return [entry if len(entry) == 4 else (*entry, None) for entry in e]
+
+    def _mla_layer_entries(self) -> list[tuple[str, tuple, bool]]:
+        cfg = self.cfg
+        e = [
+            ("input_layernorm.weight", ("input_norm", "scale"), False),
+            ("post_attention_layernorm.weight", ("post_attn_norm", "scale"), False),
+            ("self_attn.kv_a_proj_with_mqa.weight", ("kv_down_proj", "kernel"), True, "k_rope"),
+            ("self_attn.kv_a_layernorm.weight", ("kv_norm", "scale"), False),
+            ("self_attn.kv_b_proj.weight", ("kv_up_proj", "kernel"), True),
+            ("self_attn.o_proj.weight", ("o_proj", "kernel"), True),
+        ]
+        if cfg.mla_q_lora_rank:
+            e += [
+                ("self_attn.q_a_proj.weight", ("q_down_proj", "kernel"), True),
+                ("self_attn.q_a_layernorm.weight", ("q_norm", "scale"), False),
+                ("self_attn.q_b_proj.weight", ("q_up_proj", "kernel"), True, "q_rope"),
+            ]
+        else:
+            e.append(("self_attn.q_proj.weight", ("q_proj", "kernel"), True, "q_rope"))
+        # note: MLA models pair with the MoE adapter; MLP entries come from
+        # the dense path only for the first-k dense layers
+        e += [
+            ("mlp.gate_proj.weight", ("gate_proj", "kernel"), True),
+            ("mlp.up_proj.weight", ("up_proj", "kernel"), True),
+            ("mlp.down_proj.weight", ("down_proj", "kernel"), True),
+        ]
+        return [entry if len(entry) == 4 else (*entry, None) for entry in e]
 
     def _top_entries(self) -> list[tuple[str, tuple, bool]]:
         e = [
@@ -85,18 +145,35 @@ class DenseDecoderAdapter:
         ]
         if not self.cfg.tie_word_embeddings:
             e.append(("lm_head.weight", ("lm_head", "kernel"), True))
-        return e
+        return [(*entry, None) for entry in e]
+
+    def _transform(self, x: np.ndarray, tname: str | None, inverse: bool) -> np.ndarray:
+        """Named weight transforms (rope layout permutations; see _rope_perm)."""
+        if tname is None:
+            return x
+        cfg = self.cfg
+        if tname == "q_rope":
+            return _permute_q_rope(
+                x, cfg.num_heads, cfg.mla_qk_nope_head_dim, cfg.mla_qk_rope_head_dim, inverse
+            )
+        if tname == "k_rope":
+            return _permute_k_rope(
+                x, cfg.mla_kv_lora_rank, cfg.mla_qk_rope_head_dim, inverse
+            )
+        raise KeyError(tname)
 
     # -- export --------------------------------------------------------------
     def to_hf(self, params: Mapping) -> Iterator[tuple[str, np.ndarray]]:
         """Yield (hf_name, tensor) — layer-stacked params are unstacked."""
-        for name, path, transpose in self._top_entries():
+        for name, path, transpose, tr in self._top_entries():
             x = np.asarray(_get(params, path))
+            x = self._transform(x, tr, inverse=True)
             yield name, (_t(x) if transpose else x)
         layers = params["layers"]
         for i in range(self.cfg.num_layers):
-            for suffix, path, transpose in self._layer_entries():
+            for suffix, path, transpose, tr in self._layer_entries():
                 x = np.asarray(_get(layers, path)[i])
+                x = self._transform(x, tr, inverse=True)
                 yield f"model.layers.{i}.{suffix}", (_t(x) if transpose else x)
 
     # -- import --------------------------------------------------------------
@@ -109,15 +186,16 @@ class DenseDecoderAdapter:
             sh = _get(shardings, path) if shardings is not None else None
             _set(out, path, jax.device_put(value, sh) if sh is not None else value)
 
-        for name, path, transpose in self._top_entries():
-            x = read(name)
-            put(path, _t(x) if transpose else np.asarray(x))
-        for suffix, path, transpose in self._layer_entries():
+        def one(name, transpose, tr):
+            x = _t(read(name)) if transpose else np.asarray(read(name))
+            return self._transform(x, tr, inverse=False)
+
+        for name, path, transpose, tr in self._top_entries():
+            put(path, one(name, transpose, tr))
+        for suffix, path, transpose, tr in self._layer_entries():
             stacked = np.stack(
                 [
-                    _t(read(f"model.layers.{i}.{suffix}"))
-                    if transpose
-                    else np.asarray(read(f"model.layers.{i}.{suffix}"))
+                    one(f"model.layers.{i}.{suffix}", transpose, tr)
                     for i in range(self.cfg.num_layers)
                 ]
             )
@@ -156,28 +234,34 @@ class MoEDecoderAdapter:
         return DenseDecoderAdapter(self.cfg)
 
     def _attn_entries(self):
+        mlp_keys = ("gate_proj", "up_proj", "down_proj")
         return [
-            (s, p, t)
-            for (s, p, t) in self._dense()._layer_entries()
-            if not p[0].endswith("_proj") or p[0] in ("q_proj", "k_proj", "v_proj", "o_proj")
+            entry
+            for entry in self._dense()._layer_entries()
+            if entry[1][0] not in mlp_keys
         ]
 
     def to_hf(self, params: Mapping) -> Iterator[tuple[str, np.ndarray]]:
         cfg = self.cfg
-        for name, path, transpose in self._dense()._top_entries():
-            x = np.asarray(_get(params, path))
+        dense = self._dense()
+        for name, path, transpose, tr in dense._top_entries():
+            x = dense._transform(np.asarray(_get(params, path)), tr, inverse=True)
             yield name, (_t(x) if transpose else x)
         fk = cfg.first_k_dense
         if fk:
             for i in range(fk):
-                for suffix, path, transpose in self._dense()._layer_entries():
-                    x = np.asarray(_get(params["dense_layers"], path)[i])
+                for suffix, path, transpose, tr in dense._layer_entries():
+                    x = dense._transform(
+                        np.asarray(_get(params["dense_layers"], path)[i]), tr, inverse=True
+                    )
                     yield f"model.layers.{i}.{suffix}", (_t(x) if transpose else x)
         moe_layers = params["moe_layers"]
         for li in range(cfg.num_moe_layers):
             i = fk + li
-            for suffix, path, transpose in self._attn_entries():
-                x = np.asarray(_get(moe_layers, path)[li])
+            for suffix, path, transpose, tr in self._attn_entries():
+                x = dense._transform(
+                    np.asarray(_get(moe_layers, path)[li]), tr, inverse=True
+                )
                 yield f"model.layers.{i}.{suffix}", (_t(x) if transpose else x)
             moe = moe_layers["moe"]
             yield self._gate_name(i), _t(np.asarray(moe["gate"]["weight"][li]))
@@ -202,25 +286,25 @@ class MoEDecoderAdapter:
             sh = _get(shardings, path) if shardings is not None else None
             _set(out, path, jax.device_put(value, sh) if sh is not None else value)
 
-        for name, path, transpose in self._dense()._top_entries():
-            x = read(name)
-            put(path, _t(x) if transpose else np.asarray(x))
+        dense = self._dense()
+
+        def one(name, transpose, tr):
+            x = _t(read(name)) if transpose else np.asarray(read(name))
+            return dense._transform(x, tr, inverse=False)
+
+        for name, path, transpose, tr in dense._top_entries():
+            put(path, one(name, transpose, tr))
         fk = cfg.first_k_dense
         if fk:
-            for suffix, path, transpose in self._dense()._layer_entries():
+            for suffix, path, transpose, tr in dense._layer_entries():
                 stacked = np.stack(
-                    [
-                        _t(read(f"model.layers.{i}.{suffix}")) if transpose
-                        else np.asarray(read(f"model.layers.{i}.{suffix}"))
-                        for i in range(fk)
-                    ]
+                    [one(f"model.layers.{i}.{suffix}", transpose, tr) for i in range(fk)]
                 )
                 put(("dense_layers",) + path, stacked)
-        for suffix, path, transpose in self._attn_entries():
+        for suffix, path, transpose, tr in self._attn_entries():
             stacked = np.stack(
                 [
-                    _t(read(f"model.layers.{fk + li}.{suffix}")) if transpose
-                    else np.asarray(read(f"model.layers.{fk + li}.{suffix}"))
+                    one(f"model.layers.{fk + li}.{suffix}", transpose, tr)
                     for li in range(cfg.num_moe_layers)
                 ]
             )

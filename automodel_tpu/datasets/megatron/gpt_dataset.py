@@ -1,0 +1,99 @@
+"""Memory-mapped token-stream pretraining dataset with native index maps.
+
+The analog of the reference's Megatron GPT pretraining dataset + nanogpt
+bin shards (reference: nemo_automodel/components/datasets/llm/
+megatron_dataset.py:554, nanogpt_dataset.py:481). Layout on disk:
+
+    <prefix>.bin          flat token stream (uint16 or int32, memmapped)
+    <prefix>.doclens.npy  optional int32 per-document token counts
+
+Per epoch: documents are shuffled (native Fisher–Yates), the contiguous
+(seq_len+1)-token sample map is built natively (index_helpers.cpp), and the
+sample order is shuffled — deterministic in (seed, epoch), resumable by
+sample index.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import numpy as np
+
+from automodel_tpu.datasets.megatron.index_helpers import (
+    build_sample_index,
+    build_shuffle_index,
+)
+
+
+@dataclasses.dataclass
+class TokenBinDatasetConfig:
+    prefix: str = ""
+    seq_len: int = 2048
+    seed: int = 0
+    dtype: str = "uint16"
+
+    def build(self) -> "TokenBinDataset":
+        return TokenBinDataset(self)
+
+
+class TokenBinDataset:
+    def __init__(self, config: TokenBinDatasetConfig, epoch: int = 0):
+        self.config = config
+        self.tokens = np.memmap(config.prefix + ".bin", dtype=config.dtype, mode="r")
+        doclens_path = config.prefix + ".doclens.npy"
+        if os.path.exists(doclens_path):
+            self.doc_lens = np.load(doclens_path).astype(np.int32)
+        else:
+            self.doc_lens = np.asarray([len(self.tokens)], np.int32)
+        assert int(self.doc_lens.sum()) == len(self.tokens), "doclens != stream length"
+        self._epoch = None
+        self.set_epoch(epoch)
+
+    def set_epoch(self, epoch: int) -> None:
+        if epoch == self._epoch:
+            return
+        self._epoch = epoch
+        c = self.config
+        seed = c.seed * 1000003 + epoch
+        # document order, sample map, and sample order — all native builders
+        self.doc_order = build_shuffle_index(len(self.doc_lens), seed)
+        self.shuffled_lens = self.doc_lens[self.doc_order]
+        shuffled_lens = self.shuffled_lens
+        total_tokens = int(self.doc_lens.sum())
+        max_samples = max((total_tokens - 1) // c.seq_len, 0)
+        self.sample_idx = build_sample_index(shuffled_lens, c.seq_len, max_samples)
+        self.sample_order = build_shuffle_index(len(self.sample_idx) - 1, seed + 1)
+        # token offsets of each (shuffled) document in the original stream
+        starts = np.zeros(len(self.doc_lens) + 1, np.int64)
+        np.cumsum(self.doc_lens, out=starts[1:])
+        self.doc_starts = starts[self.doc_order]
+
+    def __len__(self) -> int:
+        return len(self.sample_order)
+
+    def _gather(self, row: int) -> np.ndarray:
+        """Tokens for sample `row` of the shuffled map: may span documents."""
+        c = self.config
+        doc0, off0 = self.sample_idx[row]
+        need = c.seq_len + 1
+        out = np.empty((need,), np.int64)
+        got = 0
+        d, off = int(doc0), int(off0)
+        lens = self.shuffled_lens
+        while got < need:
+            take = min(int(lens[d]) - off, need - got)
+            s = int(self.doc_starts[d]) + off
+            out[got : got + take] = self.tokens[s : s + take]
+            got += take
+            d += 1
+            off = 0
+        return out
+
+    def __getitem__(self, idx: int) -> dict:
+        row = int(self.sample_order[idx])
+        tokens = self._gather(row)
+        return {
+            "input_ids": tokens[:-1].astype(np.int32),
+            "labels": tokens[1:].astype(np.int32),
+        }

@@ -1,0 +1,135 @@
+"""ctypes bindings for the native index-map builders (index_helpers.cpp).
+
+The analog of the reference's pybind11 `helpers_cpp` module
+(reference: nemo_automodel/components/datasets/llm/megatron/helpers.cpp +
+Makefile). The shared library builds on first use with g++ (no pybind11 in
+the image — plain C ABI via ctypes), with a pure-numpy fallback when no
+compiler is available so CI never hard-fails on toolchain differences.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "index_helpers.cpp")
+# "lib" prefix keeps the artifact out of Python's extension-module
+# import candidates (a bare index_helpers.so would shadow this .py file)
+_SO = os.path.join(_DIR, "libindex_helpers.so")
+
+_lib = None
+_tried = False
+
+
+def _load():
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    try:
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            # build to a temp path + atomic rename so concurrent dataloader
+            # workers never dlopen a half-written file
+            tmp = f"{_SO}.{os.getpid()}.tmp"
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
+                check=True,
+                capture_output=True,
+            )
+            os.replace(tmp, _SO)
+        lib = ctypes.CDLL(_SO)
+        lib.am_build_sample_index.restype = ctypes.c_int64
+        lib.am_build_shuffle_index.restype = ctypes.c_int64
+        lib.am_build_blending_indices.restype = ctypes.c_int64
+        _lib = lib
+    except (subprocess.CalledProcessError, FileNotFoundError, OSError) as e:
+        logger.warning("native index helpers unavailable (%s); numpy fallback", e)
+        _lib = None
+    return _lib
+
+
+def _ptr(a, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def build_sample_index(doc_lens: np.ndarray, seq_len: int, num_samples: int) -> np.ndarray:
+    """(num_samples+1, 2) rows of (doc_idx, token_offset); see .cpp."""
+    doc_lens = np.ascontiguousarray(doc_lens, np.int32)
+    out = np.zeros(((num_samples + 1) * 2,), np.int64)
+    lib = _load()
+    if lib is not None:
+        n = lib.am_build_sample_index(
+            _ptr(doc_lens, ctypes.c_int32),
+            ctypes.c_int64(len(doc_lens)),
+            ctypes.c_int64(seq_len),
+            ctypes.c_int64(num_samples),
+            _ptr(out, ctypes.c_int64),
+        )
+        if n < 0:
+            raise ValueError("am_build_sample_index failed")
+        return out.reshape(num_samples + 1, 2)[: n + 1]
+    # numpy fallback (slow; reference semantics)
+    rows = [(0, 0)]
+    doc, offset = 0, 0
+    for _ in range(num_samples):
+        remaining = seq_len + 1
+        while remaining > 0:
+            if doc >= len(doc_lens):
+                return np.asarray(rows, np.int64)
+            avail = int(doc_lens[doc]) - offset
+            if avail > remaining:
+                offset += remaining
+                remaining = 0
+            else:
+                remaining -= avail
+                doc += 1
+                offset = 0
+        rows.append((doc, offset))
+    return np.asarray(rows, np.int64)
+
+
+def build_shuffle_index(n: int, seed: int) -> np.ndarray:
+    out = np.zeros((n,), np.int64)
+    lib = _load()
+    if lib is not None:
+        r = lib.am_build_shuffle_index(
+            ctypes.c_int64(n), ctypes.c_uint64(seed), _ptr(out, ctypes.c_int64)
+        )
+        if r < 0:
+            raise ValueError("am_build_shuffle_index failed")
+        return out
+    rng = np.random.default_rng(seed)
+    return rng.permutation(n).astype(np.int64)
+
+
+def build_blending_indices(weights: np.ndarray, n: int) -> tuple[np.ndarray, np.ndarray]:
+    weights = np.ascontiguousarray(weights, np.float64)
+    ds_index = np.zeros((n,), np.int32)
+    ds_sample = np.zeros((n,), np.int64)
+    lib = _load()
+    if lib is not None:
+        r = lib.am_build_blending_indices(
+            _ptr(weights, ctypes.c_double),
+            ctypes.c_int64(len(weights)),
+            ctypes.c_int64(n),
+            _ptr(ds_index, ctypes.c_int32),
+            _ptr(ds_sample, ctypes.c_int64),
+        )
+        if r < 0:
+            raise ValueError("am_build_blending_indices failed")
+        return ds_index, ds_sample
+    counts = np.zeros(len(weights), np.int64)
+    for i in range(n):
+        deficit = weights * (i + 1) - counts
+        d = int(np.argmax(deficit))
+        ds_index[i] = d
+        ds_sample[i] = counts[d]
+        counts[d] += 1
+    return ds_index, ds_sample

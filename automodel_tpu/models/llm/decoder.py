@@ -60,6 +60,13 @@ class TransformerConfig:
     tie_word_embeddings: bool = False
     activation: str = "silu"
     zero_centered_norm: bool = False  # gemma stores scale-1
+    # attention flavor: "gqa" (default) or "mla" (DeepSeek latent attention)
+    attention_type: str = "gqa"
+    mla_q_lora_rank: Optional[int] = None
+    mla_kv_lora_rank: int = 512
+    mla_qk_nope_head_dim: int = 128
+    mla_qk_rope_head_dim: int = 64
+    mla_v_head_dim: int = 128
     # execution knobs
     dtype: Any = jnp.bfloat16
     remat_policy: str = "full"
@@ -69,7 +76,35 @@ class TransformerConfig:
 
     @property
     def resolved_head_dim(self) -> int:
+        if self.attention_type == "mla":
+            return self.mla_qk_nope_head_dim + self.mla_qk_rope_head_dim
         return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def rope_dim(self) -> int:
+        if self.attention_type == "mla":
+            return self.mla_qk_rope_head_dim
+        return self.resolved_head_dim
+
+    def attn_params_per_layer(self) -> int:
+        """Projection parameter count of one attention block."""
+        H = self.hidden_size
+        if self.attention_type == "mla":
+            dn, dr, dv = (
+                self.mla_qk_nope_head_dim,
+                self.mla_qk_rope_head_dim,
+                self.mla_v_head_dim,
+            )
+            n = self.num_heads
+            q = (
+                H * self.mla_q_lora_rank + self.mla_q_lora_rank * n * (dn + dr)
+                if self.mla_q_lora_rank
+                else H * n * (dn + dr)
+            )
+            kv = H * (self.mla_kv_lora_rank + dr) + self.mla_kv_lora_rank * n * (dn + dv)
+            return q + kv + n * dv * H
+        D = self.resolved_head_dim
+        return H * (self.num_heads + 2 * self.num_kv_heads) * D + self.num_heads * D * H
 
     def flops_per_token(self, seq_len: int) -> float:
         """Training FLOPs/token (fwd+bwd ≈ 6*N + attention term) for MFU."""
@@ -78,8 +113,7 @@ class TransformerConfig:
             self.vocab_size * self.hidden_size * (1 if self.tie_word_embeddings else 2)
             + self.num_layers
             * (
-                self.hidden_size * (self.num_heads + 2 * self.num_kv_heads) * D
-                + self.num_heads * D * self.hidden_size
+                self.attn_params_per_layer()
                 + 3 * self.hidden_size * self.intermediate_size
             )
         )
@@ -118,6 +152,10 @@ def _stack(init_fn, key, shape, L):
 
 def init_attention_layers(cfg: TransformerConfig, rng: jax.Array, L: int) -> dict:
     """Attention + norms portion of a layer stack (shared with MoE models)."""
+    if cfg.attention_type == "mla":
+        from automodel_tpu.models.llm.mla import init_mla_layers
+
+        return init_mla_layers(cfg, rng, L)
     D = cfg.resolved_head_dim
     H = cfg.hidden_size
     ks = jax.random.split(rng, 4)
@@ -143,6 +181,10 @@ def init_attention_layers(cfg: TransformerConfig, rng: jax.Array, L: int) -> dic
 
 
 def attention_layer_specs(cfg: TransformerConfig) -> dict:
+    if cfg.attention_type == "mla":
+        from automodel_tpu.models.llm.mla import mla_layer_specs
+
+        return mla_layer_specs(cfg)
     layers = {
         "input_norm": {"scale": ("layers", "norm")},
         "q_proj": {"kernel": ("layers", "embed", "heads")},
@@ -246,7 +288,7 @@ def forward(
         h = h * jnp.asarray(cfg.embed_scale, cfg_dtype)
     h = constrain(h, ("act_batch", "act_seq", "act_embed"))
 
-    inv_freq = rope_frequencies(cfg.resolved_head_dim, cfg.rope_theta, cfg.rope_scaling)
+    inv_freq = rope_frequencies(cfg.rope_dim, cfg.rope_theta, cfg.rope_scaling)
 
     if mesh_ctx is not None and mesh_ctx.sizes["pp"] > 1:
         from automodel_tpu.parallel.pp import pipeline_layers
@@ -302,6 +344,12 @@ def attention_block(h, lp, cfg: TransformerConfig, positions, segment_ids, inv_f
     as ring attention over the cp axis (parallel/cp.py); otherwise the
     backend dispatcher in ops/attention.py picks flash (TPU) or XLA.
     """
+    if cfg.attention_type == "mla":
+        from automodel_tpu.models.llm.mla import mla_attention_block
+
+        return mla_attention_block(
+            h, lp, cfg, positions, segment_ids, inv_freq, constrain, sliding_window, mesh_ctx
+        )
     D = cfg.resolved_head_dim
     B, S, _ = h.shape
 

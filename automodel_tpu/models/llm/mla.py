@@ -1,0 +1,141 @@
+"""Multi-head Latent Attention (MLA) — the DeepSeek V2/V3 attention.
+
+The analog of the reference's MLA implementation inside
+nemo_automodel/components/models/deepseek_v3/model.py:45-263 (Block / MLA
+layers) — queries and keys/values are projected through low-rank latents;
+RoPE applies to a small per-head rope slice plus ONE shared key-rope head:
+
+    q = W_uq · rmsnorm(W_dq · x)            (or a direct W_q when no q rank)
+    [c_kv ; k_rope] = W_dkv · x             (kv_lora_rank + qk_rope_head_dim)
+    [k_nope ; v] = W_ukv · rmsnorm(c_kv)
+    per head:  q = [q_nope ; rope(q_rope)],  k = [k_nope ; rope(k_rope)]
+
+Attention logits use head_dim = qk_nope + qk_rope while values use
+v_head_dim — the XLA attention path handles the asymmetric dims natively
+(a dedicated Pallas MLA kernel is a later-round optimization; the
+reference's TileLang sparse-MLA kernels map to that slot).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.llm.decoder import _dense
+from automodel_tpu.ops.attention import dot_product_attention
+from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.rope import apply_rope
+
+
+def init_mla_layers(cfg, rng: jax.Array, L: int) -> dict:
+    """MLA attention params for a stacked layer block (cfg: TransformerConfig
+    with mla_* fields set)."""
+    from automodel_tpu.models.llm.decoder import _stack
+    from automodel_tpu.models.common.layers import dense_init
+
+    H = cfg.hidden_size
+    n = cfg.num_heads
+    qk = cfg.mla_qk_nope_head_dim + cfg.mla_qk_rope_head_dim
+    ks = jax.random.split(rng, 6)
+    layers: dict = {
+        "input_norm": {"scale": jnp.ones((L, H))},
+        "post_attn_norm": {"scale": jnp.ones((L, H))},
+        "kv_down_proj": {
+            "kernel": _stack(
+                dense_init, ks[0], (H, cfg.mla_kv_lora_rank + cfg.mla_qk_rope_head_dim), L
+            )
+        },
+        "kv_norm": {"scale": jnp.ones((L, cfg.mla_kv_lora_rank))},
+        "kv_up_proj": {
+            "kernel": _stack(
+                dense_init, ks[1],
+                (cfg.mla_kv_lora_rank, n * (cfg.mla_qk_nope_head_dim + cfg.mla_v_head_dim)),
+                L,
+            )
+        },
+        "o_proj": {"kernel": _stack(dense_init, ks[2], (n * cfg.mla_v_head_dim, H), L)},
+    }
+    if cfg.mla_q_lora_rank:
+        layers["q_down_proj"] = {"kernel": _stack(dense_init, ks[3], (H, cfg.mla_q_lora_rank), L)}
+        layers["q_norm"] = {"scale": jnp.ones((L, cfg.mla_q_lora_rank))}
+        layers["q_up_proj"] = {
+            "kernel": _stack(dense_init, ks[4], (cfg.mla_q_lora_rank, n * qk), L)
+        }
+    else:
+        layers["q_proj"] = {"kernel": _stack(dense_init, ks[5], (H, n * qk), L)}
+    return layers
+
+
+def mla_layer_specs(cfg) -> dict:
+    layers = {
+        "input_norm": {"scale": ("layers", "norm")},
+        "post_attn_norm": {"scale": ("layers", "norm")},
+        "kv_down_proj": {"kernel": ("layers", "embed", None)},  # latent: replicated
+        "kv_norm": {"scale": ("layers", "norm")},
+        "kv_up_proj": {"kernel": ("layers", None, "heads")},
+        "o_proj": {"kernel": ("layers", "heads", "embed")},
+    }
+    if cfg.mla_q_lora_rank:
+        layers["q_down_proj"] = {"kernel": ("layers", "embed", None)}
+        layers["q_norm"] = {"scale": ("layers", "norm")}
+        layers["q_up_proj"] = {"kernel": ("layers", None, "heads")}
+    else:
+        layers["q_proj"] = {"kernel": ("layers", "embed", "heads")}
+    return layers
+
+
+def mla_attention_block(h, lp, cfg, positions, segment_ids, inv_freq, constrain, sliding_window, mesh_ctx=None):
+    """Pre-norm MLA attention with residual (drop-in for attention_block)."""
+    B, S, H = h.shape
+    n = cfg.num_heads
+    dn, dr, dv = cfg.mla_qk_nope_head_dim, cfg.mla_qk_rope_head_dim, cfg.mla_v_head_dim
+
+    x = rms_norm(h, lp["input_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
+
+    if cfg.mla_q_lora_rank:
+        q_lat = rms_norm(x @ lp["q_down_proj"]["kernel"], lp["q_norm"]["scale"], cfg.rms_norm_eps)
+        q = q_lat @ lp["q_up_proj"]["kernel"]
+    else:
+        q = x @ lp["q_proj"]["kernel"]
+    q = q.reshape(B, S, n, dn + dr)
+    q_nope, q_rope = q[..., :dn], q[..., dn:]
+    q_rope = apply_rope(q_rope, positions, inv_freq)
+    q = jnp.concatenate([q_nope, q_rope], axis=-1)
+    q = constrain(q, ("act_batch", "act_seq", "act_heads", None))
+
+    kv = x @ lp["kv_down_proj"]["kernel"]  # (B,S, kv_rank + dr)
+    c_kv, k_rope = kv[..., : cfg.mla_kv_lora_rank], kv[..., cfg.mla_kv_lora_rank :]
+    # shared single-head key rope, broadcast across heads after rotation
+    k_rope = apply_rope(k_rope[:, :, None, :], positions, inv_freq)
+    c_kv = rms_norm(c_kv, lp["kv_norm"]["scale"], cfg.rms_norm_eps)
+    kv_up = (c_kv @ lp["kv_up_proj"]["kernel"]).reshape(B, S, n, dn + dv)
+    k_nope, v = kv_up[..., :dn], kv_up[..., dn:]
+    k = jnp.concatenate([k_nope, jnp.broadcast_to(k_rope, (B, S, n, dr))], axis=-1)
+    k = constrain(k, ("act_batch", "act_seq", "act_heads", None))
+    v = constrain(v, ("act_batch", "act_seq", "act_heads", None))
+
+    scale = cfg.attn_scale if cfg.attn_scale is not None else (dn + dr) ** -0.5
+    if mesh_ctx is not None and mesh_ctx.sizes["cp"] > 1:
+        from automodel_tpu.parallel.cp import ring_dot_product_attention
+
+        attn = ring_dot_product_attention(
+            q, k, v, positions, segment_ids, mesh_ctx,
+            causal=True,
+            sliding_window=sliding_window,
+            logits_soft_cap=cfg.attn_soft_cap,
+            scale=scale,
+        )
+    else:
+        attn = dot_product_attention(
+            q, k, v,
+            causal=True,
+            segment_ids=segment_ids,
+            positions=positions,
+            sliding_window=sliding_window,
+            logits_soft_cap=cfg.attn_soft_cap,
+            scale=scale,
+            impl="xla",  # asymmetric qk/v dims — flash MLA kernel is future work
+        )
+    attn = attn.reshape(B, S, n * dv)
+    h = h + _dense(attn, {"kernel": lp["o_proj"]["kernel"]})
+    return constrain(h, ("act_batch", "act_seq", "act_embed"))

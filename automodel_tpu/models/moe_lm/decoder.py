@@ -54,7 +54,7 @@ class MoETransformerConfig(TransformerConfig):
         """Activated-params FLOPs/token for MFU (routed experts count k/E)."""
         D = self.resolved_head_dim
         H = self.hidden_size
-        attn_params = H * (self.num_heads + 2 * self.num_kv_heads) * D + self.num_heads * D * H
+        attn_params = self.attn_params_per_layer()
         dense_mlp = 3 * H * self.intermediate_size
         moe_mlp = (
             3 * H * self.moe.moe_intermediate_size * self.moe.experts_per_token
@@ -163,7 +163,7 @@ def forward(
         h = h * jnp.asarray(cfg.embed_scale, cfg.dtype)
     h = constrain(h, ("act_batch", "act_seq", "act_embed"))
 
-    inv_freq = rope_frequencies(cfg.resolved_head_dim, cfg.rope_theta, cfg.rope_scaling)
+    inv_freq = rope_frequencies(cfg.rope_dim, cfg.rope_theta, cfg.rope_scaling)
     windows = layer_windows(cfg)
     Lm, E = cfg.num_moe_layers, cfg.moe.n_routed_experts
 

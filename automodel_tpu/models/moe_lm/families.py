@@ -50,8 +50,7 @@ def mixtral_config(hf: Mapping[str, Any], **overrides) -> MoETransformerConfig:
 def deepseek_v3_moe_config(hf: Mapping[str, Any], **overrides) -> MoETransformerConfig:
     """DeepSeek-V3-style MoE body: sigmoid scores, group-limited routing,
     shared experts, aux-free gate-bias balancing, first-k-dense layers.
-    NOTE: uses GQA attention until the MLA attention module lands; register
-    under DeepseekV3ForCausalLM only once MLA is in (checkpoint shapes differ).
+    Uses MLA attention when the HF config carries kv_lora_rank.
     """
     kw = _base_kwargs(hf)
     moe = MoEConfig(
@@ -68,6 +67,18 @@ def deepseek_v3_moe_config(hf: Mapping[str, Any], **overrides) -> MoETransformer
         gate_bias_update_speed=float(hf.get("bias_update_speed", 0.001)),
     )
     first_k = int(hf.get("first_k_dense_replace", 0))
+    if hf.get("kv_lora_rank"):
+        kw["attention_type"] = "mla"
+        kw["mla_q_lora_rank"] = int(hf["q_lora_rank"]) if hf.get("q_lora_rank") else None
+        kw["mla_kv_lora_rank"] = int(hf["kv_lora_rank"])
+        kw["mla_qk_nope_head_dim"] = int(hf.get("qk_nope_head_dim", 128))
+        kw["mla_qk_rope_head_dim"] = int(hf.get("qk_rope_head_dim", 64))
+        kw["mla_v_head_dim"] = int(hf.get("v_head_dim", 128))
+        kw["head_dim"] = None
+        rs = kw["rope_scaling"]
+        if rs.rope_type == "yarn":
+            qk = kw["mla_qk_nope_head_dim"] + kw["mla_qk_rope_head_dim"]
+            kw["attn_scale"] = qk ** -0.5 * rs.yarn_mscale() ** 2
     moe_overrides = overrides.pop("moe", None)
     kw.update(overrides)
     return MoETransformerConfig(moe=moe_overrides or moe, first_k_dense=first_k, **kw)

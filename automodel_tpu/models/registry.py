@@ -40,6 +40,10 @@ MODEL_ARCH_MAPPING: dict[str, ModelSpec] = {
         "mixtral", moe_families.mixtral_config, moe_decoder,
         adapter_name="moe_decoder", adapter_kwargs={"style": "mixtral"},
     ),
+    "DeepseekV3ForCausalLM": ModelSpec(
+        "deepseek_v3", moe_families.deepseek_v3_moe_config, moe_decoder,
+        adapter_name="moe_decoder", adapter_kwargs={"style": "deepseek"},
+    ),
 }
 
 

@@ -89,7 +89,8 @@ def xla_attention(
         logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bkgst,btkd->bskgd", probs.astype(v.dtype), v)
-    return out.reshape(B, S, Hq, D)
+    # v's head dim may differ from q/k's (MLA) — reshape with v's
+    return out.reshape(B, S, Hq, v.shape[-1])
 
 
 def dot_product_attention(

@@ -120,7 +120,7 @@ def _partial_attention(q, k, v, qpos, kpos, qseg, kseg, *, scale, soft_cap, wind
     p = jnp.where(mask[:, None, None, :, :], p, 0.0)
     l = jnp.sum(p, axis=-1)
     o = jnp.einsum("bkgst,btkd->bskgd", p.astype(v.dtype), v)
-    return m, l, o.reshape(B, S, Hq, D)
+    return m, l, o.reshape(B, S, Hq, v.shape[-1])
 
 
 def ring_attention(
@@ -169,7 +169,7 @@ def ring_attention(
 
     m0 = jnp.full((B, Hkv, G, S), NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, Hkv, G, S), jnp.float32)
-    o0 = jnp.zeros((B, S, Hq, D), jnp.float32)
+    o0 = jnp.zeros((B, S, Hq, v.shape[-1]), jnp.float32)
     kv0 = (k, v, positions, segment_ids)
     (m_f, l_f, o_f, _), _ = lax.scan(step, (m0, l0, o0, kv0), None, length=cp)
 

@@ -227,3 +227,109 @@ def test_moe_layer_types_windows():
     out_mix, _ = moe_decoder.forward(params, cfg, ids)
     out_all, _ = moe_decoder.forward(params, cfg_all, ids)
     assert not np.allclose(np.asarray(out_mix), np.asarray(out_all))
+
+
+def test_deepseek_v3_mla_end_to_end(tmp_path):
+    """DSv3-style config: MLA + sigmoid grouped gate + shared experts +
+    first-k dense; forward, grads, EP sharding, HF checkpoint roundtrip."""
+    import dataclasses as dc
+
+    from automodel_tpu.checkpoint import (
+        HFCheckpointReader,
+        MoEDecoderAdapter,
+        save_hf_checkpoint,
+    )
+    from automodel_tpu.models.registry import get_model_spec
+
+    hf = {
+        "architectures": ["DeepseekV3ForCausalLM"],
+        "vocab_size": 64, "hidden_size": 32, "intermediate_size": 48,
+        "num_hidden_layers": 3, "num_attention_heads": 4,
+        "num_key_value_heads": 4,
+        "q_lora_rank": 12, "kv_lora_rank": 16,
+        "qk_nope_head_dim": 8, "qk_rope_head_dim": 4, "v_head_dim": 8,
+        "n_routed_experts": 8, "n_shared_experts": 1, "num_experts_per_tok": 2,
+        "n_group": 4, "topk_group": 2, "moe_intermediate_size": 16,
+        "first_k_dense_replace": 1, "routed_scaling_factor": 2.5,
+        "scoring_func": "sigmoid", "norm_topk_prob": True,
+    }
+    spec = get_model_spec(hf)
+    cfg = spec.config_from_hf(hf, dtype=jnp.float32, remat_policy="none")
+    assert cfg.attention_type == "mla" and cfg.moe.score_func == "sigmoid"
+    assert cfg.moe.gate_bias_update_speed > 0  # aux-free balancing default
+
+    params = spec.module.init(cfg, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (2, 8), 0, 64)
+    logits, aux = spec.module.forward(params, cfg, ids)
+    assert logits.shape == (2, 8, 64)
+    assert np.isfinite(np.asarray(logits)).all()
+
+    # causality holds through MLA
+    ids2 = ids.at[0, 6].set((int(ids[0, 6]) + 1) % 64)
+    l2, _ = spec.module.forward(params, cfg, ids2)
+    np.testing.assert_allclose(
+        np.asarray(logits[0, :6]), np.asarray(l2[0, :6]), rtol=2e-5, atol=2e-5
+    )
+
+    # sharded parity incl. ep
+    ctx = MeshConfig(dp_shard=2, ep=4).build()
+    from automodel_tpu.parallel import logical_to_shardings
+
+    sh = logical_to_shardings(
+        spec.module.param_specs(cfg), ctx,
+        shapes=jax.tree.map(lambda p: p.shape, params),
+    )
+    sp = jax.device_put(params, sh)
+
+    @jax.jit
+    def f(p, i):
+        return spec.module.forward(p, cfg, i, mesh_ctx=ctx)
+
+    ids8 = jax.random.randint(jax.random.key(2), (8, 8), 0, 64)
+    ref, _ = spec.module.forward(params, cfg, ids8)
+    out, _ = f(sp, jax.device_put(ids8, ctx.sharding("batch", None)))
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=2e-3, atol=2e-3)
+
+    # HF checkpoint roundtrip with deepseek naming
+    adapter = MoEDecoderAdapter(cfg, style="deepseek")
+    save_hf_checkpoint(adapter.to_hf(params), str(tmp_path))
+    reader = HFCheckpointReader(str(tmp_path))
+    assert "model.layers.1.self_attn.kv_a_proj_with_mqa.weight" in reader.keys()
+    assert "model.layers.1.self_attn.q_b_proj.weight" in reader.keys()
+    restored = adapter.from_hf(reader)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_yarn_rope_and_rope_permutation():
+    """Yarn frequencies behave (interp at low freq, original at high freq);
+    the adapter's rope permutation is a true inverse pair and de-interleaves."""
+    import numpy as np
+    from automodel_tpu.checkpoint.hf_adapter import _permute_k_rope, _permute_q_rope
+    from automodel_tpu.ops.rope import RopeScalingConfig, rope_frequencies
+
+    base = rope_frequencies(64, 10000.0)
+    yarn = rope_frequencies(
+        64, 10000.0,
+        RopeScalingConfig(rope_type="yarn", factor=4.0,
+                          original_max_position_embeddings=2048,
+                          beta_fast=32, beta_slow=1, mscale_all_dim=1.0),
+    )
+    # highest-frequency dims unchanged, lowest-frequency dims divided by 4
+    np.testing.assert_allclose(np.asarray(yarn[0]), np.asarray(base[0]), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(yarn[-1]), np.asarray(base[-1]) / 4.0, rtol=1e-6)
+    rs = RopeScalingConfig(rope_type="yarn", factor=4.0, mscale_all_dim=1.0)
+    assert rs.yarn_mscale() > 1.0
+
+    # permutation: interleaved (p0,p1,p2,...) → half-split (evens, odds)
+    dn, dr, n = 2, 4, 2
+    kernel = np.arange(3 * n * (dn + dr)).reshape(3, n * (dn + dr)).astype(np.float64)
+    fwd = _permute_q_rope(kernel, n, dn, dr, inverse=False)
+    # head 0 rope cols were [2,3,4,5] (interleaved pairs) → [2,4,3,5]
+    np.testing.assert_array_equal(fwd[0, :6], [0, 1, 2, 4, 3, 5])
+    back = _permute_q_rope(fwd, n, dn, dr, inverse=True)
+    np.testing.assert_array_equal(back, kernel)
+    kv = np.arange(2 * 7).reshape(2, 7).astype(np.float64)  # kv_rank=3, dr=4
+    fwd = _permute_k_rope(kv, 3, 4, inverse=False)
+    np.testing.assert_array_equal(fwd[0], [0, 1, 2, 3, 5, 4, 6])
+    np.testing.assert_array_equal(_permute_k_rope(fwd, 3, 4, inverse=True), kv)
