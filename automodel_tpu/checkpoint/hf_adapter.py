@@ -41,7 +41,6 @@ def _rope_perm(dr: int, inverse: bool) -> np.ndarray:
     ((0,1),(2,3),…) while this framework rotates the llama half-split way
     ([evens…, odds…]); permute the weight COLUMNS once at load/export so
     runtime rotation needs no de-interleave (the vLLM approach)."""
-    half = dr // 2
     deinter = np.concatenate([np.arange(0, dr, 2), np.arange(1, dr, 2)])
     if not inverse:
         return deinter

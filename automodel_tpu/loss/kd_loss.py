@@ -1,0 +1,107 @@
+"""Knowledge-distillation losses.
+
+The analog of the reference KD stack (reference: nemo_automodel/components/
+loss/kd_loss.py + soft_ce.py Triton soft-label CE; recipes/llm/kd.py).
+Temperature-scaled soft cross-entropy between teacher and student logits,
+masked like the hard loss, returned as (sum, token_count) to ride the same
+global-token normalization as everything else. Chunked over the sequence so
+teacher+student logits never co-materialize at full (B*S, V).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.loss.masked_ce import IGNORE_INDEX
+
+
+def soft_cross_entropy_sum(
+    student_logits: jnp.ndarray,  # (..., V)
+    teacher_logits: jnp.ndarray,  # (..., V)
+    labels: jnp.ndarray,          # (...,) mask via IGNORE_INDEX
+    *,
+    temperature: float = 1.0,
+    ignore_index: int = IGNORE_INDEX,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """sum_t T² · CE(softmax(teacher/T), softmax(student/T)) over valid tokens."""
+    mask = labels != ignore_index
+    t = jnp.float32(temperature)
+    s = jax.nn.log_softmax(student_logits.astype(jnp.float32) / t, axis=-1)
+    p = jax.nn.softmax(teacher_logits.astype(jnp.float32) / t, axis=-1)
+    ce = -jnp.sum(p * s, axis=-1) * (t * t)
+    ce = jnp.where(mask, ce, 0.0)
+    return jnp.sum(ce), jnp.sum(mask).astype(jnp.float32)
+
+
+def fused_kd_cross_entropy(
+    student_hidden: jnp.ndarray,   # (B, S, H)
+    student_kernel: jnp.ndarray,   # (H, V)
+    teacher_hidden: jnp.ndarray,   # (B, S, Ht)
+    teacher_kernel: jnp.ndarray,   # (Ht, V)
+    labels: jnp.ndarray,           # (B, S)
+    *,
+    kd_ratio: float = 0.5,
+    temperature: float = 1.0,
+    chunk_size: int = 1024,
+    ignore_index: int = IGNORE_INDEX,
+    student_soft_cap: float | None = None,
+    teacher_soft_cap: float | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Combined hard CE + soft KD without materializing full logits:
+    loss = (1-kd_ratio)·CE(student, labels) + kd_ratio·softCE(teacher→student).
+
+    Returns (sum, num_label_tokens). Same chunked-lm-head trade as
+    loss/linear_ce.py, with the teacher's head projected per chunk too.
+    """
+    B, S, H = student_hidden.shape
+    N = B * S
+    sh = student_hidden.reshape(N, H)
+    th = teacher_hidden.reshape(N, teacher_hidden.shape[-1])
+    fl = labels.reshape(N)
+    chunk_size = min(chunk_size, N)
+    pad = (-N) % chunk_size
+    if pad:
+        sh = jnp.pad(sh, ((0, pad), (0, 0)))
+        th = jnp.pad(th, ((0, pad), (0, 0)))
+        fl = jnp.pad(fl, (0, pad), constant_values=ignore_index)
+    n_chunks = sh.shape[0] // chunk_size
+    sh = sh.reshape(n_chunks, chunk_size, -1)
+    th = th.reshape(n_chunks, chunk_size, -1)
+    fl = fl.reshape(n_chunks, chunk_size)
+
+    @partial(jax.checkpoint, policy=jax.checkpoint_policies.nothing_saveable)
+    def chunk(carry, xs):
+        s_h, t_h, l = xs
+        s_logits = jnp.einsum(
+            "ch,hv->cv", s_h, student_kernel.astype(s_h.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        if student_soft_cap is not None:
+            s_logits = student_soft_cap * jnp.tanh(s_logits / student_soft_cap)
+        t_logits = jax.lax.stop_gradient(
+            jnp.einsum(
+                "ch,hv->cv", t_h, teacher_kernel.astype(t_h.dtype),
+                preferred_element_type=jnp.float32,
+            )
+        )
+        if teacher_soft_cap is not None:
+            t_logits = teacher_soft_cap * jnp.tanh(t_logits / teacher_soft_cap)
+        mask = l != ignore_index
+        safe = jnp.where(mask, l, 0)
+        lse = jax.scipy.special.logsumexp(s_logits, axis=-1)
+        picked = jnp.take_along_axis(s_logits, safe[:, None], axis=-1)[:, 0]
+        hard = jnp.where(mask, lse - picked, 0.0)
+        soft_sum, _ = soft_cross_entropy_sum(
+            s_logits, t_logits, l, temperature=temperature, ignore_index=ignore_index
+        )
+        total, n = carry
+        combined = (1.0 - kd_ratio) * jnp.sum(hard) + kd_ratio * soft_sum
+        return (total + combined, n + jnp.sum(mask).astype(jnp.float32)), None
+
+    (total, n), _ = jax.lax.scan(
+        chunk, (jnp.float32(0.0), jnp.float32(0.0)), (sh, th, fl)
+    )
+    return total, n

@@ -73,6 +73,7 @@ class TransformerConfig:
     scan_unroll: int = 1
     attn_impl: str = "auto"
     pipeline_microbatches: int = 2  # used when the mesh has pp > 1
+    linear_precision: Optional[str] = None  # None | "fp8" | "int8"
 
     @property
     def resolved_head_dim(self) -> int:
@@ -252,8 +253,10 @@ def param_specs(cfg: TransformerConfig) -> dict:
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
-def _dense(x, p):
-    y = x @ p["kernel"]
+def _dense(x, p, precision=None):
+    from automodel_tpu.ops.quant import matmul
+
+    y = matmul(x, p["kernel"], precision)
     if "bias" in p:
         y = y + p["bias"]
     return y
@@ -355,9 +358,9 @@ def attention_block(h, lp, cfg: TransformerConfig, positions, segment_ids, inv_f
 
     # -- attention ----------------------------------------------------------
     x = rms_norm(h, lp["input_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
-    q = _dense(x, lp["q_proj"]).reshape(B, S, cfg.num_heads, D)
-    k = _dense(x, lp["k_proj"]).reshape(B, S, cfg.num_kv_heads, D)
-    v = _dense(x, lp["v_proj"]).reshape(B, S, cfg.num_kv_heads, D)
+    q = _dense(x, lp["q_proj"], cfg.linear_precision).reshape(B, S, cfg.num_heads, D)
+    k = _dense(x, lp["k_proj"], cfg.linear_precision).reshape(B, S, cfg.num_kv_heads, D)
+    v = _dense(x, lp["v_proj"], cfg.linear_precision).reshape(B, S, cfg.num_kv_heads, D)
     q = constrain(q, ("act_batch", "act_seq", "act_heads", None))
     k = constrain(k, ("act_batch", "act_seq", "act_kv_heads", None))
     v = constrain(v, ("act_batch", "act_seq", "act_kv_heads", None))
@@ -389,7 +392,7 @@ def attention_block(h, lp, cfg: TransformerConfig, positions, segment_ids, inv_f
             impl=cfg.attn_impl,
         )
     attn = attn.reshape(B, S, cfg.num_heads * D)
-    attn_out = _dense(attn, lp["o_proj"])
+    attn_out = _dense(attn, lp["o_proj"], cfg.linear_precision)
     if cfg.use_post_norms:
         attn_out = rms_norm(
             attn_out, lp["post_attn_out_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm
@@ -400,12 +403,14 @@ def attention_block(h, lp, cfg: TransformerConfig, positions, segment_ids, inv_f
 
 def mlp_block(h, lp, cfg: TransformerConfig, constrain):
     """Pre-norm gated MLP with residual."""
+    from automodel_tpu.ops.quant import matmul as _mm
+
     x = rms_norm(h, lp["post_attn_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
     act = ACTIVATIONS[cfg.activation]
-    gate = act(x @ lp["gate_proj"]["kernel"])
-    up = x @ lp["up_proj"]["kernel"]
+    gate = act(_mm(x, lp["gate_proj"]["kernel"], cfg.linear_precision))
+    up = _mm(x, lp["up_proj"]["kernel"], cfg.linear_precision)
     mlp = constrain(gate * up, ("act_batch", "act_seq", "act_mlp"))
-    mlp_out = mlp @ lp["down_proj"]["kernel"]
+    mlp_out = _mm(mlp, lp["down_proj"]["kernel"], cfg.linear_precision)
     if cfg.use_post_norms:
         mlp_out = rms_norm(
             mlp_out, lp["post_mlp_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm

@@ -90,25 +90,28 @@ def mla_attention_block(h, lp, cfg, positions, segment_ids, inv_freq, constrain,
     n = cfg.num_heads
     dn, dr, dv = cfg.mla_qk_nope_head_dim, cfg.mla_qk_rope_head_dim, cfg.mla_v_head_dim
 
+    from automodel_tpu.ops.quant import matmul as _mm
+
+    prec = cfg.linear_precision
     x = rms_norm(h, lp["input_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
 
     if cfg.mla_q_lora_rank:
-        q_lat = rms_norm(x @ lp["q_down_proj"]["kernel"], lp["q_norm"]["scale"], cfg.rms_norm_eps)
-        q = q_lat @ lp["q_up_proj"]["kernel"]
+        q_lat = rms_norm(_mm(x, lp["q_down_proj"]["kernel"], prec), lp["q_norm"]["scale"], cfg.rms_norm_eps)
+        q = _mm(q_lat, lp["q_up_proj"]["kernel"], prec)
     else:
-        q = x @ lp["q_proj"]["kernel"]
+        q = _mm(x, lp["q_proj"]["kernel"], prec)
     q = q.reshape(B, S, n, dn + dr)
     q_nope, q_rope = q[..., :dn], q[..., dn:]
     q_rope = apply_rope(q_rope, positions, inv_freq)
     q = jnp.concatenate([q_nope, q_rope], axis=-1)
     q = constrain(q, ("act_batch", "act_seq", "act_heads", None))
 
-    kv = x @ lp["kv_down_proj"]["kernel"]  # (B,S, kv_rank + dr)
+    kv = _mm(x, lp["kv_down_proj"]["kernel"], prec)  # (B,S, kv_rank + dr)
     c_kv, k_rope = kv[..., : cfg.mla_kv_lora_rank], kv[..., cfg.mla_kv_lora_rank :]
     # shared single-head key rope, broadcast across heads after rotation
     k_rope = apply_rope(k_rope[:, :, None, :], positions, inv_freq)
     c_kv = rms_norm(c_kv, lp["kv_norm"]["scale"], cfg.rms_norm_eps)
-    kv_up = (c_kv @ lp["kv_up_proj"]["kernel"]).reshape(B, S, n, dn + dv)
+    kv_up = _mm(c_kv, lp["kv_up_proj"]["kernel"], prec).reshape(B, S, n, dn + dv)
     k_nope, v = kv_up[..., :dn], kv_up[..., dn:]
     k = jnp.concatenate([k_nope, jnp.broadcast_to(k_rope, (B, S, n, dr))], axis=-1)
     k = constrain(k, ("act_batch", "act_seq", "act_heads", None))
@@ -137,5 +140,5 @@ def mla_attention_block(h, lp, cfg, positions, segment_ids, inv_freq, constrain,
             impl="xla",  # asymmetric qk/v dims — flash MLA kernel is future work
         )
     attn = attn.reshape(B, S, n * dv)
-    h = h + _dense(attn, {"kernel": lp["o_proj"]["kernel"]})
+    h = h + _dense(attn, {"kernel": lp["o_proj"]["kernel"]}, prec)
     return constrain(h, ("act_batch", "act_seq", "act_embed"))

@@ -1,0 +1,73 @@
+"""FP8 / INT8 quantized matmul — the torchao Float8Linear analog.
+
+The reference quantizes linears via torchao `Float8Linear` with dynamic
+scaling plus TE FP8 autocast recipes (reference: nemo_automodel/components/
+quantization/fp8.py:130 `apply_fp8_to_model`, models/common/utils.py:100-155
+TEFp8Config). TPU-native form: a drop-in matmul with per-tensor dynamic
+scales, quantize → MXU dot in the low-precision dtype → rescale. Backward
+runs in bf16 against the dequantized operands (delayed-scaling-style
+training), via custom_vjp. Models opt in with
+`TransformerConfig.linear_precision = "fp8" | "int8"`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+FP8_MAX = 448.0   # float8_e4m3fn
+INT8_MAX = 127.0
+
+
+def _quantize(x, qdtype, qmax):
+    scale = jnp.max(jnp.abs(x)).astype(jnp.float32) / qmax + 1e-12
+    q = (x.astype(jnp.float32) / scale)
+    if qdtype == jnp.int8:
+        q = jnp.round(q)
+    q = jnp.clip(q, -qmax, qmax).astype(qdtype)
+    return q, scale
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def quantized_matmul(x, w, precision: str = "fp8"):
+    """x (..., K) @ w (K, N) with per-tensor dynamic quantization."""
+    return _qmm_fwd(x, w, precision)[0]
+
+
+def _qmm_fwd(x, w, precision):
+    qdtype, qmax = (
+        (jnp.int8, INT8_MAX) if precision == "int8" else (jnp.float8_e4m3fn, FP8_MAX)
+    )
+    qx, sx = _quantize(x, qdtype, qmax)
+    qw, sw = _quantize(w, qdtype, qmax)
+    out = jnp.einsum(
+        "...k,kn->...n", qx, qw, preferred_element_type=jnp.float32
+    ) * (sx * sw)
+    return out.astype(x.dtype), (x, w)
+
+
+def _qmm_bwd(precision, res, g):
+    # backward in bf16 on the ORIGINAL operands (dynamic-scaling fp8 training
+    # quantizes activations/weights forward-only; grads stay high precision)
+    x, w = res
+    gf = g.astype(jnp.bfloat16)
+    dx = jnp.einsum("...n,kn->...k", gf, w.astype(jnp.bfloat16)).astype(x.dtype)
+    dw = jnp.einsum(
+        "...k,...n->kn",
+        x.astype(jnp.bfloat16),
+        gf,
+        preferred_element_type=jnp.float32,
+    ).astype(w.dtype)
+    return dx, dw
+
+
+quantized_matmul.defvjp(_qmm_fwd, _qmm_bwd)
+
+
+def matmul(x, kernel, precision: str | None = None):
+    """Precision-dispatching matmul used by the decoders' linears."""
+    if precision in ("fp8", "int8"):
+        return quantized_matmul(x, kernel, precision)
+    return x @ kernel

@@ -30,6 +30,7 @@ class OptimizerConfig:
     eps: float = 1e-8
     weight_decay: float = 0.1
     momentum: float = 0.9  # sgd only
+    adamw_lr: float = 3e-4  # muon only: lr for the non-matrix (adamw) params
     decay_mask: Optional[Callable] = dataclasses.field(default=None, repr=False)
 
     def build(self, lr_schedule: "float | Callable" = None) -> optax.GradientTransformation:
@@ -48,6 +49,22 @@ class OptimizerConfig:
             return optax.adafactor(lr)
         if self.name == "lion":
             return optax.lion(lr, b1=self.betas[0], b2=self.betas[1], weight_decay=self.weight_decay)
+        if self.name in ("muon", "dion"):
+            from automodel_tpu.optim.muon import MuonConfig
+
+            # the adamw half (embeddings/norms/biases) follows the SAME
+            # schedule shape, rescaled from the muon peak lr to adamw_lr
+            if callable(lr):
+                ratio = self.adamw_lr / self.lr
+                adamw_sched = lambda step: lr(step) * ratio
+            else:
+                adamw_sched = self.adamw_lr
+            return MuonConfig(
+                lr=self.lr,
+                adamw_lr=self.adamw_lr,
+                weight_decay=self.weight_decay,
+                betas=self.betas,
+            ).build(lr_schedule=lr, adamw_schedule=adamw_sched)
         raise ValueError(f"Unknown optimizer '{self.name}'")
 
 

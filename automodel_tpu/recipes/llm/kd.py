@@ -1,0 +1,148 @@
+"""Knowledge-distillation recipe: frozen teacher → student.
+
+The analog of the reference KD recipe (reference: nemo_automodel/recipes/
+llm/kd.py + recipes/kd_utils.py). Reuses the full train-recipe setup for
+the STUDENT; the teacher is a second (frozen) model whose params ride the
+jitted step as pass-through extra args (like LoRA base weights — never
+baked in as constants, never in the optimizer).
+
+YAML adds:
+
+    teacher_model:
+      hf_config: {...}        # or pretrained_path
+      dtype: bfloat16
+    kd: {ratio: 0.5, temperature: 2.0}
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.checkpoint import HFCheckpointReader, get_adapter
+from automodel_tpu.config import ConfigNode
+from automodel_tpu.loss.kd_loss import fused_kd_cross_entropy
+from automodel_tpu.models.registry import get_model_spec
+from automodel_tpu.parallel import logical_to_shardings
+from automodel_tpu.recipes.llm.train_ft import (
+    TrainFinetuneRecipeForNextTokenPrediction,
+    _DTYPES,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class KDRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPrediction):
+    def setup(self) -> None:
+        if self.cfg.get("peft") is not None:
+            raise NotImplementedError("KD+PEFT in one run is not supported yet")
+        super().setup()
+        if self.is_moe:
+            raise NotImplementedError("MoE students not wired into KD yet")
+
+    # -- teacher -----------------------------------------------------------
+    def _build_model(self) -> None:
+        super()._build_model()
+        cfg = self.cfg
+        tcfg = cfg.get("teacher_model")
+        if tcfg is None:
+            raise ValueError("KD recipe requires a `teacher_model:` section")
+        dtype = _DTYPES[tcfg.get("dtype", "bfloat16")]
+        pretrained = tcfg.get("pretrained_path", None)
+        if pretrained:
+            reader = HFCheckpointReader(pretrained)
+            hf_config = reader.hf_config()
+        else:
+            reader = None
+            hf_config = tcfg.get("hf_config")
+            hf_config = hf_config.to_dict() if isinstance(hf_config, ConfigNode) else dict(hf_config)
+        self.teacher_spec = get_model_spec(hf_config)
+        if self.teacher_spec.adapter_name == "moe_decoder":
+            raise NotImplementedError("MoE teachers not wired yet")
+        self.teacher_cfg = self.teacher_spec.config_from_hf(
+            hf_config, dtype=dtype, remat_policy=tcfg.get("remat_policy", "full")
+        )
+        module = self.teacher_spec.module
+        shapes = jax.eval_shape(lambda: module.init(self.teacher_cfg, jax.random.key(0)))
+        shardings = logical_to_shardings(
+            module.param_specs(self.teacher_cfg), self.mesh_ctx,
+            shapes=jax.tree.map(lambda p: p.shape, shapes),
+        )
+        if reader is not None:
+            adapter = get_adapter(self.teacher_spec.adapter_name, self.teacher_cfg)
+            self.teacher_params = adapter.from_hf(reader, shardings=shardings)
+            logger.info("teacher loaded from %s", pretrained)
+        else:
+            self.teacher_params = jax.jit(
+                lambda k: module.init(self.teacher_cfg, k), out_shardings=shardings
+            )(jax.random.key(int(cfg.get("teacher_seed", 7))))
+        # teacher is inference-only: keep in compute dtype to halve memory
+        self.teacher_params = jax.tree.map(
+            lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p,
+            self.teacher_params,
+        )
+
+    # -- loss --------------------------------------------------------------
+    def _build_optimizer(self) -> None:
+        super()._build_optimizer()
+        cfg = self.cfg
+        kd_ratio = float(cfg.get("kd.ratio", 0.5))
+        temperature = float(cfg.get("kd.temperature", 1.0))
+        chunk = int(cfg.get("loss.chunk_size", 1024))
+        student_module = self.model_spec.module
+        student_cfg = self.model_cfg
+        teacher_module = self.teacher_spec.module
+        teacher_cfg = self.teacher_cfg
+        mesh_ctx = self.mesh_ctx
+
+        def kd_loss_fn(params, batch, rng, teacher_params):
+            kw = {}
+            for k in ("positions", "segment_ids"):
+                if k in batch:
+                    kw[k] = batch[k]
+            s_hidden = student_module.forward(
+                params, student_cfg, batch["input_ids"],
+                return_hidden=True, mesh_ctx=mesh_ctx, **kw,
+            )
+            t_hidden = jax.lax.stop_gradient(
+                teacher_module.forward(
+                    teacher_params, teacher_cfg, batch["input_ids"],
+                    return_hidden=True, mesh_ctx=mesh_ctx, **kw,
+                )
+            )
+            s_kernel = (
+                params["embed"]["embedding"].T
+                if student_cfg.tie_word_embeddings
+                else params["lm_head"]["kernel"]
+            )
+            t_kernel = (
+                teacher_params["embed"]["embedding"].T
+                if teacher_cfg.tie_word_embeddings
+                else teacher_params["lm_head"]["kernel"]
+            )
+            total, n = fused_kd_cross_entropy(
+                s_hidden, s_kernel, t_hidden, t_kernel, batch["labels"],
+                kd_ratio=kd_ratio, temperature=temperature, chunk_size=chunk,
+                student_soft_cap=student_cfg.logits_soft_cap,
+                teacher_soft_cap=teacher_cfg.logits_soft_cap,
+            )
+            return total, {"num_label_tokens": n}
+
+        from automodel_tpu.training import TrainStepConfig, make_train_step
+
+        step_cfg = TrainStepConfig(max_grad_norm=cfg.get("max_grad_norm", 1.0))
+        self._train_step = jax.jit(
+            make_train_step(kd_loss_fn, self.tx, self.lr_schedule, step_cfg),
+            donate_argnums=0,
+        )
+
+        def eval_loss(params, batch, *extra):
+            loss_sum, aux = kd_loss_fn(params, batch, jax.random.key(0), *extra)
+            return loss_sum, aux["num_label_tokens"]
+
+        self._eval_step = jax.jit(eval_loss)
+
+    def _step_extra(self) -> tuple:
+        return (self.teacher_params,)

@@ -133,6 +133,8 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             remat_policy=mcfg.get("remat_policy", "full"),
             attn_impl=mcfg.get("attn_impl", "auto"),
         )
+        if mcfg.get("linear_precision", None):
+            overrides["linear_precision"] = mcfg.get("linear_precision")
 
         pretrained = mcfg.get("pretrained_path", None)
         if pretrained:

@@ -1,0 +1,89 @@
+"""Training utilities: EMA, NEFTune noise, Megatron-style timers.
+
+Analogs of the reference training utils (reference: nemo_automodel/
+components/training/ema.py:40,97 EMA managers; neftune.py noisy
+embeddings; timers.py Megatron-style timer hierarchy).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import defaultdict
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# EMA — exponential moving average of params (reference: training/ema.py)
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class EMAConfig:
+    decay: float = 0.999
+    update_every: int = 1
+
+
+def init_ema(params: Any) -> Any:
+    return jax.tree.map(lambda p: p, params)
+
+
+def update_ema(ema: Any, params: Any, decay: float) -> Any:
+    """ema ← decay·ema + (1-decay)·params (jit-friendly, sharding-preserving)."""
+    return jax.tree.map(lambda e, p: decay * e + (1.0 - decay) * p, ema, params)
+
+
+# ---------------------------------------------------------------------------
+# NEFTune — uniform noise on embeddings during SFT (reference: neftune.py)
+# ---------------------------------------------------------------------------
+def neftune_noise(embeddings: jnp.ndarray, rng: jax.Array, alpha: float) -> jnp.ndarray:
+    """Add U(-mag, mag) with mag = alpha / sqrt(seq_len * dim) per NEFTune."""
+    B, S, D = embeddings.shape
+    mag = alpha / jnp.sqrt(jnp.float32(S * D))
+    noise = jax.random.uniform(rng, embeddings.shape, jnp.float32, -1.0, 1.0) * mag
+    return embeddings + noise.astype(embeddings.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Timers (reference: training/timers.py)
+# ---------------------------------------------------------------------------
+class Timers:
+    """Named wall-clock timers with simple start/stop/log semantics."""
+
+    def __init__(self):
+        self._starts: dict[str, float] = {}
+        self._totals: dict[str, float] = defaultdict(float)
+        self._counts: dict[str, int] = defaultdict(int)
+
+    def start(self, name: str) -> None:
+        self._starts[name] = time.perf_counter()
+
+    def stop(self, name: str) -> float:
+        dt = time.perf_counter() - self._starts.pop(name)
+        self._totals[name] += dt
+        self._counts[name] += 1
+        return dt
+
+    def __call__(self, name: str):
+        """Context-manager form: `with timers("fwd"): ...`"""
+        timers = self
+
+        class _Ctx:
+            def __enter__(self):
+                timers.start(name)
+
+            def __exit__(self, *exc):
+                timers.stop(name)
+
+        return _Ctx()
+
+    def summary(self) -> dict:
+        return {
+            name: {
+                "total_s": self._totals[name],
+                "count": self._counts[name],
+                "mean_ms": 1e3 * self._totals[name] / max(self._counts[name], 1),
+            }
+            for name in self._totals
+        }
