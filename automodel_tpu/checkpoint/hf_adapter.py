@@ -476,3 +476,138 @@ def _set(tree: dict, path: tuple, value) -> None:
     for p in path[:-1]:
         tree = tree.setdefault(p, {})
     tree[path[-1]] = value
+
+
+@dataclasses.dataclass
+class LlavaAdapter:
+    """llava-style VLM ↔ models/vlm/llava params.
+
+    HF layout: `language_model.model.*` / `language_model.lm_head.weight`,
+    `multi_modal_projector.linear_{1,2}.*`, and a CLIP-style
+    `vision_tower.vision_model.encoder.layers.{i}.*` tower
+    (reference: models/llava_onevision/state_dict_adapter.py).
+    """
+
+    cfg: Any  # LlavaConfig
+
+    def _lm(self) -> DenseDecoderAdapter:
+        return DenseDecoderAdapter(self.cfg.text)
+
+    _VIT_LAYER = (
+        ("layer_norm1.weight", ("ln1", "scale"), False),
+        ("layer_norm1.bias", ("ln1", "bias"), False),
+        ("self_attn.q_proj.weight", ("q_proj", "kernel"), True),
+        ("self_attn.q_proj.bias", ("q_proj", "bias"), False),
+        ("self_attn.k_proj.weight", ("k_proj", "kernel"), True),
+        ("self_attn.k_proj.bias", ("k_proj", "bias"), False),
+        ("self_attn.v_proj.weight", ("v_proj", "kernel"), True),
+        ("self_attn.v_proj.bias", ("v_proj", "bias"), False),
+        ("self_attn.out_proj.weight", ("o_proj", "kernel"), True),
+        ("self_attn.out_proj.bias", ("o_proj", "bias"), False),
+        ("layer_norm2.weight", ("ln2", "scale"), False),
+        ("layer_norm2.bias", ("ln2", "bias"), False),
+        ("mlp.fc1.weight", ("fc1", "kernel"), True),
+        ("mlp.fc1.bias", ("fc1", "bias"), False),
+        ("mlp.fc2.weight", ("fc2", "kernel"), True),
+        ("mlp.fc2.bias", ("fc2", "bias"), False),
+    )
+
+    def _vit_top(self):
+        e = [
+            ("vision_model.embeddings.patch_embedding.weight", ("patch_embed", "kernel"), "patch"),
+            ("vision_model.embeddings.patch_embedding.bias", ("patch_embed", "bias"), None),
+            ("vision_model.embeddings.position_embedding.weight", ("pos_embed",), None),
+            ("vision_model.post_layernorm.weight", ("final_ln", "scale"), None),
+            ("vision_model.post_layernorm.bias", ("final_ln", "bias"), None),
+        ]
+        if self.cfg.vision.use_cls_token:
+            e.append(("vision_model.embeddings.class_embedding", ("cls_embed",), None))
+        if self.cfg.vision.use_pre_layernorm:
+            e += [
+                ("vision_model.pre_layrnorm.weight", ("pre_ln", "scale"), None),
+                ("vision_model.pre_layrnorm.bias", ("pre_ln", "bias"), None),
+            ]
+        return e
+
+    def _patch_kernel(self, x: np.ndarray, to_hf: bool) -> np.ndarray:
+        """HF conv patch embed (H, C, P, P) ↔ our (P*P*C, H) matmul kernel.
+        Our patchify flattens row-major as (P, P, C)."""
+        cfg = self.cfg.vision
+        P, C, H = cfg.patch_size, cfg.num_channels, cfg.hidden_size
+        if to_hf:
+            k = np.asarray(x).reshape(P, P, C, H).transpose(3, 2, 0, 1)
+            return np.ascontiguousarray(k)
+        k = np.asarray(x).transpose(2, 3, 1, 0)  # (P, P, C, H)
+        return np.ascontiguousarray(k.reshape(P * P * C, H))
+
+    def to_hf(self, params: Mapping) -> Iterator[tuple[str, np.ndarray]]:
+        for name, tensor in self._lm().to_hf(params["language_model"]):
+            yield f"language_model.{name}", tensor
+        pj = params["projector"]
+        yield "multi_modal_projector.linear_1.weight", _t(np.asarray(pj["fc1"]["kernel"]))
+        yield "multi_modal_projector.linear_1.bias", np.asarray(pj["fc1"]["bias"])
+        yield "multi_modal_projector.linear_2.weight", _t(np.asarray(pj["fc2"]["kernel"]))
+        yield "multi_modal_projector.linear_2.bias", np.asarray(pj["fc2"]["bias"])
+        vt = params["vision_tower"]
+        for name, path, kind in self._vit_top():
+            x = np.asarray(_get(vt, path))
+            if kind == "patch":
+                x = self._patch_kernel(x, to_hf=True)
+            yield f"vision_tower.{name}", x
+        for i in range(self.cfg.vision.num_layers):
+            for suffix, path, transpose in self._VIT_LAYER:
+                x = np.asarray(_get(vt["layers"], path)[i])
+                yield (
+                    f"vision_tower.vision_model.encoder.layers.{i}.{suffix}",
+                    (_t(x) if transpose else x),
+                )
+
+    def from_hf(self, read: Reader, shardings: Any = None) -> dict:
+        def sub_read(prefix):
+            return lambda name: read(f"{prefix}.{name}")
+
+        lm_shardings = shardings["language_model"] if shardings is not None else None
+        out: dict = {
+            "language_model": self._lm().from_hf(sub_read("language_model"), lm_shardings)
+        }
+        pj = {
+            "fc1": {
+                "kernel": _t(read("multi_modal_projector.linear_1.weight")),
+                "bias": np.asarray(read("multi_modal_projector.linear_1.bias")),
+            },
+            "fc2": {
+                "kernel": _t(read("multi_modal_projector.linear_2.weight")),
+                "bias": np.asarray(read("multi_modal_projector.linear_2.bias")),
+            },
+        }
+        vt: dict = {}
+        for name, path, kind in self._vit_top():
+            x = np.asarray(read(f"vision_tower.{name}"))
+            if kind == "patch":
+                x = self._patch_kernel(x, to_hf=False)
+            _set(vt, path, x)
+        layers: dict = {}
+        for suffix, path, transpose in self._VIT_LAYER:
+            stacked = np.stack(
+                [
+                    _t(read(f"vision_tower.vision_model.encoder.layers.{i}.{suffix}"))
+                    if transpose
+                    else np.asarray(
+                        read(f"vision_tower.vision_model.encoder.layers.{i}.{suffix}")
+                    )
+                    for i in range(self.cfg.vision.num_layers)
+                ]
+            )
+            _set(layers, path, stacked)
+        vt["layers"] = layers
+        out["projector"] = pj
+        out["vision_tower"] = vt
+        if shardings is not None:
+            for key in ("projector", "vision_tower"):
+                out[key] = jax.tree.map(
+                    lambda v, sh: jax.device_put(v, sh), out[key], shardings[key]
+                )
+        return out
+
+
+ADAPTERS["llava"] = LlavaAdapter

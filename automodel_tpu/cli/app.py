@@ -25,6 +25,7 @@ RECIPE_ALIASES = {
     "llm_pretrain": "automodel_tpu.recipes.llm.train_ft.TrainFinetuneRecipeForNextTokenPrediction",
     "llm_benchmark": "automodel_tpu.recipes.llm.benchmark.BenchmarkRecipe",
     "llm_kd": "automodel_tpu.recipes.llm.kd.KDRecipeForNextTokenPrediction",
+    "vlm_finetune": "automodel_tpu.recipes.vlm.finetune.FinetuneRecipeForVLM",
 }
 
 

@@ -97,11 +97,17 @@ def stack_microbatches(microbatches: list) -> dict:
 
 
 def make_global_batch(batch: dict, mesh_ctx, spec) -> dict:
-    """Place host batches into the sharded global layout. Single-host: a
-    device_put; multi-host: assemble from process-local rows."""
-    sharding = mesh_ctx.sharding(*spec) if isinstance(spec, tuple) else spec
+    """Place host batches into the sharded global layout. `spec` may be a
+    single sharding/axis-tuple or a per-key dict of shardings. Single-host:
+    a device_put; multi-host: assemble from process-local rows."""
+    if isinstance(spec, dict):
+        shardings = spec
+    else:
+        sharding = mesh_ctx.sharding(*spec) if isinstance(spec, tuple) else spec
+        shardings = {k: sharding for k in batch}
     if jax.process_count() == 1:
-        return jax.device_put(batch, sharding)
-    return jax.tree.map(
-        lambda x: jax.make_array_from_process_local_data(sharding, x), batch
-    )
+        return {k: jax.device_put(v, shardings[k]) for k, v in batch.items()}
+    return {
+        k: jax.make_array_from_process_local_data(shardings[k], v)
+        for k, v in batch.items()
+    }

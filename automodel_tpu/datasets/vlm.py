@@ -1,0 +1,60 @@
+"""VLM datasets: mock image+text SFT samples (hermetic CI).
+
+The analog of the reference's VLM collators/datasets (reference:
+nemo_automodel/components/datasets/vlm/ — per-family make_*_collate_fns).
+Each sample: pixel_values (H, W, C), input_ids with the image's patch count
+of placeholder tokens at the front (llava layout), labels masking the
+image span and prompt.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+IGNORE_INDEX = -100
+
+
+@dataclasses.dataclass
+class MockVLMDatasetConfig:
+    num_samples: int = 64
+    seq_len: int = 128
+    vocab_size: int = 512
+    image_size: int = 56
+    patch_size: int = 14
+    num_channels: int = 3
+    image_token_id: int = 500
+    seed: int = 0
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    def build(self) -> "MockVLMDataset":
+        return MockVLMDataset(self)
+
+
+class MockVLMDataset:
+    def __init__(self, config: MockVLMDatasetConfig):
+        self.config = config
+        assert config.num_patches < config.seq_len, (
+            f"image occupies {config.num_patches} patch tokens but seq_len is "
+            f"only {config.seq_len}; raise seq_len or patch_size"
+        )
+
+    def __len__(self) -> int:
+        return self.config.num_samples
+
+    def __getitem__(self, idx: int) -> dict:
+        c = self.config
+        rng = np.random.default_rng(c.seed * 99991 + idx)
+        pixels = rng.normal(size=(c.image_size, c.image_size, c.num_channels)).astype(
+            np.float32
+        )
+        n_img = c.num_patches
+        text = rng.integers(1, c.image_token_id, c.seq_len - n_img, dtype=np.int32)
+        ids = np.concatenate([np.full(n_img, c.image_token_id, np.int32), text])
+        labels = np.concatenate([ids[1:], [IGNORE_INDEX]]).astype(np.int32)
+        labels[: n_img] = IGNORE_INDEX  # no supervision on the image span
+        return {"input_ids": ids, "labels": labels, "pixel_values": pixels}

@@ -272,6 +272,7 @@ def forward(
     mesh_ctx=None,
     rules=None,
     return_hidden: bool = False,
+    inputs_embeds: jnp.ndarray | None = None,  # (B,S,H) — VLM merged embeds
 ) -> jnp.ndarray:
     """Run the decoder. Returns logits (B,S,V) fp32, or hidden (B,S,H) when
     `return_hidden` (pair with loss/linear_ce.py to avoid materializing
@@ -286,7 +287,10 @@ def forward(
 
     constrain = _make_constrain(mesh_ctx, rules)
 
-    h = jnp.take(params["embed"]["embedding"], input_ids, axis=0).astype(cfg_dtype)
+    if inputs_embeds is not None:
+        h = inputs_embeds.astype(cfg_dtype)
+    else:
+        h = jnp.take(params["embed"]["embedding"], input_ids, axis=0).astype(cfg_dtype)
     if cfg.embed_scale != 1.0:
         h = h * jnp.asarray(cfg.embed_scale, cfg_dtype)
     h = constrain(h, ("act_batch", "act_seq", "act_embed"))

@@ -14,6 +14,7 @@ from typing import Any, Callable, Mapping
 from automodel_tpu.models.llm import decoder, families
 from automodel_tpu.models.moe_lm import decoder as moe_decoder
 from automodel_tpu.models.moe_lm import families as moe_families
+from automodel_tpu.models.vlm import llava as llava_module
 
 
 @dataclasses.dataclass(frozen=True)
@@ -43,6 +44,12 @@ MODEL_ARCH_MAPPING: dict[str, ModelSpec] = {
     "DeepseekV3ForCausalLM": ModelSpec(
         "deepseek_v3", moe_families.deepseek_v3_moe_config, moe_decoder,
         adapter_name="moe_decoder", adapter_kwargs={"style": "deepseek"},
+    ),
+    "LlavaForConditionalGeneration": ModelSpec(
+        "llava", llava_module.llava_config, llava_module, adapter_name="llava"
+    ),
+    "LlavaOnevisionForConditionalGeneration": ModelSpec(
+        "llava_onevision", llava_module.llava_config, llava_module, adapter_name="llava"
     ),
 }
 

@@ -1,0 +1,190 @@
+"""Vision transformer encoder (CLIP/SigLIP-style) for VLM towers.
+
+The analog of the reference's vision towers inside its VLM families
+(reference: nemo_automodel/components/models/llava_onevision,
+qwen3_vl_moe, kimivl … — all wrap a ViT encoder + projector). Functional
+pytree style matching the decoders: patchify → linear embed → learned
+position embeddings → pre-LN bidirectional transformer (stacked-layer
+scan) → final LN.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.layers import dense_init, maybe_remat
+from automodel_tpu.ops.attention import dot_product_attention
+from automodel_tpu.ops.norms import layer_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class VisionConfig:
+    image_size: int = 224
+    patch_size: int = 14
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    num_channels: int = 3
+    layer_norm_eps: float = 1e-6
+    # CLIP-style towers: class token + pre-LN + quick_gelu; SigLIP: none
+    use_cls_token: bool = False
+    use_pre_layernorm: bool = False
+    activation: str = "gelu_tanh"  # or "quick_gelu"
+    # -1 = after final post-LN; -2 = output of the penultimate layer (HF
+    # llava's vision_feature_layer), etc.
+    feature_layer: int = -1
+    dtype: Any = jnp.bfloat16
+    remat_policy: str = "full"
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def num_positions(self) -> int:
+        return self.num_patches + (1 if self.use_cls_token else 0)
+
+    def param_count(self) -> int:
+        H, I, L = self.hidden_size, self.intermediate_size, self.num_layers
+        return (
+            self.patch_size ** 2 * self.num_channels * H
+            + self.num_positions * H
+            + L * (4 * H * H + 2 * H * I)
+        )
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @classmethod
+    def from_hf(cls, hf: dict, **overrides) -> "VisionConfig":
+        kw = dict(
+            image_size=int(hf.get("image_size", 224)),
+            patch_size=int(hf.get("patch_size", 14)),
+            hidden_size=int(hf.get("hidden_size", 768)),
+            intermediate_size=int(hf.get("intermediate_size", 3072)),
+            num_layers=int(hf.get("num_hidden_layers", 12)),
+            num_heads=int(hf.get("num_attention_heads", 12)),
+            num_channels=int(hf.get("num_channels", 3)),
+            layer_norm_eps=float(hf.get("layer_norm_eps", 1e-6)),
+        )
+        if hf.get("hidden_act") == "quick_gelu":
+            kw["activation"] = "quick_gelu"
+        kw.update(overrides)
+        return cls(**kw)
+
+
+def init(cfg: VisionConfig, rng: jax.Array) -> dict:
+    H, I, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    D_patch = cfg.patch_size * cfg.patch_size * cfg.num_channels
+    ks = jax.random.split(rng, 8)
+
+    def stack(key, shape):
+        keys = jax.random.split(key, L)
+        return jnp.stack([dense_init(k, shape) for k in keys])
+
+    params = {
+        "patch_embed": {
+            "kernel": dense_init(ks[0], (D_patch, H)),
+            "bias": jnp.zeros((H,)),
+        },
+        "pos_embed": 0.02 * jax.random.normal(ks[1], (cfg.num_positions, H)),
+        "layers": {
+            "ln1": {"scale": jnp.ones((L, H)), "bias": jnp.zeros((L, H))},
+            "q_proj": {"kernel": stack(ks[2], (H, H)), "bias": jnp.zeros((L, H))},
+            "k_proj": {"kernel": stack(ks[3], (H, H)), "bias": jnp.zeros((L, H))},
+            "v_proj": {"kernel": stack(ks[4], (H, H)), "bias": jnp.zeros((L, H))},
+            "o_proj": {"kernel": stack(ks[5], (H, H)), "bias": jnp.zeros((L, H))},
+            "ln2": {"scale": jnp.ones((L, H)), "bias": jnp.zeros((L, H))},
+            "fc1": {"kernel": stack(ks[6], (H, I)), "bias": jnp.zeros((L, I))},
+            "fc2": {"kernel": stack(ks[7], (I, H)), "bias": jnp.zeros((L, H))},
+        },
+        "final_ln": {"scale": jnp.ones((H,)), "bias": jnp.zeros((H,))},
+    }
+    if cfg.use_cls_token:
+        params["cls_embed"] = 0.02 * jax.random.normal(
+            jax.random.fold_in(rng, 123), (H,)
+        )
+    if cfg.use_pre_layernorm:
+        params["pre_ln"] = {"scale": jnp.ones((H,)), "bias": jnp.zeros((H,))}
+    return params
+
+
+def param_specs(cfg: VisionConfig) -> dict:
+    specs = {
+        "patch_embed": {"kernel": (None, "embed"), "bias": ("norm",)},
+        "pos_embed": (None, "embed"),
+        "layers": {
+            "ln1": {"scale": ("layers", "norm"), "bias": ("layers", "norm")},
+            "q_proj": {"kernel": ("layers", "embed", "heads"), "bias": ("layers", "heads")},
+            "k_proj": {"kernel": ("layers", "embed", "heads"), "bias": ("layers", "heads")},
+            "v_proj": {"kernel": ("layers", "embed", "heads"), "bias": ("layers", "heads")},
+            "o_proj": {"kernel": ("layers", "heads", "embed"), "bias": ("layers", "norm")},
+            "ln2": {"scale": ("layers", "norm"), "bias": ("layers", "norm")},
+            "fc1": {"kernel": ("layers", "embed", "mlp"), "bias": ("layers", "mlp")},
+            "fc2": {"kernel": ("layers", "mlp", "embed"), "bias": ("layers", "norm")},
+        },
+        "final_ln": {"scale": ("norm",), "bias": ("norm",)},
+    }
+    if cfg.use_cls_token:
+        specs["cls_embed"] = ("norm",)
+    if cfg.use_pre_layernorm:
+        specs["pre_ln"] = {"scale": ("norm",), "bias": ("norm",)}
+    return specs
+
+
+def patchify(images: jnp.ndarray, patch: int) -> jnp.ndarray:
+    """(B, H, W, C) → (B, N, patch*patch*C), row-major patches."""
+    B, H, W, C = images.shape
+    gh, gw = H // patch, W // patch
+    x = images.reshape(B, gh, patch, gw, patch, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(B, gh * gw, patch * patch * C)
+
+
+def forward(params: dict, cfg: VisionConfig, images: jnp.ndarray) -> jnp.ndarray:
+    """images (B, H, W, C) float → patch features (B, N, hidden)."""
+    from automodel_tpu.models.common.layers import cast_params
+
+    params = cast_params(params, cfg.dtype)
+    x = patchify(images.astype(cfg.dtype), cfg.patch_size)
+    x = x @ params["patch_embed"]["kernel"] + params["patch_embed"]["bias"]
+    if cfg.use_cls_token:
+        cls = jnp.broadcast_to(params["cls_embed"], (x.shape[0], 1, x.shape[-1]))
+        x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_embed"].astype(cfg.dtype)
+    if cfg.use_pre_layernorm:
+        x = layer_norm(x, params["pre_ln"]["scale"], params["pre_ln"]["bias"], cfg.layer_norm_eps)
+    B, N, H = x.shape
+    nh, hd = cfg.num_heads, cfg.head_dim
+    eps = cfg.layer_norm_eps
+
+    def layer(x, lp):
+        y = layer_norm(x, lp["ln1"]["scale"], lp["ln1"]["bias"], eps)
+        q = (y @ lp["q_proj"]["kernel"] + lp["q_proj"]["bias"]).reshape(B, N, nh, hd)
+        k = (y @ lp["k_proj"]["kernel"] + lp["k_proj"]["bias"]).reshape(B, N, nh, hd)
+        v = (y @ lp["v_proj"]["kernel"] + lp["v_proj"]["bias"]).reshape(B, N, nh, hd)
+        a = dot_product_attention(q, k, v, causal=False, impl="xla")
+        x = x + a.reshape(B, N, H) @ lp["o_proj"]["kernel"] + lp["o_proj"]["bias"]
+        y = layer_norm(x, lp["ln2"]["scale"], lp["ln2"]["bias"], eps)
+        y = y @ lp["fc1"]["kernel"] + lp["fc1"]["bias"]
+        if cfg.activation == "quick_gelu":
+            y = y * jax.nn.sigmoid(1.702 * y)
+        else:
+            y = jax.nn.gelu(y, approximate=True)
+        return x + y @ lp["fc2"]["kernel"] + lp["fc2"]["bias"]
+
+    # feature_layer=-k: stop after num_layers+1-k layers, NO final post-LN
+    # (the HF llava vision_feature_layer semantics)
+    n_run = cfg.num_layers + 1 + cfg.feature_layer if cfg.feature_layer != -1 else cfg.num_layers
+    run_params = jax.tree.map(lambda a: a[:n_run], params["layers"])
+    fn = maybe_remat(lambda c, lp: (layer(c, lp), None), cfg.remat_policy)
+    x, _ = jax.lax.scan(fn, x, run_params)
+    if cfg.feature_layer == -1:
+        x = layer_norm(x, params["final_ln"]["scale"], params["final_ln"]["bias"], eps)
+    return x

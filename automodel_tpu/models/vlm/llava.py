@@ -1,0 +1,156 @@
+"""LLaVA-style vision-language model: ViT tower → MLP projector → decoder.
+
+The analog of the reference's VLM families (reference: nemo_automodel/
+components/models/llava_onevision/ — 909 LoC; _transformers
+NeMoAutoModelForImageTextToText). Image patch features are projected into
+the text embedding space and scattered into the token stream at the image
+placeholder positions (the HF llava merge), then the standard dense decoder
+runs on the merged embeddings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.layers import dense_init
+from automodel_tpu.models.llm import decoder as text_decoder
+from automodel_tpu.models.llm.families import llama_config, qwen2_config
+from automodel_tpu.models.vision import vit
+
+
+@dataclasses.dataclass(frozen=True)
+class LlavaConfig:
+    vision: vit.VisionConfig = dataclasses.field(default_factory=vit.VisionConfig)
+    text: Any = dataclasses.field(default_factory=text_decoder.TransformerConfig)
+    image_token_id: int = 32000
+    projector_layers: int = 2
+
+    @property
+    def dtype(self):
+        return self.text.dtype
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Text FLOPs/token + the tower+projector cost of one image per
+        sample amortized over the sequence."""
+        Hv, Ht = self.vision.hidden_size, self.text.hidden_size
+        vision_per_image = 6.0 * self.vision.param_count() * self.vision.num_positions
+        projector_per_image = 6.0 * (Hv * Ht + Ht * Ht) * self.vision.num_patches
+        return (
+            self.text.flops_per_token(seq_len)
+            + (vision_per_image + projector_per_image) / seq_len
+        )
+
+
+_TEXT_ADAPTERS = {
+    "llama": llama_config,
+    "qwen2": qwen2_config,
+}
+
+
+def llava_config(hf: Mapping[str, Any], **overrides) -> LlavaConfig:
+    """HF llava-style config: {vision_config, text_config, image_token_index}."""
+    text_hf = dict(hf["text_config"])
+    arch = (text_hf.get("architectures") or ["LlamaForCausalLM"])[0]
+    name = "qwen2" if "Qwen2" in arch else "llama"
+    text_overrides = {
+        k: overrides[k] for k in ("dtype", "remat_policy", "attn_impl") if k in overrides
+    }
+    text = _TEXT_ADAPTERS[name](text_hf, **text_overrides)
+    vision_hf = dict(hf["vision_config"])
+    vision_kw = dict(
+        dtype=text.dtype,
+        remat_policy=text_overrides.get("remat_policy", "full"),
+    )
+    if vision_hf.get("model_type", "") == "clip_vision_model":
+        # CLIP towers: class token, pre-LN, quick_gelu, and llava selects
+        # the penultimate layer's patch features by default
+        vision_kw.update(
+            use_cls_token=True,
+            use_pre_layernorm=True,
+            activation="quick_gelu",
+            feature_layer=int(hf.get("vision_feature_layer", -2)),
+        )
+    vision = vit.VisionConfig.from_hf(vision_hf, **vision_kw)
+    return LlavaConfig(
+        vision=vision,
+        text=text,
+        image_token_id=int(hf.get("image_token_index", hf.get("image_token_id", 32000))),
+    )
+
+
+def init(cfg: LlavaConfig, rng: jax.Array) -> dict:
+    kv, kt, kp = jax.random.split(rng, 3)
+    Hv, Ht = cfg.vision.hidden_size, cfg.text.hidden_size
+    k1, k2 = jax.random.split(kp)
+    return {
+        "vision_tower": vit.init(cfg.vision, kv),
+        "projector": {
+            "fc1": {"kernel": dense_init(k1, (Hv, Ht)), "bias": jnp.zeros((Ht,))},
+            "fc2": {"kernel": dense_init(k2, (Ht, Ht)), "bias": jnp.zeros((Ht,))},
+        },
+        "language_model": text_decoder.init(cfg.text, kt),
+    }
+
+
+def param_specs(cfg: LlavaConfig) -> dict:
+    return {
+        "vision_tower": vit.param_specs(cfg.vision),
+        "projector": {
+            "fc1": {"kernel": ("embed", "mlp"), "bias": ("norm",)},
+            "fc2": {"kernel": ("mlp", "embed"), "bias": ("norm",)},
+        },
+        "language_model": text_decoder.param_specs(cfg.text),
+    }
+
+
+def merge_image_embeddings(
+    token_embeds: jnp.ndarray,   # (B, S, H)
+    image_embeds: jnp.ndarray,   # (B, N, H)
+    image_mask: jnp.ndarray,     # (B, S) bool — True at placeholder tokens
+) -> jnp.ndarray:
+    """Scatter the j-th image patch into the j-th placeholder position
+    (the HF llava merge, jit-friendly via cumsum indexing)."""
+    order = jnp.cumsum(image_mask.astype(jnp.int32), axis=1) - 1  # (B, S)
+    order = jnp.clip(order, 0, image_embeds.shape[1] - 1)
+    gathered = jnp.take_along_axis(image_embeds, order[..., None], axis=1)
+    return jnp.where(image_mask[..., None], gathered.astype(token_embeds.dtype), token_embeds)
+
+
+def forward(
+    params: dict,
+    cfg: LlavaConfig,
+    input_ids: jnp.ndarray,      # (B, S)
+    pixel_values: jnp.ndarray,   # (B, H, W, C)
+    *,
+    positions=None,
+    segment_ids=None,
+    mesh_ctx=None,
+    rules=None,
+    return_hidden: bool = False,
+):
+    feats = vit.forward(params["vision_tower"], cfg.vision, pixel_values)
+    if cfg.vision.use_cls_token:
+        feats = feats[:, 1:]  # llava "default" select: drop the CLS feature
+    pj = params["projector"]
+    x = jax.nn.gelu(
+        feats.astype(cfg.dtype) @ pj["fc1"]["kernel"].astype(cfg.dtype)
+        + pj["fc1"]["bias"].astype(cfg.dtype),
+        approximate=True,
+    )
+    image_embeds = x @ pj["fc2"]["kernel"].astype(cfg.dtype) + pj["fc2"]["bias"].astype(cfg.dtype)
+
+    lm = params["language_model"]
+    token_embeds = jnp.take(lm["embed"]["embedding"], input_ids, axis=0).astype(cfg.dtype)
+    merged = merge_image_embeddings(
+        token_embeds, image_embeds, input_ids == cfg.image_token_id
+    )
+    return text_decoder.forward(
+        lm, cfg.text, input_ids,
+        positions=positions, segment_ids=segment_ids,
+        mesh_ctx=mesh_ctx, rules=rules,
+        return_hidden=return_hidden, inputs_embeds=merged,
+    )

@@ -12,6 +12,16 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def layer_norm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+    """Mean-centered LayerNorm in fp32 (vision towers use LN, not RMSNorm)."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(dtype)
+
+
 def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-6, zero_centered: bool = False) -> jnp.ndarray:
     """RMSNorm in fp32, output in x.dtype. scale shape: (hidden,).
 

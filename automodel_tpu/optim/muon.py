@@ -113,7 +113,9 @@ class MuonConfig:
             for path, leaf in flat:
                 keys = [str(getattr(k, "key", k)) for k in path]
                 is_matrix = leaf.ndim >= 2
-                is_embed = any(k in ("embed", "lm_head", "embedding") for k in keys)
+                # any embedding-like table (embed/pos_embed/patch_embed/
+                # lm_head/…) stays on AdamW, per Muon's exclusions
+                is_embed = any(("embed" in k) or k == "lm_head" for k in keys)
                 labels["/".join(keys)] = (
                     "muon" if (is_matrix and not is_embed) else "adamw"
                 )

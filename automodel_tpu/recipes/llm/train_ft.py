@@ -307,11 +307,21 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
     def _batch_spec(self) -> tuple:
         return (None, "batch", "cp")  # (accum, batch, seq)
 
+    def _make_global(self, batch_np: dict):
+        return make_global_batch(
+            batch_np, self.mesh_ctx, self.mesh_ctx.sharding(*self._batch_spec())
+        )
+
+    def _make_global_eval(self, batch_np: dict):
+        return make_global_batch(
+            batch_np, self.mesh_ctx, self.mesh_ctx.sharding("batch", "cp")
+        )
+
     def run_train_validation_loop(self) -> None:
         t_last = time.perf_counter()
         for microbatches in self.step_scheduler:
             batch_np = stack_microbatches(microbatches)
-            batch = make_global_batch(batch_np, self.mesh_ctx, self.mesh_ctx.sharding(*self._batch_spec()))
+            batch = self._make_global(batch_np)
             self.train_state, metrics = self._train_step(
                 self.train_state, batch, self.rng.next_key(), *self._step_extra()
             )
@@ -372,9 +382,7 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
     def _run_validation(self, step: int) -> None:
         total, count = 0.0, 0.0
         for mb in self.val_dataloader:
-            batch = make_global_batch(
-                mb, self.mesh_ctx, self.mesh_ctx.sharding("batch", "cp")
-            )
+            batch = self._make_global_eval(mb)
             loss_sum, n = self._eval_step(
                 self.train_state.params, batch, *self._step_extra()
             )

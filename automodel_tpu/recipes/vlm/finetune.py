@@ -1,0 +1,91 @@
+"""VLM finetune recipe: image+text SFT on llava-style models.
+
+The analog of `FinetuneRecipeForVLM` (reference: nemo_automodel/recipes/
+vlm/finetune.py:385). Subclasses the LLM train recipe; the differences are
+exactly the reference's: pixel_values flow through the loss, the vision
+tower can be frozen, and batches carry image tensors that shard on the
+batch axis only.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.datasets.loader import make_global_batch
+from automodel_tpu.loss import fused_linear_cross_entropy
+from automodel_tpu.recipes.llm.train_ft import TrainFinetuneRecipeForNextTokenPrediction
+
+logger = logging.getLogger(__name__)
+
+
+class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
+    def _build_optimizer(self) -> None:
+        # build tx/state via parent, then replace the loss with the VLM one
+        super()._build_optimizer()
+        cfg = self.cfg
+        module = self.model_spec.module
+        model_cfg = self.model_cfg
+        mesh_ctx = self.mesh_ctx
+        chunk = int(cfg.get("loss.chunk_size", 1024))
+        # NOTE: freezing is stop_gradient-based — pair with weight_decay: 0
+        # (or a decay mask) so AdamW's decoupled decay cannot drift the
+        # frozen tower; optimizer-exclusion freeze lands with multi-group
+        # param handling next round.
+        freeze_vision = bool(cfg.get("freeze_vision_tower", False))
+
+        def loss_fn(params, batch, rng, *extra):
+            if freeze_vision:
+                params = {**params, "vision_tower": jax.lax.stop_gradient(params["vision_tower"])}
+            kw = {}
+            for k in ("positions", "segment_ids"):
+                if k in batch:
+                    kw[k] = batch[k]
+            hidden = module.forward(
+                params, model_cfg, batch["input_ids"], batch["pixel_values"],
+                return_hidden=True, mesh_ctx=mesh_ctx, **kw,
+            )
+            lm = params["language_model"]
+            kernel = (
+                lm["embed"]["embedding"].T
+                if model_cfg.text.tie_word_embeddings
+                else lm["lm_head"]["kernel"]
+            )
+            ce, n = fused_linear_cross_entropy(
+                hidden, kernel, batch["labels"], chunk_size=chunk,
+                logits_soft_cap=model_cfg.text.logits_soft_cap,
+            )
+            return ce, {"num_label_tokens": n}
+
+        from automodel_tpu.training import TrainStepConfig, make_train_step
+
+        step_cfg = TrainStepConfig(max_grad_norm=cfg.get("max_grad_norm", 1.0))
+        self._train_step = jax.jit(
+            make_train_step(loss_fn, self.tx, self.lr_schedule, step_cfg),
+            donate_argnums=0,
+        )
+
+        def eval_loss(params, batch, *extra):
+            loss_sum, aux = loss_fn(params, batch, jax.random.key(0), *extra)
+            return loss_sum, aux["num_label_tokens"]
+
+        self._eval_step = jax.jit(eval_loss)
+
+    def _make_global(self, batch_np: dict):
+        """Sequence tensors shard (accum, batch, cp); images (accum, batch)."""
+        seq_sh = self.mesh_ctx.sharding(None, "batch", "cp")
+        img_sh = self.mesh_ctx.sharding(None, "batch")
+        shardings = {
+            k: (img_sh if k == "pixel_values" else seq_sh) for k in batch_np
+        }
+        return make_global_batch(batch_np, self.mesh_ctx, shardings)
+
+    def _make_global_eval(self, batch_np: dict):
+        seq_sh = self.mesh_ctx.sharding("batch", "cp")
+        img_sh = self.mesh_ctx.sharding("batch")
+        shardings = {
+            k: (img_sh if k == "pixel_values" else seq_sh) for k in batch_np
+        }
+        return make_global_batch(batch_np, self.mesh_ctx, shardings)

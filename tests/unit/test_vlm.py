@@ -1,0 +1,160 @@
+"""VLM tier: ViT encoder, llava merge, recipe, HF adapter roundtrip."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu.checkpoint import HFCheckpointReader, get_adapter, save_hf_checkpoint
+from automodel_tpu.models.vision import vit
+from automodel_tpu.models.vlm import llava
+
+HF_VLM = {
+    "architectures": ["LlavaForConditionalGeneration"],
+    "image_token_index": 500,
+    "vision_config": {
+        "image_size": 28, "patch_size": 14, "hidden_size": 24,
+        "intermediate_size": 48, "num_hidden_layers": 2, "num_attention_heads": 4,
+    },
+    "text_config": {
+        "architectures": ["LlamaForCausalLM"],
+        "vocab_size": 512, "hidden_size": 32, "intermediate_size": 64,
+        "num_hidden_layers": 2, "num_attention_heads": 4, "num_key_value_heads": 2,
+    },
+}
+
+
+def _cfg():
+    return llava.llava_config(HF_VLM, dtype=jnp.float32, remat_policy="none")
+
+
+def test_vit_forward_and_permutation_invariance():
+    cfg = vit.VisionConfig(
+        image_size=28, patch_size=14, hidden_size=24, intermediate_size=48,
+        num_layers=2, num_heads=4, dtype=jnp.float32, remat_policy="none",
+    )
+    params = vit.init(cfg, jax.random.key(0))
+    img = jax.random.normal(jax.random.key(1), (2, 28, 28, 3))
+    out = vit.forward(params, cfg, img)
+    assert out.shape == (2, 4, 24)
+    assert np.isfinite(np.asarray(out)).all()
+    # different images → different features
+    out2 = vit.forward(params, cfg, img + 1.0)
+    assert not np.allclose(np.asarray(out), np.asarray(out2))
+
+
+def test_merge_scatters_patches_in_order():
+    tok = jnp.zeros((1, 6, 4))
+    img = jnp.arange(12, dtype=jnp.float32).reshape(1, 3, 4)
+    mask = jnp.asarray([[True, False, True, True, False, False]])
+    merged = llava.merge_image_embeddings(tok, img, mask)
+    np.testing.assert_array_equal(np.asarray(merged[0, 0]), np.asarray(img[0, 0]))
+    np.testing.assert_array_equal(np.asarray(merged[0, 2]), np.asarray(img[0, 1]))
+    np.testing.assert_array_equal(np.asarray(merged[0, 3]), np.asarray(img[0, 2]))
+    np.testing.assert_array_equal(np.asarray(merged[0, 1]), 0.0)
+
+
+def test_llava_forward_image_dependence():
+    cfg = _cfg()
+    params = llava.init(cfg, jax.random.key(0))
+    n_img = cfg.vision.num_patches
+    ids = jnp.concatenate(
+        [jnp.full((1, n_img), 500, jnp.int32),
+         jnp.arange(8, dtype=jnp.int32)[None, :] + 1], axis=1,
+    )
+    img1 = jax.random.normal(jax.random.key(1), (1, 28, 28, 3))
+    img2 = img1 + 1.0
+    l1 = llava.forward(params, cfg, ids, img1)
+    l2 = llava.forward(params, cfg, ids, img2)
+    assert l1.shape == (1, n_img + 8, 512)
+    assert not np.allclose(np.asarray(l1), np.asarray(l2))  # image reaches logits
+
+
+def test_llava_hf_roundtrip(tmp_path):
+    cfg = _cfg()
+    params = llava.init(cfg, jax.random.key(0))
+    adapter = get_adapter("llava", cfg)
+    save_hf_checkpoint(adapter.to_hf(params), str(tmp_path))
+    reader = HFCheckpointReader(str(tmp_path))
+    assert "language_model.model.embed_tokens.weight" in reader.keys()
+    assert "multi_modal_projector.linear_1.weight" in reader.keys()
+    assert "vision_tower.vision_model.encoder.layers.0.mlp.fc1.weight" in reader.keys()
+    restored = adapter.from_hf(reader)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_vlm_recipe_trains(tmp_path):
+    from automodel_tpu.cli.app import resolve_recipe_class
+    from automodel_tpu.config import ConfigNode
+
+    cfg = ConfigNode({
+        "seed": 11,
+        "recipe": "vlm_finetune",
+        "run_dir": str(tmp_path),
+        "auto_resume": False,
+        "model": {"hf_config": HF_VLM, "dtype": "float32", "remat_policy": "none"},
+        "distributed": {"dp_shard": -1},
+        "freeze_vision_tower": True,
+        "dataset": {
+            "_target_": "automodel_tpu.datasets.vlm.MockVLMDatasetConfig",
+            "num_samples": 64, "seq_len": 32, "vocab_size": 512,
+            "image_size": 28, "patch_size": 14, "image_token_id": 500,
+        },
+        "dataloader": {"microbatch_size": 8, "grad_acc_steps": 1},
+        "optimizer": {"name": "adamw", "lr": 1e-3, "weight_decay": 0.0},
+        "lr_scheduler": {"style": "constant", "warmup_steps": 0},
+        "step_scheduler": {"max_steps": 4, "ckpt_every_steps": 100},
+        "checkpoint": {"enabled": False},
+        "loss": {"chunk_size": 32},
+    })
+    recipe_cls = resolve_recipe_class(cfg)
+    assert recipe_cls.__name__ == "FinetuneRecipeForVLM"
+    r = recipe_cls(cfg)
+    r.setup()
+    vt_before = jax.tree.map(lambda x: np.asarray(x).copy(),
+                             r.train_state.params["vision_tower"])
+    r.run_train_validation_loop()
+    recs = [json.loads(l) for l in open(tmp_path / "training.jsonl")]
+    assert len(recs) == 4 and all(np.isfinite(x["loss"]) for x in recs)
+    # frozen vision tower unchanged; language model moved
+    for a, b in zip(jax.tree.leaves(vt_before),
+                    jax.tree.leaves(r.train_state.params["vision_tower"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_clip_style_tower_roundtrip(tmp_path):
+    """CLIP variant: cls token, pre-LN, quick_gelu, penultimate feature layer."""
+    hf = dict(HF_VLM)
+    hf["vision_config"] = {**HF_VLM["vision_config"], "model_type": "clip_vision_model"}
+    hf["vision_feature_layer"] = -2
+    cfg = llava.llava_config(hf, dtype=jnp.float32, remat_policy="none")
+    assert cfg.vision.use_cls_token and cfg.vision.use_pre_layernorm
+    assert cfg.vision.activation == "quick_gelu" and cfg.vision.feature_layer == -2
+    assert cfg.vision.num_positions == cfg.vision.num_patches + 1
+    params = llava.init(cfg, jax.random.key(0))
+    n_img = cfg.vision.num_patches
+    ids = jnp.concatenate(
+        [jnp.full((1, n_img), 500, jnp.int32),
+         jnp.arange(8, dtype=jnp.int32)[None, :] + 1], axis=1,
+    )
+    img = jax.random.normal(jax.random.key(2), (1, 28, 28, 3))
+    logits = llava.forward(params, cfg, ids, img)
+    assert np.isfinite(np.asarray(logits)).all()
+
+    adapter = get_adapter("llava", cfg)
+    save_hf_checkpoint(adapter.to_hf(params), str(tmp_path))
+    reader = HFCheckpointReader(str(tmp_path))
+    assert "vision_tower.vision_model.embeddings.class_embedding" in reader.keys()
+    assert "vision_tower.vision_model.pre_layrnorm.weight" in reader.keys()
+    restored = adapter.from_hf(reader)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_vlm_flops_include_tower():
+    cfg = _cfg()
+    text_only = cfg.text.flops_per_token(64)
+    assert cfg.flops_per_token(64) > text_only
