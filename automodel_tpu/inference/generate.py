@@ -1,0 +1,179 @@
+"""Autoregressive generation with a static KV cache (dense decoder).
+
+The analog of the reference's generation surfaces (reference: examples
+vlm_generate / dllm_generate; speculative target servers). TPU-native
+design: a static-shape (L, B, max_len, Hkv, D) cache; prefill runs one
+batched pass over the prompt collecting per-layer K/V as scan outputs;
+decode is a `lax.scan` over new tokens with an inner layer scan — the whole
+generate call is one jit with no dynamic shapes.
+
+Scope: the dense GQA decoder (models/llm/decoder). Greedy or temperature
+sampling. MoE/MLA decode and batched beam search are next-round work.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.layers import cast_params
+from automodel_tpu.models.llm.decoder import (
+    TransformerConfig,
+    _dense,
+    mlp_inner,
+    project_qkv,
+    unembed,
+)
+from automodel_tpu.ops.quant import matmul as _mm
+from automodel_tpu.ops.attention import NEG_INF
+from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.rope import apply_rope, rope_frequencies
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerateConfig:
+    max_new_tokens: int = 64
+    temperature: float = 0.0  # 0 → greedy
+    eos_token_id: int | None = None
+
+
+def _attend(q, keys, values, mask_len, cfg, *, q_positions):
+    """q (B,Sq,Hq,D) vs cache keys/values (B,T,Hkv,D); attend to < mask_len
+    (per-query causal when q spans several positions)."""
+    B, Sq, Hq, D = q.shape
+    T, Hkv = keys.shape[1], keys.shape[2]
+    G = Hq // Hkv
+    qg = q.reshape(B, Sq, Hkv, G, D)
+    s = jnp.einsum("bskgd,btkd->bkgst", qg, keys, preferred_element_type=jnp.float32)
+    scale = cfg.attn_scale if cfg.attn_scale is not None else D ** -0.5
+    s = s * scale
+    if cfg.attn_soft_cap is not None:
+        s = cfg.attn_soft_cap * jnp.tanh(s / cfg.attn_soft_cap)
+    kv_idx = jnp.arange(T)
+    mask = kv_idx[None, :] <= q_positions[:, :, None]  # (B, Sq, T) causal
+    mask = jnp.logical_and(mask, (kv_idx < mask_len)[None, None, :])
+    s = jnp.where(mask[:, None, None, :, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bkgst,btkd->bskgd", p.astype(values.dtype), values)
+    return o.reshape(B, Sq, Hq, D)
+
+
+def _layer_with_cache(h, lp, cfg, positions, inv_freq, cache_k, cache_v, write_at, attend_len):
+    """Run one decoder layer, writing this chunk's K/V into the cache at
+    `write_at` and attending over cache[:attend_len]."""
+    B, Sq, _ = h.shape
+    x = rms_norm(h, lp["input_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
+    q, k, v = project_qkv(x, lp, cfg, positions, inv_freq)
+    cache_k = jax.lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype), (0, write_at, 0, 0))
+    cache_v = jax.lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype), (0, write_at, 0, 0))
+    attn = _attend(q, cache_k, cache_v, attend_len, cfg, q_positions=positions)
+    attn = attn.reshape(B, Sq, cfg.num_heads * cfg.resolved_head_dim)
+    attn_out = _dense(attn, lp["o_proj"])
+    if cfg.use_post_norms:
+        attn_out = rms_norm(attn_out, lp["post_attn_out_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
+    h = h + attn_out
+    x = rms_norm(h, lp["post_attn_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
+    mlp_out = _mm(mlp_inner(x, lp, cfg), lp["down_proj"]["kernel"], cfg.linear_precision)
+    if cfg.use_post_norms:
+        mlp_out = rms_norm(mlp_out, lp["post_mlp_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
+    return h + mlp_out, cache_k, cache_v
+
+
+def _embed(params, cfg, ids):
+    h = jnp.take(params["embed"]["embedding"], ids, axis=0).astype(cfg.dtype)
+    if cfg.embed_scale != 1.0:
+        h = h * jnp.asarray(cfg.embed_scale, cfg.dtype)
+    return h
+
+
+@partial(jax.jit, static_argnames=("cfg", "gen"))
+def generate(
+    params: dict,
+    cfg: TransformerConfig,
+    input_ids: jnp.ndarray,  # (B, S_prompt) — right-aligned, no padding
+    rng: jax.Array,
+    gen: GenerateConfig = GenerateConfig(),
+) -> jnp.ndarray:
+    """Returns (B, S_prompt + max_new_tokens) token ids."""
+    if cfg.sliding_window is not None or cfg.attention_type != "gqa":
+        raise NotImplementedError("generate: dense global-attention GQA only (r1)")
+    params = cast_params(params, cfg.dtype)
+    B, S = input_ids.shape
+    T = S + gen.max_new_tokens
+    D = cfg.resolved_head_dim
+    inv_freq = rope_frequencies(cfg.rope_dim, cfg.rope_theta, cfg.rope_scaling)
+    L = jax.tree.leaves(params["layers"])[0].shape[0]
+
+    cache_shape = (L, B, T, cfg.num_kv_heads, D)
+    cache_k = jnp.zeros(cache_shape, cfg.dtype)
+    cache_v = jnp.zeros(cache_shape, cfg.dtype)
+
+    # -- prefill: one batched pass over the prompt --------------------------
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
+    h = _embed(params, cfg, input_ids)
+
+    def prefill_layer(carry, xs):
+        h, = carry
+        lp, ck, cv = xs
+        h, ck, cv = _layer_with_cache(h, lp, cfg, positions, inv_freq, ck, cv, 0, S)
+        return (h,), (ck, cv)
+
+    (h,), (cache_k, cache_v) = jax.lax.scan(
+        prefill_layer, (h,), (params["layers"], cache_k, cache_v)
+    )
+    h_last = rms_norm(h[:, -1:], params["final_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
+    logits = unembed(params, cfg, h_last)[:, 0]
+
+    def sample(logits, key):
+        if gen.temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(key, logits / gen.temperature, axis=-1).astype(jnp.int32)
+
+    first = sample(logits, rng)
+    eos = gen.eos_token_id
+    done0 = (
+        first == eos if eos is not None else jnp.zeros_like(first, dtype=bool)
+    )
+
+    # -- decode loop ---------------------------------------------------------
+    def decode_step(carry, step):
+        token, done, cache_k, cache_v, key = carry
+        pos = S + step  # position of `token` in the sequence
+        positions = jnp.broadcast_to(pos[None, None], (B, 1)).astype(jnp.int32)
+        h = _embed(params, cfg, token[:, None])
+
+        def layer(carry, xs):
+            h, = carry
+            lp, ck, cv = xs
+            h, ck, cv = _layer_with_cache(
+                h, lp, cfg, positions, inv_freq, ck, cv, pos, pos + 1
+            )
+            return (h,), (ck, cv)
+
+        (h,), (cache_k, cache_v) = jax.lax.scan(
+            layer, (h,), (params["layers"], cache_k, cache_v)
+        )
+        h = rms_norm(h, params["final_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
+        logits = unembed(params, cfg, h)[:, 0]
+        key, sub = jax.random.split(key)
+        next_token = sample(logits, sub)
+        if eos is not None:
+            # static shapes: after EOS, keep emitting EOS (HF-style padding)
+            next_token = jnp.where(done, eos, next_token)
+            done = jnp.logical_or(done, next_token == eos)
+        return (next_token, done, cache_k, cache_v, key), token
+
+    (last, _, _, _, _), tokens = jax.lax.scan(
+        decode_step,
+        (first, done0, cache_k, cache_v, rng),
+        jnp.arange(gen.max_new_tokens - 1) if gen.max_new_tokens > 1 else jnp.arange(0),
+    )
+    new_tokens = (
+        jnp.concatenate([tokens.T, last[:, None]], axis=1)
+        if gen.max_new_tokens > 1
+        else first[:, None]
+    )
+    return jnp.concatenate([input_ids, new_tokens], axis=1)

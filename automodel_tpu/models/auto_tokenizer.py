@@ -1,0 +1,47 @@
+"""Tokenizer wrapper over HF AutoTokenizer.
+
+The analog of `NeMoAutoTokenizer` (reference: nemo_automodel/
+_transformers/auto_tokenizer.py + components/tokenization/): passthrough
+construction with the quality-of-life defaults the recipes rely on —
+pad-token defaulting to EOS, optional chat-template application, and a
+plain-callable interface the datasets use.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+logger = logging.getLogger(__name__)
+
+
+def build_tokenizer(
+    pretrained_path: str,
+    *,
+    default_pad_to_eos: bool = True,
+    trust_remote_code: bool = False,
+    **kwargs: Any,
+):
+    """Load an HF tokenizer from a local path/hub name with pad defaulting."""
+    from transformers import AutoTokenizer
+
+    tok = AutoTokenizer.from_pretrained(
+        pretrained_path, trust_remote_code=trust_remote_code, **kwargs
+    )
+    if tok.pad_token_id is None and default_pad_to_eos and tok.eos_token_id is not None:
+        tok.pad_token = tok.eos_token
+        logger.info("tokenizer pad_token defaulted to eos (%s)", tok.eos_token)
+    return tok
+
+
+def apply_chat_template(tokenizer, messages: list, add_generation_prompt: bool = False) -> str:
+    """Render a chat conversation via the tokenizer's template (or a plain
+    role-prefixed fallback when none is defined)."""
+    if getattr(tokenizer, "chat_template", None):
+        return tokenizer.apply_chat_template(
+            messages, tokenize=False, add_generation_prompt=add_generation_prompt
+        )
+    text = "".join(f"<|{m['role']}|>\n{m['content']}\n" for m in messages)
+    if add_generation_prompt:
+        text += "<|assistant|>\n"
+    return text

@@ -344,6 +344,32 @@ def unembed(params: dict, cfg: TransformerConfig, h: jnp.ndarray) -> jnp.ndarray
     return logits
 
 
+def project_qkv(x, lp, cfg: TransformerConfig, positions, inv_freq):
+    """q/k/v projections incl. bias, qk-norm, rope, linear precision —
+    shared by training attention and the KV-cache generate path."""
+    B, S, _ = x.shape
+    D = cfg.resolved_head_dim
+    q = _dense(x, lp["q_proj"], cfg.linear_precision).reshape(B, S, cfg.num_heads, D)
+    k = _dense(x, lp["k_proj"], cfg.linear_precision).reshape(B, S, cfg.num_kv_heads, D)
+    v = _dense(x, lp["v_proj"], cfg.linear_precision).reshape(B, S, cfg.num_kv_heads, D)
+    if cfg.qk_norm:
+        q = rms_norm(q, lp["q_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
+        k = rms_norm(k, lp["k_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
+    q = apply_rope(q, positions, inv_freq)
+    k = apply_rope(k, positions, inv_freq)
+    return q, k, v
+
+
+def mlp_inner(x, lp, cfg: TransformerConfig):
+    """Gated MLP core (no norm/residual) — shared with generate."""
+    from automodel_tpu.ops.quant import matmul as _mm
+
+    act = ACTIVATIONS[cfg.activation]
+    gate = act(_mm(x, lp["gate_proj"]["kernel"], cfg.linear_precision))
+    up = _mm(x, lp["up_proj"]["kernel"], cfg.linear_precision)
+    return gate * up
+
+
 def attention_block(h, lp, cfg: TransformerConfig, positions, segment_ids, inv_freq, constrain, sliding_window, mesh_ctx=None):
     """Pre-norm attention with residual; shared by dense and MoE decoders.
 
@@ -362,17 +388,10 @@ def attention_block(h, lp, cfg: TransformerConfig, positions, segment_ids, inv_f
 
     # -- attention ----------------------------------------------------------
     x = rms_norm(h, lp["input_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
-    q = _dense(x, lp["q_proj"], cfg.linear_precision).reshape(B, S, cfg.num_heads, D)
-    k = _dense(x, lp["k_proj"], cfg.linear_precision).reshape(B, S, cfg.num_kv_heads, D)
-    v = _dense(x, lp["v_proj"], cfg.linear_precision).reshape(B, S, cfg.num_kv_heads, D)
+    q, k, v = project_qkv(x, lp, cfg, positions, inv_freq)
     q = constrain(q, ("act_batch", "act_seq", "act_heads", None))
     k = constrain(k, ("act_batch", "act_seq", "act_kv_heads", None))
     v = constrain(v, ("act_batch", "act_seq", "act_kv_heads", None))
-    if cfg.qk_norm:
-        q = rms_norm(q, lp["q_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
-        k = rms_norm(k, lp["k_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
-    q = apply_rope(q, positions, inv_freq)
-    k = apply_rope(k, positions, inv_freq)
 
     if mesh_ctx is not None and mesh_ctx.sizes["cp"] > 1:
         from automodel_tpu.parallel.cp import ring_dot_product_attention
@@ -410,10 +429,7 @@ def mlp_block(h, lp, cfg: TransformerConfig, constrain):
     from automodel_tpu.ops.quant import matmul as _mm
 
     x = rms_norm(h, lp["post_attn_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
-    act = ACTIVATIONS[cfg.activation]
-    gate = act(_mm(x, lp["gate_proj"]["kernel"], cfg.linear_precision))
-    up = _mm(x, lp["up_proj"]["kernel"], cfg.linear_precision)
-    mlp = constrain(gate * up, ("act_batch", "act_seq", "act_mlp"))
+    mlp = constrain(mlp_inner(x, lp, cfg), ("act_batch", "act_seq", "act_mlp"))
     mlp_out = _mm(mlp, lp["down_proj"]["kernel"], cfg.linear_precision)
     if cfg.use_post_norms:
         mlp_out = rms_norm(

@@ -179,9 +179,20 @@ def forward(params: dict, cfg: VisionConfig, images: jnp.ndarray) -> jnp.ndarray
             y = jax.nn.gelu(y, approximate=True)
         return x + y @ lp["fc2"]["kernel"] + lp["fc2"]["bias"]
 
-    # feature_layer=-k: stop after num_layers+1-k layers, NO final post-LN
-    # (the HF llava vision_feature_layer semantics)
-    n_run = cfg.num_layers + 1 + cfg.feature_layer if cfg.feature_layer != -1 else cfg.num_layers
+    # feature_layer semantics follow HF hidden_states indexing: -1 = final
+    # (post-LN applied), -k = output of layer L+1-k, k>=0 = output of layer k
+    # — intermediate selections skip the final post-LN.
+    if cfg.feature_layer == -1:
+        n_run = cfg.num_layers
+    elif cfg.feature_layer < 0:
+        n_run = cfg.num_layers + 1 + cfg.feature_layer
+    else:
+        n_run = cfg.feature_layer
+    if not 0 < n_run <= cfg.num_layers:
+        raise ValueError(
+            f"vision feature_layer={cfg.feature_layer} out of range for "
+            f"{cfg.num_layers} layers"
+        )
     run_params = jax.tree.map(lambda a: a[:n_run], params["layers"])
     fn = maybe_remat(lambda c, lp: (layer(c, lp), None), cfg.remat_policy)
     x, _ = jax.lax.scan(fn, x, run_params)

@@ -106,6 +106,10 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         self.metric_logger = MetricLogger(os.path.join(run_dir, "training.jsonl"))
         self.val_logger = MetricLogger(os.path.join(run_dir, "validation.jsonl"))
 
+        from automodel_tpu.utils.profiling import ProfilingConfig
+
+        self.profiler = _dataclass_from_cfg(ProfilingConfig, cfg.get("profiling")).build()
+
         seq_len = int(cfg.get("dataset.seq_len", 512))
         self.mfu = MFUCalculator(
             flops_per_token=self.model_cfg.flops_per_token(seq_len),
@@ -278,9 +282,27 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         self._eval_step = jax.jit(eval_loss)
 
     # ------------------------------------------------------------------
+    def _build_tokenizer(self):
+        """Optional `tokenizer:` section → HF tokenizer with pad defaulting
+        (the NeMoAutoTokenizer analog), handed to datasets that take one."""
+        node = self.cfg.get("tokenizer")
+        if node is None:
+            return None
+        from automodel_tpu.models.auto_tokenizer import build_tokenizer
+
+        return build_tokenizer(
+            node.get("pretrained_path"),
+            trust_remote_code=bool(node.get("trust_remote_code", False)),
+        )
+
     def _build_data(self) -> None:
         cfg = self.cfg
-        dataset = cfg.get("dataset").instantiate().build()
+        tokenizer = self._build_tokenizer()
+        ds_cfg = cfg.get("dataset").instantiate()
+        try:
+            dataset = ds_cfg.build(tokenizer) if tokenizer is not None else ds_cfg.build()
+        except TypeError:
+            dataset = ds_cfg.build()
         dl_cfg = _dataclass_from_cfg(DataloaderConfig, cfg.get("dataloader"))
         div = self.mesh_ctx.batch_size_divisor
         if dl_cfg.microbatch_size % div != 0:
@@ -326,6 +348,7 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                 self.train_state, batch, self.rng.next_key(), *self._step_extra()
             )
             step = self.step_scheduler.step
+            self.profiler.step(step)
 
             if self.is_moe and self.model_cfg.moe.gate_bias_update_speed > 0:
                 self._update_gate_bias(metrics["tokens_per_expert"])
@@ -364,6 +387,7 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             self.checkpointer.wait()
         if self.cfg.get("checkpoint.save_consolidated", False):
             self.save_consolidated_hf()
+        self.profiler.close()
         self.metric_logger.close()
         self.val_logger.close()
 

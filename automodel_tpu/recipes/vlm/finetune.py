@@ -35,8 +35,14 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
         # frozen tower; optimizer-exclusion freeze lands with multi-group
         # param handling next round.
         freeze_vision = bool(cfg.get("freeze_vision_tower", False))
+        peft_cfg = self.peft_cfg
 
         def loss_fn(params, batch, rng, *extra):
+            if peft_cfg is not None:
+                from automodel_tpu.peft.lora import merge_lora
+
+                (base_params,) = extra
+                params = merge_lora(base_params, params, peft_cfg)
             if freeze_vision:
                 params = {**params, "vision_tower": jax.lax.stop_gradient(params["vision_tower"])}
             kw = {}

@@ -217,3 +217,17 @@ def test_benchmark_recipe_moe_fake_gate(tmp_path):
 
     recs = [_json.loads(l) for l in open(tmp_path / "training.jsonl")]
     assert recs[-1]["metric"] == "benchmark_step_seconds"
+
+
+def test_profiling_trace_capture(tmp_path):
+    cfg = _smoke_cfg(tmp_path)
+    cfg.set("profiling", {"trace_dir": str(tmp_path / "trace"), "start_step": 1, "num_steps": 2})
+    r = resolve_recipe_class(cfg)(cfg)
+    r.setup()
+    r.run_train_validation_loop()
+    assert r.profiler.done
+    import glob
+
+    assert glob.glob(str(tmp_path / "trace" / "**" / "*.pb"), recursive=True) or glob.glob(
+        str(tmp_path / "trace" / "**" / "*.json.gz"), recursive=True
+    ), "no trace files written"
