@@ -26,6 +26,8 @@ RECIPE_ALIASES = {
     "llm_benchmark": "automodel_tpu.recipes.llm.benchmark.BenchmarkRecipe",
     "llm_kd": "automodel_tpu.recipes.llm.kd.KDRecipeForNextTokenPrediction",
     "vlm_finetune": "automodel_tpu.recipes.vlm.finetune.FinetuneRecipeForVLM",
+    "llm_seq_cls": "automodel_tpu.recipes.llm.train_seq_cls.TrainSeqClsRecipe",
+    "retrieval_bi_encoder": "automodel_tpu.recipes.retrieval.train_bi_encoder.TrainBiEncoderRecipe",
 }
 
 

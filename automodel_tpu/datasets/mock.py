@@ -60,3 +60,76 @@ class MockDataset:
             labels = sample["labels"]
             labels[np.flatnonzero(np.diff(seg))] = -100
         return sample
+
+
+@dataclasses.dataclass
+class MockSeqClsDatasetConfig:
+    """Mock sequence-classification set (reference: mock_seq_cls)."""
+
+    num_samples: int = 256
+    seq_len: int = 64
+    vocab_size: int = 512
+    num_labels: int = 4
+    seed: int = 0
+
+    def build(self) -> "MockSeqClsDataset":
+        return MockSeqClsDataset(self)
+
+
+class MockSeqClsDataset:
+    def __init__(self, config: MockSeqClsDatasetConfig):
+        self.config = config
+
+    def __len__(self) -> int:
+        return self.config.num_samples
+
+    def __getitem__(self, idx: int) -> dict:
+        c = self.config
+        rng = np.random.default_rng(c.seed * 77771 + idx)
+        label = int(rng.integers(0, c.num_labels))
+        # learnable signal: the label's token id is over-represented
+        tokens = rng.integers(1, c.vocab_size, c.seq_len, dtype=np.int32)
+        tokens[rng.random(c.seq_len) < 0.3] = label + 1
+        n_real = int(rng.integers(c.seq_len // 2, c.seq_len + 1))
+        mask = np.zeros(c.seq_len, np.int32)
+        mask[:n_real] = 1
+        tokens[n_real:] = 0
+        return {
+            "input_ids": tokens,
+            "attention_mask": mask,
+            "label": np.int32(label),
+        }
+
+
+@dataclasses.dataclass
+class MockRetrievalDatasetConfig:
+    """Mock (query, positive-doc) pairs for bi-encoder training."""
+
+    num_samples: int = 256
+    seq_len: int = 32
+    vocab_size: int = 512
+    seed: int = 0
+
+    def build(self) -> "MockRetrievalDataset":
+        return MockRetrievalDataset(self)
+
+
+class MockRetrievalDataset:
+    def __init__(self, config: MockRetrievalDatasetConfig):
+        self.config = config
+
+    def __len__(self) -> int:
+        return self.config.num_samples
+
+    def __getitem__(self, idx: int) -> dict:
+        c = self.config
+        rng = np.random.default_rng(c.seed * 55001 + idx)
+        # query and its positive share a vocabulary slice → learnable match
+        base = rng.integers(1, c.vocab_size // 2)
+        q = rng.integers(base, base + 40, c.seq_len).astype(np.int32) % c.vocab_size
+        d = rng.integers(base, base + 40, c.seq_len).astype(np.int32) % c.vocab_size
+        ones = np.ones(c.seq_len, np.int32)
+        return {
+            "query_ids": q, "doc_ids": d,
+            "query_mask": ones, "doc_mask": ones.copy(),
+        }

@@ -1,0 +1,41 @@
+"""InfoNCE contrastive loss for retrieval training.
+
+The analog of the reference retrieval loss (reference: nemo_automodel/
+components/loss/infonce.py; recipes train_bi_encoder). In-batch negatives:
+each query's positive is its own document; every other document in the
+(global) batch is a negative.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def info_nce_loss(
+    query_emb: jnp.ndarray,  # (B, D)
+    doc_emb: jnp.ndarray,    # (B, D)
+    *,
+    temperature: float = 0.05,
+    symmetric: bool = True,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (sum_loss, count) matching the framework loss contract."""
+    q = query_emb / (jnp.linalg.norm(query_emb, axis=-1, keepdims=True) + 1e-8)
+    d = doc_emb / (jnp.linalg.norm(doc_emb, axis=-1, keepdims=True) + 1e-8)
+    logits = (q @ d.T).astype(jnp.float32) / temperature  # (B, B)
+    labels = jnp.arange(q.shape[0])
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    loss_q = jnp.sum(lse - jnp.take_along_axis(logits, labels[:, None], 1)[:, 0])
+    if symmetric:
+        lse_d = jax.scipy.special.logsumexp(logits.T, axis=-1)
+        loss_d = jnp.sum(lse_d - jnp.take_along_axis(logits.T, labels[:, None], 1)[:, 0])
+        total = 0.5 * (loss_q + loss_d)
+    else:
+        total = loss_q
+    return total, jnp.float32(q.shape[0])
+
+
+def mean_pool(hidden: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Masked mean pooling (B,S,H) → (B,H)."""
+    m = mask.astype(hidden.dtype)[..., None]
+    return jnp.sum(hidden * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)

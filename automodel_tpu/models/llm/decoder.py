@@ -60,6 +60,9 @@ class TransformerConfig:
     tie_word_embeddings: bool = False
     activation: str = "silu"
     zero_centered_norm: bool = False  # gemma stores scale-1
+    # False → bidirectional attention (retrieval/embedding encoders,
+    # reference: models/llama_bidirectional)
+    causal: bool = True
     # attention flavor: "gqa" (default) or "mla" (DeepSeek latent attention)
     attention_type: str = "gqa"
     mla_q_lora_rank: Optional[int] = None
@@ -398,7 +401,7 @@ def attention_block(h, lp, cfg: TransformerConfig, positions, segment_ids, inv_f
 
         attn = ring_dot_product_attention(
             q, k, v, positions, segment_ids, mesh_ctx,
-            causal=True,
+            causal=cfg.causal,
             sliding_window=sliding_window,
             logits_soft_cap=cfg.attn_soft_cap,
             scale=cfg.attn_scale,
@@ -406,7 +409,7 @@ def attention_block(h, lp, cfg: TransformerConfig, positions, segment_ids, inv_f
     else:
         attn = dot_product_attention(
             q, k, v,
-            causal=True,
+            causal=cfg.causal,
             segment_ids=segment_ids,
             positions=positions,
             sliding_window=sliding_window,

@@ -123,7 +123,7 @@ def mla_attention_block(h, lp, cfg, positions, segment_ids, inv_freq, constrain,
 
         attn = ring_dot_product_attention(
             q, k, v, positions, segment_ids, mesh_ctx,
-            causal=True,
+            causal=cfg.causal,
             sliding_window=sliding_window,
             logits_soft_cap=cfg.attn_soft_cap,
             scale=scale,
@@ -131,7 +131,7 @@ def mla_attention_block(h, lp, cfg, positions, segment_ids, inv_freq, constrain,
     else:
         attn = dot_product_attention(
             q, k, v,
-            causal=True,
+            causal=cfg.causal,
             segment_ids=segment_ids,
             positions=positions,
             sliding_window=sliding_window,

@@ -31,8 +31,18 @@ class MoEConfig:
     moe_intermediate_size: int = 512
     shared_expert_intermediate_size: Optional[int] = None
     capacity_factor: float = 1.25    # static-shape dispatch headroom
+    # "capacity": einsum dispatch with padding (EP-friendly; GSPMD A2A)
+    # "dropless": sort + ragged grouped GEMM (no drops; ep=1 meshes)
+    dispatcher: str = "capacity"
     router_dtype: str = "float32"
     fake_balanced_gate: bool = False  # perf benchmarking (reference layers.py:126)
+
+    def __post_init__(self):
+        if self.dispatcher not in ("capacity", "dropless"):
+            raise ValueError(
+                f"Unknown MoE dispatcher '{self.dispatcher}' "
+                "(expected 'capacity' or 'dropless')"
+            )
 
     @property
     def shared_intermediate(self) -> int:

@@ -93,6 +93,47 @@ def dispatch_tensors(
     return dispatch, combine
 
 
+def experts_forward_dropless(
+    params: dict,
+    cfg: MoEConfig,
+    x: jnp.ndarray,        # (T, H)
+    weights: jnp.ndarray,  # (T, K)
+    indices: jnp.ndarray,  # (T, K)
+) -> jnp.ndarray:
+    """Dropless sort-based dispatch + ragged grouped GEMM.
+
+    The megablox/`GroupedExpertsDeepEP` analog (reference: experts.py:651):
+    (token, slot) pairs are sorted by expert id, the three expert matmuls run
+    as `lax.ragged_dot` over the per-expert group sizes (no capacity padding,
+    no dropped tokens), and outputs scatter-add back into token order. Static
+    shapes throughout (TK rows total), so jit-compatible.
+
+    Scope: replicated or dp-sharded experts (ep=1) — ragged group sizes
+    don't currently split across an `ep` axis under GSPMD; EP meshes use the
+    capacity dispatcher.
+    """
+    T, H = x.shape
+    K = cfg.experts_per_token
+    E = cfg.n_routed_experts
+    act = _EXPERT_ACT[cfg.expert_activation]
+    dtype = x.dtype
+
+    flat_expert = indices.reshape(T * K)
+    # stable sort groups rows by expert while keeping token order within
+    sort_idx = jnp.argsort(flat_expert, stable=True)
+    token_of = sort_idx // K
+    xs = jnp.take(x, token_of, axis=0)  # (TK, H)
+    group_sizes = jnp.bincount(flat_expert, length=E).astype(jnp.int32)
+
+    g = act(jax.lax.ragged_dot(xs, params["gate_proj"]["kernel"].astype(dtype), group_sizes))
+    u = jax.lax.ragged_dot(xs, params["up_proj"]["kernel"].astype(dtype), group_sizes)
+    y = jax.lax.ragged_dot(g * u, params["down_proj"]["kernel"].astype(dtype), group_sizes)
+
+    w_sorted = jnp.take(weights.reshape(T * K), sort_idx, axis=0).astype(dtype)
+    contrib = y * w_sorted[:, None]
+    return jnp.zeros((T, H), dtype).at[token_of].add(contrib)
+
+
 def experts_forward(
     params: dict,
     cfg: MoEConfig,

@@ -23,6 +23,7 @@ from automodel_tpu.moe.experts import (
     dispatch_tensors,
     expert_param_specs,
     experts_forward,
+    experts_forward_dropless,
     init_experts,
 )
 from automodel_tpu.moe.gate import gate_forward, gate_param_specs, init_gate
@@ -72,9 +73,12 @@ def moe_forward(
     flat = x.reshape(B * S, H)
     flat_mask = token_mask.reshape(B * S) if token_mask is not None else None
     weights, indices, aux_loss, stats = gate_forward(params["gate"], cfg, flat, flat_mask)
-    capacity = compute_capacity(cfg, B * S)
-    dispatch, combine = dispatch_tensors(cfg, indices, weights, capacity)
-    routed = experts_forward(params["experts"], cfg, flat, dispatch, combine, constrain)
+    if cfg.dispatcher == "dropless":
+        routed = experts_forward_dropless(params["experts"], cfg, flat, weights, indices)
+    else:
+        capacity = compute_capacity(cfg, B * S)
+        dispatch, combine = dispatch_tensors(cfg, indices, weights, capacity)
+        routed = experts_forward(params["experts"], cfg, flat, dispatch, combine, constrain)
     out = routed
     if cfg.n_shared_experts > 0:
         sp = params["shared"]

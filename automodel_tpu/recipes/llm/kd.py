@@ -85,8 +85,7 @@ class KDRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPrediction):
         )
 
     # -- loss --------------------------------------------------------------
-    def _build_optimizer(self) -> None:
-        super()._build_optimizer()
+    def _make_loss_fn(self):
         cfg = self.cfg
         kd_ratio = float(cfg.get("kd.ratio", 0.5))
         temperature = float(cfg.get("kd.temperature", 1.0))
@@ -130,19 +129,7 @@ class KDRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPrediction):
             )
             return total, {"num_label_tokens": n}
 
-        from automodel_tpu.training import TrainStepConfig, make_train_step
-
-        step_cfg = TrainStepConfig(max_grad_norm=cfg.get("max_grad_norm", 1.0))
-        self._train_step = jax.jit(
-            make_train_step(kd_loss_fn, self.tx, self.lr_schedule, step_cfg),
-            donate_argnums=0,
-        )
-
-        def eval_loss(params, batch, *extra):
-            loss_sum, aux = kd_loss_fn(params, batch, jax.random.key(0), *extra)
-            return loss_sum, aux["num_label_tokens"]
-
-        self._eval_step = jax.jit(eval_loss)
+        return kd_loss_fn
 
     def _step_extra(self) -> tuple:
         return (self.teacher_params,)

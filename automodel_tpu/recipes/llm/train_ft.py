@@ -152,12 +152,18 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         self.model_spec = get_model_spec(hf_config)
         self.is_moe = self.model_spec.adapter_name == "moe_decoder"
         self.model_cfg = self.model_spec.config_from_hf(hf_config, **overrides)
-        if self.is_moe and cfg.get("model.fake_balanced_gate", False):
-            # benchmark conditions (reference: FakeBalancedGate, layers.py:126)
-            self.model_cfg = dataclasses.replace(
-                self.model_cfg,
-                moe=dataclasses.replace(self.model_cfg.moe, fake_balanced_gate=True),
-            )
+        if self.is_moe:
+            moe_over = {}
+            if cfg.get("model.fake_balanced_gate", False):
+                # benchmark conditions (reference: FakeBalancedGate, layers.py:126)
+                moe_over["fake_balanced_gate"] = True
+            if cfg.get("model.moe_dispatcher", None):
+                moe_over["dispatcher"] = cfg.get("model.moe_dispatcher")
+            if moe_over:
+                self.model_cfg = dataclasses.replace(
+                    self.model_cfg,
+                    moe=dataclasses.replace(self.model_cfg.moe, **moe_over),
+                )
         self._hf_config = dict(hf_config)
 
         module = self.model_spec.module
@@ -225,7 +231,27 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             return s if isinstance(s, jax.sharding.NamedSharding) else rep
 
         self.train_state = jax.device_put(state, jax.tree.map(_sh, state))
+        self._install_loss(self._make_loss_fn())
 
+    def _install_loss(self, loss_fn) -> None:
+        """Jit the train/eval steps around a loss function. Single install
+        point — subclasses provide the loss via _make_loss_fn()."""
+        step_cfg = TrainStepConfig(max_grad_norm=self.cfg.get("max_grad_norm", 1.0))
+        self._train_step = jax.jit(
+            make_train_step(loss_fn, self.tx, self.lr_schedule, step_cfg),
+            donate_argnums=0,
+        )
+
+        def eval_loss(params, batch, *extra):
+            loss_sum, aux = loss_fn(params, batch, jax.random.key(0), *extra)
+            if not isinstance(aux, dict):
+                aux = {"num_label_tokens": aux}
+            return loss_sum, aux["num_label_tokens"]
+
+        self._eval_step = jax.jit(eval_loss)
+
+    def _make_loss_fn(self):
+        cfg = self.cfg
         module = self.model_spec.module
         model_cfg = self.model_cfg
         mesh_ctx = self.mesh_ctx
@@ -269,17 +295,7 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             total, n = combine_losses(ce_sum, n, aux)
             return total, {"num_label_tokens": n, **extra}
 
-        step_cfg = TrainStepConfig(max_grad_norm=cfg.get("max_grad_norm", 1.0))
-        self._train_step = jax.jit(
-            make_train_step(loss_fn, self.tx, self.lr_schedule, step_cfg),
-            donate_argnums=0,
-        )
-
-        def eval_loss(params, batch, *extra):
-            loss_sum, aux = loss_fn(params, batch, jax.random.key(0), *extra)
-            return loss_sum, aux["num_label_tokens"]
-
-        self._eval_step = jax.jit(eval_loss)
+        return loss_fn
 
     # ------------------------------------------------------------------
     def _build_tokenizer(self):
@@ -334,6 +350,11 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             batch_np, self.mesh_ctx, self.mesh_ctx.sharding(*self._batch_spec())
         )
 
+    def _batch_token_count(self, batch_np: dict) -> int:
+        """Tokens processed this step (for tps/MFU); recipes with other batch
+        layouts override."""
+        return int(batch_np["input_ids"].size)
+
     def _make_global_eval(self, batch_np: dict):
         return make_global_batch(
             batch_np, self.mesh_ctx, self.mesh_ctx.sharding("batch", "cp")
@@ -355,7 +376,7 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
 
             now = time.perf_counter()
             n_tokens = float(metrics["num_label_tokens"])
-            global_tokens = int(batch_np["input_ids"].size) * jax.process_count()
+            global_tokens = self._batch_token_count(batch_np) * jax.process_count()
             perf = self.mfu.metrics(global_tokens, now - t_last)
             t_last = now
             record = {
@@ -372,6 +393,10 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                 record["moe_load_imbalance"] = float(
                     tpe.max(-1).mean() / max(tpe.mean(), 1e-9)
                 )
+            # forward any extra scalar aux metrics a loss_fn reported
+            for k, v in metrics.items():
+                if k not in record and k != "tokens_per_expert" and getattr(v, "ndim", 0) == 0:
+                    record[k] = float(v)
             self.metric_logger.log(record)
 
             if self.step_scheduler.is_val_step and self.val_dataloader is not None:

@@ -1,0 +1,92 @@
+"""Sequence-classification finetune recipe.
+
+The analog of the reference seq-cls recipe (reference: nemo_automodel/
+recipes/llm/train_seq_cls.py + NeMoAutoModelForSequenceClassification).
+The decoder runs with `return_hidden`; the last non-padded token's hidden
+state feeds a classification head (the HF `*ForSequenceClassification`
+convention). The head's params live next to the backbone in the train
+state, so checkpoints/PEFT/etc. all work unchanged.
+
+YAML adds:
+
+    seq_cls: {num_labels: 4}
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.layers import dense_init
+from automodel_tpu.recipes.llm.train_ft import TrainFinetuneRecipeForNextTokenPrediction
+
+logger = logging.getLogger(__name__)
+
+
+class TrainSeqClsRecipe(TrainFinetuneRecipeForNextTokenPrediction):
+    def _build_model(self) -> None:
+        super()._build_model()
+        if self.peft_cfg is not None:
+            raise NotImplementedError("seq-cls + PEFT lands next round")
+        if self.is_moe:
+            raise NotImplementedError("seq-cls with MoE backbones lands next round")
+        num_labels = int(self.cfg.get("seq_cls.num_labels", 2))
+        self.num_labels = num_labels
+        head = dense_init(
+            self.rng.next_key(), (self.model_cfg.hidden_size, num_labels)
+        )
+        self._init_params = {
+            **self._init_params,
+            "score_head": {"kernel": jax.device_put(head, self.mesh_ctx.replicated())},
+        }
+
+    def _make_loss_fn(self):
+        cfg = self.cfg
+        module = self.model_spec.module
+        model_cfg = self.model_cfg
+        mesh_ctx = self.mesh_ctx
+
+        def loss_fn(params, batch, rng, *extra):
+            backbone = {k: v for k, v in params.items() if k != "score_head"}
+            hidden = module.forward(
+                backbone, model_cfg, batch["input_ids"],
+                return_hidden=True, mesh_ctx=mesh_ctx,
+            )
+            # last non-pad token per row (attention_mask: 1 = real token)
+            mask = batch.get("attention_mask", jnp.ones_like(batch["input_ids"]))
+            last = jnp.maximum(jnp.sum(mask, axis=-1) - 1, 0)  # (B,)
+            pooled = jnp.take_along_axis(hidden, last[:, None, None], axis=1)[:, 0]
+            logits = (
+                pooled @ params["score_head"]["kernel"].astype(pooled.dtype)
+            ).astype(jnp.float32)
+            labels = batch["label"]
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            picked = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+            loss_sum = jnp.sum(lse - picked)
+            acc = jnp.sum((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+            n = jnp.float32(labels.shape[0])
+            return loss_sum, {"num_label_tokens": n, "num_correct": acc}
+
+        return loss_fn
+
+    def _make_global(self, batch_np: dict):
+        from automodel_tpu.datasets.loader import make_global_batch
+
+        seq_sh = self.mesh_ctx.sharding(None, "batch", "cp")
+        lbl_sh = self.mesh_ctx.sharding(None, "batch")
+        shardings = {
+            k: (lbl_sh if k == "label" else seq_sh) for k in batch_np
+        }
+        return make_global_batch(batch_np, self.mesh_ctx, shardings)
+
+    def _make_global_eval(self, batch_np: dict):
+        from automodel_tpu.datasets.loader import make_global_batch
+
+        seq_sh = self.mesh_ctx.sharding("batch", "cp")
+        lbl_sh = self.mesh_ctx.sharding("batch")
+        shardings = {
+            k: (lbl_sh if k == "label" else seq_sh) for k in batch_np
+        }
+        return make_global_batch(batch_np, self.mesh_ctx, shardings)

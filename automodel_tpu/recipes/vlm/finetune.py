@@ -22,9 +22,7 @@ logger = logging.getLogger(__name__)
 
 
 class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
-    def _build_optimizer(self) -> None:
-        # build tx/state via parent, then replace the loss with the VLM one
-        super()._build_optimizer()
+    def _make_loss_fn(self):
         cfg = self.cfg
         module = self.model_spec.module
         model_cfg = self.model_cfg
@@ -65,19 +63,7 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
             )
             return ce, {"num_label_tokens": n}
 
-        from automodel_tpu.training import TrainStepConfig, make_train_step
-
-        step_cfg = TrainStepConfig(max_grad_norm=cfg.get("max_grad_norm", 1.0))
-        self._train_step = jax.jit(
-            make_train_step(loss_fn, self.tx, self.lr_schedule, step_cfg),
-            donate_argnums=0,
-        )
-
-        def eval_loss(params, batch, *extra):
-            loss_sum, aux = loss_fn(params, batch, jax.random.key(0), *extra)
-            return loss_sum, aux["num_label_tokens"]
-
-        self._eval_step = jax.jit(eval_loss)
+        return loss_fn
 
     def _make_global(self, batch_np: dict):
         """Sequence tensors shard (accum, batch, cp); images (accum, batch)."""

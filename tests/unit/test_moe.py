@@ -333,3 +333,62 @@ def test_yarn_rope_and_rope_permutation():
     fwd = _permute_k_rope(kv, 3, 4, inverse=False)
     np.testing.assert_array_equal(fwd[0], [0, 1, 2, 3, 5, 4, 6])
     np.testing.assert_array_equal(_permute_k_rope(fwd, 3, 4, inverse=True), kv)
+
+
+def test_dropless_matches_capacity_with_ample_headroom():
+    """With no drops possible, dropless == capacity dispatch exactly."""
+    import dataclasses as dc
+
+    from automodel_tpu.moe.experts import experts_forward_dropless
+    from automodel_tpu.moe.layer import moe_forward as _mf
+
+    cfg_cap = dc.replace(MOE, capacity_factor=4.0)
+    cfg_drop = dc.replace(MOE, dispatcher="dropless")
+    params = init_moe(cfg_cap, 16, jax.random.key(0))
+    x = jax.random.normal(jax.random.key(4), (2, 6, 16))
+    out_cap, aux1, _ = _mf(params, cfg_cap, x)
+    out_drop, aux2, _ = _mf(params, cfg_drop, x)
+    np.testing.assert_allclose(
+        np.asarray(out_cap), np.asarray(out_drop), rtol=2e-3, atol=2e-3
+    )
+    np.testing.assert_allclose(float(aux1), float(aux2), rtol=1e-5)
+
+
+def test_dropless_no_drops_under_imbalance():
+    """All tokens route to ONE expert: capacity drops most, dropless keeps all."""
+    import dataclasses as dc
+
+    cfg = dc.replace(
+        MOE, n_routed_experts=4, experts_per_token=1, capacity_factor=1.0,
+        dispatcher="dropless",
+    )
+    params = init_moe(cfg, 16, jax.random.key(0))
+    x = jax.random.normal(jax.random.key(5), (32, 16))
+    w = jnp.ones((32, 1))
+    idx = jnp.zeros((32, 1), jnp.int32)  # everyone → expert 0
+    from automodel_tpu.moe.experts import experts_forward_dropless
+
+    out = experts_forward_dropless(params["experts"], cfg, x, w, idx)
+    # every row equals the dense expert-0 computation (nothing dropped)
+    ek = params["experts"]
+    g = jax.nn.silu(x @ ek["gate_proj"]["kernel"][0])
+    u = x @ ek["up_proj"]["kernel"][0]
+    ref = (g * u) @ ek["down_proj"]["kernel"][0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+def test_dropless_grads_and_masked_tokens():
+    import dataclasses as dc
+
+    cfg = dc.replace(MOE, dispatcher="dropless")
+    params = init_moe(cfg, 16, jax.random.key(0))
+    x = jax.random.normal(jax.random.key(6), (1, 8, 16))
+    mask = jnp.asarray([[True] * 5 + [False] * 3])
+
+    def loss(p):
+        out, aux, _ = moe_forward(p, cfg, x, token_mask=mask)
+        return jnp.sum(out ** 2) + aux
+
+    g = jax.grad(loss)(params)
+    for leaf in jax.tree.leaves(g):
+        assert np.isfinite(np.asarray(leaf)).all()
