@@ -108,6 +108,10 @@ class DenseDecoderAdapter:
                 ("self_attn.q_norm.weight", ("q_norm", "scale"), False),
                 ("self_attn.k_norm.weight", ("k_norm", "scale"), False),
             ]
+        if getattr(cfg, "o_proj_bias", False):
+            e.append(("self_attn.o_proj.bias", ("o_proj", "bias"), False))
+        if getattr(cfg, "attention_sinks", False):
+            e.append(("self_attn.sinks", ("sinks",), False))
         return [entry if len(entry) == 4 else (*entry, None) for entry in e]
 
     def _mla_layer_entries(self) -> list[tuple[str, tuple, bool]]:
@@ -227,6 +231,8 @@ class MoEDecoderAdapter:
     def _gate_name(self, i: int) -> str:
         if self.style == "mixtral":
             return f"model.layers.{i}.block_sparse_moe.gate.weight"
+        if self.style == "gpt_oss":
+            return f"model.layers.{i}.mlp.router.weight"
         return f"model.layers.{i}.mlp.gate.weight"
 
     def _dense(self) -> DenseDecoderAdapter:
@@ -264,6 +270,31 @@ class MoEDecoderAdapter:
                 yield f"model.layers.{i}.{suffix}", (_t(x) if transpose else x)
             moe = moe_layers["moe"]
             yield self._gate_name(i), _t(np.asarray(moe["gate"]["weight"][li]))
+            if "bias" in moe["gate"]:
+                yield self._gate_name(i).replace(".weight", ".bias"), np.asarray(
+                    moe["gate"]["bias"][li]
+                )
+            if self.style == "gpt_oss":
+                ek = moe["experts"]
+                g = np.asarray(ek["gate_proj"]["kernel"][li])  # (E, H, I)
+                u = np.asarray(ek["up_proj"]["kernel"][li])
+                fused = np.empty((g.shape[0], g.shape[1], 2 * g.shape[2]), g.dtype)
+                fused[..., ::2] = g
+                fused[..., 1::2] = u
+                yield f"model.layers.{i}.mlp.experts.gate_up_proj", fused
+                gb = np.asarray(ek["gate_proj"]["bias"][li])
+                ub = np.asarray(ek["up_proj"]["bias"][li])
+                fb = np.empty((gb.shape[0], 2 * gb.shape[1]), gb.dtype)
+                fb[..., ::2] = gb
+                fb[..., 1::2] = ub
+                yield f"model.layers.{i}.mlp.experts.gate_up_proj_bias", fb
+                yield f"model.layers.{i}.mlp.experts.down_proj", np.asarray(
+                    ek["down_proj"]["kernel"][li]
+                )
+                yield f"model.layers.{i}.mlp.experts.down_proj_bias", np.asarray(
+                    ek["down_proj"]["bias"][li]
+                )
+                continue
             if "e_score_bias" in moe["gate"]:
                 yield f"model.layers.{i}.mlp.gate.e_score_correction_bias", np.asarray(
                     moe["gate"]["e_score_bias"][li]
@@ -312,6 +343,42 @@ class MoEDecoderAdapter:
             ("moe_layers", "moe", "gate", "weight"),
             np.stack([_t(read(self._gate_name(fk + li))) for li in range(cfg.num_moe_layers)]),
         )
+        if cfg.moe.router_bias:
+            put(
+                ("moe_layers", "moe", "gate", "bias"),
+                np.stack([
+                    np.asarray(read(self._gate_name(fk + li).replace(".weight", ".bias")))
+                    for li in range(cfg.num_moe_layers)
+                ]),
+            )
+        if self.style == "gpt_oss":
+            fused = np.stack([
+                np.asarray(read(f"model.layers.{fk + li}.mlp.experts.gate_up_proj"))
+                for li in range(cfg.num_moe_layers)
+            ])  # (L, E, H, 2I)
+            put(("moe_layers", "moe", "experts", "gate_proj", "kernel"), fused[..., ::2])
+            put(("moe_layers", "moe", "experts", "up_proj", "kernel"), fused[..., 1::2])
+            fb = np.stack([
+                np.asarray(read(f"model.layers.{fk + li}.mlp.experts.gate_up_proj_bias"))
+                for li in range(cfg.num_moe_layers)
+            ])
+            put(("moe_layers", "moe", "experts", "gate_proj", "bias"), fb[..., ::2])
+            put(("moe_layers", "moe", "experts", "up_proj", "bias"), fb[..., 1::2])
+            put(
+                ("moe_layers", "moe", "experts", "down_proj", "kernel"),
+                np.stack([
+                    np.asarray(read(f"model.layers.{fk + li}.mlp.experts.down_proj"))
+                    for li in range(cfg.num_moe_layers)
+                ]),
+            )
+            put(
+                ("moe_layers", "moe", "experts", "down_proj", "bias"),
+                np.stack([
+                    np.asarray(read(f"model.layers.{fk + li}.mlp.experts.down_proj_bias"))
+                    for li in range(cfg.num_moe_layers)
+                ]),
+            )
+            return out
         if cfg.moe.gate_bias_update_speed > 0:
             def read_bias(li):
                 try:

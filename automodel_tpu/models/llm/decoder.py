@@ -63,6 +63,9 @@ class TransformerConfig:
     # False → bidirectional attention (retrieval/embedding encoders,
     # reference: models/llama_bidirectional)
     causal: bool = True
+    # gpt-oss: learnable per-head sink logits in the softmax denominator
+    attention_sinks: bool = False
+    o_proj_bias: bool = False  # gpt-oss biases ALL four attention projections
     # attention flavor: "gqa" (default) or "mla" (DeepSeek latent attention)
     attention_type: str = "gqa"
     mla_q_lora_rank: Optional[int] = None
@@ -175,12 +178,16 @@ def init_attention_layers(cfg: TransformerConfig, rng: jax.Array, L: int) -> dic
         layers["q_proj"]["bias"] = jnp.zeros((L, cfg.num_heads * D))
         layers["k_proj"]["bias"] = jnp.zeros((L, cfg.num_kv_heads * D))
         layers["v_proj"]["bias"] = jnp.zeros((L, cfg.num_kv_heads * D))
+    if cfg.o_proj_bias:
+        layers["o_proj"]["bias"] = jnp.zeros((L, H))
     if cfg.qk_norm:
         layers["q_norm"] = {"scale": jnp.ones((L, D))}
         layers["k_norm"] = {"scale": jnp.ones((L, D))}
     if cfg.use_post_norms:
         layers["post_attn_out_norm"] = {"scale": jnp.ones((L, H))}
         layers["post_mlp_norm"] = {"scale": jnp.ones((L, H))}
+    if cfg.attention_sinks:
+        layers["sinks"] = jnp.zeros((L, cfg.num_heads))
     return layers
 
 
@@ -201,12 +208,16 @@ def attention_layer_specs(cfg: TransformerConfig) -> dict:
         layers["q_proj"]["bias"] = ("layers", "heads")
         layers["k_proj"]["bias"] = ("layers", "kv_heads")
         layers["v_proj"]["bias"] = ("layers", "kv_heads")
+    if cfg.o_proj_bias:
+        layers["o_proj"]["bias"] = ("layers", "norm")
     if cfg.qk_norm:
         layers["q_norm"] = {"scale": ("layers", "norm")}
         layers["k_norm"] = {"scale": ("layers", "norm")}
     if cfg.use_post_norms:
         layers["post_attn_out_norm"] = {"scale": ("layers", "norm")}
         layers["post_mlp_norm"] = {"scale": ("layers", "norm")}
+    if cfg.attention_sinks:
+        layers["sinks"] = ("layers", "heads")
     return layers
 
 
@@ -415,6 +426,7 @@ def attention_block(h, lp, cfg: TransformerConfig, positions, segment_ids, inv_f
             sliding_window=sliding_window,
             logits_soft_cap=cfg.attn_soft_cap,
             scale=cfg.attn_scale,
+            sinks=lp.get("sinks") if cfg.attention_sinks else None,
             impl=cfg.attn_impl,
         )
     attn = attn.reshape(B, S, cfg.num_heads * D)

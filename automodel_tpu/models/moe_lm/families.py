@@ -82,3 +82,39 @@ def deepseek_v3_moe_config(hf: Mapping[str, Any], **overrides) -> MoETransformer
     moe_overrides = overrides.pop("moe", None)
     kw.update(overrides)
     return MoETransformerConfig(moe=moe_overrides or moe, first_k_dense=first_k, **kw)
+
+
+def gpt_oss_config(hf: Mapping[str, Any], **overrides) -> MoETransformerConfig:
+    """GptOssForCausalLM: alternating sliding/full attention with learnable
+    sinks, biased router, fused-gate_up experts with biases and the clamped
+    swiglu-oai activation (reference: models/gpt_oss, 1082 LoC)."""
+    kw = _base_kwargs(hf)
+    kw["attention_bias"] = bool(hf.get("attention_bias", True))
+    kw["o_proj_bias"] = bool(hf.get("attention_bias", True))
+    kw["attention_sinks"] = True
+    if hf.get("sliding_window"):
+        kw["sliding_window"] = int(hf["sliding_window"])
+        if hf.get("layer_types"):
+            kw["layer_types"] = tuple(
+                "sliding" if t == "sliding_attention" else "global"
+                for t in hf["layer_types"]
+            )
+        else:
+            kw["layer_types"] = tuple(
+                "sliding" if i % 2 == 0 else "global" for i in range(kw["num_layers"])
+            )
+    moe = MoEConfig(
+        n_routed_experts=int(hf["num_local_experts"]),
+        experts_per_token=int(hf.get("num_experts_per_tok", 4)),
+        moe_intermediate_size=int(hf["intermediate_size"]),
+        norm_topk_prob=True,   # softmax-over-top-k == normalized softmax top-k
+        score_func="softmax",
+        router_bias=True,
+        expert_bias=True,
+        expert_activation="swigluoai",
+        swiglu_limit=float(hf.get("swiglu_limit", 7.0)),
+        aux_loss_coeff=float(hf.get("router_aux_loss_coef", 0.0)),
+    )
+    moe_overrides = overrides.pop("moe", None)
+    kw.update(overrides)
+    return MoETransformerConfig(moe=moe_overrides or moe, first_k_dense=0, **kw)

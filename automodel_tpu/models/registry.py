@@ -45,6 +45,10 @@ MODEL_ARCH_MAPPING: dict[str, ModelSpec] = {
         "deepseek_v3", moe_families.deepseek_v3_moe_config, moe_decoder,
         adapter_name="moe_decoder", adapter_kwargs={"style": "deepseek"},
     ),
+    "GptOssForCausalLM": ModelSpec(
+        "gpt_oss", moe_families.gpt_oss_config, moe_decoder,
+        adapter_name="moe_decoder", adapter_kwargs={"style": "gpt_oss"},
+    ),
     "LlavaForConditionalGeneration": ModelSpec(
         "llava", llava_module.llava_config, llava_module, adapter_name="llava"
     ),

@@ -27,7 +27,10 @@ class MoEConfig:
     route_scale: float = 1.0
     aux_loss_coeff: float = 0.0
     gate_bias_update_speed: float = 0.0  # deepseek aux-free balancing
-    expert_activation: str = "silu"   # silu | geglu | quick_geglu | relu2
+    expert_activation: str = "silu"   # silu | geglu | quick_geglu | relu2 | swigluoai
+    expert_bias: bool = False         # gpt-oss experts carry projection biases
+    swiglu_limit: float = 7.0         # swigluoai clamp (HF swiglu_limit)
+    router_bias: bool = False         # gpt-oss router linear has a bias
     moe_intermediate_size: int = 512
     shared_expert_intermediate_size: Optional[int] = None
     capacity_factor: float = 1.25    # static-shape dispatch headroom

@@ -34,23 +34,43 @@ _EXPERT_ACT = {
 }
 
 
+def gated_combine(g, u, kind: str, limit: float = 7.0):
+    """gate/up → MLP inner. "swigluoai" is gpt-oss's clamped variant:
+    min(g,limit)·sigmoid(1.702·g)·(clip(u,±limit)+1); others are act(g)·u."""
+    if kind == "swigluoai":
+        g = jnp.minimum(g, limit)
+        u = jnp.clip(u, -limit, limit)
+        return g * jax.nn.sigmoid(1.702 * g) * (u + 1.0)
+    return _EXPERT_ACT[kind](g) * u
+
+
 def init_experts(cfg: MoEConfig, hidden_size: int, rng: jax.Array) -> dict:
     E, H, I = cfg.n_routed_experts, hidden_size, cfg.moe_intermediate_size
     k1, k2, k3 = jax.random.split(rng, 3)
     std_in, std_out = H ** -0.5, I ** -0.5
-    return {
+    params = {
         "gate_proj": {"kernel": std_in * jax.random.truncated_normal(k1, -3, 3, (E, H, I))},
         "up_proj": {"kernel": std_in * jax.random.truncated_normal(k2, -3, 3, (E, H, I))},
         "down_proj": {"kernel": std_out * jax.random.truncated_normal(k3, -3, 3, (E, I, H))},
     }
+    if cfg.expert_bias:
+        params["gate_proj"]["bias"] = jnp.zeros((E, I))
+        params["up_proj"]["bias"] = jnp.zeros((E, I))
+        params["down_proj"]["bias"] = jnp.zeros((E, H))
+    return params
 
 
 def expert_param_specs(cfg: MoEConfig) -> dict:
-    return {
+    specs = {
         "gate_proj": {"kernel": ("expert", "expert_embed", "expert_mlp")},
         "up_proj": {"kernel": ("expert", "expert_embed", "expert_mlp")},
         "down_proj": {"kernel": ("expert", "expert_mlp", "expert_embed")},
     }
+    if cfg.expert_bias:
+        specs["gate_proj"]["bias"] = ("expert", "expert_mlp")
+        specs["up_proj"]["bias"] = ("expert", "expert_mlp")
+        specs["down_proj"]["bias"] = ("expert", "expert_embed")
+    return specs
 
 
 def compute_capacity(cfg: MoEConfig, num_tokens: int) -> int:
@@ -115,19 +135,27 @@ def experts_forward_dropless(
     T, H = x.shape
     K = cfg.experts_per_token
     E = cfg.n_routed_experts
-    act = _EXPERT_ACT[cfg.expert_activation]
     dtype = x.dtype
 
     flat_expert = indices.reshape(T * K)
     # stable sort groups rows by expert while keeping token order within
     sort_idx = jnp.argsort(flat_expert, stable=True)
     token_of = sort_idx // K
+    expert_of = jnp.take(flat_expert, sort_idx)
     xs = jnp.take(x, token_of, axis=0)  # (TK, H)
     group_sizes = jnp.bincount(flat_expert, length=E).astype(jnp.int32)
 
-    g = act(jax.lax.ragged_dot(xs, params["gate_proj"]["kernel"].astype(dtype), group_sizes))
+    g = jax.lax.ragged_dot(xs, params["gate_proj"]["kernel"].astype(dtype), group_sizes)
     u = jax.lax.ragged_dot(xs, params["up_proj"]["kernel"].astype(dtype), group_sizes)
-    y = jax.lax.ragged_dot(g * u, params["down_proj"]["kernel"].astype(dtype), group_sizes)
+    if "bias" in params["gate_proj"]:
+        safe = jnp.clip(expert_of, 0, E - 1)
+        g = g + jnp.take(params["gate_proj"]["bias"].astype(dtype), safe, axis=0)
+        u = u + jnp.take(params["up_proj"]["bias"].astype(dtype), safe, axis=0)
+    h_in = gated_combine(g, u, cfg.expert_activation, cfg.swiglu_limit)
+    y = jax.lax.ragged_dot(h_in, params["down_proj"]["kernel"].astype(dtype), group_sizes)
+    if "bias" in params["down_proj"]:
+        safe = jnp.clip(expert_of, 0, E - 1)
+        y = y + jnp.take(params["down_proj"]["bias"].astype(dtype), safe, axis=0)
 
     w_sorted = jnp.take(weights.reshape(T * K), sort_idx, axis=0).astype(dtype)
     contrib = y * w_sorted[:, None]
@@ -143,15 +171,20 @@ def experts_forward(
     constrain=None,
 ) -> jnp.ndarray:
     """Dispatch → batched expert MLP → weighted combine. Returns (T, H)."""
-    act = _EXPERT_ACT[cfg.expert_activation]
     c = constrain or (lambda a, axes: a)
     dtype = x.dtype
     # tokens → expert-major: XLA inserts the A2A here when ep-sharded
     xe = jnp.einsum("tec,th->ech", dispatch.astype(dtype), x)
     xe = c(xe, ("act_expert", None, "act_embed"))
-    g = act(jnp.einsum("ech,ehi->eci", xe, params["gate_proj"]["kernel"].astype(dtype)))
+    g = jnp.einsum("ech,ehi->eci", xe, params["gate_proj"]["kernel"].astype(dtype))
     u = jnp.einsum("ech,ehi->eci", xe, params["up_proj"]["kernel"].astype(dtype))
-    y = jnp.einsum("eci,eih->ech", g * u, params["down_proj"]["kernel"].astype(dtype))
+    if "bias" in params["gate_proj"]:
+        g = g + params["gate_proj"]["bias"].astype(dtype)[:, None, :]
+        u = u + params["up_proj"]["bias"].astype(dtype)[:, None, :]
+    h_in = gated_combine(g, u, cfg.expert_activation, cfg.swiglu_limit)
+    y = jnp.einsum("eci,eih->ech", h_in, params["down_proj"]["kernel"].astype(dtype))
+    if "bias" in params["down_proj"]:
+        y = y + params["down_proj"]["bias"].astype(dtype)[:, None, :]
     y = c(y, ("act_expert", None, "act_embed"))
     # expert-major → tokens (the A2A back), weighted by routing probs
     return jnp.einsum("tec,ech->th", combine.astype(dtype), y)

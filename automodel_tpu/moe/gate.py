@@ -23,6 +23,8 @@ def init_gate(cfg: MoEConfig, hidden_size: int, rng: jax.Array) -> dict:
             rng, -3.0, 3.0, (hidden_size, cfg.n_routed_experts)
         )
     }
+    if cfg.router_bias:
+        params["bias"] = jnp.zeros((cfg.n_routed_experts,))
     if cfg.gate_bias_update_speed > 0:
         # selection-only bias (not part of the autodiff graph semantics)
         params["e_score_bias"] = jnp.zeros((cfg.n_routed_experts,))
@@ -31,6 +33,8 @@ def init_gate(cfg: MoEConfig, hidden_size: int, rng: jax.Array) -> dict:
 
 def gate_param_specs(cfg: MoEConfig) -> dict:
     specs = {"weight": ("embed", None)}
+    if cfg.router_bias:
+        specs["bias"] = (None,)
     if cfg.gate_bias_update_speed > 0:
         specs["e_score_bias"] = (None,)
     return specs
@@ -85,6 +89,8 @@ def gate_forward(
         return weights, base.astype(jnp.int32), jnp.float32(0.0), stats
 
     logits = x.astype(jnp.float32) @ params["weight"].astype(jnp.float32)  # (T, E)
+    if "bias" in params:
+        logits = logits + params["bias"].astype(jnp.float32)
     if cfg.score_func == "softmax":
         scores = jax.nn.softmax(logits, axis=-1)
     elif cfg.score_func == "sigmoid":

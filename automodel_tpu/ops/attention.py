@@ -19,10 +19,14 @@ logit soft-capping (gemma-style).
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Literal
 
 import jax
 import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
+_warned_sinks_fallback = False
 
 AttnImpl = Literal["auto", "xla", "flash"]
 
@@ -70,8 +74,14 @@ def xla_attention(
     mask: jnp.ndarray | None,
     scale: float | None = None,
     logits_soft_cap: float | None = None,
+    sinks: jnp.ndarray | None = None,  # (Hq,) learnable sink logits
 ) -> jnp.ndarray:
-    """Reference einsum attention; softmax in fp32."""
+    """Reference einsum attention; softmax in fp32.
+
+    `sinks` implements gpt-oss attention sinks: one virtual kv slot per head
+    whose logit is learned; it absorbs probability mass (joins the softmax
+    denominator) but contributes no value.
+    """
     B, S, Hq, D = q.shape
     _, T, Hkv, _ = k.shape
     assert Hq % Hkv == 0, f"GQA requires Hq % Hkv == 0, got {Hq} % {Hkv}"
@@ -87,7 +97,14 @@ def xla_attention(
         if mask.ndim == 2:
             mask = mask[None]
         logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
+    if sinks is not None:
+        sink = jnp.broadcast_to(
+            sinks.astype(jnp.float32).reshape(1, Hkv, G, 1, 1), (B, Hkv, G, S, 1)
+        )
+        logits = jnp.concatenate([logits, sink], axis=-1)
     probs = jax.nn.softmax(logits, axis=-1)
+    if sinks is not None:
+        probs = probs[..., :-1]
     out = jnp.einsum("bkgst,btkd->bskgd", probs.astype(v.dtype), v)
     # v's head dim may differ from q/k's (MLA) — reshape with v's
     return out.reshape(B, S, Hq, v.shape[-1])
@@ -104,12 +121,28 @@ def dot_product_attention(
     sliding_window: int | None = None,
     logits_soft_cap: float | None = None,
     scale: float | None = None,
+    sinks: jnp.ndarray | None = None,
     impl: AttnImpl = "auto",
 ) -> jnp.ndarray:
     """Main attention entry. Shapes: q (B,S,Hq,D); k,v (B,T,Hkv,D)."""
     resolved = impl
     if impl == "auto":
         resolved = "flash" if _on_tpu() else "xla"
+    if sinks is not None and resolved == "flash":
+        if impl == "flash":
+            raise NotImplementedError(
+                "attention sinks are not supported by the flash kernel yet; "
+                "use attn_impl='xla' (full S×T logits) or drop the sinks"
+            )
+        global _warned_sinks_fallback
+        if not _warned_sinks_fallback:
+            logger.warning(
+                "attention sinks force the XLA attention path (full S×T fp32 "
+                "logits) — expect higher memory until the flash kernel gains "
+                "sink slots"
+            )
+            _warned_sinks_fallback = True
+        resolved = "xla"
     if resolved == "flash":
         from automodel_tpu.ops.pallas.flash_attention import flash_attention
 
@@ -136,6 +169,7 @@ def dot_product_attention(
             sliding_window=sliding_window,
         )
         return xla_attention(
-            q, k, v, mask=mask, scale=scale, logits_soft_cap=logits_soft_cap
+            q, k, v, mask=mask, scale=scale,
+            logits_soft_cap=logits_soft_cap, sinks=sinks,
         )
     raise ValueError(f"Unknown attention impl '{impl}'")

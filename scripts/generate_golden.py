@@ -1,0 +1,47 @@
+"""Regenerate the committed golden training metrics.
+
+The analog of the reference's golden-value CI tier (reference:
+tests/ci_tests/golden_values/**/training.jsonl + scripts/
+assert_finite_train_metrics.py): a pinned tiny recipe runs to completion
+and its per-step JSONL is committed; CI replays the recipe and compares
+step-by-step. Regenerate ONLY when an intentional numeric change lands:
+
+    PYTHONPATH=. python scripts/generate_golden.py
+"""
+
+import os
+import shutil
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tests.golden_config import GOLDEN_DIR, golden_cfg  # noqa: E402
+
+
+def main():
+    import tempfile
+
+    from automodel_tpu.cli.app import resolve_recipe_class
+
+    with tempfile.TemporaryDirectory() as tmp:
+        cfg = golden_cfg(tmp)
+        recipe = resolve_recipe_class(cfg)(cfg)
+        recipe.setup()
+        recipe.run_train_validation_loop()
+        os.makedirs(GOLDEN_DIR, exist_ok=True)
+        shutil.copy(
+            os.path.join(tmp, "training.jsonl"),
+            os.path.join(GOLDEN_DIR, "training.jsonl"),
+        )
+    print(f"golden values written to {GOLDEN_DIR}/training.jsonl")
+
+
+if __name__ == "__main__":
+    main()

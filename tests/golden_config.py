@@ -1,0 +1,36 @@
+"""The pinned recipe behind the golden-value tier (see scripts/generate_golden.py)."""
+
+import os
+
+from automodel_tpu.config import ConfigNode
+
+GOLDEN_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden_values")
+
+
+def golden_cfg(run_dir: str) -> ConfigNode:
+    return ConfigNode({
+        "seed": 1234,
+        "auto_resume": False,
+        "run_dir": run_dir,
+        "model": {
+            "hf_config": {
+                "architectures": ["LlamaForCausalLM"],
+                "vocab_size": 256, "hidden_size": 64, "intermediate_size": 128,
+                "num_hidden_layers": 2, "num_attention_heads": 4,
+                "num_key_value_heads": 2,
+            },
+            "dtype": "float32",
+            "remat_policy": "none",
+        },
+        "distributed": {"dp_shard": -1},
+        "dataset": {
+            "_target_": "automodel_tpu.datasets.mock.MockDatasetConfig",
+            "num_samples": 128, "seq_len": 64, "vocab_size": 256, "seed": 7,
+        },
+        "dataloader": {"microbatch_size": 8, "grad_acc_steps": 2, "seed": 7},
+        "optimizer": {"name": "adamw", "lr": 1e-3, "weight_decay": 0.01},
+        "lr_scheduler": {"warmup_steps": 2, "decay_steps": 20, "style": "cosine"},
+        "step_scheduler": {"max_steps": 8, "ckpt_every_steps": 1000},
+        "checkpoint": {"enabled": False},
+        "loss": {"chunk_size": 64},
+    })

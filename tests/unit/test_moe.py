@@ -392,3 +392,67 @@ def test_dropless_grads_and_masked_tokens():
     g = jax.grad(loss)(params)
     for leaf in jax.tree.leaves(g):
         assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_gpt_oss_end_to_end(tmp_path):
+    """gpt-oss: attention sinks + alternating windows + biased router +
+    fused-gate_up swigluoai experts; forward, sinks effect, HF roundtrip."""
+    from automodel_tpu.checkpoint import (
+        HFCheckpointReader,
+        MoEDecoderAdapter,
+        save_hf_checkpoint,
+    )
+    from automodel_tpu.models.registry import get_model_spec
+
+    hf = {
+        "architectures": ["GptOssForCausalLM"],
+        "vocab_size": 128, "hidden_size": 32, "intermediate_size": 32,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "num_key_value_heads": 2, "head_dim": 8,
+        "num_local_experts": 4, "num_experts_per_tok": 2,
+        "sliding_window": 4,
+        "layer_types": ["sliding_attention", "full_attention"],
+    }
+    spec = get_model_spec(hf)
+    cfg = spec.config_from_hf(hf, dtype=jnp.float32, remat_policy="none")
+    assert cfg.attention_sinks and cfg.moe.router_bias and cfg.moe.expert_bias
+    assert cfg.o_proj_bias
+    assert cfg.moe.expert_activation == "swigluoai"
+    assert cfg.layer_types == ("sliding", "global")
+
+    params = spec.module.init(cfg, jax.random.key(0))
+    assert "sinks" in params["moe_layers"]
+    assert "bias" in params["moe_layers"]["moe"]["experts"]["gate_proj"]
+    ids = jax.random.randint(jax.random.key(1), (2, 12), 0, 128)
+    logits, aux = spec.module.forward(params, cfg, ids)
+    assert np.isfinite(np.asarray(logits)).all()
+
+    # sinks affect outputs
+    p2 = jax.tree_util.tree_map(lambda x: x, params)
+    p2["moe_layers"]["sinks"] = p2["moe_layers"]["sinks"] + 5.0
+    l2, _ = spec.module.forward(p2, cfg, ids)
+    assert not np.allclose(np.asarray(logits), np.asarray(l2))
+
+    # HF roundtrip with fused interleaved gate_up + biases + sinks
+    adapter = MoEDecoderAdapter(cfg, style="gpt_oss")
+    save_hf_checkpoint(adapter.to_hf(params), str(tmp_path))
+    reader = HFCheckpointReader(str(tmp_path))
+    assert "model.layers.0.mlp.experts.gate_up_proj" in reader.keys()
+    assert "model.layers.0.mlp.router.bias" in reader.keys()
+    assert "model.layers.1.self_attn.sinks" in reader.keys()
+    assert "model.layers.0.self_attn.o_proj.bias" in reader.keys()
+    restored = adapter.from_hf(reader)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_swigluoai_combine():
+    from automodel_tpu.moe.experts import gated_combine
+
+    g = jnp.asarray([-2.0, 0.0, 10.0])
+    u = jnp.asarray([10.0, 0.5, -10.0])
+    out = np.asarray(gated_combine(g, u, "swigluoai"))
+    # gate clamped at 7, up clamped to ±7, (u+1) multiplier
+    g_c = np.minimum(np.asarray(g), 7.0)
+    expect = g_c / (1 + np.exp(-1.702 * g_c)) * (np.clip(np.asarray(u), -7, 7) + 1)
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
