@@ -1,0 +1,116 @@
+"""Tool-call accuracy evaluation for agent SFT.
+
+The analog of the reference evaluator (reference: nemo_automodel/
+components/eval/tool_call_evaluator.py + parser): extract JSON tool calls
+from generated text, compare against gold calls by function name and
+arguments (exact and fuzzy-normalized), and report call/name/arg accuracy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+from typing import Any
+
+
+_CALL_RE = re.compile(
+    r"<tool_call>\s*(\{.*?\})\s*</tool_call>|```json\s*(\{.*?\})\s*```",
+    re.DOTALL,
+)
+
+
+def parse_tool_calls(text: str) -> list[dict]:
+    """Extract tool-call dicts from generated text.
+
+    Accepts `<tool_call>{...}</tool_call>` blocks, ```json fences, or the
+    whole string being a JSON object/array of {name, arguments}.
+    """
+    calls: list[dict] = []
+    for m in _CALL_RE.finditer(text):
+        blob = m.group(1) or m.group(2)
+        try:
+            calls.append(json.loads(blob))
+        except json.JSONDecodeError:
+            continue
+    if not calls:
+        try:
+            data = json.loads(text.strip())
+            if isinstance(data, dict):
+                calls = [data]
+            elif isinstance(data, list):
+                calls = [c for c in data if isinstance(c, dict)]
+        except json.JSONDecodeError:
+            pass
+    return [c for c in map(normalize_call, calls) if c is not None]
+
+
+def normalize_call(c: dict) -> dict | None:
+    """Canonicalize one call dict (shared by predictions AND gold refs):
+    resolve name aliases and JSON-decode string-typed arguments."""
+    name = c.get("name") or c.get("function", {}).get("name")
+    args = c.get("arguments", c.get("parameters", {}))
+    if isinstance(args, str):
+        try:
+            args = json.loads(args)
+        except json.JSONDecodeError:
+            args = {"_raw": args}
+    if not name:
+        return None
+    return {"name": name, "arguments": args or {}}
+
+
+def _norm(v: Any) -> Any:
+    if isinstance(v, str):
+        s = v.strip().lower()
+        try:
+            return float(s)
+        except ValueError:
+            return s
+    if isinstance(v, (int, float)):
+        return float(v)
+    if isinstance(v, dict):
+        return {k: _norm(x) for k, x in sorted(v.items())}
+    if isinstance(v, list):
+        return [_norm(x) for x in v]
+    return v
+
+
+@dataclasses.dataclass
+class ToolCallMetrics:
+    num_examples: int = 0
+    name_matches: int = 0
+    exact_matches: int = 0
+    fuzzy_matches: int = 0
+
+    def as_dict(self) -> dict:
+        n = max(self.num_examples, 1)
+        return {
+            "num_examples": self.num_examples,
+            "name_accuracy": self.name_matches / n,
+            "exact_accuracy": self.exact_matches / n,
+            "fuzzy_accuracy": self.fuzzy_matches / n,
+        }
+
+
+def evaluate_tool_calls(predictions: list[str], references: list[list[dict]]) -> dict:
+    """Per-example: all gold calls must be matched (order-insensitive)."""
+    m = ToolCallMetrics()
+    for pred_text, gold in zip(predictions, references):
+        m.num_examples += 1
+        pred = parse_tool_calls(pred_text)
+        gold = [c for c in map(normalize_call, gold) if c is not None]
+        if sorted(c["name"] for c in pred) == sorted(c["name"] for c in gold):
+            m.name_matches += 1
+        else:
+            continue
+        def key_exact(c):
+            return (c["name"], json.dumps(c["arguments"], sort_keys=True))
+        def key_fuzzy(c):
+            return (c["name"], json.dumps(_norm(c["arguments"]), sort_keys=True))
+        if sorted(map(key_exact, pred)) == sorted(map(key_exact, gold)):
+            m.exact_matches += 1
+            m.fuzzy_matches += 1
+        elif sorted(map(key_fuzzy, pred)) == sorted(map(key_fuzzy, gold)):
+            m.fuzzy_matches += 1
+    return m.as_dict()

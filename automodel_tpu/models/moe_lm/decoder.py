@@ -45,6 +45,8 @@ from automodel_tpu.ops.rope import rope_frequencies
 class MoETransformerConfig(TransformerConfig):
     moe: MoEConfig = dataclasses.field(default_factory=MoEConfig)
     first_k_dense: int = 0  # deepseek first_k_dense_replace
+    mtp_num_layers: int = 0      # depth-1 MTP head when > 0
+    mtp_loss_coeff: float = 0.1  # weight of the MTP CE term
 
     @property
     def num_moe_layers(self) -> int:
@@ -98,6 +100,10 @@ def init(cfg: MoETransformerConfig, rng: jax.Array) -> dict:
     )
     moe_layers["moe"] = moe_stacked
     params["moe_layers"] = moe_layers
+    if cfg.mtp_num_layers > 0:
+        from automodel_tpu.models.moe_lm.mtp import init_mtp
+
+        params["mtp"] = init_mtp(cfg, jax.random.fold_in(rng, 777))
     if not cfg.tie_word_embeddings:
         params["lm_head"] = {"kernel": dense_init(ks[5], (H, cfg.vocab_size))}
     return params
@@ -125,6 +131,10 @@ def param_specs(cfg: MoETransformerConfig) -> dict:
         is_leaf=lambda x: isinstance(x, tuple),
     )
     specs["moe_layers"] = m
+    if cfg.mtp_num_layers > 0:
+        from automodel_tpu.models.moe_lm.mtp import mtp_param_specs
+
+        specs["mtp"] = mtp_param_specs(cfg)
     if not cfg.tie_word_embeddings:
         specs["lm_head"] = {"kernel": ("embed", "vocab")}
     return specs

@@ -67,6 +67,8 @@ def deepseek_v3_moe_config(hf: Mapping[str, Any], **overrides) -> MoETransformer
         gate_bias_update_speed=float(hf.get("bias_update_speed", 0.001)),
     )
     first_k = int(hf.get("first_k_dense_replace", 0))
+    if hf.get("num_nextn_predict_layers"):
+        kw["mtp_num_layers"] = min(int(hf["num_nextn_predict_layers"]), 1)
     if hf.get("kv_lora_rank"):
         kw["attention_type"] = "mla"
         kw["mla_q_lora_rank"] = int(hf["q_lora_rank"]) if hf.get("q_lora_rank") else None

@@ -1,0 +1,134 @@
+"""Multi-Token Prediction (MTP) head — DeepSeek-V3's auxiliary depth-1
+future-token predictor.
+
+The analog of the reference's MTP module + loss (reference:
+nemo_automodel/components/models/common/ MTP module, deepseek_v3/model.py
+MTP wiring, loss/mtp.py `calculate_mtp_loss`). Structure (depth 1):
+
+    h_mtp = Block( W_proj · concat( RMSNorm_h(h_main), RMSNorm_e(embed(t+1)) ) )
+
+sharing the main embedding and unembedding; its logits predict t+2. The
+loss is the same chunked fused linear CE, scaled by `mtp_loss_coeff` and
+joined to the main objective by the recipe.
+
+Deviation from DSv3: the MTP block uses a dense MLP (the reference's MTP
+block is a full MoE decoder block); MTP weights are training-only state and
+are not mapped by the HF adapter.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.loss.linear_ce import fused_linear_cross_entropy
+from automodel_tpu.loss.masked_ce import IGNORE_INDEX
+from automodel_tpu.models.common.layers import dense_init
+from automodel_tpu.models.llm.decoder import (
+    attention_block,
+    attention_layer_specs,
+    init_attention_layers,
+    mlp_block,
+)
+from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.rope import rope_frequencies
+
+
+def init_mtp(cfg, rng: jax.Array) -> dict:
+    """One MTP block; layer params keep the stacked (L=1, ...) convention."""
+    H = cfg.hidden_size
+    k1, k2 = jax.random.split(rng)
+    block = init_attention_layers(cfg, k1, 1)
+    block.update(
+        {
+            "gate_proj": {"kernel": dense_init(jax.random.fold_in(k2, 0), (1, H, cfg.intermediate_size))},
+            "up_proj": {"kernel": dense_init(jax.random.fold_in(k2, 1), (1, H, cfg.intermediate_size))},
+            "down_proj": {"kernel": dense_init(jax.random.fold_in(k2, 2), (1, cfg.intermediate_size, H))},
+        }
+    )
+    return {
+        "hnorm": {"scale": jnp.ones((H,))},
+        "enorm": {"scale": jnp.ones((H,))},
+        "eh_proj": {"kernel": dense_init(jax.random.fold_in(k2, 3), (2 * H, H))},
+        "block": block,
+        "final_norm": {"scale": jnp.ones((H,))},
+    }
+
+
+def mtp_param_specs(cfg) -> dict:
+    return {
+        "hnorm": {"scale": ("norm",)},
+        "enorm": {"scale": ("norm",)},
+        "eh_proj": {"kernel": (None, "embed")},
+        "block": {
+            **attention_layer_specs(cfg),
+            "gate_proj": {"kernel": ("layers", "embed", "mlp")},
+            "up_proj": {"kernel": ("layers", "embed", "mlp")},
+            "down_proj": {"kernel": ("layers", "mlp", "embed")},
+        },
+        "final_norm": {"scale": ("norm",)},
+    }
+
+
+def mtp_hidden(
+    params: dict,       # full model params (embed + mtp subtree)
+    cfg,
+    h_main: jnp.ndarray,    # (B, S, H) final hidden states of the main model
+    input_ids: jnp.ndarray, # (B, S)
+    positions: jnp.ndarray,
+    segment_ids,
+    constrain,
+) -> jnp.ndarray:
+    """Hidden states whose logits predict token t+2 at position t."""
+    mtp = params["mtp"]
+    if positions is None:
+        B, S = input_ids.shape
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
+    # embedding of the NEXT token (t+1), shifted left; last slot repeats
+    next_ids = jnp.concatenate([input_ids[:, 1:], input_ids[:, -1:]], axis=1)
+    emb = jnp.take(params["embed"]["embedding"], next_ids, axis=0).astype(cfg.dtype)
+    x = jnp.concatenate(
+        [
+            rms_norm(h_main, mtp["hnorm"]["scale"], cfg.rms_norm_eps),
+            rms_norm(emb, mtp["enorm"]["scale"], cfg.rms_norm_eps),
+        ],
+        axis=-1,
+    )
+    h = x @ mtp["eh_proj"]["kernel"].astype(cfg.dtype)
+
+    inv_freq = rope_frequencies(cfg.rope_dim, cfg.rope_theta, cfg.rope_scaling)
+    lp = jax.tree.map(lambda a: a[0], mtp["block"])  # unstack the L=1 dim
+    h = attention_block(h, lp, cfg, positions, segment_ids, inv_freq, constrain, cfg.sliding_window)
+    h = mlp_block(h, lp, cfg, constrain)
+    return rms_norm(h, mtp["final_norm"]["scale"], cfg.rms_norm_eps)
+
+
+def mtp_loss(
+    hidden_mtp: jnp.ndarray,    # (B, S, H)
+    lm_kernel: jnp.ndarray,     # (H, V)
+    labels: jnp.ndarray,        # (B, S) — next-token labels (t+1 at slot t)
+    *,
+    chunk_size: int = 1024,
+    segment_ids: jnp.ndarray | None = None,  # (B, S) — packed documents
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """CE against labels shifted one more step (t+2 at slot t).
+
+    On packed sequences, positions where the NEXT token belongs to a
+    different document are masked — MTP must never supervise across
+    document boundaries (matches datasets/packing.py's invariant).
+    """
+    mtp_labels = jnp.concatenate(
+        [labels[:, 1:], jnp.full_like(labels[:, -1:], IGNORE_INDEX)], axis=1
+    )
+    if segment_ids is not None:
+        same_doc = jnp.concatenate(
+            [
+                segment_ids[:, 1:] == segment_ids[:, :-1],
+                jnp.zeros_like(segment_ids[:, -1:], dtype=bool),
+            ],
+            axis=1,
+        )
+        mtp_labels = jnp.where(same_doc, mtp_labels, IGNORE_INDEX)
+    return fused_linear_cross_entropy(
+        hidden_mtp, lm_kernel, mtp_labels, chunk_size=chunk_size
+    )

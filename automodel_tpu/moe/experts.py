@@ -145,17 +145,18 @@ def experts_forward_dropless(
     xs = jnp.take(x, token_of, axis=0)  # (TK, H)
     group_sizes = jnp.bincount(flat_expert, length=E).astype(jnp.int32)
 
+    # masked tokens carry the sentinel index E (see gate_forward) — clip once
+    # for the bias gathers; their rows are zero-weighted in the combine anyway
+    safe_expert = jnp.clip(expert_of, 0, E - 1)
     g = jax.lax.ragged_dot(xs, params["gate_proj"]["kernel"].astype(dtype), group_sizes)
     u = jax.lax.ragged_dot(xs, params["up_proj"]["kernel"].astype(dtype), group_sizes)
     if "bias" in params["gate_proj"]:
-        safe = jnp.clip(expert_of, 0, E - 1)
-        g = g + jnp.take(params["gate_proj"]["bias"].astype(dtype), safe, axis=0)
-        u = u + jnp.take(params["up_proj"]["bias"].astype(dtype), safe, axis=0)
+        g = g + jnp.take(params["gate_proj"]["bias"].astype(dtype), safe_expert, axis=0)
+        u = u + jnp.take(params["up_proj"]["bias"].astype(dtype), safe_expert, axis=0)
     h_in = gated_combine(g, u, cfg.expert_activation, cfg.swiglu_limit)
     y = jax.lax.ragged_dot(h_in, params["down_proj"]["kernel"].astype(dtype), group_sizes)
     if "bias" in params["down_proj"]:
-        safe = jnp.clip(expert_of, 0, E - 1)
-        y = y + jnp.take(params["down_proj"]["bias"].astype(dtype), safe, axis=0)
+        y = y + jnp.take(params["down_proj"]["bias"].astype(dtype), safe_expert, axis=0)
 
     w_sorted = jnp.take(weights.reshape(T * K), sort_idx, axis=0).astype(dtype)
     contrib = y * w_sorted[:, None]

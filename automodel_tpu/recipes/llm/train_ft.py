@@ -180,6 +180,15 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             )
             params = adapter.from_hf(self._hf_reader, shardings=self.param_shardings)
             params = jax.tree.map(lambda p: jnp.asarray(p, jnp.float32), params)
+            if self.is_moe and getattr(self.model_cfg, "mtp_num_layers", 0) > 0 and "mtp" not in params:
+                # MTP weights are training-only and not part of HF
+                # checkpoints — initialize them fresh
+                from automodel_tpu.models.moe_lm.mtp import init_mtp
+
+                params["mtp"] = jax.device_put(
+                    init_mtp(self.model_cfg, self.rng.next_key()),
+                    self.param_shardings["mtp"],
+                )
             logger.info("loaded pretrained weights from %s", self._hf_reader._dir)
         else:
             init_fn = jax.jit(
@@ -292,6 +301,21 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                 hidden, kernel, batch["labels"], chunk_size=chunk,
                 logits_soft_cap=model_cfg.logits_soft_cap,
             )
+            if is_moe and getattr(model_cfg, "mtp_num_layers", 0) > 0:
+                # DeepSeek MTP auxiliary objective (reference: loss/mtp.py,
+                # train_ft.py:1061) — same token normalization as the main CE
+                from automodel_tpu.models.moe_lm.mtp import mtp_hidden, mtp_loss
+
+                h_mtp = mtp_hidden(
+                    params, model_cfg, hidden, batch["input_ids"],
+                    kw.get("positions"), kw.get("segment_ids"),
+                    lambda x, axes: x,
+                )
+                mtp_ce, _ = mtp_loss(
+                    h_mtp, kernel, batch["labels"], chunk_size=chunk,
+                    segment_ids=kw.get("segment_ids"),
+                )
+                ce_sum = ce_sum + model_cfg.mtp_loss_coeff * mtp_ce
             total, n = combine_losses(ce_sum, n, aux)
             return total, {"num_label_tokens": n, **extra}
 

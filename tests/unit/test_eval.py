@@ -1,0 +1,34 @@
+from automodel_tpu.eval.tool_call_evaluator import evaluate_tool_calls, parse_tool_calls
+
+
+def test_parse_formats():
+    assert parse_tool_calls('<tool_call>{"name": "get_weather", "arguments": {"city": "Paris"}}</tool_call>') == [
+        {"name": "get_weather", "arguments": {"city": "Paris"}}
+    ]
+    assert parse_tool_calls('```json\n{"name": "f", "arguments": {"x": 1}}\n```')[0]["name"] == "f"
+    assert parse_tool_calls('{"name": "g", "arguments": "{\\"y\\": 2}"}')[0]["arguments"] == {"y": 2}
+    assert parse_tool_calls("no calls here") == []
+
+
+def test_evaluate_accuracy_levels():
+    gold = [[{"name": "get_weather", "arguments": {"city": "Paris", "days": 3}}]]
+    exact = ['<tool_call>{"name": "get_weather", "arguments": {"days": 3, "city": "Paris"}}</tool_call>']
+    fuzzy = ['<tool_call>{"name": "get_weather", "arguments": {"city": " PARIS ", "days": "3"}}</tool_call>']
+    wrong_args = ['<tool_call>{"name": "get_weather", "arguments": {"city": "London", "days": 3}}</tool_call>']
+    wrong_name = ['<tool_call>{"name": "weather", "arguments": {"city": "Paris"}}</tool_call>']
+
+    m = evaluate_tool_calls(exact, gold)
+    assert m["exact_accuracy"] == 1.0 and m["name_accuracy"] == 1.0
+    m = evaluate_tool_calls(fuzzy, gold)
+    assert m["exact_accuracy"] == 0.0 and m["fuzzy_accuracy"] == 1.0
+    m = evaluate_tool_calls(wrong_args, gold)
+    assert m["name_accuracy"] == 1.0 and m["fuzzy_accuracy"] == 0.0
+    m = evaluate_tool_calls(wrong_name, gold)
+    assert m["name_accuracy"] == 0.0
+
+
+def test_gold_with_string_arguments_normalized():
+    gold = [[{"name": "f", "arguments": "{\"y\": 2}"}]]
+    pred = ['<tool_call>{"name": "f", "arguments": {"y": 2}}</tool_call>']
+    m = evaluate_tool_calls(pred, gold)
+    assert m["exact_accuracy"] == 1.0
