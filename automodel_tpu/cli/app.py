@@ -44,8 +44,41 @@ def resolve_recipe_class(cfg: ConfigNode):
     return _resolve_target(path)
 
 
+def print_capabilities() -> None:
+    """`python -m automodel_tpu --capabilities` — the analog of the
+    reference's capability query (reference: cli/query_capabilities.py)."""
+    import json
+
+    import jax
+
+    from automodel_tpu import __version__
+    from automodel_tpu.models.registry import MODEL_ARCH_MAPPING
+
+    caps = {
+        "version": __version__,
+        "backend": jax.default_backend(),
+        "devices": len(jax.devices()),
+        "architectures": sorted(MODEL_ARCH_MAPPING),
+        "recipes": sorted(RECIPE_ALIASES),
+        "parallelism": ["dp_replicate", "dp_shard(fsdp)", "tp", "cp(ring)", "ep", "pp(gpipe)"],
+        "features": [
+            "lora_peft", "knowledge_distillation", "mtp", "fp8_int8_matmul",
+            "dropless_moe", "attention_sinks", "kv_cache_generation",
+            "orbax_checkpointing", "hf_safetensors_io", "golden_value_ci",
+            "profiler_traces", "wandb_mlflow_trackers",
+        ],
+    }
+    print(json.dumps(caps, indent=2))
+
+
 def main(argv=None) -> None:
-    cfg = parse_args_and_load_config(argv)
+    import sys as _sys
+
+    args = list(_sys.argv[1:] if argv is None else argv)
+    if args and args[0] in ("--capabilities", "capabilities"):
+        print_capabilities()
+        return
+    cfg = parse_args_and_load_config(args)
     recipe_cls = resolve_recipe_class(cfg)
     recipe = recipe_cls(cfg)
     recipe.setup()

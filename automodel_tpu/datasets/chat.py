@@ -1,0 +1,79 @@
+"""Chat / agent SFT dataset: messages → templated tokens with
+assistant-only loss masking.
+
+The analog of the reference's chat datasets (reference: nemo_automodel/
+components/datasets/llm/chat datasets + xlam tool-call sets): each row is
+{"messages": [{"role", "content"}, ...]}; the conversation is rendered
+message-by-message through the tokenizer's chat template (with a plain
+role-tag fallback), and only assistant-message tokens carry labels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional
+
+import numpy as np
+
+from automodel_tpu.loss.masked_ce import IGNORE_INDEX
+from automodel_tpu.models.auto_tokenizer import apply_chat_template
+
+
+@dataclasses.dataclass
+class ChatDatasetConfig:
+    path: str = ""          # jsonl with a "messages" column
+    seq_len: int = 1024
+    train_on_assistant_only: bool = True
+
+    def build(self, tokenizer) -> "ChatDataset":
+        return ChatDataset(self, tokenizer)
+
+
+class ChatDataset:
+    def __init__(self, config: ChatDatasetConfig, tokenizer):
+        self.config = config
+        self.tokenizer = tokenizer
+        with open(config.path) as f:
+            self.rows = [json.loads(line) for line in f if line.strip()]
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def __getitem__(self, idx: int) -> dict:
+        c = self.config
+        tok = self.tokenizer
+        messages = self.rows[idx]["messages"]
+        ids: list[int] = []
+        labels: list[int] = []
+        # Render growing PREFIXES of the conversation and take token deltas —
+        # templates that emit a one-time preamble (bos / system prompt) keep
+        # it exactly once, and the token stream matches inference-time
+        # rendering of the full messages list.
+        prev_ids: list[int] = []
+        last_supervised = False
+        for k, m in enumerate(messages, 1):
+            text = apply_chat_template(tok, messages[:k])
+            cur_ids = tok(text, add_special_tokens=False)["input_ids"]
+            delta = cur_ids[len(prev_ids):]
+            prev_ids = cur_ids
+            supervise = (not c.train_on_assistant_only) or m["role"] == "assistant"
+            last_supervised = supervise
+            ids.extend(delta)
+            labels.extend(delta if supervise else [IGNORE_INDEX] * len(delta))
+        eos = getattr(tok, "eos_token_id", None)
+        if eos is not None:
+            ids.append(eos)
+            # only teach EOS after a supervised (assistant) final turn
+            labels.append(eos if last_supervised else IGNORE_INDEX)
+
+        # next-token shift
+        labels = labels[1:] + [IGNORE_INDEX]
+        ids = ids[: c.seq_len]
+        labels = labels[: c.seq_len]
+        pad_id = getattr(tok, "pad_token_id", None) or 0
+        pad = c.seq_len - len(ids)
+        return {
+            "input_ids": np.asarray(ids + [pad_id] * pad, np.int32),
+            "labels": np.asarray(labels + [IGNORE_INDEX] * pad, np.int32),
+        }

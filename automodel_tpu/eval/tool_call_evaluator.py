@@ -95,6 +95,10 @@ class ToolCallMetrics:
 
 def evaluate_tool_calls(predictions: list[str], references: list[list[dict]]) -> dict:
     """Per-example: all gold calls must be matched (order-insensitive)."""
+    if len(predictions) != len(references):
+        raise ValueError(
+            f"{len(predictions)} predictions vs {len(references)} references"
+        )
     m = ToolCallMetrics()
     for pred_text, gold in zip(predictions, references):
         m.num_examples += 1

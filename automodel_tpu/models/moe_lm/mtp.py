@@ -110,6 +110,7 @@ def mtp_loss(
     *,
     chunk_size: int = 1024,
     segment_ids: jnp.ndarray | None = None,  # (B, S) — packed documents
+    logits_soft_cap: float | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """CE against labels shifted one more step (t+2 at slot t).
 
@@ -130,5 +131,6 @@ def mtp_loss(
         )
         mtp_labels = jnp.where(same_doc, mtp_labels, IGNORE_INDEX)
     return fused_linear_cross_entropy(
-        hidden_mtp, lm_kernel, mtp_labels, chunk_size=chunk_size
+        hidden_mtp, lm_kernel, mtp_labels, chunk_size=chunk_size,
+        logits_soft_cap=logits_soft_cap,
     )

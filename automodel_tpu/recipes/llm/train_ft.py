@@ -106,6 +106,12 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         self.metric_logger = MetricLogger(os.path.join(run_dir, "training.jsonl"))
         self.val_logger = MetricLogger(os.path.join(run_dir, "validation.jsonl"))
 
+        from automodel_tpu.loggers.trackers import build_trackers
+
+        self.trackers = build_trackers(cfg, run_dir)
+        for t in self.trackers:
+            t.log_config(cfg.to_dict(redact=True))
+
         from automodel_tpu.utils.profiling import ProfilingConfig
 
         self.profiler = _dataclass_from_cfg(ProfilingConfig, cfg.get("profiling")).build()
@@ -314,6 +320,7 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                 mtp_ce, _ = mtp_loss(
                     h_mtp, kernel, batch["labels"], chunk_size=chunk,
                     segment_ids=kw.get("segment_ids"),
+                    logits_soft_cap=model_cfg.logits_soft_cap,
                 )
                 ce_sum = ce_sum + model_cfg.mtp_loss_coeff * mtp_ce
             total, n = combine_losses(ce_sum, n, aux)
@@ -385,6 +392,16 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         )
 
     def run_train_validation_loop(self) -> None:
+        try:
+            self._run_train_validation_loop()
+        except BaseException:
+            # crashed runs must not look FINISHED in tracker UIs
+            for t in self.trackers:
+                t.finish(status="FAILED")
+            self.trackers = []
+            raise
+
+    def _run_train_validation_loop(self) -> None:
         t_last = time.perf_counter()
         for microbatches in self.step_scheduler:
             batch_np = stack_microbatches(microbatches)
@@ -422,6 +439,8 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                 if k not in record and k != "tokens_per_expert" and getattr(v, "ndim", 0) == 0:
                     record[k] = float(v)
             self.metric_logger.log(record)
+            for t in self.trackers:
+                t.log({k: v for k, v in record.items() if k not in ("step", "ts")}, step=step)
 
             if self.step_scheduler.is_val_step and self.val_dataloader is not None:
                 self._run_validation(step)
@@ -429,6 +448,10 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                 self.save_checkpoint(step, force=self.step_scheduler.sigterm_received)
             if self.step_scheduler.sigterm_received:
                 logger.info("SIGTERM received — checkpointed and exiting")
+                # mark external trackers KILLED (reference: mlflow_utils.py)
+                for t in self.trackers:
+                    t.finish(status="KILLED")
+                self.trackers = []
                 break
 
         if self.checkpointer is not None:
@@ -437,6 +460,8 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         if self.cfg.get("checkpoint.save_consolidated", False):
             self.save_consolidated_hf()
         self.profiler.close()
+        for t in self.trackers:
+            t.finish()
         self.metric_logger.close()
         self.val_logger.close()
 
