@@ -28,6 +28,7 @@ RECIPE_ALIASES = {
     "vlm_finetune": "automodel_tpu.recipes.vlm.finetune.FinetuneRecipeForVLM",
     "llm_seq_cls": "automodel_tpu.recipes.llm.train_seq_cls.TrainSeqClsRecipe",
     "retrieval_bi_encoder": "automodel_tpu.recipes.retrieval.train_bi_encoder.TrainBiEncoderRecipe",
+    "retrieval_cross_encoder": "automodel_tpu.recipes.retrieval.train_cross_encoder.TrainCrossEncoderRecipe",
 }
 
 

@@ -55,12 +55,27 @@ class ChatDataset:
         for k, m in enumerate(messages, 1):
             text = apply_chat_template(tok, messages[:k])
             cur_ids = tok(text, add_special_tokens=False)["input_ids"]
-            delta = cur_ids[len(prev_ids):]
-            prev_ids = cur_ids
             supervise = (not c.train_on_assistant_only) or m["role"] == "assistant"
             last_supervised = supervise
-            ids.extend(delta)
-            labels.extend(delta if supervise else [IGNORE_INDEX] * len(delta))
+            if cur_ids[: len(prev_ids)] == prev_ids:
+                delta = cur_ids[len(prev_ids):]
+                ids.extend(delta)
+                labels.extend(delta if supervise else [IGNORE_INDEX] * len(delta))
+            else:
+                # BPE merged across the message boundary: resynchronize on
+                # the common prefix; the merged/merged-over tokens take this
+                # message's supervision so ids always match the FULL rendering
+                common = 0
+                for a, b in zip(prev_ids, cur_ids):
+                    if a != b:
+                        break
+                    common += 1
+                tail = cur_ids[common:]
+                ids = list(cur_ids)
+                labels = labels[:common] + (
+                    tail if supervise else [IGNORE_INDEX] * len(tail)
+                )
+            prev_ids = cur_ids
         eos = getattr(tok, "eos_token_id", None)
         if eos is not None:
             ids.append(eos)

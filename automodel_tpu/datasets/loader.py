@@ -22,6 +22,8 @@ class DataloaderConfig:
     microbatch_size: int = 8       # per GLOBAL step, per grad-accum slice
     grad_acc_steps: int = 1
     shuffle: bool = True
+    # group similar lengths per microbatch (needs `dataset.lengths`)
+    length_grouped: bool = False
     seed: int = 0
     drop_last: bool = True
 
@@ -52,6 +54,16 @@ class Dataloader:
 
     def _order(self) -> np.ndarray:
         n = len(self.dataset)
+        if self.config.length_grouped:
+            lengths = getattr(self.dataset, "lengths", None)
+            if lengths is None:
+                raise ValueError(
+                    "dataloader.length_grouped requires the dataset to expose "
+                    "a `lengths` sequence"
+                )
+            return length_grouped_order(
+                lengths, self.config.microbatch_size, self.config.seed, self.epoch
+            )
         if not self.config.shuffle:
             return np.arange(n)
         rng = np.random.default_rng(self.config.seed * 1000003 + self.epoch)
@@ -88,6 +100,24 @@ class Dataloader:
     def load_state_dict(self, state: dict) -> None:
         self.epoch = int(state["epoch"])
         self.batch_index = int(state["batch_index"])
+
+
+def length_grouped_order(lengths, microbatch_size: int, seed: int, epoch: int):
+    """Shuffled length-grouped sample order (reference: the length-grouped
+    sampler): sort by length within shuffled mega-chunks so microbatches have
+    similar lengths (less padding waste) while keeping epoch-level shuffling."""
+    import numpy as _np
+
+    lengths = _np.asarray(lengths)
+    n = len(lengths)
+    rng = _np.random.default_rng(seed * 7919 + epoch)
+    perm = rng.permutation(n)
+    mega = microbatch_size * 64
+    out = []
+    for start in range(0, n, mega):
+        chunk = perm[start : start + mega]
+        out.append(chunk[_np.argsort(lengths[chunk], kind="stable")])
+    return _np.concatenate(out)
 
 
 def stack_microbatches(microbatches: list) -> dict:

@@ -133,3 +133,39 @@ class MockRetrievalDataset:
             "query_ids": q, "doc_ids": d,
             "query_mask": ones, "doc_mask": ones.copy(),
         }
+
+
+@dataclasses.dataclass
+class MockRerankDatasetConfig:
+    """Mock (query ⊕ doc) groups: slot 0 positive, rest negatives."""
+
+    num_samples: int = 256
+    seq_len: int = 32
+    group_size: int = 4
+    vocab_size: int = 512
+    seed: int = 0
+
+    def build(self) -> "MockRerankDataset":
+        return MockRerankDataset(self)
+
+
+class MockRerankDataset:
+    def __init__(self, config: MockRerankDatasetConfig):
+        self.config = config
+
+    def __len__(self) -> int:
+        return self.config.num_samples
+
+    def __getitem__(self, idx: int) -> dict:
+        c = self.config
+        rng = np.random.default_rng(c.seed * 31337 + idx)
+        # positive pair shares a token band; negatives are uniform
+        base = int(rng.integers(1, c.vocab_size // 2))
+        pos = rng.integers(base, base + 30, c.seq_len).astype(np.int32) % c.vocab_size
+        pairs = [pos]
+        for _ in range(c.group_size - 1):
+            pairs.append(rng.integers(1, c.vocab_size, c.seq_len).astype(np.int32))
+        return {
+            "pair_ids": np.stack(pairs),
+            "pair_mask": np.ones((c.group_size, c.seq_len), np.int32),
+        }

@@ -131,6 +131,12 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             except FileNotFoundError:
                 pass
 
+        from automodel_tpu.training.utils import GCController
+
+        self.gc = GCController(
+            every_steps=int(cfg.get("gc_every_steps", 100)),
+            enabled=bool(cfg.get("gc_control", False)),
+        )
         self.step_scheduler.install_sigterm_handler()
 
     # ------------------------------------------------------------------
@@ -251,7 +257,10 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
     def _install_loss(self, loss_fn) -> None:
         """Jit the train/eval steps around a loss function. Single install
         point — subclasses provide the loss via _make_loss_fn()."""
-        step_cfg = TrainStepConfig(max_grad_norm=self.cfg.get("max_grad_norm", 1.0))
+        step_cfg = TrainStepConfig(
+            max_grad_norm=self.cfg.get("max_grad_norm", 1.0),
+            skip_nonfinite_updates=bool(self.cfg.get("skip_nonfinite_updates", False)),
+        )
         self._train_step = jax.jit(
             make_train_step(loss_fn, self.tx, self.lr_schedule, step_cfg),
             donate_argnums=0,
@@ -400,6 +409,8 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                 t.finish(status="FAILED")
             self.trackers = []
             raise
+        finally:
+            self.gc.close()  # never leave process-wide GC disabled
 
     def _run_train_validation_loop(self) -> None:
         t_last = time.perf_counter()
@@ -411,6 +422,7 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             )
             step = self.step_scheduler.step
             self.profiler.step(step)
+            self.gc.step(step)
 
             if self.is_moe and self.model_cfg.moe.gate_bias_update_speed > 0:
                 self._update_gate_bias(metrics["tokens_per_expert"])
@@ -460,6 +472,7 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         if self.cfg.get("checkpoint.save_consolidated", False):
             self.save_consolidated_hf()
         self.profiler.close()
+        self.gc.close()
         for t in self.trackers:
             t.finish()
         self.metric_logger.close()

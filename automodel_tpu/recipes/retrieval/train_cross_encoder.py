@@ -1,0 +1,84 @@
+"""Cross-encoder (reranker) training: joint query⊕doc scoring.
+
+The analog of the reference's cross-encoder recipe (reference:
+nemo_automodel/recipes/retrieval/train_cross_encoder.py). Each example is
+one positive document and N in-batch/provided negatives; the backbone
+encodes the concatenated (query, doc) pair, the last-token hidden feeds a
+scalar score head, and a listwise softmax CE pushes the positive above the
+negatives.
+
+Dataset rows: {"pair_ids": (G, S), "pair_mask": (G, S)} where group G holds
+the positive at slot 0 followed by negatives.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.layers import dense_init
+from automodel_tpu.recipes.llm.train_ft import TrainFinetuneRecipeForNextTokenPrediction
+
+logger = logging.getLogger(__name__)
+
+
+class TrainCrossEncoderRecipe(TrainFinetuneRecipeForNextTokenPrediction):
+    def _build_model(self) -> None:
+        super()._build_model()
+        if self.is_moe or self.peft_cfg is not None:
+            raise NotImplementedError("cross-encoder: dense full-FT backbones (r1)")
+        head = dense_init(self.rng.next_key(), (self.model_cfg.hidden_size, 1))
+        self._init_params = {
+            **self._init_params,
+            "score_head": {"kernel": jax.device_put(head, self.mesh_ctx.replicated())},
+        }
+
+    def _make_loss_fn(self):
+        module = self.model_spec.module
+        model_cfg = self.model_cfg
+        mesh_ctx = self.mesh_ctx
+
+        def loss_fn(params, batch, rng, *extra):
+            ids = batch["pair_ids"]      # (B, G, S)
+            mask = batch["pair_mask"]    # (B, G, S)
+            B, G, S = ids.shape
+            backbone = {k: v for k, v in params.items() if k != "score_head"}
+            hidden = module.forward(
+                backbone, model_cfg, ids.reshape(B * G, S),
+                return_hidden=True, mesh_ctx=mesh_ctx,
+            )
+            flat_mask = mask.reshape(B * G, S)
+            last = jnp.maximum(jnp.sum(flat_mask, axis=-1) - 1, 0)
+            pooled = jnp.take_along_axis(hidden, last[:, None, None], axis=1)[:, 0]
+            scores = (
+                pooled @ params["score_head"]["kernel"].astype(pooled.dtype)
+            ).astype(jnp.float32).reshape(B, G)
+            # listwise CE: positive is slot 0
+            lse = jax.scipy.special.logsumexp(scores, axis=-1)
+            loss_sum = jnp.sum(lse - scores[:, 0])
+            acc = jnp.sum((jnp.argmax(scores, -1) == 0).astype(jnp.float32))
+            return loss_sum, {
+                "num_label_tokens": jnp.float32(B),
+                "num_correct": acc,
+            }
+
+        return loss_fn
+
+    def _batch_token_count(self, batch_np: dict) -> int:
+        return int(batch_np["pair_ids"].size)
+
+    def _make_global(self, batch_np: dict):
+        from automodel_tpu.datasets.loader import make_global_batch
+
+        return make_global_batch(
+            batch_np, self.mesh_ctx, self.mesh_ctx.sharding(None, "batch", None, None)
+        )
+
+    def _make_global_eval(self, batch_np: dict):
+        from automodel_tpu.datasets.loader import make_global_batch
+
+        return make_global_batch(
+            batch_np, self.mesh_ctx, self.mesh_ctx.sharding("batch", None, None)
+        )

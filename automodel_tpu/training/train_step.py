@@ -42,6 +42,9 @@ def init_train_state(params, tx: optax.GradientTransformation) -> TrainState:
 @dataclasses.dataclass
 class TrainStepConfig:
     max_grad_norm: Optional[float] = 1.0
+    # skip the optimizer update when gradients are non-finite (loss spike /
+    # overflow robustness; the reference guards via assert_finite CI checks)
+    skip_nonfinite_updates: bool = False
 
 
 def make_train_step(
@@ -106,6 +109,15 @@ def make_train_step(
 
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
+        ok = jnp.logical_and(jnp.isfinite(grad_norm), jnp.isfinite(ce_sum))
+        if config.skip_nonfinite_updates:
+            params = jax.tree.map(
+                lambda new, old: jnp.where(ok, new, old), params, state.params
+            )
+            opt_state = jax.tree.map(
+                lambda new, old: jnp.where(ok, new, old) if hasattr(new, "shape") else new,
+                opt_state, state.opt_state,
+            )
         new_state = TrainState(step=state.step + 1, params=params, opt_state=opt_state)
 
         metrics = {
@@ -113,6 +125,8 @@ def make_train_step(
             "grad_norm": grad_norm,
             **aux_sum,
         }
+        if config.skip_nonfinite_updates:
+            metrics["skipped_nonfinite"] = 1.0 - ok.astype(jnp.float32)
         if lr_schedule is not None:
             metrics["lr"] = lr_schedule(state.step)
         return new_state, metrics

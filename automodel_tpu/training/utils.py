@@ -46,6 +46,34 @@ def neftune_noise(embeddings: jnp.ndarray, rng: jax.Array, alpha: float) -> jnp.
 
 
 # ---------------------------------------------------------------------------
+# GC cadence (reference: training/garbage_collection.py:22) — automatic
+# gen-2 collections mid-step cause host-side jitter that shows up as device
+# bubbles; freeze the warm state and collect on a fixed step cadence instead.
+# ---------------------------------------------------------------------------
+import gc
+
+
+class GCController:
+    def __init__(self, every_steps: int = 100, enabled: bool = True):
+        self.every_steps = every_steps
+        self.enabled = enabled
+        if enabled:
+            gc.collect()
+            gc.freeze()
+            gc.disable()
+
+    def step(self, step_num: int) -> None:
+        if self.enabled and self.every_steps > 0 and step_num % self.every_steps == 0:
+            gc.collect()
+
+    def close(self) -> None:
+        if self.enabled:
+            gc.enable()
+            gc.unfreeze()
+            self.enabled = False
+
+
+# ---------------------------------------------------------------------------
 # Timers (reference: training/timers.py)
 # ---------------------------------------------------------------------------
 class Timers:
