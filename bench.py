@@ -28,6 +28,12 @@ H100_BASELINE_MFU_PCT = 40.6  # reference Llama3-8B single-GPU, BASELINE.md
 def build(preset: str):
     from automodel_tpu.models.llm.decoder import TransformerConfig
 
+    if preset == "tiny":  # harness sanity check (runs on a CPU mesh)
+        return TransformerConfig(
+            vocab_size=512, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=4, num_kv_heads=2,
+            dtype=jnp.float32, remat_policy="none", attn_impl="xla",
+        ), 4, 128
     if preset == "small":  # fits v5e (16 GB) with adam fp32 states
         return TransformerConfig(
             vocab_size=32768, hidden_size=1024, intermediate_size=4096,
@@ -61,6 +67,10 @@ def main() -> None:
     cfg, batch, seq = build(args.preset)
     ctx = MeshConfig().build()
     n_dev = ctx.num_devices
+    # batch must divide across the token-sharding axes of whatever mesh
+    # this host exposes (1 chip on TPU, N virtual devices on CPU)
+    div = ctx.batch_size_divisor
+    batch = ((batch + div - 1) // div) * div
 
     params = jax.jit(
         lambda k: decoder.init(cfg, k),
