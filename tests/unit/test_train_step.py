@@ -94,3 +94,35 @@ def test_sharded_train_step_runs_and_matches():
     _, m_shd = step_shd(state_sharded, batch_sharded, jax.random.key(0))
     np.testing.assert_allclose(float(m_ref["loss"]), float(m_shd["loss"]), rtol=1e-4)
     np.testing.assert_allclose(float(m_ref["grad_norm"]), float(m_shd["grad_norm"]), rtol=1e-3)
+
+
+def test_hsdp_sharded_train_step_matches():
+    """HSDP (dp_replicate x dp_shard) == single-device step."""
+    ctx = MeshConfig(dp_replicate=2, dp_shard=2, tp=2).build()
+    params = decoder.init(CFG, jax.random.key(0))
+    tx = OptimizerConfig(lr=1e-3, weight_decay=0.0).build()
+
+    def loss_sharded(p, batch, rng):
+        hidden = decoder.forward(p, CFG, batch["input_ids"], return_hidden=True, mesh_ctx=ctx)
+        return fused_linear_cross_entropy(hidden, p["lm_head"]["kernel"], batch["labels"], chunk_size=32)
+
+    shardings = logical_to_shardings(
+        decoder.param_specs(CFG), ctx, shapes=jax.tree.map(lambda p: p.shape, params)
+    )
+    sp = jax.device_put(params, shardings)
+    # params replicate over dp_replicate: each param lives on twice as many
+    # devices as pure-FSDP sharding alone would imply
+    q = sp["layers"]["q_proj"]["kernel"]
+    assert len(q.sharding.device_set) == 8
+    assert "dp_replicate" not in jax.tree.leaves([q.sharding.spec])[0:1][0]
+
+    state_sharded = init_train_state(sp, tx)
+    batch = _make_batch(jax.random.key(3), 1, 8, 16)
+    batch_sharded = jax.device_put(batch, ctx.sharding(None, "batch", None))
+
+    step_ref = jax.jit(make_train_step(_loss_fn, tx))
+    step_shd = jax.jit(make_train_step(loss_sharded, tx))
+    _, m_ref = step_ref(init_train_state(params, tx), batch, jax.random.key(0))
+    _, m_shd = step_shd(state_sharded, batch_sharded, jax.random.key(0))
+    np.testing.assert_allclose(float(m_ref["loss"]), float(m_shd["loss"]), rtol=1e-4)
+    np.testing.assert_allclose(float(m_ref["grad_norm"]), float(m_shd["grad_norm"]), rtol=1e-3)
