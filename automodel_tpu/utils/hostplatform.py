@@ -1,0 +1,46 @@
+"""Force the host (CPU) JAX platform with a virtual device count.
+
+Single home for the recipe used by tests/conftest.py, bench.py and
+__graft_entry__.py: this container's sitecustomize registers an `axon` TPU
+platform with priority over env vars, and if that tunnel is down, any
+backend touch hangs indefinitely. Must be called BEFORE the first JAX
+backend initialization (importing jax is fine — backends are lazy).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_cpu_devices(n_devices: int = 1) -> None:
+    """Point JAX at an n-device virtual CPU platform, replacing any stale
+    device count already present in XLA_FLAGS."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    flag = f"{_COUNT_FLAG}={n_devices}"
+    if _COUNT_FLAG in flags:
+        flags = re.sub(rf"{_COUNT_FLAG}=\d+", flag, flags)
+    else:
+        flags = (flags + " " + flag).strip()
+    os.environ["XLA_FLAGS"] = flags
+
+    # The env var alone loses to the sitecustomize platform registration;
+    # the config knob must be set too.
+    import jax
+
+    try:
+        from jax._src import xla_bridge
+
+        if xla_bridge.backends_are_initialized():
+            raise RuntimeError(
+                "force_cpu_devices() called after a JAX backend was already "
+                "initialized — the CPU platform / device count cannot take "
+                "effect. Call it before any jax.devices()/computation."
+            )
+    except ImportError:  # private API moved; skip the guard rather than lie
+        pass
+
+    jax.config.update("jax_platforms", "cpu")

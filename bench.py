@@ -15,17 +15,57 @@ from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 import time
-
-import jax
-import jax.numpy as jnp
-import numpy as np
 
 H100_BASELINE_MFU_PCT = 40.6  # reference Llama3-8B single-GPU, BASELINE.md
 
 
+def _probe_accelerator(timeout: float = 120.0, retries: int = 2) -> str | None:
+    """Check in a SUBPROCESS whether the ambient accelerator backend works.
+
+    The axon TPU tunnel can fail two ways: a fast UNAVAILABLE error (round-1
+    BENCH rc=1) or an indefinite hang. Probing in-process can't recover from
+    the hang, so run `jax.devices()` + one tiny computation in a child with a
+    hard timeout, retrying once for transient outages. Returns the device
+    kind string, or None if the accelerator is unusable.
+    """
+    probe = (
+        "import jax, jax.numpy as jnp;"
+        "d = jax.devices();"
+        "print('NOACCEL:' + repr(d)) if d[0].platform == 'cpu' else None;"
+        "assert d[0].platform != 'cpu';"
+        "jnp.ones((128, 128)).sum().block_until_ready();"
+        "print('KIND:' + d[0].device_kind)"
+    )
+    for attempt in range(retries):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", probe],
+                capture_output=True, text=True, timeout=timeout,
+            )
+            for line in out.stdout.splitlines():
+                if line.startswith("KIND:"):
+                    return line[len("KIND:"):]
+                if line.startswith("NOACCEL:"):
+                    return None  # deterministic: no accelerator registered
+        except subprocess.TimeoutExpired:
+            pass
+        if attempt + 1 < retries:
+            time.sleep(10.0)
+    return None
+
+
+def _force_cpu(n_devices: int = 1) -> None:
+    from automodel_tpu.utils.hostplatform import force_cpu_devices
+
+    force_cpu_devices(n_devices)
+
+
 def build(preset: str):
+    import jax.numpy as jnp
+
     from automodel_tpu.models.llm.decoder import TransformerConfig
 
     if preset == "tiny":  # harness sanity check (runs on a CPU mesh)
@@ -53,8 +93,55 @@ def build(preset: str):
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=20)
-    ap.add_argument("--preset", default="small")
+    ap.add_argument("--preset", default=None, choices=["tiny", "small", "medium"])
+    ap.add_argument(
+        "--platform", default="auto", choices=["auto", "accel", "cpu"],
+        help="auto: probe the accelerator, fall back to a tiny CPU run; "
+        "accel: require the accelerator (fail fast if unusable); cpu: force CPU",
+    )
     args = ap.parse_args()
+
+    fallback = None
+    if args.platform == "cpu":
+        _force_cpu()
+        args.preset = args.preset or "tiny"
+    else:
+        kind = _probe_accelerator()
+        if kind is None and args.platform == "accel":
+            print(json.dumps({
+                "metric": "llama_pretrain_mfu_pct", "value": 0.0,
+                "unit": "% MFU", "vs_baseline": 0.0,
+                "detail": {"error": "accelerator required but unusable (probe failed)"},
+            }))
+            return
+        if kind is None:
+            # Clamp to tiny regardless of --preset: the fallback's contract is
+            # a fast parseable line, never an hours-long CPU train run.
+            fallback = "accelerator unavailable after retries; tiny CPU run"
+            _force_cpu()
+            args.preset = "tiny"
+        else:
+            args.preset = args.preset or "small"
+
+    try:
+        result = _run(args)
+        if fallback:
+            result["detail"]["fallback"] = fallback
+    except Exception as e:  # noqa: BLE001 — one parseable line, no matter what
+        result = {
+            "metric": "llama_pretrain_mfu_pct",
+            "value": 0.0,
+            "unit": "% MFU",
+            "vs_baseline": 0.0,
+            "detail": {"error": repr(e)[:500], "fallback": fallback},
+        }
+    print(json.dumps(result))
+
+
+def _run(args) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
 
     from automodel_tpu.distributed import MeshConfig
     from automodel_tpu.loss import fused_linear_cross_entropy
@@ -118,7 +205,7 @@ def main() -> None:
         flops_per_token=cfg.flops_per_token(seq), num_devices=n_dev
     ).metrics(tokens, dt)
 
-    result = {
+    return {
         "metric": "llama_pretrain_mfu_pct",
         "value": round(mfu["mfu_pct"], 2),
         "unit": "% MFU",
@@ -134,7 +221,6 @@ def main() -> None:
             "loss": float(m["loss"]),
         },
     }
-    print(json.dumps(result))
 
 
 if __name__ == "__main__":

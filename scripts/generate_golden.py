@@ -13,14 +13,11 @@ import os
 import shutil
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
-
-import jax
-
-jax.config.update("jax_platforms", "cpu")
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from automodel_tpu.utils.hostplatform import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(8)
 
 from tests.golden_config import GOLDEN_DIR, golden_cfg  # noqa: E402
 

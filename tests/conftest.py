@@ -1,19 +1,10 @@
-"""Test harness: force an 8-device virtual CPU mesh before JAX imports.
+"""Test harness: force an 8-device virtual CPU mesh before JAX backend init.
 
 The analog of the reference's FakeStore/fake-process-group trick
 (reference: tests/unit_tests/distributed/test_cp_sharder.py) — distributed
 semantics are exercised on a host-only mesh with no accelerators.
 """
 
-import os
+from automodel_tpu.utils.hostplatform import force_cpu_devices
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-
-import jax  # noqa: E402
-
-# The container's sitecustomize registers the axon TPU platform with higher
-# priority than the env var; force the config knob before backend init.
-jax.config.update("jax_platforms", "cpu")
+force_cpu_devices(8)
