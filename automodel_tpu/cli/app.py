@@ -80,6 +80,14 @@ def main(argv=None) -> None:
         print_capabilities()
         return
     cfg = parse_args_and_load_config(args)
+    # `platform: {force_cpu_devices: N}` — run the recipe on an N-device
+    # virtual CPU mesh (dev boxes / CI without accelerators). Must happen
+    # before the recipe's first JAX backend touch.
+    n_cpu = cfg.get("platform.force_cpu_devices", None)
+    if n_cpu:
+        from automodel_tpu.utils.hostplatform import force_cpu_devices
+
+        force_cpu_devices(int(n_cpu))
     recipe_cls = resolve_recipe_class(cfg)
     recipe = recipe_cls(cfg)
     recipe.setup()

@@ -189,7 +189,7 @@ def forward(
         h = attention_block(h, lp, cfg, positions, segment_ids, inv_freq, constrain, window, mesh_ctx)
         x = rms_norm(h, lp["post_attn_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
         moe_out, layer_aux, layer_stats = moe_forward(
-            lp["moe"], cfg.moe, x, constrain, token_mask=token_mask
+            lp["moe"], cfg.moe, x, constrain, token_mask=token_mask, mesh_ctx=mesh_ctx
         )
         h = constrain(h + moe_out, ("act_batch", "act_seq", "act_embed"))
         stats = jax.lax.dynamic_update_index_in_dim(

@@ -163,6 +163,139 @@ def experts_forward_dropless(
     return jnp.zeros((T, H), dtype).at[token_of].add(contrib)
 
 
+def _dropless_ep_local(params, cfg, x, weights, indices, *, axis_name, bucket):
+    """Per-shard body of the EP dropless dispatch; call INSIDE shard_map.
+
+    The DeepEP-semantics analog (reference: moe/megatron/fused_a2a.py:139
+    `fused_dispatch`, :238 `fused_combine`; token_dispatcher.py:504): tokens
+    travel to the EP rank that owns their expert and come back, with NO
+    capacity drops. NVSHMEM ragged buffers are replaced by a static
+    (ep, bucket, H) all_to_all — bucket = T_loc*K is the dropless worst case
+    (XLA:CPU has no ragged-all-to-all; on TPU the same layout rides ICI).
+
+    Layout invariant: rows sorted by global expert id are grouped by owner
+    rank (experts are contiguous per rank), so one stable sort serves both
+    the send bucketing and, on the receiver, the ragged_dot grouping.
+    """
+    from jax import lax
+
+    T, H = x.shape
+    K = cfg.experts_per_token
+    E = cfg.n_routed_experts
+    P = lax.axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    E_loc = E // P
+    TK = T * K
+    dtype = x.dtype
+
+    flat_expert = indices.reshape(TK)                       # sentinel E = masked
+    sort_idx = jnp.argsort(flat_expert, stable=True)
+    expert_sorted = jnp.take(flat_expert, sort_idx)
+    token_of = sort_idx // K
+    xs = jnp.take(x, token_of, axis=0)                      # (TK, H) sorted rows
+
+    counts_e = jnp.bincount(flat_expert, length=E).astype(jnp.int32)
+    counts_peer = counts_e.reshape(P, E_loc).sum(-1)        # rows per dest rank
+    offsets_peer = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(counts_peer)[:-1]]
+    )
+
+    dest = jnp.minimum(expert_sorted // E_loc, P)           # sentinel → P (drop)
+    slot = jnp.arange(TK, dtype=jnp.int32) - jnp.take(
+        offsets_peer, jnp.minimum(dest, P - 1)
+    )
+    valid_send = (dest < P) & (slot < bucket)
+    flat_pos = jnp.where(valid_send, dest * bucket + slot, P * bucket)
+
+    send_x = jnp.zeros((P * bucket, H), dtype).at[flat_pos].set(xs, mode="drop")
+    send_eid = jnp.full((P * bucket,), E, jnp.int32).at[flat_pos].set(
+        expert_sorted, mode="drop"
+    )
+
+    recv_x = lax.all_to_all(send_x.reshape(P, bucket, H), axis_name, 0, 0)
+    recv_eid = lax.all_to_all(send_eid.reshape(P, bucket), axis_name, 0, 0)
+    recv_x = recv_x.reshape(P * bucket, H)
+    le = recv_eid.reshape(P * bucket) - r * E_loc           # local expert id
+    recv_valid = (le >= 0) & (le < E_loc)
+
+    # regroup received rows by local expert (invalid rows sort last);
+    # group sizes come from the received expert ids — no extra collective
+    key = jnp.where(recv_valid, le, E_loc)
+    sort2 = jnp.argsort(key, stable=True)
+    xs2 = jnp.take(recv_x, sort2, axis=0)
+    group_sizes = jnp.bincount(key, length=E_loc + 1)[:E_loc].astype(jnp.int32)
+    safe_le = jnp.clip(jnp.take(key, sort2), 0, E_loc - 1)
+
+    g = lax.ragged_dot(xs2, params["gate_proj"]["kernel"].astype(dtype), group_sizes)
+    u = lax.ragged_dot(xs2, params["up_proj"]["kernel"].astype(dtype), group_sizes)
+    if "bias" in params["gate_proj"]:
+        g = g + jnp.take(params["gate_proj"]["bias"].astype(dtype), safe_le, axis=0)
+        u = u + jnp.take(params["up_proj"]["bias"].astype(dtype), safe_le, axis=0)
+    h_in = gated_combine(g, u, cfg.expert_activation, cfg.swiglu_limit)
+    y2 = lax.ragged_dot(h_in, params["down_proj"]["kernel"].astype(dtype), group_sizes)
+    if "bias" in params["down_proj"]:
+        y2 = y2 + jnp.take(params["down_proj"]["bias"].astype(dtype), safe_le, axis=0)
+    y2 = jnp.where(jnp.take(recv_valid, sort2)[:, None], y2, 0.0)
+
+    # undo the regroup sort, return rows to their source rank
+    y_recv = jnp.zeros_like(y2).at[sort2].set(y2)
+    y_back = lax.all_to_all(y_recv.reshape(P, bucket, H), axis_name, 0, 0)
+    y_back = y_back.reshape(P * bucket, H)
+
+    ys = jnp.take(y_back, jnp.minimum(flat_pos, P * bucket - 1), axis=0)
+    ys = jnp.where(valid_send[:, None], ys, 0.0)
+    w_sorted = jnp.take(weights.reshape(TK), sort_idx).astype(dtype)
+    return jnp.zeros((T, H), dtype).at[token_of].add(ys * w_sorted[:, None])
+
+
+def experts_forward_dropless_ep(
+    params: dict,
+    cfg: MoEConfig,
+    x: jnp.ndarray,        # (T, H) flat tokens, sharded (dp, ep, cp)
+    weights: jnp.ndarray,  # (T, K)
+    indices: jnp.ndarray,  # (T, K)
+    mesh_ctx,
+) -> jnp.ndarray:
+    """Dropless dispatch ACROSS an ep>1 mesh axis (DeepEP semantics).
+
+    shard_map wrapper around `_dropless_ep_local`: tokens stay sharded on
+    (dp, ep, cp); expert weights enter sharded on `ep` only (fsdp/tp dims
+    are gathered at the boundary, the FSDP-unshard analog).
+    """
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    ep = mesh_ctx.sizes["ep"]
+    E = cfg.n_routed_experts
+    if E % ep != 0:
+        raise ValueError(f"n_routed_experts={E} not divisible by ep={ep}")
+
+    tok = P(("dp_replicate", "dp_shard", "ep", "cp"), None)
+    tok_k = tok
+    eparams = {proj: params[proj] for proj in ("gate_proj", "up_proj", "down_proj")}
+    espec = {
+        proj: {k: P("ep", *([None] * (v.ndim - 1))) for k, v in eparams[proj].items()}
+        for proj in eparams
+    }
+
+    # dropless worst case: every local (token, slot) row targets one rank
+    t_total = x.shape[0]
+    t_loc = t_total // (mesh_ctx.axis_size("batch") * mesh_ctx.sizes["cp"])
+    bucket = max(8, t_loc * cfg.experts_per_token)
+
+    fn = functools.partial(
+        _dropless_ep_local, axis_name="ep", bucket=bucket, cfg=cfg
+    )
+    return jax.shard_map(
+        lambda p, xx, ww, ii: fn(p, x=xx, weights=ww, indices=ii),
+        mesh=mesh_ctx.mesh,
+        in_specs=(espec, tok, tok_k, tok_k),
+        out_specs=tok,
+        check_vma=False,
+    )(eparams, x, weights, indices)
+
+
 def experts_forward(
     params: dict,
     cfg: MoEConfig,

@@ -24,6 +24,7 @@ from automodel_tpu.moe.experts import (
     expert_param_specs,
     experts_forward,
     experts_forward_dropless,
+    experts_forward_dropless_ep,
     init_experts,
 )
 from automodel_tpu.moe.gate import gate_forward, gate_param_specs, init_gate
@@ -67,6 +68,7 @@ def moe_forward(
     x: jnp.ndarray,  # (B, S, H)
     constrain=None,
     token_mask: jnp.ndarray | None = None,  # (B, S) bool
+    mesh_ctx=None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, dict]:
     """Returns (out (B,S,H), aux_loss scalar, stats)."""
     B, S, H = x.shape
@@ -74,7 +76,12 @@ def moe_forward(
     flat_mask = token_mask.reshape(B * S) if token_mask is not None else None
     weights, indices, aux_loss, stats = gate_forward(params["gate"], cfg, flat, flat_mask)
     if cfg.dispatcher == "dropless":
-        routed = experts_forward_dropless(params["experts"], cfg, flat, weights, indices)
+        if mesh_ctx is not None and mesh_ctx.sizes["ep"] > 1:
+            routed = experts_forward_dropless_ep(
+                params["experts"], cfg, flat, weights, indices, mesh_ctx
+            )
+        else:
+            routed = experts_forward_dropless(params["experts"], cfg, flat, weights, indices)
     else:
         capacity = compute_capacity(cfg, B * S)
         dispatch, combine = dispatch_tensors(cfg, indices, weights, capacity)

@@ -22,14 +22,16 @@ import time
 H100_BASELINE_MFU_PCT = 40.6  # reference Llama3-8B single-GPU, BASELINE.md
 
 
-def _probe_accelerator(timeout: float = 120.0, retries: int = 2) -> str | None:
+def _probe_accelerator(
+    timeout: float = 120.0, retries: int = 2
+) -> tuple[str | None, str]:
     """Check in a SUBPROCESS whether the ambient accelerator backend works.
 
     The axon TPU tunnel can fail two ways: a fast UNAVAILABLE error (round-1
     BENCH rc=1) or an indefinite hang. Probing in-process can't recover from
     the hang, so run `jax.devices()` + one tiny computation in a child with a
-    hard timeout, retrying once for transient outages. Returns the device
-    kind string, or None if the accelerator is unusable.
+    hard timeout, retrying once for transient outages. Returns
+    (device_kind, "") on success or (None, diagnostic) when unusable.
     """
     probe = (
         "import jax, jax.numpy as jnp;"
@@ -39,6 +41,7 @@ def _probe_accelerator(timeout: float = 120.0, retries: int = 2) -> str | None:
         "jnp.ones((128, 128)).sum().block_until_ready();"
         "print('KIND:' + d[0].device_kind)"
     )
+    diag = ""
     for attempt in range(retries):
         try:
             out = subprocess.run(
@@ -47,14 +50,15 @@ def _probe_accelerator(timeout: float = 120.0, retries: int = 2) -> str | None:
             )
             for line in out.stdout.splitlines():
                 if line.startswith("KIND:"):
-                    return line[len("KIND:"):]
+                    return line[len("KIND:"):], ""
                 if line.startswith("NOACCEL:"):
-                    return None  # deterministic: no accelerator registered
+                    return None, "no accelerator platform registered"
+            diag = f"probe rc={out.returncode}: {out.stderr.strip()[-300:]}"
         except subprocess.TimeoutExpired:
-            pass
+            diag = f"probe timed out after {timeout:.0f}s (backend hang)"
         if attempt + 1 < retries:
             time.sleep(10.0)
-    return None
+    return None, diag
 
 
 def _force_cpu(n_devices: int = 1) -> None:
@@ -106,18 +110,18 @@ def main() -> None:
         _force_cpu()
         args.preset = args.preset or "tiny"
     else:
-        kind = _probe_accelerator()
+        kind, diag = _probe_accelerator()
         if kind is None and args.platform == "accel":
             print(json.dumps({
                 "metric": "llama_pretrain_mfu_pct", "value": 0.0,
                 "unit": "% MFU", "vs_baseline": 0.0,
-                "detail": {"error": "accelerator required but unusable (probe failed)"},
+                "detail": {"error": f"accelerator required but unusable ({diag})"},
             }))
             return
         if kind is None:
             # Clamp to tiny regardless of --preset: the fallback's contract is
             # a fast parseable line, never an hours-long CPU train run.
-            fallback = "accelerator unavailable after retries; tiny CPU run"
+            fallback = f"accelerator unavailable ({diag}); tiny CPU run"
             _force_cpu()
             args.preset = "tiny"
         else:

@@ -527,3 +527,80 @@ def test_mtp_masks_document_boundaries():
     _, n = mtp_loss(hidden, kernel, labels, chunk_size=8, segment_ids=seg)
     # positions 0,1 (doc1) and 3,4 (doc2) supervise; t=2 crosses docs, t=5 ends
     assert float(n) == 4
+
+
+def test_dropless_ep_matches_ep1_oracle():
+    """EP-distributed dropless dispatch (bucketed A2A, DeepEP semantics —
+    reference: moe/megatron/fused_a2a.py:139,238) must match the ep=1
+    sort/ragged_dot oracle exactly: same routed output, no drops, grads
+    flowing through the all_to_all pair. Includes masked (sentinel) tokens
+    and a heavily imbalanced routing."""
+    import dataclasses as dc
+
+    from automodel_tpu.moe.experts import (
+        experts_forward_dropless,
+        experts_forward_dropless_ep,
+        init_experts,
+    )
+
+    cfg = dc.replace(
+        MOE, n_routed_experts=8, experts_per_token=2, dispatcher="dropless"
+    )
+    H, T = 16, 64
+    params = init_experts(cfg, H, jax.random.key(0))
+    gate = init_gate(cfg, H, jax.random.key(1))
+    x = jax.random.normal(jax.random.key(2), (T, H), jnp.float32)
+    mask = jnp.ones((T,), bool).at[-3:].set(False)
+    w, idx, _, _ = gate_forward(gate, cfg, x, mask)
+    # overwrite half the routing to one expert: imbalance must not drop rows
+    idx = idx.at[: T // 2, 0].set(3)
+
+    ref = experts_forward_dropless(params, cfg, x, w, idx)
+    for epn in (2, 4):
+        ctx = MeshConfig(ep=epn, dp_shard=8 // epn).build()
+        xin = jax.device_put(
+            x, ctx.sharding(("dp_replicate", "dp_shard", "ep", "cp"), None)
+        )
+        out = jax.jit(
+            lambda p, xx: experts_forward_dropless_ep(p, cfg, xx, w, idx, ctx)
+        )(params, xin)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+        def loss_ep(p):
+            y = experts_forward_dropless_ep(p, cfg, xin, w, idx, ctx)
+            return jnp.sum(y**2)
+
+        def loss_ref(p):
+            return jnp.sum(experts_forward_dropless(p, cfg, x, w, idx) ** 2)
+
+        g_ep = jax.jit(jax.grad(loss_ep))(params)
+        g_ref = jax.grad(loss_ref)(params)
+        for a, b in zip(jax.tree.leaves(g_ep), jax.tree.leaves(g_ref)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4
+            )
+
+
+def test_dropless_ep_full_decoder_train_step():
+    """dispatcher=dropless with ep=2 through the FULL MoE decoder forward
+    (mesh_ctx threaded decoder → moe_forward → shard_map dispatch)."""
+    import dataclasses as dc
+
+    ctx = MeshConfig(ep=2, dp_shard=2, cp=2).build()
+    cfg = dc.replace(MOE_LM, moe=dc.replace(MOE_LM.moe, dispatcher="dropless"))
+    params = moe_decoder.init(cfg, jax.random.key(0))
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(1, cfg.vocab_size, (4, 8)), jnp.int32
+    )
+    ids = jax.device_put(ids, ctx.sharding("batch", "cp"))
+
+    def loss(p):
+        logits, aux = moe_decoder.forward(p, cfg, ids, mesh_ctx=ctx)
+        return jnp.mean(logits**2) + aux
+
+    val, g = jax.jit(jax.value_and_grad(loss))(params)
+    assert np.isfinite(float(val))
+    for leaf in jax.tree.leaves(g):
+        assert np.isfinite(np.asarray(leaf)).all()
