@@ -319,14 +319,37 @@ def forward(
             raise NotImplementedError("pp with per-layer window types")
         seg = segment_ids if segment_ids is not None else jnp.zeros_like(positions)
 
+        # inside the pipeline shard_map, tp is explicit: each tp rank holds a
+        # head/mlp slice, so the layer cfg carries the LOCAL counts
+        tp = mesh_ctx.sizes["tp"]
+        if tp > 1:
+            if (cfg.num_heads % tp or cfg.num_kv_heads % tp
+                    or cfg.intermediate_size % tp):
+                raise ValueError(
+                    f"pp×tp needs num_heads={cfg.num_heads}, "
+                    f"num_kv_heads={cfg.num_kv_heads}, "
+                    f"intermediate_size={cfg.intermediate_size} divisible by tp={tp}"
+                )
+            cfg_pl = dataclasses.replace(
+                cfg,
+                num_heads=cfg.num_heads // tp,
+                num_kv_heads=cfg.num_kv_heads // tp,
+                intermediate_size=cfg.intermediate_size // tp,
+                head_dim=cfg.resolved_head_dim,  # pin before num_heads changes
+            )
+        else:
+            cfg_pl = cfg
+
         def pl_layer(hh, lp, pos, sg):
             return _decoder_layer(
-                hh, lp, cfg, pos, sg, inv_freq, lambda x, axes: x, windows[0], None
+                hh, lp, cfg_pl, pos, sg, inv_freq, lambda x, axes: x,
+                windows[0], mesh_ctx, manual=True,
             )
 
         h = pipeline_layers(
             h, positions, seg, params["layers"], pl_layer, mesh_ctx,
             cfg.pipeline_microbatches, remat_policy=cfg.remat_policy,
+            param_logical_specs=param_specs(cfg)["layers"],
         )
     else:
 
@@ -384,12 +407,18 @@ def mlp_inner(x, lp, cfg: TransformerConfig):
     return gate * up
 
 
-def attention_block(h, lp, cfg: TransformerConfig, positions, segment_ids, inv_freq, constrain, sliding_window, mesh_ctx=None):
+def attention_block(h, lp, cfg: TransformerConfig, positions, segment_ids, inv_freq, constrain, sliding_window, mesh_ctx=None, manual=False):
     """Pre-norm attention with residual; shared by dense and MoE decoders.
 
     When the mesh has cp > 1 the sequence dim is sharded and attention runs
     as ring attention over the cp axis (parallel/cp.py); otherwise the
     backend dispatcher in ops/attention.py picks flash (TPU) or XLA.
+
+    `manual=True` = running INSIDE a full-mesh shard_map (the pp pipeline):
+    GSPMD constraints are inert there, so tensor parallelism is explicit —
+    lp holds the per-tp-rank head/mlp slice (cfg carries the LOCAL counts)
+    and the o_proj partial sum is psum'd over `tp`; cp attention calls the
+    in-shard ring directly.
     """
     if cfg.attention_type == "mla":
         from automodel_tpu.models.llm.mla import mla_attention_block
@@ -408,15 +437,26 @@ def attention_block(h, lp, cfg: TransformerConfig, positions, segment_ids, inv_f
     v = constrain(v, ("act_batch", "act_seq", "act_kv_heads", None))
 
     if mesh_ctx is not None and mesh_ctx.sizes["cp"] > 1:
-        from automodel_tpu.parallel.cp import ring_dot_product_attention
+        if manual:
+            from automodel_tpu.parallel.cp import ring_attention
 
-        attn = ring_dot_product_attention(
-            q, k, v, positions, segment_ids, mesh_ctx,
-            causal=cfg.causal,
-            sliding_window=sliding_window,
-            logits_soft_cap=cfg.attn_soft_cap,
-            scale=cfg.attn_scale,
-        )
+            attn = ring_attention(
+                q, k, v, positions, segment_ids, axis_name="cp",
+                causal=cfg.causal,
+                sliding_window=sliding_window,
+                logits_soft_cap=cfg.attn_soft_cap,
+                scale=cfg.attn_scale,
+            )
+        else:
+            from automodel_tpu.parallel.cp import ring_dot_product_attention
+
+            attn = ring_dot_product_attention(
+                q, k, v, positions, segment_ids, mesh_ctx,
+                causal=cfg.causal,
+                sliding_window=sliding_window,
+                logits_soft_cap=cfg.attn_soft_cap,
+                scale=cfg.attn_scale,
+            )
     else:
         attn = dot_product_attention(
             q, k, v,
@@ -430,7 +470,13 @@ def attention_block(h, lp, cfg: TransformerConfig, positions, segment_ids, inv_f
             impl=cfg.attn_impl,
         )
     attn = attn.reshape(B, S, cfg.num_heads * D)
-    attn_out = _dense(attn, lp["o_proj"], cfg.linear_precision)
+    from automodel_tpu.ops.quant import matmul as _mm
+
+    attn_out = _mm(attn, lp["o_proj"]["kernel"], cfg.linear_precision)
+    if manual and mesh_ctx is not None and mesh_ctx.sizes["tp"] > 1:
+        attn_out = jax.lax.psum(attn_out, "tp")  # partial head-slice sums
+    if "bias" in lp["o_proj"]:
+        attn_out = attn_out + lp["o_proj"]["bias"]
     if cfg.use_post_norms:
         attn_out = rms_norm(
             attn_out, lp["post_attn_out_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm
@@ -439,13 +485,16 @@ def attention_block(h, lp, cfg: TransformerConfig, positions, segment_ids, inv_f
     return constrain(h, ("act_batch", "act_seq", "act_embed"))
 
 
-def mlp_block(h, lp, cfg: TransformerConfig, constrain):
-    """Pre-norm gated MLP with residual."""
+def mlp_block(h, lp, cfg: TransformerConfig, constrain, mesh_ctx=None, manual=False):
+    """Pre-norm gated MLP with residual. `manual` as in attention_block:
+    explicit tp — lp holds the I/tp slice; the down_proj partial is psum'd."""
     from automodel_tpu.ops.quant import matmul as _mm
 
     x = rms_norm(h, lp["post_attn_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
     mlp = constrain(mlp_inner(x, lp, cfg), ("act_batch", "act_seq", "act_mlp"))
     mlp_out = _mm(mlp, lp["down_proj"]["kernel"], cfg.linear_precision)
+    if manual and mesh_ctx is not None and mesh_ctx.sizes["tp"] > 1:
+        mlp_out = jax.lax.psum(mlp_out, "tp")
     if cfg.use_post_norms:
         mlp_out = rms_norm(
             mlp_out, lp["post_mlp_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm
@@ -454,9 +503,9 @@ def mlp_block(h, lp, cfg: TransformerConfig, constrain):
     return constrain(h, ("act_batch", "act_seq", "act_embed"))
 
 
-def _decoder_layer(h, lp, cfg: TransformerConfig, positions, segment_ids, inv_freq, constrain, sliding_window, mesh_ctx=None):
-    h = attention_block(h, lp, cfg, positions, segment_ids, inv_freq, constrain, sliding_window, mesh_ctx)
-    return mlp_block(h, lp, cfg, constrain)
+def _decoder_layer(h, lp, cfg: TransformerConfig, positions, segment_ids, inv_freq, constrain, sliding_window, mesh_ctx=None, manual=False):
+    h = attention_block(h, lp, cfg, positions, segment_ids, inv_freq, constrain, sliding_window, mesh_ctx, manual)
+    return mlp_block(h, lp, cfg, constrain, mesh_ctx, manual)
 
 
 def _make_constrain(mesh_ctx, rules):

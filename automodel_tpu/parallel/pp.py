@@ -27,6 +27,7 @@ GPipe (P-1)/(M+P-1).
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Callable
 
 import jax
@@ -35,6 +36,14 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from automodel_tpu.distributed.mesh import MeshContext
+
+logger = logging.getLogger(__name__)
+
+
+def pipeline_bubble_fraction(num_microbatches: int, num_stages: int) -> float:
+    """Idle fraction of the schedule span — (P-1)/(M+P-1) for both GPipe
+    and non-interleaved 1F1B (1F1B buys memory, not bubble)."""
+    return (num_stages - 1) / (num_microbatches + num_stages - 1)
 
 
 def pipeline_layers(
@@ -47,23 +56,28 @@ def pipeline_layers(
     num_microbatches: int,
     batch_axes: tuple = ("dp_replicate", "dp_shard", "ep"),
     remat_policy: str | None = "full",
+    param_logical_specs: Any = None,
 ) -> jnp.ndarray:
     """Run the stacked layers as a pp-staged pipeline; returns (B, S, H).
 
     positions/segment_ids travel with their microbatch through the ring so
     every stage masks with the right coordinates.
+
+    Composition: the seq dim stays sharded on `cp` (layer_fn must run the
+    in-shard ring attention — decoder `manual=True` mode); head/mlp param
+    dims stay sharded on `tp` when `param_logical_specs` names them
+    (layer_fn psums the partial o/down projections over tp).
     """
     pp = mesh_ctx.sizes["pp"]
-    if mesh_ctx.sizes["tp"] != 1 or mesh_ctx.sizes["cp"] != 1:
-        raise NotImplementedError(
-            "pipeline parallelism currently composes with dp/ep only "
-            f"(got tp={mesh_ctx.sizes['tp']} cp={mesh_ctx.sizes['cp']})"
-        )
     B, S, H = h.shape
     M = num_microbatches
     assert B % M == 0, f"batch {B} must divide into {M} microbatches"
     L = jax.tree.leaves(stacked_params)[0].shape[0]
     assert L % pp == 0, f"{L} layers not divisible by pp={pp}"
+    logger.info(
+        "pipeline(gpipe): pp=%d M=%d bubble=%.3f",
+        pp, M, pipeline_bubble_fraction(M, pp),
+    )
 
     h_mb = h.reshape(M, B // M, S, H)
     pos_mb = positions.reshape(M, B // M, S)
@@ -116,21 +130,301 @@ def pipeline_layers(
         )
         return outputs
 
-    act_spec = P(None, batch_axes, None, None)  # (M, B, S, H)
-    tok_spec = P(None, batch_axes, None)
+    act_spec = P(None, batch_axes, "cp", None)  # (M, B, S_cp, H)
+    tok_spec = P(None, batch_axes, "cp")
     out = jax.shard_map(
         run,
         mesh=mesh_ctx.mesh,
-        in_specs=(act_spec, tok_spec, tok_spec, _param_specs_pp(stacked_params)),
+        in_specs=(
+            act_spec, tok_spec, tok_spec,
+            _param_specs_pp(stacked_params, param_logical_specs),
+        ),
         out_specs=act_spec,
         check_vma=False,
     )(h_mb, pos_mb, seg_mb, stacked_params)
     return out.reshape(B, S, H)
 
 
-def _param_specs_pp(stacked_params):
-    """Every stacked leaf: dim 0 on pp, everything else replicated in-map."""
-    def one(x):
-        return P(*(["pp"] + [None] * (x.ndim - 1)))
+# ---------------------------------------------------------------------------
+# 1F1B schedule (memory-capped training pipeline)
+# ---------------------------------------------------------------------------
+def one_f_one_b_tables(num_microbatches: int, num_stages: int):
+    """Static per-half-tick action tables for non-interleaved 1F1B.
 
-    return jax.tree.map(one, stacked_params)
+    The schedule builder analog (reference: distributed/pipelining/
+    functional.py:777): greedy simulation of Megatron's policy — stage p
+    warms up with (P-1-p) forwards, then alternates 1 fwd / 1 bwd, then
+    drains. Returns (fwd_mb, bwd_mb): int arrays (T, P) holding the
+    microbatch id acted on, or -1 for an idle slot. At most one action per
+    (tick, stage); dependencies are satisfied with ≥1-tick latency, so
+    ppermute streams inserted between ticks carry the data in time.
+    """
+    M, P = num_microbatches, num_stages
+    not_done = 10 ** 9
+    fwd_done = [[not_done] * M for _ in range(P)]  # completion half-tick
+    bwd_done = [[not_done] * M for _ in range(P)]
+    next_f = [0] * P
+    next_b = [0] * P
+    warmup_left = [P - 1 - p for p in range(P)]
+    fwd_rows, bwd_rows = [], []
+    t = 0
+    while any(next_b[p] < M for p in range(P)) and t < 4 * (M + P):
+        frow, brow = [-1] * P, [-1] * P
+        for p in range(P):
+            f, b = next_f[p], next_b[p]
+            # 1F1B memory bound: at most P-p microbatches in flight at stage
+            # p (warmup depth + the steady-state one) — also what keeps the
+            # mod-P stash indexing collision-free
+            f_ready = (
+                f < M
+                and (p == 0 or fwd_done[p - 1][f] < t)
+                and (f - b) < (P - p)
+            )
+            b_ready = (
+                b < M
+                and fwd_done[p][b] < t
+                and (p == P - 1 or bwd_done[p + 1][b] < t)
+            )
+            # policy: forwards during warmup, then bwd-first (1F1B steady)
+            if warmup_left[p] > 0 and f_ready:
+                frow[p] = f
+                fwd_done[p][f] = t
+                next_f[p] += 1
+                warmup_left[p] -= 1
+            elif b_ready:
+                brow[p] = b
+                bwd_done[p][b] = t
+                next_b[p] += 1
+            elif f_ready:
+                frow[p] = f
+                fwd_done[p][f] = t
+                next_f[p] += 1
+        fwd_rows.append(frow)
+        bwd_rows.append(brow)
+        t += 1
+    import numpy as np
+
+    return np.asarray(fwd_rows, np.int32), np.asarray(bwd_rows, np.int32)
+
+
+def pipeline_train_1f1b(
+    h: jnp.ndarray,            # (B, S, H) embedded activations (global)
+    positions: jnp.ndarray,    # (B, S)
+    segment_ids: jnp.ndarray,  # (B, S)
+    labels: jnp.ndarray,       # (B, S) int32 (-100 = ignored)
+    stacked_params: Any,       # leaves (L, ...), L % pp == 0
+    layer_fn: Callable,        # (h, layer_params, positions, segment_ids) -> h
+    head_params: Any,
+    head_loss_fn: Callable,    # (h_mb, head_params, labels_mb) -> scalar SUM loss
+    mesh_ctx: MeshContext,
+    num_microbatches: int,
+    batch_axes: tuple = ("dp_replicate", "dp_shard", "ep"),
+    param_logical_specs: Any = None,
+) -> tuple:
+    """1F1B training pipeline: returns (loss_sum, d_h, layer_grads, head_grads).
+
+    Unlike `pipeline_layers` (GPipe + autodiff, which stashes all M
+    microbatch boundary activations), this runs an explicit fwd/bwd
+    interleave with per-stage `jax.vjp`: at most `pp` microbatch inputs are
+    stashed per stage — the 1F1B memory bound — at the same bubble fraction
+    (P-1)/(M+P-1). The head (final-norm + lm-head + loss) runs fused into
+    the last stage's backward, so logits are never stored.
+
+    Grads come back already reduced: layer_grads sharded (pp on dim 0),
+    head_grads and d_h replicated. Compose with `jax.vjp` of the embedding
+    outside. Loss/grad parity vs end-to-end autodiff: tests/unit/test_pp.py.
+    """
+    pp = mesh_ctx.sizes["pp"]
+    B, S, H = h.shape
+    M = num_microbatches
+    assert B % M == 0, f"batch {B} must divide into {M} microbatches"
+    fwd_tab, bwd_tab = one_f_one_b_tables(M, pp)
+    T = fwd_tab.shape[0]
+    logger.info(
+        "pipeline(1f1b): pp=%d M=%d ticks=%d bubble=%.3f",
+        pp, M, T, pipeline_bubble_fraction(M, pp),
+    )
+
+    h_mb = h.reshape(M, B // M, S, H)
+    pos_mb = positions.reshape(M, B // M, S)
+    seg_mb = segment_ids.reshape(M, B // M, S)
+    lab_mb = labels.reshape(M, B // M, S)
+
+    def run(h_mb, pos_mb, seg_mb, lab_mb, params_local, head_local):
+        p_idx = lax.axis_index("pp")
+        n_stage = lax.axis_size("pp")
+        is_last = p_idx == n_stage - 1
+        ftab = jnp.asarray(fwd_tab)
+        btab = jnp.asarray(bwd_tab)
+
+        def stage(x, params, pos, seg):
+            def body(c, lp):
+                return layer_fn(c, lp, pos, seg), None
+
+            y, _ = lax.scan(body, x, params)
+            return y
+
+        def full_bwd(x, params, head, pos, seg, lab, dy):
+            """Backward of one microbatch at this stage: last stage fuses the
+            head+loss (ignoring dy), others pull the streamed cotangent."""
+
+            def fwd_last(xx, pp_, hh_):
+                return head_loss_fn(stage(xx, pp_, pos, seg), hh_, lab).astype(
+                    jnp.float32
+                )
+
+            def fwd_mid(xx, pp_, hh_):
+                del hh_
+                y = stage(xx, pp_, pos, seg)
+                return jnp.vdot(y.astype(jnp.float32), dy.astype(jnp.float32))
+
+            loss, vjp = jax.vjp(
+                lambda xx, pp_, hh_: lax.cond(
+                    is_last, fwd_last, fwd_mid, xx, pp_, hh_
+                ),
+                x, params, head,
+            )
+            dx, dparams, dhead = vjp(jnp.ones((), loss.dtype))
+            return jnp.where(is_last, loss, 0.0), dx, dparams, dhead
+
+        zeros_g = jax.tree.map(jnp.zeros_like, params_local)
+        zeros_h = jax.tree.map(jnp.zeros_like, head_local)
+        stash0 = jnp.zeros((n_stage,) + h_mb.shape[1:], h_mb.dtype)
+
+        def tick(carry, t):
+            (fstream, bstream, fstash, bstash, stash,
+             gacc, hacc, dh_acc, loss_acc) = carry
+            mf = jnp.take(ftab[t], p_idx)
+            mb = jnp.take(btab[t], p_idx)
+
+            # ---- bank arrivals (streams hold the NEIGHBOR's t-1 output;
+            # consumption may be ticks later, so stash by microbatch id) ----
+            prev_t = jnp.maximum(t - 1, 0)
+            from_prev = jnp.take(ftab[prev_t], (p_idx - 1) % n_stage)
+            f_arrived = jnp.logical_and(
+                jnp.logical_and(t > 0, p_idx > 0), from_prev >= 0
+            )
+            fstash = jnp.where(
+                f_arrived,
+                lax.dynamic_update_index_in_dim(
+                    fstash, fstream, jnp.clip(from_prev, 0, M - 1) % n_stage, 0
+                ),
+                fstash,
+            )
+            from_next = jnp.take(btab[prev_t], (p_idx + 1) % n_stage)
+            b_arrived = jnp.logical_and(
+                jnp.logical_and(t > 0, p_idx < n_stage - 1), from_next >= 0
+            )
+            bstash = jnp.where(
+                b_arrived,
+                lax.dynamic_update_index_in_dim(
+                    bstash, bstream, jnp.clip(from_next, 0, M - 1) % n_stage, 0
+                ),
+                bstash,
+            )
+
+            # ---- forward slot ----
+            mf_c = jnp.clip(mf, 0, M - 1)
+            x_in = jnp.where(p_idx == 0, h_mb[mf_c], fstash[mf_c % n_stage])
+            stash = jnp.where(
+                mf >= 0,
+                lax.dynamic_update_index_in_dim(stash, x_in, mf_c % n_stage, 0),
+                stash,
+            )
+            y = stage(x_in, params_local, pos_mb[mf_c], seg_mb[mf_c])
+            fout = jnp.where(mf >= 0, y, jnp.zeros_like(y))
+
+            # ---- backward slot ----
+            mb_c = jnp.clip(mb, 0, M - 1)
+            x_b = stash[mb_c % n_stage]
+            loss_i, dx, dparams, dhead = full_bwd(
+                x_b, params_local, head_local,
+                pos_mb[mb_c], seg_mb[mb_c], lab_mb[mb_c], bstash[mb_c % n_stage],
+            )
+            do_b = mb >= 0
+            gacc = jax.tree.map(
+                lambda a, g: a + jnp.where(do_b, g, jnp.zeros_like(g)), gacc, dparams
+            )
+            hacc = jax.tree.map(
+                lambda a, g: a + jnp.where(do_b, g, jnp.zeros_like(g)), hacc, dhead
+            )
+            dh_acc = jnp.where(
+                jnp.logical_and(do_b, p_idx == 0),
+                lax.dynamic_update_index_in_dim(dh_acc, dx, mb_c, 0),
+                dh_acc,
+            )
+            loss_acc = loss_acc + jnp.where(do_b, loss_i, 0.0)
+
+            fwd_perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+            bwd_perm = [((i + 1) % n_stage, i) for i in range(n_stage)]
+            fstream = lax.ppermute(fout, "pp", fwd_perm)
+            bout = jnp.where(do_b, dx, jnp.zeros_like(dx))
+            bstream = lax.ppermute(bout, "pp", bwd_perm)
+            return (
+                fstream, bstream, fstash, bstash, stash,
+                gacc, hacc, dh_acc, loss_acc,
+            ), None
+
+        carry0 = (
+            jnp.zeros_like(h_mb[0]),
+            jnp.zeros_like(h_mb[0]),
+            stash0,
+            stash0,
+            stash0,
+            zeros_g,
+            zeros_h,
+            jnp.zeros_like(h_mb),
+            jnp.zeros((), jnp.float32),
+        )
+        (_, _, _, _, _, gacc, hacc, dh_acc, loss_acc), _ = lax.scan(
+            tick, carry0, jnp.arange(T)
+        )
+        # Manual-collective grad reduction (the transpose of shard_map would
+        # have inserted these in the autodiff path): param grads are partial
+        # per data shard → psum over batch+cp; NOT over tp (activations are
+        # tp-replicated so per-rank grads are already correct for each
+        # rank's param slice). Layer grads stay on their own pp stage; head
+        # grads / loss / d_h are made consistent across pp.
+        data_axes = tuple(batch_axes) + ("cp",)
+        gacc = jax.tree.map(lambda g: lax.psum(g, data_axes), gacc)
+        hacc = jax.tree.map(lambda g: lax.psum(g, data_axes + ("pp",)), hacc)
+        dh_acc = lax.psum(dh_acc, "pp")
+        loss_acc = lax.psum(loss_acc, data_axes + ("pp",))
+        return loss_acc, dh_acc, gacc, hacc
+
+    act_spec = P(None, batch_axes, "cp", None)
+    tok_spec = P(None, batch_axes, "cp")
+    pspecs = _param_specs_pp(stacked_params, param_logical_specs)
+    hspec = jax.tree.map(lambda x: P(*([None] * x.ndim)), head_params)
+    loss, dh, gl, gh = jax.shard_map(
+        run,
+        mesh=mesh_ctx.mesh,
+        in_specs=(act_spec, tok_spec, tok_spec, tok_spec, pspecs, hspec),
+        out_specs=(P(), act_spec, pspecs, hspec),
+        check_vma=False,
+    )(h_mb, pos_mb, seg_mb, lab_mb, stacked_params, head_params)
+    return loss, dh.reshape(B, S, H), gl, gh
+
+
+#: logical param axes that stay sharded inside the pipeline shard_map;
+#: everything else (fsdp/embed dims) is gathered at the boundary — the
+#: per-step FSDP-unshard analog.
+_PP_MANUAL_AXES = {"layers": "pp", "heads": "tp", "kv_heads": "tp", "mlp": "tp"}
+
+
+def _param_specs_pp(stacked_params, logical=None):
+    """Stacked-leaf in_specs: dim 0 on pp; tp dims kept when `logical`
+    (a pytree of logical axis-name tuples, decoder param_specs style)."""
+    if logical is None:
+        return jax.tree.map(
+            lambda x: P(*(["pp"] + [None] * (x.ndim - 1))), stacked_params
+        )
+
+    def one(spec):
+        return P(*(_PP_MANUAL_AXES.get(ax) for ax in spec))
+
+    return jax.tree.map(
+        one, logical,
+        is_leaf=lambda x: isinstance(x, tuple)
+        and all(isinstance(a, (str, type(None))) for a in x),
+    )

@@ -40,7 +40,7 @@ def force_cpu_devices(n_devices: int = 1) -> None:
                 "initialized — the CPU platform / device count cannot take "
                 "effect. Call it before any jax.devices()/computation."
             )
-    except ImportError:  # private API moved; skip the guard rather than lie
-        pass
+    except (ImportError, AttributeError):
+        pass  # private API moved; skip the guard rather than lie
 
     jax.config.update("jax_platforms", "cpu")
