@@ -287,10 +287,17 @@ def forward(
     rules=None,
     return_hidden: bool = False,
     inputs_embeds: jnp.ndarray | None = None,  # (B,S,H) — VLM merged embeds
+    return_aux_hidden: tuple | None = None,    # layer indices → EAGLE-3 aux
 ) -> jnp.ndarray:
     """Run the decoder. Returns logits (B,S,V) fp32, or hidden (B,S,H) when
     `return_hidden` (pair with loss/linear_ce.py to avoid materializing
-    logits — the FusedLinearCrossEntropy analog)."""
+    logits — the FusedLinearCrossEntropy analog).
+
+    `return_aux_hidden=(lo, mid, hi)` additionally returns the outputs of
+    those layers (pre-final-norm) stacked (k, B, S, H) — the target-side
+    hidden capture for EAGLE-3 speculative training (reference:
+    components/speculative/eagle/target.py hidden-state hooks; here it is a
+    scan-ys selection, no hooks needed). Result becomes (out, aux)."""
     from automodel_tpu.models.common.layers import cast_params
 
     params = cast_params(params, cfg.dtype)  # fp32 master → compute dtype
@@ -317,6 +324,15 @@ def forward(
         windows = layer_windows(cfg)
         if len(set(windows)) != 1:
             raise NotImplementedError("pp with per-layer window types")
+        if return_aux_hidden is not None:
+            raise NotImplementedError("aux-hidden capture inside the pp pipeline")
+        if cfg.attention_type == "mla" and (
+            mesh_ctx.sizes["tp"] > 1 or mesh_ctx.sizes["cp"] > 1
+        ):
+            raise NotImplementedError(
+                "pp×tp / pp×cp with MLA attention: the manual-collective "
+                "layer mode is implemented for standard GQA attention only"
+            )
         seg = segment_ids if segment_ids is not None else jnp.zeros_like(positions)
 
         # inside the pipeline shard_map, tp is explicit: each tp rank holds a
@@ -358,15 +374,42 @@ def forward(
                 h, lp, cfg, positions, segment_ids, inv_freq, constrain, window, mesh_ctx
             )
 
-        h = scan_layers_windowed(
-            layer, h, params["layers"], layer_windows(cfg),
-            remat_policy=cfg.remat_policy, unroll=cfg.scan_unroll,
-        )
+        if return_aux_hidden is not None:
+            windows = layer_windows(cfg)
+            if len(set(windows)) != 1:
+                raise NotImplementedError("aux-hidden capture with mixed windows")
+            from automodel_tpu.models.common.layers import maybe_remat
+
+            aux_ids = tuple(return_aux_hidden)
+
+            # carry an (A, B, S, H) buffer updated only at the selected
+            # layers — never materializes all L per-layer outputs
+            def body(carry, xs):
+                c, aux = carry
+                lp, i = xs
+                y = layer(c, lp, windows[0])
+                for j, lid in enumerate(aux_ids):
+                    aux = aux.at[j].set(jnp.where(i == lid, y, aux[j]))
+                return (y, aux), None
+
+            aux0 = jnp.zeros((len(aux_ids),) + h.shape, h.dtype)
+            (h, aux), _ = jax.lax.scan(
+                maybe_remat(body, cfg.remat_policy),
+                (h, aux0),
+                (params["layers"], jnp.arange(cfg.num_layers)),
+                unroll=cfg.scan_unroll,
+            )
+        else:
+            h = scan_layers_windowed(
+                layer, h, params["layers"], layer_windows(cfg),
+                remat_policy=cfg.remat_policy, unroll=cfg.scan_unroll,
+            )
 
     h = rms_norm(h, params["final_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
-    if return_hidden:
-        return h
-    return unembed(params, cfg, h)
+    out = h if return_hidden else unembed(params, cfg, h)
+    if return_aux_hidden is not None:
+        return out, aux
+    return out
 
 
 def unembed(params: dict, cfg: TransformerConfig, h: jnp.ndarray) -> jnp.ndarray:

@@ -202,6 +202,10 @@ def one_f_one_b_tables(num_microbatches: int, num_stages: int):
         fwd_rows.append(frow)
         bwd_rows.append(brow)
         t += 1
+    assert all(next_b[p] == M and next_f[p] == M for p in range(P)), (
+        f"1F1B schedule did not complete for M={M} P={P}: "
+        f"fwd={next_f} bwd={next_b} — silent gradient loss prevented"
+    )
     import numpy as np
 
     return np.asarray(fwd_rows, np.int32), np.asarray(bwd_rows, np.int32)

@@ -1,0 +1,205 @@
+"""EAGLE-3 speculative draft training recipe.
+
+The analog of the reference trainer (reference: nemo_automodel/recipes/llm/
+train_eagle3.py `TrainEagle3Recipe`): a frozen target model produces
+aux hidden states + logits online, the drafter trains with the TTT unroll,
+and the simulated acceptance length is tracked in the metrics JSONL.
+
+Reuses the whole finetune-recipe chassis (data, scheduler, checkpoint,
+trackers); only the model build and the loss change. The target rides the
+jitted step as a pass-through extra arg like the KD teacher — inference
+only, never in the optimizer.
+
+YAML:
+
+    recipe: llm_train_eagle3
+    target_model:
+      hf_config: {...}            # or pretrained_path
+      dtype: bfloat16
+    speculative:
+      draft_vocab_size: 16384     # ≤ target vocab
+      ttt_steps: 3
+      aux_layer_ids: [2, 8, 14]   # default: (2, L//2, L-3) clipped
+      hidden_size: null           # default: target hidden size
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.checkpoint import HFCheckpointReader, get_adapter
+from automodel_tpu.config import ConfigNode
+from automodel_tpu.models.registry import get_model_spec
+from automodel_tpu.parallel import logical_to_shardings
+from automodel_tpu.recipes.llm.train_ft import (
+    TrainFinetuneRecipeForNextTokenPrediction,
+    _DTYPES,
+)
+from automodel_tpu.speculative.eagle3 import (
+    Eagle3Config,
+    build_vocab_mapping,
+    drafter_param_specs,
+    eagle3_ttt_loss,
+    init_drafter,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class TrainEagle3Recipe(TrainFinetuneRecipeForNextTokenPrediction):
+    def _build_model(self) -> None:
+        cfg = self.cfg
+        tcfg = cfg.get("target_model") or cfg.get("model")
+        if tcfg is None:
+            raise ValueError("EAGLE-3 recipe requires a `target_model:` section")
+        dtype = _DTYPES[tcfg.get("dtype", "bfloat16")]
+        pretrained = tcfg.get("pretrained_path", None)
+        if pretrained:
+            reader = HFCheckpointReader(pretrained)
+            hf_config = reader.hf_config()
+        else:
+            reader = None
+            hf_config = tcfg.get("hf_config")
+            hf_config = (
+                hf_config.to_dict()
+                if isinstance(hf_config, ConfigNode)
+                else dict(hf_config)
+            )
+        self.target_spec = get_model_spec(hf_config)
+        if self.target_spec.adapter_name != "dense_decoder":
+            raise NotImplementedError(
+                "EAGLE-3 targets are dense decoders for now (MoE targets need "
+                "aux-hidden capture in the MoE scan)"
+            )
+        self.target_cfg = self.target_spec.config_from_hf(
+            hf_config, dtype=dtype, remat_policy=tcfg.get("remat_policy", "none")
+        )
+        module = self.target_spec.module
+        shapes = jax.eval_shape(lambda: module.init(self.target_cfg, jax.random.key(0)))
+        shardings = logical_to_shardings(
+            module.param_specs(self.target_cfg), self.mesh_ctx,
+            shapes=jax.tree.map(lambda p: p.shape, shapes),
+        )
+        if reader is not None:
+            adapter = get_adapter(self.target_spec.adapter_name, self.target_cfg)
+            self.target_params = adapter.from_hf(reader, shardings=shardings)
+            logger.info("target loaded from %s", pretrained)
+        else:
+            self.target_params = jax.jit(
+                lambda k: module.init(self.target_cfg, k), out_shardings=shardings
+            )(jax.random.key(int(cfg.get("target_seed", 7))))
+        self.target_params = jax.tree.map(
+            lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p,
+            self.target_params,
+        )
+
+        # -- drafter -------------------------------------------------------
+        scfg = cfg.get("speculative")
+        t = self.target_cfg
+        L = t.num_layers
+        default_aux = tuple(sorted({min(max(i, 0), L - 1) for i in (2, L // 2, L - 3)}))
+        aux_ids = tuple(
+            int(i) for i in (scfg.get("aux_layer_ids") if scfg else None) or default_aux
+        )
+        self.aux_layer_ids = aux_ids
+        self.eagle_cfg = Eagle3Config(
+            vocab_size=t.vocab_size,
+            draft_vocab_size=int(scfg.get("draft_vocab_size", t.vocab_size) if scfg else t.vocab_size),
+            hidden_size=int(scfg.get("hidden_size", 0) if scfg else 0) or t.hidden_size,
+            intermediate_size=int(scfg.get("intermediate_size", 0) if scfg else 0) or t.intermediate_size,
+            num_heads=int(scfg.get("num_heads", 0) if scfg else 0) or t.num_heads,
+            num_kv_heads=int(scfg.get("num_kv_heads", 0) if scfg else 0) or t.num_kv_heads,
+            target_hidden_size=t.hidden_size,
+            num_aux_hidden_states=len(aux_ids),
+            ttt_steps=int(scfg.get("ttt_steps", 3) if scfg else 3),
+            rope_theta=t.rope_theta,
+            dtype=_DTYPES[scfg.get("dtype", "float32") if scfg else "float32"],
+        )
+        # draft vocab = most frequent target tokens; without corpus counts the
+        # mapping defaults to the lowest ids (HF tokenizers put specials +
+        # common tokens first, and the mock path is deterministic either way)
+        counts_path = scfg.get("vocab_counts_path", None) if scfg else None
+        if counts_path:
+            import numpy as np
+
+            counts = jnp.asarray(np.load(counts_path))
+        else:
+            counts = jnp.arange(t.vocab_size, 0, -1, dtype=jnp.float32)
+        self.d2t, self.t2d_mask = build_vocab_mapping(
+            counts, self.eagle_cfg.draft_vocab_size
+        )
+
+        params = init_drafter(self.eagle_cfg, jax.random.key(int(cfg.get("seed", 42))))
+        # warm-start the drafter embedding from the target's (frozen) table —
+        # only when the widths agree; explicit copy, sharing the buffer would
+        # clash with step donation
+        if self.eagle_cfg.hidden_size == t.hidden_size:
+            params["embed"]["embedding"] = jnp.array(
+                self.target_params["embed"]["embedding"], jnp.float32, copy=True
+            )
+        dshardings = logical_to_shardings(
+            drafter_param_specs(self.eagle_cfg), self.mesh_ctx,
+            shapes=jax.tree.map(lambda p: p.shape, params),
+        )
+        self._init_params = jax.device_put(params, dshardings)
+        # chassis attributes: MFU + logging use the TARGET's flops (the
+        # target forward dominates the online step)
+        self.model_cfg = self.target_cfg
+        self.model_spec = self.target_spec
+        self.peft_cfg = None
+        self.is_moe = False
+
+    def _make_loss_fn(self):
+        eagle_cfg = self.eagle_cfg
+        target_cfg = self.target_cfg
+        target_module = self.target_spec.module
+        aux_ids = self.aux_layer_ids
+        d2t, t2d_mask = self.d2t, self.t2d_mask
+        mesh_ctx = self.mesh_ctx
+        accum = float(self.cfg.get("dataloader.grad_acc_steps", 1))
+
+        from automodel_tpu.speculative.eagle3 import _shift_left as shift_left
+
+        def loss_fn(params, batch, rng, target_params):
+            ids = batch["input_ids"]
+            loss_mask = batch["labels"] != -100
+            kw = {}
+            for k in ("positions", "segment_ids"):
+                if k in batch:
+                    kw[k] = batch[k]
+            logits, aux_h = jax.lax.stop_gradient(
+                target_module.forward(
+                    target_params, target_cfg, ids,
+                    mesh_ctx=mesh_ctx, return_aux_hidden=aux_ids, **kw,
+                )
+            )
+            # drafter frame: everything shifts one step ahead of the target
+            # (reference: speculative/eagle/target.py:373-379)
+            loss, m = eagle3_ttt_loss(
+                params, eagle_cfg,
+                shift_left(ids), aux_h, shift_left(logits),
+                shift_left(loss_mask), d2t, t2d_mask,
+                positions=kw.get("positions"),
+                segment_ids=kw.get("segment_ids"),
+            )
+            # scalars are SUMMED over grad-accum microbatches by the train
+            # step; pre-divide so the logged value is the mean
+            return loss, {
+                "num_label_tokens": jnp.float32(1.0),
+                "supervised_tokens": m["valid_tokens"],
+                "draft_accuracy": m["accuracy"] / accum,
+                "accept_length": m["accept_length"] / accum,
+            }
+
+        return loss_fn
+
+    def _step_extra(self) -> tuple:
+        return (self.target_params,)
+
+    def save_consolidated_hf(self, out_dir=None):
+        raise NotImplementedError(
+            "EAGLE-3 drafter export to HF/SGLang format not implemented yet"
+        )

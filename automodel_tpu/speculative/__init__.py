@@ -1,0 +1,9 @@
+from automodel_tpu.speculative.eagle3 import (  # noqa: F401
+    Eagle3Config,
+    build_vocab_mapping,
+    drafter_forward_step,
+    eagle3_ttt_loss,
+    init_drafter,
+    drafter_param_specs,
+    simulated_accept_length,
+)

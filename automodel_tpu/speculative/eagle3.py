@@ -1,0 +1,368 @@
+"""EAGLE-3 speculative-decoding draft training, TPU-native.
+
+What the reference builds with torch modules + P2P process groups
+(reference: nemo_automodel/components/speculative/eagle/core.py:233
+`Eagle3TrainerModule`, draft_llama.py:186 `Eagle3LlamaAttention`,
+recipes/llm/train_eagle3.py), re-designed for JAX/GSPMD:
+
+- The drafter is a params-pytree + pure functions like every other model
+  here: one fused decoder layer whose attention input is
+  concat(norm(embed), norm(hidden)) (2H), a `fc` projection of the target's
+  three auxiliary hidden states, final norm, and a compressed-vocab lm head
+  with d2t/t2d mapping buffers.
+- The TTT (test-time-training) recurrence is a static Python loop over
+  `ttt_steps`: step s attends with a T×T causal block against step-0 K/V
+  plus one diagonal column per cached later step (q at position t sees
+  position t of K_i) — the SpecForge `cache_hidden` semantics, expressed as
+  two einsums over a stacked (s, B, T, ...) cache instead of list surgery.
+- The per-step left-shift of ids/masks/probs is a plain jnp.concatenate:
+  under GSPMD a sharded-sequence shift lowers to the halo collective-permute
+  the reference hand-writes as `_cp_shift_left` / `_cp_shift_left_zigzag`
+  (core.py:34,62) — no manual P2P, and the loss renormalization
+  `_cp_global_step_loss` (core.py:136) is unnecessary because the loss is a
+  global masked SUM under one jit.
+- Acceptance is estimated exactly like the reference: per-step prefix-hit
+  counts over supervised chains → `simulated_accept_length` = 1 + Σ_k
+  hits_k / valid_k (core.py:218).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.layers import dense_init
+from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.rope import apply_rope, rope_frequencies
+
+TTT_DECAY = 0.8  # EAGLE-3 / SpecForge per-step loss decay
+
+
+@dataclasses.dataclass
+class Eagle3Config:
+    """Drafter shape + TTT schedule.
+
+    `target_hidden_size` is the hidden size of the frozen target model whose
+    aux states feed `fc`; the drafter itself runs at `hidden_size`.
+    """
+
+    vocab_size: int                 # target vocabulary
+    draft_vocab_size: int           # compressed draft vocabulary (≤ vocab)
+    hidden_size: int
+    intermediate_size: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: Optional[int] = None
+    target_hidden_size: Optional[int] = None
+    num_aux_hidden_states: int = 3
+    ttt_steps: int = 3
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    dtype: jnp.dtype = jnp.float32
+
+    def __post_init__(self):
+        if self.ttt_steps < 1:
+            raise ValueError(f"ttt_steps must be >= 1, got {self.ttt_steps}")
+        if self.draft_vocab_size > self.vocab_size:
+            raise ValueError("draft_vocab_size cannot exceed vocab_size")
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def resolved_target_hidden(self) -> int:
+        return self.target_hidden_size or self.hidden_size
+
+
+def build_vocab_mapping(
+    token_counts: jnp.ndarray, draft_vocab_size: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(d2t, t2d_mask) from target-vocab token frequencies.
+
+    The analog of the reference's frequency-ranked draft vocabulary
+    (train_eagle3.py vocab-mapping build): the `draft_vocab_size` most
+    frequent target tokens become the draft vocab, in target-id order so the
+    mapping is deterministic. Returns d2t (Vd,) int32 draft→target ids and
+    t2d_mask (V,) bool "representable in draft vocab".
+    """
+    V = token_counts.shape[0]
+    top = jax.lax.top_k(token_counts.astype(jnp.float32), draft_vocab_size)[1]
+    d2t = jnp.sort(top).astype(jnp.int32)
+    t2d_mask = jnp.zeros((V,), bool).at[d2t].set(True)
+    return d2t, t2d_mask
+
+
+# ---------------------------------------------------------------------------
+# drafter params
+# ---------------------------------------------------------------------------
+def init_drafter(cfg: Eagle3Config, rng: jax.Array) -> dict:
+    H, I = cfg.hidden_size, cfg.intermediate_size
+    Ht, A = cfg.resolved_target_hidden, cfg.num_aux_hidden_states
+    D = cfg.resolved_head_dim
+    ks = jax.random.split(rng, 9)
+    return {
+        "embed": {"embedding": 0.02 * jax.random.normal(ks[0], (cfg.vocab_size, H))},
+        "fc": {"kernel": dense_init(ks[1], (Ht * A, H))},
+        "layer": {
+            "input_norm": {"scale": jnp.ones((H,))},
+            "hidden_norm": {"scale": jnp.ones((H,))},
+            "q_proj": {"kernel": dense_init(ks[2], (2 * H, cfg.num_heads * D))},
+            "k_proj": {"kernel": dense_init(ks[3], (2 * H, cfg.num_kv_heads * D))},
+            "v_proj": {"kernel": dense_init(ks[4], (2 * H, cfg.num_kv_heads * D))},
+            "o_proj": {"kernel": dense_init(ks[5], (cfg.num_heads * D, H))},
+            "post_attn_norm": {"scale": jnp.ones((H,))},
+            "gate_proj": {"kernel": dense_init(ks[6], (H, I))},
+            "up_proj": {"kernel": dense_init(ks[7], (H, I))},
+            "down_proj": {"kernel": dense_init(ks[8], (I, H))},
+        },
+        "final_norm": {"scale": jnp.ones((H,))},
+        "lm_head": {"kernel": dense_init(jax.random.fold_in(rng, 99), (H, cfg.draft_vocab_size))},
+    }
+
+
+def drafter_param_specs(cfg: Eagle3Config) -> dict:
+    return {
+        "embed": {"embedding": ("vocab", "embed")},
+        "fc": {"kernel": ("embed", None)},
+        "layer": {
+            "input_norm": {"scale": ("norm",)},
+            "hidden_norm": {"scale": ("norm",)},
+            "q_proj": {"kernel": ("embed", "heads")},
+            "k_proj": {"kernel": ("embed", "kv_heads")},
+            "v_proj": {"kernel": ("embed", "kv_heads")},
+            "o_proj": {"kernel": ("heads", "embed")},
+            "post_attn_norm": {"scale": ("norm",)},
+            "gate_proj": {"kernel": ("embed", "mlp")},
+            "up_proj": {"kernel": ("embed", "mlp")},
+            "down_proj": {"kernel": ("mlp", "embed")},
+        },
+        "final_norm": {"scale": ("norm",)},
+        "lm_head": {"kernel": ("embed", "vocab")},
+    }
+
+
+# ---------------------------------------------------------------------------
+# drafter forward (one TTT step)
+# ---------------------------------------------------------------------------
+def _ttt_attention(q, k0, v0, later_k, later_v, positions, scale, segment_ids=None):
+    """EAGLE-3 TTT attention (reference: draft_llama.py:371
+    `_eager_attention_forward`): causal T×T against step-0 K/V plus one
+    diagonal column per cached later step. With packed sequences,
+    segment_ids makes the causal block document-block-causal (the analog of
+    the reference's seq_lens varlen path, draft_llama.py:476).
+
+    q (B,T,Hq,D); k0/v0 (B,T,Hkv,D); later_k/v (s,B,T,Hkv,D) (s may be 0).
+    """
+    B, T, Hq, D = q.shape
+    Hkv = k0.shape[2]
+    G = Hq // Hkv
+    qg = q.reshape(B, T, Hkv, G, D)
+
+    s0 = jnp.einsum("bqkgd,btkd->bkgqt", qg, k0, preferred_element_type=jnp.float32)
+    causal = positions[:, :, None] >= positions[:, None, :]        # (B,T,T)
+    if segment_ids is not None:
+        causal &= segment_ids[:, :, None] == segment_ids[:, None, :]
+    s0 = jnp.where(causal[:, None, None, :, :], s0 * scale, -jnp.inf)
+
+    s = later_k.shape[0]
+    if s:
+        diag = jnp.einsum(
+            "bqkgd,sbqkd->bkgqs", qg, later_k, preferred_element_type=jnp.float32
+        ) * scale                                                   # (B,Hkv,G,T,s)
+        scores = jnp.concatenate([s0, diag], axis=-1)
+    else:
+        scores = s0
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgqt,btkd->bqkgd", probs[..., :T].astype(v0.dtype), v0)
+    if s:
+        out = out + jnp.einsum(
+            "bkgqs,sbqkd->bqkgd", probs[..., T:].astype(v0.dtype), later_v
+        )
+    return out.reshape(B, T, Hq * D)
+
+
+def drafter_forward_step(
+    params: dict,
+    cfg: Eagle3Config,
+    input_ids: jnp.ndarray,   # (B, T)
+    hidden: jnp.ndarray,      # (B, T, H) carried draft hidden
+    positions: jnp.ndarray,   # (B, T)
+    cache: tuple | None,      # (later_k, later_v) stacked (s,B,T,Hkv,D) or None
+    step_idx: int,
+    segment_ids: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, tuple]:
+    """One TTT step of the fused draft layer. Returns (hidden', cache')."""
+    lp = params["layer"]
+    dtype = cfg.dtype
+    B, T = input_ids.shape
+    D = cfg.resolved_head_dim
+
+    e = jnp.take(params["embed"]["embedding"], input_ids, axis=0).astype(dtype)
+    ne = rms_norm(e, lp["input_norm"]["scale"], cfg.rms_norm_eps)
+    nh = rms_norm(hidden, lp["hidden_norm"]["scale"], cfg.rms_norm_eps)
+    combined = jnp.concatenate([ne, nh], axis=-1)
+
+    q = (combined @ lp["q_proj"]["kernel"].astype(dtype)).reshape(B, T, cfg.num_heads, D)
+    k = (combined @ lp["k_proj"]["kernel"].astype(dtype)).reshape(B, T, cfg.num_kv_heads, D)
+    v = (combined @ lp["v_proj"]["kernel"].astype(dtype)).reshape(B, T, cfg.num_kv_heads, D)
+    # rotary phase advances with the TTT step (draft token depth)
+    inv_freq = rope_frequencies(D, cfg.rope_theta)
+    q = apply_rope(q, positions + step_idx, inv_freq)
+    k = apply_rope(k, positions + step_idx, inv_freq)
+
+    if cache is None:
+        Hkv = cfg.num_kv_heads
+        later_k = jnp.zeros((0, B, T, Hkv, D), k.dtype)
+        later_v = jnp.zeros((0, B, T, Hkv, D), v.dtype)
+        k0, v0 = k, v
+    else:
+        (k0, v0), (later_k, later_v) = cache[0], cache[1]
+        later_k = jnp.concatenate([later_k, k[None]], axis=0)
+        later_v = jnp.concatenate([later_v, v[None]], axis=0)
+
+    attn = _ttt_attention(
+        q, k0, v0, later_k, later_v, positions, D ** -0.5, segment_ids
+    )
+    h = hidden + attn @ lp["o_proj"]["kernel"].astype(dtype)
+
+    x = rms_norm(h, lp["post_attn_norm"]["scale"], cfg.rms_norm_eps)
+    mlp = jax.nn.silu(x @ lp["gate_proj"]["kernel"].astype(dtype)) * (
+        x @ lp["up_proj"]["kernel"].astype(dtype)
+    )
+    h = h + mlp @ lp["down_proj"]["kernel"].astype(dtype)
+    return h, ((k0, v0), (later_k, later_v))
+
+
+def _compute_logits(params, cfg, hidden):
+    h = rms_norm(hidden, params["final_norm"]["scale"], cfg.rms_norm_eps)
+    return jnp.einsum(
+        "bth,hv->btv", h, params["lm_head"]["kernel"].astype(h.dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _shift_left(x):
+    """Global left-shift, zero tail. Under GSPMD a cp-sharded seq dim turns
+    this into the boundary collective-permute automatically (replaces the
+    reference's manual `_cp_shift_left*`, core.py:34-117)."""
+    return jnp.concatenate([x[:, 1:], jnp.zeros_like(x[:, :1])], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# TTT training loss + acceptance metrics
+# ---------------------------------------------------------------------------
+def eagle3_ttt_loss(
+    draft_params: dict,
+    cfg: Eagle3Config,
+    input_ids: jnp.ndarray,      # (B, T) target-side input ids
+    aux_hidden: jnp.ndarray,     # (A, B, T, Ht) captured target layers
+    target_logits: jnp.ndarray,  # (B, T, V) frozen-target logits
+    loss_mask: jnp.ndarray,      # (B, T) bool — supervised positions
+    d2t: jnp.ndarray,            # (Vd,) int32
+    t2d_mask: jnp.ndarray,       # (V,) bool
+    positions: jnp.ndarray | None = None,
+    segment_ids: jnp.ndarray | None = None,  # (B, T) — packed-doc boundaries
+) -> tuple[jnp.ndarray, dict]:
+    """Unrolled EAGLE-3 loss. Returns (loss, metrics).
+
+    Supervision per step: soft CE between the draft logits and the target
+    distribution restricted to the draft vocab, weighted TTT_DECAY**s and
+    normalized by the weight sum (reference: core.py:455 weighting, with
+    the same deliberate normalization). Positions whose greedy target token
+    is outside the draft vocab are unsupervised but still break acceptance
+    chains (reference: Eagle3StepMetrics docstring).
+
+    metrics: accuracy, step_prefix_hits (ttt,), step_valid (ttt,),
+    accept_length.
+    """
+    B, T = input_ids.shape
+    A = aux_hidden.shape[0]
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+
+    # target distribution over the draft vocab; stop_gradient = frozen target
+    tl = jax.lax.stop_gradient(target_logits)
+    draft_target_logits = jnp.take(tl, d2t, axis=-1)             # (B,T,Vd)
+    target_probs = jax.nn.softmax(draft_target_logits.astype(jnp.float32), axis=-1)
+    target_top = jnp.argmax(tl, axis=-1)                          # (B,T)
+    position_mask = jnp.take(t2d_mask, target_top) & loss_mask.astype(bool)
+
+    aux = jnp.moveaxis(aux_hidden, 0, -2).reshape(B, T, A * aux_hidden.shape[-1])
+    hidden = (aux.astype(cfg.dtype) @ draft_params["fc"]["kernel"].astype(cfg.dtype))
+
+    cur_ids = input_ids
+    cur_pm = position_mask
+    cur_tp = target_probs
+    cur_chain = loss_mask.astype(bool)
+    # packed docs: once the shift crosses a document boundary, the slot's
+    # supervision target belongs to the next document — drop it (the
+    # doc_remaining gate of the reference, core.py:480)
+    cur_seg = segment_ids
+    cache = None
+
+    loss_sum = jnp.float32(0.0)
+    correct_sum = jnp.float32(0.0)
+    valid_sum = jnp.float32(0.0)
+    prefix_correct = None
+    prefix_valid = None
+    hits, valids = [], []
+
+    for s in range(cfg.ttt_steps):
+        hidden, cache = drafter_forward_step(
+            draft_params, cfg, cur_ids, hidden, positions, cache, s,
+            segment_ids=segment_ids,
+        )
+        logits = _compute_logits(draft_params, cfg, hidden)       # (B,T,Vd)
+
+        step_pm = cur_pm
+        step_chain = cur_chain
+        if cur_seg is not None:
+            in_doc = cur_seg == segment_ids
+            step_pm = step_pm & in_doc
+            step_chain = step_chain & in_doc
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ce = -jnp.sum(cur_tp * logp, axis=-1)                     # (B,T)
+        m = step_pm.astype(jnp.float32)
+        step_loss = jnp.sum(ce * m) / jnp.maximum(jnp.sum(m), 1.0)
+        loss_sum = loss_sum + (TTT_DECAY ** s) * step_loss
+
+        correct = (jnp.argmax(logits, -1) == jnp.argmax(cur_tp, -1)) & step_pm
+        correct_sum = correct_sum + jnp.sum(correct)
+        valid_sum = valid_sum + jnp.sum(m)
+        prefix_correct = correct if prefix_correct is None else prefix_correct & correct
+        prefix_valid = step_chain if prefix_valid is None else prefix_valid & step_chain
+        hits.append(jnp.sum(prefix_correct))
+        valids.append(jnp.sum(prefix_valid))
+
+        if s + 1 < cfg.ttt_steps:
+            cur_ids = _shift_left(cur_ids)
+            cur_pm = _shift_left(cur_pm)
+            cur_tp = _shift_left(cur_tp)
+            cur_chain = _shift_left(cur_chain)
+            if cur_seg is not None:
+                cur_seg = _shift_left(cur_seg)
+
+    weight_sum = sum(TTT_DECAY ** i for i in range(cfg.ttt_steps))
+    step_prefix_hits = jnp.stack(hits)
+    step_valid = jnp.stack(valids)
+    metrics = {
+        "accuracy": correct_sum / jnp.maximum(valid_sum, 1.0),
+        "valid_tokens": valid_sum,
+        "step_prefix_hits": step_prefix_hits,
+        "step_valid": step_valid,
+        "accept_length": simulated_accept_length(step_prefix_hits, step_valid),
+    }
+    return loss_sum / weight_sum, metrics
+
+
+def simulated_accept_length(step_prefix_hits, step_valid) -> jnp.ndarray:
+    """Expected accepted tokens per round: 1 + Σ_k hits_k/valid_k
+    (reference: core.py:218 `simulated_accept_length`)."""
+    survive = step_prefix_hits.astype(jnp.float32) / jnp.maximum(
+        step_valid.astype(jnp.float32), 1.0
+    )
+    return 1.0 + jnp.sum(survive)

@@ -1,0 +1,204 @@
+"""EAGLE-3 speculative draft training tests.
+
+Parity anchors: the TTT attention must reduce to plain causal attention at
+step 0 (reference: draft_llama.py:312 — 'on the first call ... collapse to a
+plain causal attention'), and simulated_accept_length must reproduce the
+1 + Σ prefix-survival formula (reference: core.py:218)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from automodel_tpu.speculative import (
+    Eagle3Config,
+    build_vocab_mapping,
+    drafter_forward_step,
+    drafter_param_specs,
+    eagle3_ttt_loss,
+    init_drafter,
+    simulated_accept_length,
+)
+
+CFG = Eagle3Config(
+    vocab_size=96,
+    draft_vocab_size=48,
+    hidden_size=32,
+    intermediate_size=64,
+    num_heads=4,
+    num_kv_heads=2,
+    ttt_steps=3,
+)
+
+
+def _inputs(B=2, T=12, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = jnp.asarray(rng.integers(1, CFG.vocab_size, (B, T)), jnp.int32)
+    aux = jnp.asarray(rng.normal(0, 1, (3, B, T, CFG.hidden_size)), jnp.float32)
+    logits = jnp.asarray(rng.normal(0, 1, (B, T, CFG.vocab_size)), jnp.float32)
+    mask = jnp.ones((B, T), bool)
+    return ids, aux, logits, mask
+
+
+def test_vocab_mapping():
+    counts = jnp.asarray(np.arange(96, 0, -1), jnp.float32)
+    d2t, t2d = build_vocab_mapping(counts, 48)
+    assert d2t.shape == (48,) and t2d.shape == (96,)
+    np.testing.assert_array_equal(np.asarray(d2t), np.arange(48))
+    assert bool(t2d[0]) and not bool(t2d[95])
+    # non-trivial counts: the top-k ids survive, sorted
+    counts = jnp.zeros((96,)).at[jnp.asarray([5, 90, 17])].set(10.0)
+    d2t, t2d = build_vocab_mapping(counts, 3)
+    np.testing.assert_array_equal(np.asarray(d2t), [5, 17, 90])
+
+
+def test_step0_attention_is_plain_causal():
+    """With no cache, the fused layer's attention must equal standard causal
+    attention over the same q/k/v — the TTT diagonals only appear later."""
+    params = init_drafter(CFG, jax.random.key(0))
+    ids, aux, _, _ = _inputs()
+    B, T = ids.shape
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+    hidden = jnp.moveaxis(aux, 0, -2).reshape(B, T, -1) @ params["fc"]["kernel"]
+
+    h1, cache = drafter_forward_step(params, CFG, ids, hidden, pos, None, 0)
+    assert np.isfinite(np.asarray(h1)).all()
+    (k0, v0), (lk, lv) = cache
+    # step 0's K/V becomes the causal block; no diagonal branches yet
+    assert lk.shape[0] == 0 and k0.shape == (B, T, CFG.num_kv_heads, CFG.resolved_head_dim)
+
+    # causality: changing a future token must not affect earlier outputs
+    ids2 = ids.at[:, -1].set((ids[:, -1] + 1) % CFG.vocab_size)
+    h2, _ = drafter_forward_step(params, CFG, ids2, hidden, pos, None, 0)
+    np.testing.assert_allclose(
+        np.asarray(h1[:, :-1]), np.asarray(h2[:, :-1]), rtol=1e-5, atol=1e-6
+    )
+    assert float(jnp.abs(h1[:, -1] - h2[:, -1]).max()) > 1e-6
+
+
+def test_ttt_cache_grows_and_changes_output():
+    params = init_drafter(CFG, jax.random.key(0))
+    ids, aux, _, _ = _inputs()
+    B, T = ids.shape
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+    hidden = jnp.moveaxis(aux, 0, -2).reshape(B, T, -1) @ params["fc"]["kernel"]
+
+    h, cache = drafter_forward_step(params, CFG, ids, hidden, pos, None, 0)
+    h2_with, cache2 = drafter_forward_step(params, CFG, ids, h, pos, cache, 1)
+    h2_wo, _ = drafter_forward_step(params, CFG, ids, h, pos, None, 1)
+    assert cache2[1][0].shape[0] == 1  # step-1 K/V appended as a diagonal branch
+    # the cached step-0 K/V branch must influence step 1
+    assert float(jnp.abs(h2_with - h2_wo).max()) > 1e-6
+
+
+def test_ttt_loss_grads_and_metrics():
+    params = init_drafter(CFG, jax.random.key(1))
+    ids, aux, logits, mask = _inputs()
+    mask = mask.at[:, -2:].set(False)
+    d2t, t2d = build_vocab_mapping(jnp.arange(96, 0, -1, dtype=jnp.float32), 48)
+
+    def f(p):
+        return eagle3_ttt_loss(p, CFG, ids, aux, logits, mask, d2t, t2d)
+
+    (loss, m), g = jax.jit(jax.value_and_grad(f, has_aux=True))(params)
+    assert np.isfinite(float(loss))
+    # init loss ≈ CE against an (almost) random target restricted to Vd
+    assert 2.0 < float(loss) < 8.0
+    for leaf in jax.tree.leaves(g):
+        assert np.isfinite(np.asarray(leaf)).all()
+    assert m["step_prefix_hits"].shape == (CFG.ttt_steps,)
+    # chain population shrinks as the shift rolls tokens out
+    sv = np.asarray(m["step_valid"])
+    assert (np.diff(sv) <= 0).all()
+    assert 1.0 <= float(m["accept_length"]) <= 1.0 + CFG.ttt_steps
+
+
+def test_simulated_accept_length_formula():
+    hits = jnp.asarray([50, 20, 5])
+    valid = jnp.asarray([100, 80, 50])
+    expect = 1.0 + 50 / 100 + 20 / 80 + 5 / 50
+    np.testing.assert_allclose(
+        float(simulated_accept_length(hits, valid)), expect, rtol=1e-6
+    )
+    # zero-valid steps contribute nothing
+    assert float(simulated_accept_length(jnp.zeros(3), jnp.zeros(3))) == 1.0
+
+
+def test_perfect_target_drives_accept_length_up():
+    """If the target distribution is exactly reproducible (peaked on tokens
+    the drafter can fit), a few training steps must raise accept_length."""
+    import optax
+
+    cfg = dataclasses.replace(CFG, ttt_steps=2)
+    params = init_drafter(cfg, jax.random.key(2))
+    rng = np.random.default_rng(3)
+    B, T = 4, 16
+    ids = jnp.asarray(rng.integers(1, 48, (B, T)), jnp.int32)
+    aux = jnp.asarray(rng.normal(0, 1, (3, B, T, cfg.hidden_size)), jnp.float32)
+    # target: delta distribution on a fixed single token (easy to learn)
+    tgt = jnp.full((B, T), 7, jnp.int32)
+    logits = 20.0 * jax.nn.one_hot(tgt, cfg.vocab_size)
+    mask = jnp.ones((B, T), bool)
+    d2t, t2d = build_vocab_mapping(jnp.arange(96, 0, -1, dtype=jnp.float32), 48)
+
+    tx = optax.adam(3e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(p, o):
+        (l, m), g = jax.value_and_grad(
+            lambda pp: eagle3_ttt_loss(pp, cfg, ids, aux, logits, mask, d2t, t2d),
+            has_aux=True,
+        )(p)
+        u, o = tx.update(g, o, p)
+        return optax.apply_updates(p, u), o, l, m
+
+    params2, o, l0, m0 = step(params, opt)
+    for _ in range(30):
+        params2, o, l1, m1 = step(params2, o)
+    assert float(l1) < float(l0)
+    assert float(m1["accept_length"]) > float(m0["accept_length"])
+    assert float(m1["accept_length"]) > 2.5  # near-perfect 2-step chain
+
+
+def test_drafter_specs_match_params():
+    params = init_drafter(CFG, jax.random.key(0))
+    specs = drafter_param_specs(CFG)
+    flat_p = jax.tree.leaves(params)
+    flat_s = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, tuple))
+    assert len(flat_p) == len(flat_s)
+    for p, s in zip(flat_p, flat_s):
+        assert p.ndim == len(s), (p.shape, s)
+
+
+def test_target_aux_hidden_capture_matches_prefix_runs():
+    """decoder.forward(return_aux_hidden=...) must return exactly the
+    per-layer outputs (pre-final-norm) at the selected indices."""
+    from automodel_tpu.models.llm import decoder
+    from automodel_tpu.models.llm.decoder import TransformerConfig
+
+    tcfg = TransformerConfig(
+        vocab_size=64, hidden_size=16, intermediate_size=32,
+        num_layers=4, num_heads=2, num_kv_heads=1,
+        dtype=jnp.float32, remat_policy="none",
+    )
+    params = decoder.init(tcfg, jax.random.key(0))
+    ids = jnp.asarray(np.random.default_rng(0).integers(1, 64, (2, 8)), jnp.int32)
+    logits, aux = decoder.forward(params, tcfg, ids, return_aux_hidden=(0, 2, 3))
+    ref_logits = decoder.forward(params, tcfg, ids)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits), rtol=1e-6)
+
+    # prefix truncation oracle: run only the first k+1 layers
+    for j, lid in enumerate((0, 2, 3)):
+        sub = dataclasses.replace(tcfg, num_layers=lid + 1)
+        sub_params = dict(params)
+        sub_params["layers"] = jax.tree.map(lambda x: x[: lid + 1], params["layers"])
+        h_ref = decoder.forward(sub_params, sub, ids, return_hidden=True)
+        # return_hidden applies the final norm; undo by comparing pre-norm:
+        # capture includes no final norm, so compare via the capture of the
+        # truncated model instead
+        _, aux_sub = decoder.forward(sub_params, sub, ids, return_aux_hidden=(lid,))
+        np.testing.assert_allclose(
+            np.asarray(aux[j]), np.asarray(aux_sub[0]), rtol=1e-5, atol=1e-6
+        )
