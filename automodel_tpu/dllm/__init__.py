@@ -1,0 +1,5 @@
+from automodel_tpu.dllm.mdlm import (  # noqa: F401
+    corrupt_blockwise,
+    corrupt_uniform,
+    mdlm_loss_from_hidden,
+)

@@ -1,0 +1,96 @@
+"""Masked-diffusion LM (MDLM / LLaDA-style) training primitives.
+
+The analog of the reference dLLM stack (reference: nemo_automodel/recipes/
+dllm/train_ft.py `DiffusionLMSFTRecipe`, strategy.py `MDLMStrategy`,
+components/datasets/dllm/corruption.py:73 `corrupt_uniform`,
+components/loss/dllm_loss.py:105 `MDLMCrossEntropyLoss`), TPU-native:
+
+- Corruption runs INSIDE the jitted train step from the step's folded PRNG
+  key, so the noise realization is a pure function of (step, microbatch) —
+  the resume-determinism the reference retrofits with hand-seeded torch
+  Generators (train_ft.py:223 comment) falls out of the design.
+- The loss rides the chunked fused lm-head CE (no (B·S, V) logits) with the
+  absorbing-kernel ELBO weight 1/p as a per-token weight.
+- The model is the standard dense decoder with `causal=False` —
+  bidirectional attention is a config flag, not a separate model family.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.loss.linear_ce import fused_linear_cross_entropy
+
+
+def corrupt_uniform(
+    rng: jax.Array,
+    input_ids: jnp.ndarray,   # (B, L)
+    loss_mask: jnp.ndarray,   # (B, L) bool — supervised positions
+    mask_token_id: int,
+    eps: float = 1e-3,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """LLaDA/MDLM absorbing corruption (reference: corruption.py:73).
+
+    Per sequence, t ~ U[0,1]; p = (1-eps)·t + eps; each supervised token is
+    independently replaced by [MASK] with probability p. Returns
+    (noisy_ids, noise_mask, p_mask).
+    """
+    B, L = input_ids.shape
+    kt, km = jax.random.split(rng)
+    t = jax.random.uniform(kt, (B,))
+    p_mask = jnp.broadcast_to(((1.0 - eps) * t + eps)[:, None], (B, L))
+    noise = jax.random.uniform(km, (B, L)) < p_mask
+    noise_mask = noise & loss_mask.astype(bool)
+    noisy = jnp.where(noise_mask, mask_token_id, input_ids)
+    return noisy, noise_mask, p_mask.astype(jnp.float32)
+
+
+def corrupt_blockwise(
+    rng: jax.Array,
+    input_ids: jnp.ndarray,
+    loss_mask: jnp.ndarray,
+    mask_token_id: int,
+    block_size: int,
+    eps: float = 1e-3,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Blockwise variant: an independent t (hence p) per length-`block_size`
+    block, so one sequence mixes clean and heavily-masked spans (reference:
+    corruption.py `corrupt_blockwise`)."""
+    B, L = input_ids.shape
+    nb = (L + block_size - 1) // block_size
+    kt, km = jax.random.split(rng)
+    t = jax.random.uniform(kt, (B, nb))
+    p_blocks = (1.0 - eps) * t + eps
+    p_mask = jnp.repeat(p_blocks, block_size, axis=1)[:, :L]
+    noise = jax.random.uniform(km, (B, L)) < p_mask
+    noise_mask = noise & loss_mask.astype(bool)
+    noisy = jnp.where(noise_mask, mask_token_id, input_ids)
+    return noisy, noise_mask, p_mask.astype(jnp.float32)
+
+
+def mdlm_loss_from_hidden(
+    hidden: jnp.ndarray,          # (B, L, H) — model output on NOISY ids
+    lm_head_kernel: jnp.ndarray,  # (H, V)
+    clean_ids: jnp.ndarray,       # (B, L) uncorrupted targets
+    noise_mask: jnp.ndarray,      # (B, L) bool
+    p_mask: jnp.ndarray,          # (B, L)
+    loss_mask: jnp.ndarray,       # (B, L) bool
+    *,
+    chunk_size: int = 1024,
+    logits_soft_cap: float | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """MDLM ELBO (reference: dllm_loss.py:105): CE at masked∩supervised
+    positions weighted 1/p, normalized by the TOTAL supervised (maskable)
+    count. Returns (weighted_sum, num_supervised) for the standard
+    sum/÷tokens train-step contract."""
+    eff = noise_mask & loss_mask.astype(bool)
+    labels = jnp.where(eff, clean_ids, -100)
+    weights = 1.0 / jnp.maximum(p_mask, 1e-8)
+    ce_sum, _ = fused_linear_cross_entropy(
+        hidden, lm_head_kernel, labels,
+        chunk_size=chunk_size, logits_soft_cap=logits_soft_cap,
+        token_weights=weights,
+    )
+    n_supervised = jnp.sum(loss_mask.astype(jnp.float32))
+    return ce_sum, n_supervised

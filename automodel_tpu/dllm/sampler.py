@@ -1,0 +1,58 @@
+"""MDLM iterative-unmasking generation (LLaDA-style).
+
+The inference counterpart of the masked-diffusion trainer (reference:
+recipes/dllm/ — the reference trains dLLMs and defers serving to external
+engines; this minimal sampler makes the trained checkpoint usable
+standalone): start from an all-[MASK] canvas after the prompt, and over
+`steps` rounds fill in the highest-confidence predictions, re-denoising the
+rest — low-confidence counts stay masked for later rounds.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def generate_mdlm(
+    forward_logits,            # (ids (B,L)) -> logits (B,L,V)
+    prompt_ids: jnp.ndarray,   # (B, P)
+    gen_len: int,
+    mask_token_id: int,
+    *,
+    steps: int = 8,
+    temperature: float = 0.0,
+    rng: jax.Array | None = None,
+) -> jnp.ndarray:
+    """Returns (B, P + gen_len) ids with the canvas filled in."""
+    B, P = prompt_ids.shape
+    canvas = jnp.concatenate(
+        [prompt_ids, jnp.full((B, gen_len), mask_token_id, prompt_ids.dtype)], axis=1
+    )
+    per_round = max(1, gen_len // steps)
+    rng = rng if rng is not None else jax.random.key(0)
+
+    for s in range(steps):
+        logits = forward_logits(canvas)
+        # the mask token is never a legal output — keep it out of the argmax
+        # and the sampler so every committed slot is a real token
+        logits = logits.at[..., mask_token_id].set(-jnp.inf)
+        if temperature > 0.0:
+            rng, k = jax.random.split(rng)
+            pred = jax.random.categorical(k, logits / temperature, axis=-1)
+        else:
+            pred = jnp.argmax(logits, axis=-1)
+        pred = pred.astype(canvas.dtype)
+        # confidence of the token actually committed, not the argmax
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        conf = jnp.take_along_axis(logp, pred[..., None], axis=-1)[..., 0]
+
+        masked = canvas == mask_token_id
+        # unmask the per_round most confident masked slots (all, final round)
+        conf_m = jnp.where(masked, conf, -jnp.inf)
+        n_left = steps - s
+        k_now = gen_len if n_left == 1 else per_round
+        thresh = jax.lax.top_k(conf_m, min(k_now, conf_m.shape[1]))[0][:, -1:]
+        take = masked & (conf_m >= thresh)
+        canvas = jnp.where(take, pred, canvas)
+    return canvas

@@ -28,24 +28,36 @@ def fused_linear_cross_entropy(
     chunk_size: int = 1024,
     ignore_index: int = IGNORE_INDEX,
     logits_soft_cap: float | None = None,
+    token_weights: jnp.ndarray | None = None,  # (B, S) per-token CE weight
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Returns (sum_ce_fp32, num_valid_tokens_fp32)."""
+    """Returns (sum_ce_fp32, num_valid_tokens_fp32).
+
+    `token_weights` scales each valid token's CE before the sum (the dLLM
+    1/p_mask ELBO weight rides this); the returned count stays unweighted.
+    """
     B, S, H = hidden.shape
     flat_h = hidden.reshape(B * S, H)
     flat_l = labels.reshape(B * S)
     N = B * S
     chunk_size = min(chunk_size, N)
     pad = (-N) % chunk_size
+    flat_w = None
+    if token_weights is not None:
+        flat_w = token_weights.reshape(B * S).astype(jnp.float32)
     if pad:
         flat_h = jnp.pad(flat_h, ((0, pad), (0, 0)))
         flat_l = jnp.pad(flat_l, (0, pad), constant_values=ignore_index)
+        if flat_w is not None:
+            flat_w = jnp.pad(flat_w, (0, pad))
     n_chunks = flat_h.shape[0] // chunk_size
     flat_h = flat_h.reshape(n_chunks, chunk_size, H)
     flat_l = flat_l.reshape(n_chunks, chunk_size)
+    if flat_w is not None:
+        flat_w = flat_w.reshape(n_chunks, chunk_size)
 
     @partial(jax.checkpoint, policy=jax.checkpoint_policies.nothing_saveable)
     def chunk_loss(carry, xs):
-        h, l = xs
+        h, l, w = xs
         logits = jnp.einsum(
             "ch,hv->cv", h, lm_head_kernel.astype(h.dtype),
             preferred_element_type=jnp.float32,
@@ -57,8 +69,13 @@ def fused_linear_cross_entropy(
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         picked = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
         ce = jnp.where(mask, lse - picked, 0.0)
+        if w is not None:
+            ce = ce * w
         ce_sum, n = carry
         return (ce_sum + jnp.sum(ce), n + jnp.sum(mask).astype(jnp.float32)), None
 
-    (ce_sum, n), _ = jax.lax.scan(chunk_loss, (jnp.float32(0.0), jnp.float32(0.0)), (flat_h, flat_l))
+    xs = (flat_h, flat_l, flat_w)
+    (ce_sum, n), _ = jax.lax.scan(
+        chunk_loss, (jnp.float32(0.0), jnp.float32(0.0)), xs
+    )
     return ce_sum, n

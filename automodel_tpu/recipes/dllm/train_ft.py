@@ -1,0 +1,126 @@
+"""dLLM (masked-diffusion LM) SFT recipe.
+
+The analog of the reference `DiffusionLMSFTRecipe` (reference:
+nemo_automodel/recipes/dllm/train_ft.py, strategy.py `MDLMStrategy`):
+LLaDA-style SFT of a bidirectional dense decoder with absorbing-mask
+corruption and the 1/p-weighted masked CE.
+
+Differences by design: corruption happens inside the jitted step from the
+folded step key (resume-deterministic by construction), the model is the
+standard decoder with `causal=False`, and the supervision frame is
+UNSHIFTED (the model predicts the clean token at each masked position).
+
+YAML:
+
+    recipe: dllm_train_ft
+    dllm:
+      mask_token_id: 126336     # default: vocab_size - 1
+      eps: 1.0e-3
+      mode: mdlm                # or block, with block_size
+      block_size: 32
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.dllm import corrupt_blockwise, corrupt_uniform
+from automodel_tpu.dllm.mdlm import mdlm_loss_from_hidden
+from automodel_tpu.recipes.llm.train_ft import (
+    TrainFinetuneRecipeForNextTokenPrediction,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class DiffusionLMSFTRecipe(TrainFinetuneRecipeForNextTokenPrediction):
+    def _build_model(self) -> None:
+        super()._build_model()
+        if self.is_moe:
+            raise NotImplementedError("dLLM over MoE backbones not wired yet")
+        # bidirectional: the denoiser sees the whole noisy canvas
+        import dataclasses
+
+        self.model_cfg = dataclasses.replace(self.model_cfg, causal=False)
+
+        dcfg = self.cfg.get("dllm")
+        self.dllm_mode = str(dcfg.get("mode", "mdlm")) if dcfg else "mdlm"
+        self.dllm_eps = float(dcfg.get("eps", 1e-3)) if dcfg else 1e-3
+        self.dllm_block_size = int(dcfg.get("block_size", 32)) if dcfg else 32
+        mask_id = dcfg.get("mask_token_id", None) if dcfg else None
+        if mask_id is None:
+            tok = getattr(self, "tokenizer", None)
+            mask_id = getattr(tok, "mask_token_id", None) if tok else None
+        if mask_id is None:
+            mask_id = self.model_cfg.vocab_size - 1
+            logger.info("dllm.mask_token_id not set; using vocab_size-1=%d", mask_id)
+        self.mask_token_id = int(mask_id)
+        if self.dllm_mode not in ("mdlm", "block"):
+            raise ValueError(f"dllm.mode must be 'mdlm' or 'block', got {self.dllm_mode}")
+        logger.info(
+            "dLLM SFT: mode=%s mask_token_id=%d eps=%g block_size=%d",
+            self.dllm_mode, self.mask_token_id, self.dllm_eps, self.dllm_block_size,
+        )
+
+    def _make_loss_fn(self):
+        cfg = self.cfg
+        module = self.model_spec.module
+        model_cfg = self.model_cfg
+        mesh_ctx = self.mesh_ctx
+        chunk = int(cfg.get("loss.chunk_size", 1024))
+        mode = self.dllm_mode
+        eps = self.dllm_eps
+        block = self.dllm_block_size
+        mask_id = self.mask_token_id
+        accum = float(cfg.get("dataloader.grad_acc_steps", 1))
+
+        def loss_fn(params, batch, rng, *extra):
+            clean_ids = batch["input_ids"]
+            # UNSHIFTED supervision frame: position i's target is the clean
+            # token at i. The dataloader's next-token labels mark position
+            # i+1 supervised via labels[i] != -100 → roll right.
+            if "loss_mask" in batch:
+                loss_mask = batch["loss_mask"].astype(bool)
+            else:
+                shifted = batch["labels"] != -100
+                loss_mask = jnp.roll(shifted, 1, axis=-1).at[:, 0].set(False)
+
+            if mode == "block":
+                noisy, noise_mask, p_mask = corrupt_blockwise(
+                    rng, clean_ids, loss_mask, mask_id, block, eps
+                )
+            else:
+                noisy, noise_mask, p_mask = corrupt_uniform(
+                    rng, clean_ids, loss_mask, mask_id, eps
+                )
+
+            kw = {}
+            for k in ("positions", "segment_ids"):
+                if k in batch:
+                    kw[k] = batch[k]
+            hidden = module.forward(
+                params, model_cfg, noisy, return_hidden=True, mesh_ctx=mesh_ctx, **kw
+            )
+            kernel = (
+                params["embed"]["embedding"].T
+                if model_cfg.tie_word_embeddings
+                else params["lm_head"]["kernel"]
+            )
+            ce_sum, n = mdlm_loss_from_hidden(
+                hidden, kernel, clean_ids, noise_mask, p_mask, loss_mask,
+                chunk_size=chunk, logits_soft_cap=model_cfg.logits_soft_cap,
+            )
+            masked_frac = jnp.sum(noise_mask) / jnp.maximum(
+                jnp.sum(loss_mask.astype(jnp.float32)), 1.0
+            )
+            # scalar metrics are summed over grad-accum microbatches by the
+            # train step; pre-divide so the logged value is the mean
+            return ce_sum, {
+                "num_label_tokens": n,
+                "masked_fraction": masked_frac / accum,
+            }
+
+        return loss_fn

@@ -104,6 +104,11 @@ class TrainEagle3Recipe(TrainFinetuneRecipeForNextTokenPrediction):
         aux_ids = tuple(
             int(i) for i in (scfg.get("aux_layer_ids") if scfg else None) or default_aux
         )
+        if aux_ids and (min(aux_ids) < 0 or max(aux_ids) >= L):
+            raise ValueError(
+                f"speculative.aux_layer_ids={aux_ids} out of range for a "
+                f"{L}-layer target (valid: 0..{L - 1})"
+            )
         self.aux_layer_ids = aux_ids
         self.eagle_cfg = Eagle3Config(
             vocab_size=t.vocab_size,
