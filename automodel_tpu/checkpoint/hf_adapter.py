@@ -21,11 +21,14 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import os
 from typing import Any, Callable, Iterator, Mapping
 
 import jax
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 from automodel_tpu.models.llm.decoder import TransformerConfig
 
@@ -182,19 +185,39 @@ class DenseDecoderAdapter:
     # -- import --------------------------------------------------------------
     def from_hf(self, read: Reader, shardings: Any = None) -> dict:
         """Assemble the params pytree; `shardings` (same tree) places each
-        param directly into its target layout as it is built."""
+        param directly into its target layout as it is built.
+
+        Key fallbacks: base-model checkpoints (e.g. LlamaBidirectionalModel
+        saved without the CausalLM wrapper) drop the `model.` prefix, and
+        head-swapped checkpoints (ForSequenceClassification) carry no
+        `lm_head.weight` — that leaf is then simply absent and the consumer
+        (seq-cls/retrieval recipes) installs its own head."""
         out: dict = {}
 
         def put(path, value):
             sh = _get(shardings, path) if shardings is not None else None
             _set(out, path, jax.device_put(value, sh) if sh is not None else value)
 
+        def read_any(name):
+            try:
+                return read(name)
+            except KeyError:
+                if name.startswith("model."):
+                    return read(name[len("model."):])
+                raise
+
         def one(name, transpose, tr):
-            x = _t(read(name)) if transpose else np.asarray(read(name))
+            x = _t(read_any(name)) if transpose else np.asarray(read_any(name))
             return self._transform(x, tr, inverse=False)
 
         for name, path, transpose, tr in self._top_entries():
-            put(path, one(name, transpose, tr))
+            try:
+                put(path, one(name, transpose, tr))
+            except KeyError:
+                if path == ("lm_head", "kernel"):
+                    logger.warning("checkpoint has no lm_head.weight; leaf omitted")
+                    continue
+                raise
         for suffix, path, transpose, tr in self._layer_entries():
             stacked = np.stack(
                 [

@@ -49,8 +49,15 @@ def resolve_recipe_class(cfg: ConfigNode):
 
 def print_capabilities() -> None:
     """`python -m automodel_tpu --capabilities` — the analog of the
-    reference's capability query (reference: cli/query_capabilities.py)."""
+    reference's capability query (reference: cli/query_capabilities.py).
+
+    Runs on the host CPU platform: a metadata query must answer even when
+    the accelerator tunnel is down (touching a dead backend hangs)."""
     import json
+
+    from automodel_tpu.utils.hostplatform import force_cpu_devices
+
+    force_cpu_devices(1)
 
     import jax
 
@@ -59,7 +66,7 @@ def print_capabilities() -> None:
 
     caps = {
         "version": __version__,
-        "backend": jax.default_backend(),
+        "backend": "cpu (forced for query)",
         "devices": len(jax.devices()),
         "architectures": sorted(MODEL_ARCH_MAPPING),
         "recipes": sorted(RECIPE_ALIASES),

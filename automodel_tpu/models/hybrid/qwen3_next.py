@@ -1,0 +1,631 @@
+"""Qwen3-Next: hybrid gated-delta-net (linear attention) + gated full
+attention, with an optional MoE MLP.
+
+TPU-native re-design of the reference family (reference: nemo_automodel/
+components/models/qwen3_next/layers.py `Qwen3NextFp32GatedDeltaNet`,
+`Qwen3NextAttention`; model.py `Qwen3NextModel`; HF transformers
+modeling_qwen3_next.py is the numerical oracle):
+
+- The gated delta rule runs as a `lax.scan` over the sequence carrying the
+  (B, Hv, dk, dv) fp32 state: S ← S·exp(g) ; Δ = β·(v − Sᵀk) ; S ← S + kΔᵀ;
+  o = Sᵀq. Exact recurrence of HF's `torch_recurrent_gated_delta_rule`.
+  (A chunked parallel form is the planned perf upgrade; the scan is the
+  correctness baseline and already O(T) with static shapes.)
+- The depthwise causal conv over the flattened q|k|v channels is one
+  grouped `lax.conv_general_dilated` with left padding — no conv-state
+  cache object.
+- Full-attention layers reuse the shared attention ops with two additions:
+  the doubled q projection whose second half sigmoid-gates the attention
+  output, and partial RoPE (rotary over the first quarter of head_dim).
+- Norms are zero-centered ((1+w)·x̂, like gemma); the GDN output norm is
+  the gated RMSNorm w·x̂·silu(z) per value head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.layers import dense_init
+from automodel_tpu.moe.config import MoEConfig
+from automodel_tpu.moe.layer import init_moe, moe_forward, moe_param_specs
+from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.rope import rope_frequencies
+
+
+@dataclasses.dataclass
+class Qwen3NextConfig:
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    layer_types: tuple  # per layer: "linear_attention" | "full_attention"
+    # gated delta net
+    linear_num_value_heads: int
+    linear_num_key_heads: int
+    linear_key_head_dim: int
+    linear_value_head_dim: int
+    linear_conv_kernel_dim: int = 4
+    # moe (None → dense MLP)
+    moe: Optional[MoEConfig] = None
+    partial_rotary_factor: float = 0.25
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-6
+    tie_word_embeddings: bool = False
+    logits_soft_cap: Optional[float] = None
+    dtype: jnp.dtype = jnp.float32
+    remat_policy: Optional[str] = "full"
+    scan_unroll: int = 1
+    mtp_num_layers: int = 0  # chassis compatibility
+
+    def __post_init__(self):
+        assert len(self.layer_types) == self.num_layers
+        assert self.linear_num_value_heads % self.linear_num_key_heads == 0
+
+    @property
+    def gdn_key_dim(self) -> int:
+        return self.linear_key_head_dim * self.linear_num_key_heads
+
+    @property
+    def gdn_value_dim(self) -> int:
+        return self.linear_value_head_dim * self.linear_num_value_heads
+
+    @property
+    def rotary_dim(self) -> int:
+        return int(self.head_dim * self.partial_rotary_factor)
+
+    def flops_per_token(self, seq_len: int) -> float:
+        H, I = self.hidden_size, self.intermediate_size
+        n_full = sum(1 for t in self.layer_types if t == "full_attention")
+        n_lin = self.num_layers - n_full
+        attn_p = H * (2 * self.num_heads + 2 * self.num_kv_heads) * self.head_dim + self.num_heads * self.head_dim * H
+        gdn_p = H * (2 * self.gdn_key_dim + 2 * self.gdn_value_dim + 2 * self.linear_num_value_heads) + self.gdn_value_dim * H
+        if self.moe is not None:
+            mlp_p = 3 * H * self.moe.moe_intermediate_size * self.moe.experts_per_token
+            if self.moe.n_shared_experts:
+                mlp_p += 3 * H * self.moe.shared_intermediate
+        else:
+            mlp_p = 3 * H * I
+        n_params = self.vocab_size * H * (1 if self.tie_word_embeddings else 2) + n_full * attn_p + n_lin * gdn_p + self.num_layers * mlp_p
+        return 6.0 * n_params + 6 * n_full * self.num_heads * self.head_dim * seq_len
+
+
+def from_hf_config(
+    hf: dict, dtype=jnp.float32, remat_policy="full", **overrides
+) -> Qwen3NextConfig:
+    """Build from an HF Qwen3NextConfig dict. Unknown recipe overrides
+    (attn_impl etc. meant for the generic decoder) are ignored."""
+    overrides = {
+        k: v for k, v in overrides.items()
+        if k in {f.name for f in dataclasses.fields(Qwen3NextConfig)}
+    }
+    L = int(hf["num_hidden_layers"])
+    layer_types = hf.get("layer_types")
+    if layer_types is None:
+        interval = int(hf.get("full_attention_interval", 4))
+        layer_types = [
+            "full_attention" if (i + 1) % interval == 0 else "linear_attention"
+            for i in range(L)
+        ]
+    moe = None
+    if int(hf.get("num_experts", 0) or 0) > 0:
+        sparse_step = int(hf.get("decoder_sparse_step", 1) or 1)
+        mlp_only = list(hf.get("mlp_only_layers") or [])
+        if sparse_step != 1 or mlp_only:
+            raise NotImplementedError(
+                f"qwen3-next with decoder_sparse_step={sparse_step} / "
+                f"mlp_only_layers={mlp_only}: per-layer dense/MoE mixing is "
+                "not implemented — every layer would be built MoE, a "
+                "different architecture than HF"
+            )
+        moe = MoEConfig(
+            n_routed_experts=int(hf["num_experts"]),
+            experts_per_token=int(hf["num_experts_per_tok"]),
+            moe_intermediate_size=int(hf["moe_intermediate_size"]),
+            n_shared_experts=1 if int(hf.get("shared_expert_intermediate_size", 0)) else 0,
+            shared_expert_intermediate_size=int(hf.get("shared_expert_intermediate_size", 0)),
+            score_func="softmax",
+            norm_topk_prob=bool(hf.get("norm_topk_prob", True)),
+            aux_loss_coeff=float(hf.get("router_aux_loss_coef", 0.0) or 0.0),
+            shared_expert_gated=True,
+            dispatcher="dropless",  # HF never drops tokens; match it
+        )
+    return Qwen3NextConfig(
+        vocab_size=int(hf["vocab_size"]),
+        hidden_size=int(hf["hidden_size"]),
+        intermediate_size=int(hf["intermediate_size"]),
+        num_layers=L,
+        num_heads=int(hf["num_attention_heads"]),
+        num_kv_heads=int(hf["num_key_value_heads"]),
+        head_dim=int(hf.get("head_dim") or hf["hidden_size"] // hf["num_attention_heads"]),
+        layer_types=tuple(layer_types),
+        linear_num_value_heads=int(hf["linear_num_value_heads"]),
+        linear_num_key_heads=int(hf["linear_num_key_heads"]),
+        linear_key_head_dim=int(hf["linear_key_head_dim"]),
+        linear_value_head_dim=int(hf["linear_value_head_dim"]),
+        linear_conv_kernel_dim=int(hf.get("linear_conv_kernel_dim", 4)),
+        moe=moe,
+        partial_rotary_factor=float(hf.get("partial_rotary_factor", 0.25)),
+        rope_theta=float(hf.get("rope_theta", 10000.0)),
+        rms_norm_eps=float(hf.get("rms_norm_eps", 1e-6)),
+        tie_word_embeddings=bool(hf.get("tie_word_embeddings", False)),
+        dtype=dtype,
+        remat_policy=remat_policy,
+        **overrides,
+    )
+
+
+# ---------------------------------------------------------------------------
+# init / specs — layers are stacked per type (two scans, interleaved order
+# preserved via the layer_types tuple)
+# ---------------------------------------------------------------------------
+def _init_gdn(cfg: Qwen3NextConfig, rng, n) -> dict:
+    H = cfg.hidden_size
+    Kd, Vd = cfg.gdn_key_dim, cfg.gdn_value_dim
+    Hv = cfg.linear_num_value_heads
+    conv_dim = 2 * Kd + Vd
+    ks = jax.random.split(rng, 4)
+
+    def stack(k, shape):
+        return jnp.stack([dense_init(kk, shape) for kk in jax.random.split(k, n)])
+
+    return {
+        "in_proj_qkvz": {"kernel": stack(ks[0], (H, 2 * Kd + 2 * Vd))},
+        "in_proj_ba": {"kernel": stack(ks[1], (H, 2 * Hv))},
+        "conv": {"kernel": 0.2 * jax.random.normal(ks[2], (n, cfg.linear_conv_kernel_dim, conv_dim))},
+        "dt_bias": jnp.ones((n, Hv)),
+        "A_log": jnp.log(jax.random.uniform(ks[3], (n, Hv), minval=1e-3, maxval=16.0)),
+        "norm": {"scale": jnp.ones((n, cfg.linear_value_head_dim))},
+        "out_proj": {"kernel": stack(jax.random.fold_in(ks[2], 1), (Vd, H))},
+    }
+
+
+def _gdn_specs(cfg) -> dict:
+    return {
+        "in_proj_qkvz": {"kernel": ("layers", "embed", "heads")},
+        "in_proj_ba": {"kernel": ("layers", "embed", "heads")},
+        "conv": {"kernel": ("layers", None, "heads")},
+        "dt_bias": ("layers", "heads"),
+        "A_log": ("layers", "heads"),
+        "norm": {"scale": ("layers", "norm")},
+        "out_proj": {"kernel": ("layers", "heads", "embed")},
+    }
+
+
+def _init_attn(cfg: Qwen3NextConfig, rng, n) -> dict:
+    H, D = cfg.hidden_size, cfg.head_dim
+    ks = jax.random.split(rng, 4)
+
+    def stack(k, shape):
+        return jnp.stack([dense_init(kk, shape) for kk in jax.random.split(k, n)])
+
+    return {
+        "q_proj": {"kernel": stack(ks[0], (H, cfg.num_heads * D * 2))},
+        "k_proj": {"kernel": stack(ks[1], (H, cfg.num_kv_heads * D))},
+        "v_proj": {"kernel": stack(ks[2], (H, cfg.num_kv_heads * D))},
+        "o_proj": {"kernel": stack(ks[3], (cfg.num_heads * D, H))},
+        "q_norm": {"scale": jnp.zeros((n, D))},
+        "k_norm": {"scale": jnp.zeros((n, D))},
+    }
+
+
+def _attn_specs(cfg) -> dict:
+    return {
+        "q_proj": {"kernel": ("layers", "embed", "heads")},
+        "k_proj": {"kernel": ("layers", "embed", "kv_heads")},
+        "v_proj": {"kernel": ("layers", "embed", "kv_heads")},
+        "o_proj": {"kernel": ("layers", "heads", "embed")},
+        "q_norm": {"scale": ("layers", "norm")},
+        "k_norm": {"scale": ("layers", "norm")},
+    }
+
+
+def _init_mlp(cfg: Qwen3NextConfig, rng, n) -> dict:
+    if cfg.moe is not None:
+        return {
+            "moe": jax.tree.map(
+                lambda *xs: jnp.stack(xs),
+                *[init_moe(cfg.moe, cfg.hidden_size, jax.random.fold_in(rng, i)) for i in range(n)],
+            )
+        }
+    H, I = cfg.hidden_size, cfg.intermediate_size
+    ks = jax.random.split(rng, 3)
+
+    def stack(k, shape):
+        return jnp.stack([dense_init(kk, shape) for kk in jax.random.split(k, n)])
+
+    return {
+        "gate_proj": {"kernel": stack(ks[0], (H, I))},
+        "up_proj": {"kernel": stack(ks[1], (H, I))},
+        "down_proj": {"kernel": stack(ks[2], (I, H))},
+    }
+
+
+def _mlp_specs(cfg) -> dict:
+    if cfg.moe is not None:
+        inner = moe_param_specs(cfg.moe)
+        return {"moe": jax.tree.map(
+            lambda s: ("layers",) + s,
+            inner,
+            is_leaf=lambda x: isinstance(x, tuple) and all(isinstance(a, (str, type(None))) for a in x),
+        )}
+    return {
+        "gate_proj": {"kernel": ("layers", "embed", "mlp")},
+        "up_proj": {"kernel": ("layers", "embed", "mlp")},
+        "down_proj": {"kernel": ("layers", "mlp", "embed")},
+    }
+
+
+def init(cfg: Qwen3NextConfig, rng: jax.Array) -> dict:
+    n_lin = sum(1 for t in cfg.layer_types if t == "linear_attention")
+    n_full = cfg.num_layers - n_lin
+    ks = jax.random.split(rng, 6)
+    # all-linear / all-full stacks keep a 1-layer dummy so the pytree
+    # structure (and its specs/shardings) is config-independent
+    params = {
+        "embed": {"embedding": 0.02 * jax.random.normal(ks[0], (cfg.vocab_size, cfg.hidden_size))},
+        "gdn_layers": _init_gdn(cfg, ks[1], max(n_lin, 1)),
+        "attn_layers": _init_attn(cfg, ks[2], max(n_full, 1)),
+        "mlp_layers": _init_mlp(cfg, ks[3], cfg.num_layers),
+        "input_norms": {"scale": jnp.zeros((cfg.num_layers, cfg.hidden_size))},
+        "post_norms": {"scale": jnp.zeros((cfg.num_layers, cfg.hidden_size))},
+        "final_norm": {"scale": jnp.zeros((cfg.hidden_size,))},
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = {"kernel": dense_init(ks[4], (cfg.hidden_size, cfg.vocab_size))}
+    return params
+
+
+def param_specs(cfg: Qwen3NextConfig) -> dict:
+    specs = {
+        "embed": {"embedding": ("vocab", "embed")},
+        "gdn_layers": _gdn_specs(cfg),
+        "attn_layers": _attn_specs(cfg),
+        "mlp_layers": _mlp_specs(cfg),
+        "input_norms": {"scale": ("layers", "norm")},
+        "post_norms": {"scale": ("layers", "norm")},
+        "final_norm": {"scale": ("norm",)},
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = {"kernel": ("embed", "vocab")}
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# gated delta net forward
+# ---------------------------------------------------------------------------
+def _l2norm(x, eps=1e-6):
+    return x * jax.lax.rsqrt(jnp.sum(jnp.square(x), -1, keepdims=True) + eps)
+
+
+def gated_delta_rule(q, k, v, g, beta):
+    """Sequential gated delta rule (HF `torch_recurrent_gated_delta_rule`
+    oracle semantics; q,k already L2-normed and q scaled).
+
+    q,k (B,S,Hv,dk); v (B,S,Hv,dv); g,beta (B,S,Hv). Returns (B,S,Hv,dv).
+    """
+    B, S, Hv, dk = q.shape
+    dv = v.shape[-1]
+
+    def step(S_state, xs):
+        q_t, k_t, v_t, g_t, b_t = xs  # (B,Hv,dk),(B,Hv,dk),(B,Hv,dv),(B,Hv),(B,Hv)
+        S_state = S_state * jnp.exp(g_t)[..., None, None]
+        kv_mem = jnp.einsum("bhkv,bhk->bhv", S_state, k_t)
+        delta = (v_t - kv_mem) * b_t[..., None]
+        S_state = S_state + k_t[..., :, None] * delta[..., None, :]
+        o_t = jnp.einsum("bhkv,bhk->bhv", S_state, q_t)
+        return S_state, o_t
+
+    xs = jax.tree.map(lambda x: jnp.moveaxis(x, 1, 0), (q, k, v, g, beta))
+    S0 = jnp.zeros((B, Hv, dk, dv), jnp.float32)
+    _, outs = jax.lax.scan(step, S0, xs)
+    return jnp.moveaxis(outs, 0, 1)  # (B,S,Hv,dv)
+
+
+def _gdn_block(x, lp, cfg: Qwen3NextConfig):
+    """x (B,S,H) normed input → GDN output (B,S,H)."""
+    B, S, H = x.shape
+    Hk, Hv = cfg.linear_num_key_heads, cfg.linear_num_value_heads
+    dk, dv = cfg.linear_key_head_dim, cfg.linear_value_head_dim
+    gv = Hv // Hk
+    Kd, Vd = cfg.gdn_key_dim, cfg.gdn_value_dim
+    dtype = x.dtype
+
+    qkvz = x @ lp["in_proj_qkvz"]["kernel"].astype(dtype)   # (B,S,2Kd+2Vd)
+    ba = x @ lp["in_proj_ba"]["kernel"].astype(dtype)       # (B,S,2Hv)
+
+    # HF interleaved-per-key-head layout (fix_query_key_value_ordering)
+    qkvz = qkvz.reshape(B, S, Hk, 2 * dk + 2 * gv * dv)
+    q = qkvz[..., :dk]
+    k = qkvz[..., dk : 2 * dk]
+    v = qkvz[..., 2 * dk : 2 * dk + gv * dv].reshape(B, S, Hv, dv)
+    z = qkvz[..., 2 * dk + gv * dv :].reshape(B, S, Hv, dv)
+    ba = ba.reshape(B, S, Hk, 2 * gv)
+    b = ba[..., :gv].reshape(B, S, Hv)
+    a = ba[..., gv:].reshape(B, S, Hv)
+
+    # depthwise causal conv over flattened q|k|v channels, then silu
+    mixed = jnp.concatenate(
+        [q.reshape(B, S, Kd), k.reshape(B, S, Kd), v.reshape(B, S, Vd)], axis=-1
+    )
+    K_ = cfg.linear_conv_kernel_dim
+    conv_w = lp["conv"]["kernel"].astype(dtype)             # (K, C)
+    mixed = jax.lax.conv_general_dilated(
+        mixed,
+        conv_w[:, None, :],                                 # (K, 1, C) = WIO
+        window_strides=(1,),
+        padding=[(K_ - 1, 0)],
+        dimension_numbers=("NWC", "WIO", "NWC"),
+        feature_group_count=mixed.shape[-1],
+    )
+    mixed = jax.nn.silu(mixed)
+    q = mixed[..., :Kd].reshape(B, S, Hk, dk)
+    k = mixed[..., Kd : 2 * Kd].reshape(B, S, Hk, dk)
+    v = mixed[..., 2 * Kd :].reshape(B, S, Hv, dv)
+
+    q = _l2norm(q.astype(jnp.float32)) * dk ** -0.5
+    k = _l2norm(k.astype(jnp.float32))
+    q = jnp.repeat(q, gv, axis=2)
+    k = jnp.repeat(k, gv, axis=2)
+
+    beta = jax.nn.sigmoid(b.astype(jnp.float32))
+    # decay (fp32: A_log/dt_bias stay full precision, reference layers.py:79)
+    g = -jnp.exp(lp["A_log"].astype(jnp.float32)) * jax.nn.softplus(
+        a.astype(jnp.float32) + lp["dt_bias"].astype(jnp.float32)
+    )
+
+    core = gated_delta_rule(q, k, v.astype(jnp.float32), g, beta)  # (B,S,Hv,dv)
+
+    # gated RMSNorm per value head: w·x̂·silu(z) (NOT zero-centered)
+    core = rms_norm(core, lp["norm"]["scale"], cfg.rms_norm_eps)
+    core = core * jax.nn.silu(z.astype(jnp.float32))
+    core = core.reshape(B, S, Vd).astype(dtype)
+    return core @ lp["out_proj"]["kernel"].astype(dtype)
+
+
+def _partial_rope(x, positions, inv_freq, rot_dim):
+    """RoPE over the first rot_dim dims of the head; rest pass through."""
+    from automodel_tpu.ops.rope import apply_rope
+
+    xr, xp = x[..., :rot_dim], x[..., rot_dim:]
+    return jnp.concatenate([apply_rope(xr, positions, inv_freq), xp], axis=-1)
+
+
+def _attn_block(x, lp, cfg: Qwen3NextConfig, positions, segment_ids, inv_freq, mesh_ctx):
+    from automodel_tpu.ops.attention import dot_product_attention
+
+    B, S, H = x.shape
+    D = cfg.head_dim
+    dtype = x.dtype
+    q2 = (x @ lp["q_proj"]["kernel"].astype(dtype)).reshape(B, S, cfg.num_heads, 2 * D)
+    q, gate = q2[..., :D], q2[..., D:]
+    k = (x @ lp["k_proj"]["kernel"].astype(dtype)).reshape(B, S, cfg.num_kv_heads, D)
+    v = (x @ lp["v_proj"]["kernel"].astype(dtype)).reshape(B, S, cfg.num_kv_heads, D)
+    q = rms_norm(q, lp["q_norm"]["scale"], cfg.rms_norm_eps, zero_centered=True)
+    k = rms_norm(k, lp["k_norm"]["scale"], cfg.rms_norm_eps, zero_centered=True)
+    q = _partial_rope(q, positions, inv_freq, cfg.rotary_dim)
+    k = _partial_rope(k, positions, inv_freq, cfg.rotary_dim)
+    attn = dot_product_attention(
+        q, k, v, causal=True, segment_ids=segment_ids, positions=positions,
+        impl="xla",
+    )
+    attn = attn * jax.nn.sigmoid(gate.astype(attn.dtype))
+    return attn.reshape(B, S, cfg.num_heads * D) @ lp["o_proj"]["kernel"].astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def forward(
+    params: dict,
+    cfg: Qwen3NextConfig,
+    input_ids: jnp.ndarray,
+    *,
+    positions: jnp.ndarray | None = None,
+    segment_ids: jnp.ndarray | None = None,
+    mesh_ctx=None,
+    rules=None,
+    return_hidden: bool = False,
+    return_stats: bool = False,
+    token_mask: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Returns logits (or hidden). With MoE, returns (out, aux_loss[, stats])."""
+    from automodel_tpu.models.common.layers import cast_params, maybe_remat
+
+    # A_log/dt_bias must stay fp32 under bf16 compute — the exp(A_log) decay
+    # compounds through the recurrence (reference: Qwen3NextFp32GatedDeltaNet,
+    # layers.py:79). Restore them after the blanket cast.
+    fp32_gdn = {k: params["gdn_layers"][k] for k in ("A_log", "dt_bias")}
+    params = cast_params(params, cfg.dtype)
+    params["gdn_layers"] = {**params["gdn_layers"], **fp32_gdn}
+    B, S = input_ids.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
+    inv_freq = rope_frequencies(cfg.rotary_dim, cfg.rope_theta)
+
+    h = jnp.take(params["embed"]["embedding"], input_ids, axis=0).astype(cfg.dtype)
+
+    lin_idx = 0
+    full_idx = 0
+    aux_total = jnp.float32(0.0)
+    stats_list = []
+    # interleaved hybrid stack: a Python loop over layers (layer types are
+    # static); remat per layer
+    for i, lt in enumerate(cfg.layer_types):
+        ln_in = params["input_norms"]["scale"][i]
+        ln_post = params["post_norms"]["scale"][i]
+
+        def one_layer(h, _ps=params, _i=i, _lt=lt, _li=lin_idx, _fi=full_idx,
+                      _ln_in=ln_in, _ln_post=ln_post):
+            x = rms_norm(h, _ln_in, cfg.rms_norm_eps, zero_centered=True)
+            if _lt == "linear_attention":
+                lp = jax.tree.map(lambda p: p[_li], _ps["gdn_layers"])
+                h = h + _gdn_block(x, lp, cfg)
+            else:
+                lp = jax.tree.map(lambda p: p[_fi], _ps["attn_layers"])
+                h = h + _attn_block(x, lp, cfg, positions, segment_ids, inv_freq, mesh_ctx)
+            x2 = rms_norm(h, _ln_post, cfg.rms_norm_eps, zero_centered=True)
+            if cfg.moe is not None:
+                mp = jax.tree.map(lambda p: p[_i], _ps["mlp_layers"]["moe"])
+                out, aux, st = moe_forward(
+                    mp, cfg.moe, x2, token_mask=token_mask, mesh_ctx=mesh_ctx
+                )
+                return h + out, aux, st
+            mp = jax.tree.map(lambda p: p[_i], _ps["mlp_layers"])
+            mlp = jax.nn.silu(x2 @ mp["gate_proj"]["kernel"]) * (x2 @ mp["up_proj"]["kernel"])
+            return h + mlp @ mp["down_proj"]["kernel"], None, None
+
+        h, aux, st = maybe_remat(lambda hh: one_layer(hh), cfg.remat_policy)(h)
+        if aux is not None:
+            aux_total = aux_total + aux
+            stats_list.append(st["tokens_per_expert"])
+        if lt == "linear_attention":
+            lin_idx += 1
+        else:
+            full_idx += 1
+
+    h = rms_norm(h, params["final_norm"]["scale"], cfg.rms_norm_eps, zero_centered=True)
+    if return_hidden:
+        out = h
+    else:
+        kernel = (
+            params["embed"]["embedding"].T
+            if cfg.tie_word_embeddings
+            else params["lm_head"]["kernel"]
+        )
+        out = jnp.einsum("bsh,hv->bsv", h, kernel.astype(h.dtype), preferred_element_type=jnp.float32)
+    if cfg.moe is not None:
+        if return_stats:
+            return out, aux_total, {"tokens_per_expert": jnp.stack(stats_list)}
+        return out, aux_total
+    return out
+
+
+# ---------------------------------------------------------------------------
+# HF state-dict adapter (reference: qwen3_next/state_dict_adapter.py —
+# re-derived from the HF module layout, not translated)
+# ---------------------------------------------------------------------------
+class Qwen3NextAdapter:
+    """from_hf for Qwen3NextForCausalLM safetensors checkpoints."""
+
+    def __init__(self, cfg: Qwen3NextConfig):
+        self.cfg = cfg
+
+    def from_hf(self, read, shardings=None) -> dict:
+        import numpy as np
+
+        from automodel_tpu.checkpoint.hf_adapter import _get, _set
+
+        cfg = self.cfg
+        params: dict = {}
+
+        def put(tree, path, value):
+            # stream each tensor straight into its sharded layout — never
+            # hold the whole checkpoint unsharded (DenseDecoderAdapter idiom)
+            sh = _get(shardings, path) if shardings is not None else None
+            _set(tree, path, jax.device_put(value, sh) if sh is not None else jnp.asarray(value))
+        put(params, ("embed", "embedding"), read("model.embed_tokens.weight"))
+        put(params, ("final_norm", "scale"), read("model.norm.weight"))
+        if not cfg.tie_word_embeddings:
+            put(params, ("lm_head", "kernel"), np.ascontiguousarray(read("lm_head.weight").T))
+
+        L = cfg.num_layers
+        in_norms = np.stack([read(f"model.layers.{i}.input_layernorm.weight") for i in range(L)])
+        post_norms = np.stack([read(f"model.layers.{i}.post_attention_layernorm.weight") for i in range(L)])
+        put(params, ("input_norms", "scale"), in_norms)
+        put(params, ("post_norms", "scale"), post_norms)
+
+        lin_ids = [i for i, t in enumerate(cfg.layer_types) if t == "linear_attention"]
+        full_ids = [i for i, t in enumerate(cfg.layer_types) if t == "full_attention"]
+
+        def stackT(fmt, ids):
+            return np.stack([np.ascontiguousarray(read(fmt.format(i)).T) for i in ids])
+
+        def stack_(fmt, ids):
+            return np.stack([read(fmt.format(i)) for i in ids])
+
+        g = "model.layers.{}.linear_attn."
+        if lin_ids:
+            put(params, ("gdn_layers", "in_proj_qkvz", "kernel"), stackT(g + "in_proj_qkvz.weight", lin_ids))
+            put(params, ("gdn_layers", "in_proj_ba", "kernel"), stackT(g + "in_proj_ba.weight", lin_ids))
+            # HF conv1d.weight is (C, 1, K) depthwise → ours (K, C)
+            conv = np.stack([
+                np.ascontiguousarray(read((g + "conv1d.weight").format(i))[:, 0, :].T)
+                for i in lin_ids
+            ])
+            put(params, ("gdn_layers", "conv", "kernel"), conv)
+            put(params, ("gdn_layers", "dt_bias"), stack_(g + "dt_bias", lin_ids))
+            put(params, ("gdn_layers", "A_log"), stack_(g + "A_log", lin_ids))
+            put(params, ("gdn_layers", "norm", "scale"), stack_(g + "norm.weight", lin_ids))
+            put(params, ("gdn_layers", "out_proj", "kernel"), stackT(g + "out_proj.weight", lin_ids))
+        else:  # keep pytree structure (dummy 1-layer stack)
+            params["gdn_layers"] = init(cfg, jax.random.key(0))["gdn_layers"]
+
+        a = "model.layers.{}.self_attn."
+        if full_ids:
+            put(params, ("attn_layers", "q_proj", "kernel"), stackT(a + "q_proj.weight", full_ids))
+            put(params, ("attn_layers", "k_proj", "kernel"), stackT(a + "k_proj.weight", full_ids))
+            put(params, ("attn_layers", "v_proj", "kernel"), stackT(a + "v_proj.weight", full_ids))
+            put(params, ("attn_layers", "o_proj", "kernel"), stackT(a + "o_proj.weight", full_ids))
+            put(params, ("attn_layers", "q_norm", "scale"), stack_(a + "q_norm.weight", full_ids))
+            put(params, ("attn_layers", "k_norm", "scale"), stack_(a + "k_norm.weight", full_ids))
+        else:  # keep the pytree structure (init pads one dummy stack)
+            dummy = init(cfg, jax.random.key(0))["attn_layers"]
+            params["attn_layers"] = dummy
+
+        m = "model.layers.{}.mlp."
+        if cfg.moe is not None:
+            E = cfg.moe.n_routed_experts
+            moe_tree: dict = {}
+            put(moe_tree, ("gate", "weight"), stackT(m + "gate.weight", range(L)))
+            for proj in ("gate_proj", "up_proj", "down_proj"):
+                w = np.stack([
+                    np.stack([
+                        np.ascontiguousarray(
+                            read(f"model.layers.{i}.mlp.experts.{e}.{proj}.weight").T
+                        )
+                        for e in range(E)
+                    ])
+                    for i in range(L)
+                ])
+                put(moe_tree, ("experts", proj, "kernel"), w)
+            if cfg.moe.n_shared_experts:
+                for proj in ("gate_proj", "up_proj", "down_proj"):
+                    put(
+                        moe_tree, ("shared", proj, "kernel"),
+                        stackT(m + f"shared_expert.{proj}.weight", range(L)),
+                    )
+                if cfg.moe.shared_expert_gated:
+                    put(
+                        moe_tree, ("shared", "gate", "kernel"),
+                        stackT(m + "shared_expert_gate.weight", range(L)),
+                    )
+            params["mlp_layers"] = {"moe": moe_tree}
+        else:
+            for proj in ("gate_proj", "up_proj", "down_proj"):
+                put(
+                    params, ("mlp_layers", proj, "kernel"),
+                    stackT(m + f"{proj}.weight", range(L)),
+                )
+
+        return params
+
+    def to_hf(self, params):
+        raise NotImplementedError(
+            "qwen3-next export to HF format not implemented yet (from_hf only)"
+        )
+
+
+def _register_adapter():
+    from automodel_tpu.checkpoint.hf_adapter import ADAPTERS
+
+    ADAPTERS["qwen3_next"] = Qwen3NextAdapter
+
+
+_register_adapter()

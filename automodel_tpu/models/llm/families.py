@@ -43,6 +43,18 @@ def llama_config(hf: Mapping[str, Any], **overrides) -> TransformerConfig:
     return TransformerConfig(**kw)
 
 
+def llama_bidirectional_config(hf: Mapping[str, Any], **overrides) -> TransformerConfig:
+    """LlamaBidirectionalModel / ...ForSequenceClassification — the llama
+    retrieval encoder with causal masking removed (reference:
+    models/llama_bidirectional/model.py:79). Pooling ('avg'/'cls'/'last',
+    hf['pooling']) is applied by the retrieval/seq-cls recipes, not here."""
+    kw = _base_kwargs(hf)
+    kw["attention_bias"] = bool(hf.get("attention_bias", False))
+    kw["causal"] = False
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
 def mistral_config(hf: Mapping[str, Any], **overrides) -> TransformerConfig:
     """MistralForCausalLM (reference: models/mistral3)."""
     kw = _base_kwargs(hf)

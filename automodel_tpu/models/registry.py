@@ -11,6 +11,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Callable, Mapping
 
+from automodel_tpu.models.hybrid import qwen3_next as qwen3_next_module
 from automodel_tpu.models.llm import decoder, families
 from automodel_tpu.models.moe_lm import decoder as moe_decoder
 from automodel_tpu.models.moe_lm import families as moe_families
@@ -48,6 +49,16 @@ MODEL_ARCH_MAPPING: dict[str, ModelSpec] = {
     "GptOssForCausalLM": ModelSpec(
         "gpt_oss", moe_families.gpt_oss_config, moe_decoder,
         adapter_name="moe_decoder", adapter_kwargs={"style": "gpt_oss"},
+    ),
+    "LlamaBidirectionalModel": ModelSpec(
+        "llama_bidirectional", families.llama_bidirectional_config, decoder
+    ),
+    "LlamaBidirectionalForSequenceClassification": ModelSpec(
+        "llama_bidirectional", families.llama_bidirectional_config, decoder
+    ),
+    "Qwen3NextForCausalLM": ModelSpec(
+        "qwen3_next", qwen3_next_module.from_hf_config, qwen3_next_module,
+        adapter_name="qwen3_next",
     ),
     "LlavaForConditionalGeneration": ModelSpec(
         "llava", llava_module.llava_config, llava_module, adapter_name="llava"

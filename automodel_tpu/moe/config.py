@@ -33,6 +33,7 @@ class MoEConfig:
     router_bias: bool = False         # gpt-oss router linear has a bias
     moe_intermediate_size: int = 512
     shared_expert_intermediate_size: Optional[int] = None
+    shared_expert_gated: bool = False  # qwen3-next: sigmoid(gate(x))·shared(x)
     capacity_factor: float = 1.25    # static-shape dispatch headroom
     # "capacity": einsum dispatch with padding (EP-friendly; GSPMD A2A)
     # "dropless": sort + ragged grouped GEMM (no drops; ep=1 meshes)

@@ -45,6 +45,12 @@ def init_moe(cfg: MoEConfig, hidden_size: int, rng: jax.Array) -> dict:
             "up_proj": {"kernel": std_in * jax.random.truncated_normal(k2, -3, 3, (hidden_size, Hs))},
             "down_proj": {"kernel": std_out * jax.random.truncated_normal(k3, -3, 3, (Hs, hidden_size))},
         }
+        if cfg.shared_expert_gated:
+            params["shared"]["gate"] = {
+                "kernel": std_in * jax.random.truncated_normal(
+                    jax.random.fold_in(ks, 9), -3, 3, (hidden_size, 1)
+                )
+            }
     return params
 
 
@@ -59,6 +65,8 @@ def moe_param_specs(cfg: MoEConfig) -> dict:
             "up_proj": {"kernel": ("embed", "mlp")},
             "down_proj": {"kernel": ("mlp", "embed")},
         }
+        if cfg.shared_expert_gated:
+            specs["shared"]["gate"] = {"kernel": ("embed", None)}
     return specs
 
 
@@ -92,5 +100,10 @@ def moe_forward(
         dtype = x.dtype
         g = jax.nn.silu(flat @ sp["gate_proj"]["kernel"].astype(dtype))
         u = flat @ sp["up_proj"]["kernel"].astype(dtype)
-        out = out + (g * u) @ sp["down_proj"]["kernel"].astype(dtype)
+        shared_out = (g * u) @ sp["down_proj"]["kernel"].astype(dtype)
+        if cfg.shared_expert_gated:
+            shared_out = shared_out * jax.nn.sigmoid(
+                flat @ sp["gate"]["kernel"].astype(dtype)
+            )
+        out = out + shared_out
     return out.reshape(B, S, H).astype(x.dtype), aux_loss, stats

@@ -59,11 +59,11 @@ class KDRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPrediction):
             hf_config = tcfg.get("hf_config")
             hf_config = hf_config.to_dict() if isinstance(hf_config, ConfigNode) else dict(hf_config)
         self.teacher_spec = get_model_spec(hf_config)
-        if self.teacher_spec.adapter_name == "moe_decoder":
-            raise NotImplementedError("MoE teachers not wired yet")
         self.teacher_cfg = self.teacher_spec.config_from_hf(
             hf_config, dtype=dtype, remat_policy=tcfg.get("remat_policy", "full")
         )
+        if getattr(self.teacher_cfg, "moe", None) is not None:
+            raise NotImplementedError("MoE teachers not wired yet")
         module = self.teacher_spec.module
         shapes = jax.eval_shape(lambda: module.init(self.teacher_cfg, jax.random.key(0)))
         shardings = logical_to_shardings(

@@ -162,8 +162,10 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             hf_config = hf_config.to_dict() if isinstance(hf_config, ConfigNode) else dict(hf_config)
 
         self.model_spec = get_model_spec(hf_config)
-        self.is_moe = self.model_spec.adapter_name == "moe_decoder"
         self.model_cfg = self.model_spec.config_from_hf(hf_config, **overrides)
+        # MoE-ness is a config property, not an adapter name: covers the MoE
+        # decoder AND hybrid families (qwen3-next) whose forward returns aux
+        self.is_moe = getattr(self.model_cfg, "moe", None) is not None
         if self.is_moe:
             moe_over = {}
             if cfg.get("model.fake_balanced_gate", False):

@@ -92,3 +92,111 @@ def test_mixtral_logits_match_hf(tmp_path):
     ids = np.random.default_rng(2).integers(0, 128, (1, 8))
     # MoE top-k weighting amplifies tiny fp differences; slightly looser
     _compare(tmp_path, model, ids, atol=5e-4)
+
+
+def test_qwen3_next_logits_match_hf(tmp_path):
+    """Hybrid GDN + gated attention + MoE w/ gated shared expert — the whole
+    qwen3-next stack (linear-attention recurrence, causal conv, partial
+    RoPE, zero-centered norms) against the HF torch oracle."""
+    from transformers import Qwen3NextConfig, Qwen3NextForCausalLM
+
+    config = Qwen3NextConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, partial_rotary_factor=0.25,
+        layer_types=["linear_attention", "full_attention",
+                     "linear_attention", "full_attention"],
+        linear_num_value_heads=4, linear_num_key_heads=2,
+        linear_key_head_dim=8, linear_value_head_dim=8,
+        linear_conv_kernel_dim=4,
+        num_experts=4, num_experts_per_tok=2, moe_intermediate_size=32,
+        shared_expert_intermediate_size=32, norm_topk_prob=True,
+        decoder_sparse_step=1, mlp_only_layers=[],
+        max_position_embeddings=64, tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(7)
+    model = Qwen3NextForCausalLM(config)
+    _save_hf_model(model, config, tmp_path)
+    ids = np.random.default_rng(7).integers(0, 128, (2, 12))
+    _compare(tmp_path, model, ids, atol=5e-4)
+
+
+def test_llama_bidirectional_loads_and_attends_both_ways(tmp_path):
+    """The bidirectional retrieval family (reference:
+    models/llama_bidirectional/model.py:79): a llama checkpoint declared as
+    LlamaBidirectionalModel loads through the dense adapter with
+    causal=False — early positions must depend on later tokens."""
+    import dataclasses
+
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    config = LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(3)
+    model = LlamaForCausalLM(config)
+    _save_hf_model(model, config, tmp_path)
+    # rewrite the saved architecture to the bidirectional family
+    cfg_path = tmp_path / "config.json"
+    d = json.loads(cfg_path.read_text())
+    d["architectures"] = ["LlamaBidirectionalModel"]
+    d["pooling"] = "avg"
+    cfg_path.write_text(json.dumps(d))
+
+    reader = HFCheckpointReader(str(tmp_path))
+    spec = get_model_spec(reader.hf_config())
+    assert spec.name == "llama_bidirectional"
+    cfg = spec.config_from_hf(reader.hf_config(), dtype=jnp.float32, remat_policy="none")
+    assert cfg.causal is False
+    params = get_adapter(spec.adapter_name, cfg, **spec.adapter_kwargs).from_hf(reader)
+
+    ids = np.random.default_rng(3).integers(0, 128, (1, 10))
+    h1 = spec.module.forward(params, cfg, jnp.asarray(ids), return_hidden=True)
+    ids2 = ids.copy()
+    ids2[0, -1] = (ids2[0, -1] + 1) % 128
+    h2 = spec.module.forward(params, cfg, jnp.asarray(ids2), return_hidden=True)
+    # bidirectional: the first position changes when the last token changes
+    assert float(jnp.abs(h1[0, 0] - h2[0, 0]).max()) > 1e-6
+    # and the causal variant would not
+    ccfg = dataclasses.replace(cfg, causal=True)
+    c1 = spec.module.forward(params, ccfg, jnp.asarray(ids), return_hidden=True)
+    c2 = spec.module.forward(params, ccfg, jnp.asarray(ids2), return_hidden=True)
+    np.testing.assert_allclose(
+        np.asarray(c1[0, 0]), np.asarray(c2[0, 0]), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_qwen3_next_sharded_matches_single_device():
+    """GDN scan + conv + MoE under a dp×ep mesh vs single device."""
+    from automodel_tpu.distributed import MeshConfig
+    from automodel_tpu.models.hybrid import qwen3_next as q3n
+    from automodel_tpu.parallel import logical_to_shardings
+
+    hf = dict(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, layer_types=["linear_attention", "full_attention"],
+        linear_num_value_heads=4, linear_num_key_heads=2,
+        linear_key_head_dim=8, linear_value_head_dim=8,
+        num_experts=4, num_experts_per_tok=2, moe_intermediate_size=32,
+        shared_expert_intermediate_size=32,
+    )
+    cfg = q3n.from_hf_config(hf, dtype=jnp.float32, remat_policy="none")
+    params = q3n.init(cfg, jax.random.key(0))
+    ids = jnp.asarray(np.random.default_rng(5).integers(0, 128, (8, 8)), jnp.int32)
+    ref, ref_aux = q3n.forward(params, cfg, ids)
+
+    ctx = MeshConfig(dp_shard=2, ep=2, tp=2).build()
+    sh = logical_to_shardings(
+        q3n.param_specs(cfg), ctx, shapes=jax.tree.map(lambda p: p.shape, params)
+    )
+    sp = jax.device_put(params, sh)
+    out, aux = jax.jit(lambda p, i: q3n.forward(p, cfg, i, mesh_ctx=ctx))(
+        sp, jax.device_put(ids, ctx.sharding("batch", None))
+    )
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(float(ref_aux), float(aux), rtol=1e-4, atol=1e-6)
