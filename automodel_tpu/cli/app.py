@@ -88,6 +88,15 @@ def main(argv=None) -> None:
     if args and args[0] in ("--capabilities", "capabilities"):
         print_capabilities()
         return
+    if args and args[0] == "launch":
+        # `automodel_tpu launch <cfg.yaml> [--launcher.k=v ...]` — generate
+        # (and optionally submit) a SLURM/GKE multi-host job spec
+        from automodel_tpu.launcher import launch_main
+
+        largs = args[1:]
+        cfg = parse_args_and_load_config(largs)
+        launch_main(largs[0], cfg.get("launcher"))
+        return
     cfg = parse_args_and_load_config(args)
     # `platform: {force_cpu_devices: N}` — run the recipe on an N-device
     # virtual CPU mesh (dev boxes / CI without accelerators). Must happen

@@ -1,0 +1,6 @@
+from automodel_tpu.launcher.generate import (  # noqa: F401
+    LauncherConfig,
+    render_gke_jobset,
+    render_slurm_script,
+    launch_main,
+)

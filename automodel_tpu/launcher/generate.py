@@ -1,0 +1,193 @@
+"""Executable multi-host launchers: SLURM sbatch + GKE JobSet generators.
+
+The analog of the reference launcher stack (reference: slurm.sub,
+nemo_automodel/components/launcher/interactive.py:70 torchrun re-exec,
+launcher/nemo_run + launcher/skypilot submission), TPU-native:
+
+- There is no process-spawning launcher to re-exec through: a TPU pod runs
+  ONE process per host, every host executes the same
+  `python -m automodel_tpu <cfg>` command, and `jax.distributed.initialize`
+  performs the rendezvous from environment variables.
+- SLURM: `srun --ntasks-per-node=1` with the coordinator at node 0
+  (JAX_COORDINATOR_ADDRESS from `scontrol show hostnames`), SIGUSR1
+  forwarded for checkpoint-then-exit (the recipe's SIGTERM path).
+- GKE: a JobSet-style manifest with `google.com/tpu` resources and TPU
+  topology selectors; the TPU webhook injects the rendezvous env
+  (TPU_WORKER_HOSTNAMES et al., which distributed/init_utils autodetects).
+
+`automodel_tpu launch <cfg.yaml>` writes the manifest; `--launcher.submit=true`
+also invokes sbatch/kubectl when present.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shlex
+import subprocess
+from typing import Optional
+
+
+@dataclasses.dataclass
+class LauncherConfig:
+    backend: str = "slurm"             # "slurm" | "gke"
+    nodes: int = 1
+    job_name: str = "automodel-tpu"
+    output_dir: str = "launch_jobs"
+    submit: bool = False
+    extra_args: str = ""               # appended to the training command
+    # slurm
+    account: Optional[str] = None
+    partition: Optional[str] = None
+    time_limit: str = "01:00:00"
+    container_image: Optional[str] = None
+    # gke
+    namespace: str = "default"
+    tpu_type: str = "tpu-v5-lite-podslice"   # node selector accelerator
+    tpu_topology: str = "2x4"
+    tpu_chips_per_host: int = 4
+    image: str = "python:3.12"
+    workdir: str = "/workspace"
+
+    def __post_init__(self):
+        if self.backend not in ("slurm", "gke"):
+            raise ValueError(f"launcher.backend must be slurm|gke, got {self.backend}")
+        if self.nodes < 1:
+            raise ValueError(f"launcher.nodes must be >= 1, got {self.nodes}")
+
+
+def _train_command(config_path: str, extra: str) -> str:
+    cmd = f"python -m automodel_tpu {shlex.quote(config_path)}"
+    return f"{cmd} {extra}".strip()
+
+
+def render_slurm_script(cfg: LauncherConfig, config_path: str) -> str:
+    """One srun task per node; node 0 is the JAX coordinator."""
+    directives = [
+        f"#SBATCH -J {cfg.job_name}",
+        f"#SBATCH -N {cfg.nodes}",
+        "#SBATCH --ntasks-per-node=1",
+        f"#SBATCH -t {cfg.time_limit}",
+        f"#SBATCH --output={cfg.output_dir}/%x_%j.out",
+        f"#SBATCH --error={cfg.output_dir}/%x_%j.err",
+        "#SBATCH --signal=B:USR1@300",  # checkpoint-then-exit grace window
+    ]
+    if cfg.account:
+        directives.append(f"#SBATCH -A {cfg.account}")
+    if cfg.partition:
+        directives.append(f"#SBATCH -p {cfg.partition}")
+
+    srun = "srun --ntasks-per-node=1 --kill-on-bad-exit=1"
+    if cfg.container_image:
+        srun += f" --container-image={cfg.container_image}"
+
+    return "\n".join([
+        "#!/bin/bash",
+        *directives,
+        "",
+        "# JAX multi-host rendezvous: coordinator = first allocated node.",
+        'HOSTS=$(scontrol show hostnames "$SLURM_JOB_NODELIST")',
+        "export JAX_COORDINATOR_ADDRESS=$(echo \"$HOSTS\" | head -n1):8476",
+        "export JAX_NUM_PROCESSES=$SLURM_JOB_NUM_NODES",
+        "",
+        "# forward SIGUSR1 so the recipe checkpoints before the wall clock",
+        "trap 'kill -TERM $SRUN_PID 2>/dev/null' USR1",
+        "",
+        f"{srun} bash -c 'export JAX_PROCESS_ID=$SLURM_PROCID; "
+        f"{_train_command(config_path, cfg.extra_args)}' &",
+        "SRUN_PID=$!",
+        "# first wait returns when USR1 interrupts it; wait again so the",
+        "# batch script stays alive while the recipe checkpoints and exits",
+        "wait $SRUN_PID",
+        "wait $SRUN_PID",
+        "",
+    ])
+
+
+def render_gke_jobset(cfg: LauncherConfig, config_path: str) -> str:
+    """JobSet-style manifest (XPK pattern): completions==parallelism==hosts,
+    TPU topology via node selectors; the GKE TPU webhook injects the
+    rendezvous env that distributed/init_utils autodetects."""
+    cmd = _train_command(config_path, cfg.extra_args)
+    return f"""apiVersion: jobset.x-k8s.io/v1alpha2
+kind: JobSet
+metadata:
+  name: {cfg.job_name}
+  namespace: {cfg.namespace}
+spec:
+  replicatedJobs:
+    - name: workers
+      replicas: 1
+      template:
+        spec:
+          parallelism: {cfg.nodes}
+          completions: {cfg.nodes}
+          completionMode: Indexed
+          backoffLimit: 0
+          template:
+            spec:
+              restartPolicy: Never
+              nodeSelector:
+                cloud.google.com/gke-tpu-accelerator: {cfg.tpu_type}
+                cloud.google.com/gke-tpu-topology: {cfg.tpu_topology}
+              containers:
+                - name: automodel
+                  image: {cfg.image}
+                  workingDir: {cfg.workdir}
+                  command: ["bash", "-c"]
+                  args: ["{cmd}"]
+                  resources:
+                    requests:
+                      google.com/tpu: {cfg.tpu_chips_per_host}
+                    limits:
+                      google.com/tpu: {cfg.tpu_chips_per_host}
+"""
+
+
+def launch_main(config_path: str, launcher_node, submit_override: bool | None = None) -> str:
+    """Generate (and optionally submit) the job spec. Returns the spec path."""
+    def coerce(field, v):
+        t = type(field.default)
+        if field.default is None or v is None:
+            return v
+        if t is bool:  # env interpolation yields strings; bool("false") lies
+            if isinstance(v, str):
+                return v.strip().lower() in ("1", "true", "yes", "on")
+            return bool(v)
+        return t(v)
+
+    kwargs = {}
+    if launcher_node is not None:
+        for f in dataclasses.fields(LauncherConfig):
+            if f.name in launcher_node:
+                kwargs[f.name] = coerce(f, launcher_node.get(f.name))
+    cfg = LauncherConfig(**kwargs)
+    if submit_override is not None:
+        cfg.submit = submit_override
+
+    os.makedirs(cfg.output_dir, exist_ok=True)
+    if cfg.backend == "slurm":
+        spec = render_slurm_script(cfg, config_path)
+        path = os.path.join(cfg.output_dir, f"{cfg.job_name}.sub")
+        submit_cmd = ["sbatch", path]
+    else:
+        spec = render_gke_jobset(cfg, config_path)
+        path = os.path.join(cfg.output_dir, f"{cfg.job_name}.yaml")
+        submit_cmd = ["kubectl", "apply", "-f", path]
+
+    with open(path, "w") as f:
+        f.write(spec)
+    print(f"wrote {cfg.backend} job spec: {path}")
+
+    if cfg.submit:
+        try:
+            out = subprocess.run(submit_cmd, capture_output=True, text=True, timeout=60)
+            print(out.stdout.strip() or out.stderr.strip())
+            if out.returncode != 0:
+                raise RuntimeError(f"submission failed: {out.stderr.strip()[:500]}")
+        except FileNotFoundError:
+            raise RuntimeError(
+                f"`{submit_cmd[0]}` not found on this host — spec written to "
+                f"{path}; submit it from a cluster login node"
+            ) from None
+    return path

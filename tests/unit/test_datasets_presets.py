@@ -1,0 +1,144 @@
+"""NanoGPT bin-shard reader + SQuAD/HellaSwag preset tests."""
+
+import json
+
+import numpy as np
+import pytest
+
+from automodel_tpu.datasets.nanogpt import (
+    LEGACY_MAGIC,
+    HEADER_INTS,
+    NanogptBinDatasetConfig,
+    write_bin_shard,
+)
+from automodel_tpu.datasets.presets import (
+    HellaSwagDatasetConfig,
+    SquadDatasetConfig,
+)
+
+
+class FakeTok:
+    bos_token_id = 1
+    eos_token_id = 2
+    pad_token_id = 0
+
+    def __call__(self, text, add_special_tokens=False):
+        # 1 token per character, offset out of the specials range
+        return {"input_ids": [3 + (ord(c) % 50) for c in text]}
+
+
+def test_nanogpt_roundtrip_and_chunking(tmp_path):
+    toks = np.arange(1000, dtype=np.uint16)
+    write_bin_shard(toks, str(tmp_path / "s0.bin"))
+    write_bin_shard(toks + 1000, str(tmp_path / "s1.bin"))
+
+    ds = NanogptBinDatasetConfig(
+        path=str(tmp_path / "s*.bin"), seq_len=100, shuffle_seed=None
+    ).build()
+    # 9 full windows of 101 per shard ((1000-1)//100 = 9)
+    assert len(ds) == 18
+    s = ds[0]
+    np.testing.assert_array_equal(s["input_ids"], np.arange(100))
+    np.testing.assert_array_equal(s["labels"], np.arange(1, 101))
+    s = ds[9]  # first window of shard 1
+    assert s["input_ids"][0] == 1000
+
+
+def test_nanogpt_shuffle_is_seeded(tmp_path):
+    write_bin_shard(np.arange(5000, dtype=np.uint16), str(tmp_path / "a.bin"))
+    d1 = NanogptBinDatasetConfig(path=str(tmp_path / "a.bin"), seq_len=64, shuffle_seed=3).build()
+    d2 = NanogptBinDatasetConfig(path=str(tmp_path / "a.bin"), seq_len=64, shuffle_seed=3).build()
+    d3 = NanogptBinDatasetConfig(path=str(tmp_path / "a.bin"), seq_len=64, shuffle_seed=4).build()
+    np.testing.assert_array_equal(d1.index, d2.index)
+    assert not np.array_equal(d1.index, d3.index)
+    # all windows covered exactly once
+    assert sorted(d1.index[:, 1].tolist()) == sorted(d3.index[:, 1].tolist())
+
+
+def test_nanogpt_legacy_header_and_uint32(tmp_path):
+    # legacy: magic 20240520, no itemsize field (uint16 implied)
+    toks = np.arange(500, dtype=np.uint16)
+    header = np.zeros(HEADER_INTS, np.int32)
+    header[0], header[1], header[2] = LEGACY_MAGIC, 1, toks.size
+    with open(tmp_path / "legacy.bin", "wb") as f:
+        f.write(header.tobytes())
+        f.write(toks.tobytes())
+    ds = NanogptBinDatasetConfig(path=str(tmp_path / "legacy.bin"), seq_len=50).build()
+    assert len(ds) > 0 and ds[0]["input_ids"].dtype == np.int32
+
+    big = (np.arange(500, dtype=np.uint32) + 70000)  # needs uint32
+    write_bin_shard(big, str(tmp_path / "u32.bin"))
+    ds32 = NanogptBinDatasetConfig(path=str(tmp_path / "u32.bin"), seq_len=50, shuffle_seed=None).build()
+    assert int(ds32[0]["input_ids"][0]) == 70000
+
+    with pytest.raises(ValueError, match="bad magic"):
+        bad = tmp_path / "bad.bin"
+        bad.write_bytes(b"\x00" * 2048)
+        NanogptBinDatasetConfig(path=str(bad), seq_len=10).build()
+
+
+def test_squad_preset_masks_prompt(tmp_path):
+    rows = [{
+        "context": "Paris is in France.",
+        "question": "Where is Paris?",
+        "answers": {"text": ["France"], "answer_start": [0]},
+    }]
+    p = tmp_path / "squad.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows))
+    ds = SquadDatasetConfig(path_or_dataset=str(p), seq_len=96).build(FakeTok())
+    s = ds[0]
+    assert s["input_ids"].shape == (96,)
+    sup = s["labels"] != -100
+    # supervision exists and starts only after the prompt region
+    assert sup.any()
+    n_prompt = len(FakeTok()("Context: Paris is in France.\nQuestion: Where is Paris?\nAnswer:")["input_ids"])
+    assert not sup[: n_prompt - 2].any()
+
+
+def test_hellaswag_preset_picks_labeled_ending(tmp_path):
+    rows = [{"ctx": "A man sits down", "endings": ["x", "and reads.", "z"], "label": 1}]
+    p = tmp_path / "hs.jsonl"
+    p.write_text(json.dumps(rows[0]))
+    ds = HellaSwagDatasetConfig(path_or_dataset=str(p), seq_len=64).build(FakeTok())
+    s = ds[0]
+    n_ans = len(FakeTok()(" and reads.")["input_ids"])
+    assert int((s["labels"] != -100).sum()) >= n_ans
+
+
+def test_nanogpt_bos_alignment(tmp_path):
+    toks = np.zeros(1000, np.uint16)
+    bos_positions = [0, 150, 160, 400, 990]
+    for p in bos_positions:
+        toks[p] = 7
+    write_bin_shard(toks, str(tmp_path / "bos.bin"))
+    ds = NanogptBinDatasetConfig(
+        path=str(tmp_path / "bos.bin"), seq_len=100, shuffle_seed=None,
+        bos_token_id=7,
+    ).build()
+    starts = sorted(ds.index[:, 1].tolist())
+    # greedy non-overlap: 0 taken, 150 taken (>=100), 160 skipped, 400 taken;
+    # 990 has no full window
+    assert starts == [0, 150, 400]
+    assert all(ds[i]["input_ids"][0] == 7 for i in range(len(ds)))
+
+
+def test_squad_official_nested_format(tmp_path):
+    official = {"data": [{
+        "title": "t",
+        "paragraphs": [{
+            "context": "Rome is in Italy.",
+            "qas": [
+                {"question": "Where is Rome?", "answers": [{"text": "Italy", "answer_start": 0}]},
+                {"question": "What is Rome?", "answers": [{"text": "a city", "answer_start": 0}]},
+            ],
+        }],
+    }]}
+    p = tmp_path / "train.json"
+    p.write_text(json.dumps(official))
+    ds = SquadDatasetConfig(path_or_dataset=str(p), seq_len=96).build(FakeTok())
+    assert len(ds) == 2
+    s = ds[0]
+    assert (s["labels"] != -100).sum() > 0
+    # the answer text is actually tokenized into the sequence (not empty)
+    n_ans = len(FakeTok()("Italy")["input_ids"])
+    assert (s["labels"] != -100).sum() >= n_ans

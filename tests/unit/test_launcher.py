@@ -1,0 +1,66 @@
+"""Launcher manifest-generation tests (reference: slurm.sub, components/
+launcher/* — here the launcher GENERATES one-process-per-host job specs;
+jax.distributed handles rendezvous, no torchrun re-exec)."""
+
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+from automodel_tpu.launcher import (
+    LauncherConfig,
+    render_gke_jobset,
+    render_slurm_script,
+)
+
+
+def test_slurm_script_fields():
+    cfg = LauncherConfig(
+        backend="slurm", nodes=8, job_name="ft8", account="acct",
+        partition="tpu", time_limit="02:00:00",
+    )
+    s = render_slurm_script(cfg, "examples/llm_finetune/tiny_llama_mock_smoke.yaml")
+    assert s.startswith("#!/bin/bash")
+    assert "#SBATCH -N 8" in s
+    assert "#SBATCH --ntasks-per-node=1" in s
+    assert "#SBATCH -A acct" in s and "#SBATCH -p tpu" in s
+    assert "JAX_COORDINATOR_ADDRESS" in s and "JAX_PROCESS_ID=$SLURM_PROCID" in s
+    assert "python -m automodel_tpu examples/llm_finetune/tiny_llama_mock_smoke.yaml" in s
+    assert "--signal=B:USR1@300" in s  # checkpoint-then-exit grace
+
+
+def test_gke_jobset_is_valid_yaml_with_tpu_resources():
+    cfg = LauncherConfig(
+        backend="gke", nodes=4, job_name="pretrain", tpu_type="tpu-v5p-slice",
+        tpu_topology="2x2x4", tpu_chips_per_host=4, image="my/image:1",
+    )
+    doc = yaml.safe_load(render_gke_jobset(cfg, "cfg.yaml"))
+    assert doc["kind"] == "JobSet"
+    job = doc["spec"]["replicatedJobs"][0]["template"]["spec"]
+    assert job["parallelism"] == 4 and job["completions"] == 4
+    pod = job["template"]["spec"]
+    sel = pod["nodeSelector"]
+    assert sel["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5p-slice"
+    assert sel["cloud.google.com/gke-tpu-topology"] == "2x2x4"
+    c = pod["containers"][0]
+    assert c["resources"]["limits"]["google.com/tpu"] == 4
+    assert "python -m automodel_tpu cfg.yaml" in c["args"][0]
+
+
+def test_launcher_rejects_bad_backend():
+    with pytest.raises(ValueError, match="slurm|gke"):
+        LauncherConfig(backend="torchrun")
+
+
+def test_cli_launch_writes_spec(tmp_path):
+    out = subprocess.run(
+        [sys.executable, "-m", "automodel_tpu", "launch",
+         "examples/llm_finetune/tiny_llama_mock_smoke.yaml",
+         "--launcher.backend=gke", "--launcher.nodes=2",
+         f"--launcher.output_dir={tmp_path}", "--launcher.job_name=smoke"],
+        capture_output=True, text=True, timeout=120, cwd="/root/repo",
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    spec = (tmp_path / "smoke.yaml").read_text()
+    assert yaml.safe_load(spec)["metadata"]["name"] == "smoke"
