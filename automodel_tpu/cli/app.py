@@ -89,13 +89,19 @@ def main(argv=None) -> None:
         print_capabilities()
         return
     if args and args[0] == "launch":
-        # `automodel_tpu launch <cfg.yaml> [--launcher.k=v ...]` — generate
-        # (and optionally submit) a SLURM/GKE multi-host job spec
+        # `automodel_tpu launch <cfg.yaml> [--launcher.k=v] [--any.other=v]`
+        # — generate (and optionally submit) a SLURM/GKE multi-host job
+        # spec. Non-launcher overrides are forwarded into the job's train
+        # command so the cluster run matches what was asked for.
         from automodel_tpu.launcher import launch_main
 
         largs = args[1:]
         cfg = parse_args_and_load_config(largs)
-        launch_main(largs[0], cfg.get("launcher"))
+        train_overrides = " ".join(
+            a for a in largs[1:]
+            if not a.startswith("--launcher.") and not a.startswith("--platform.")
+        )
+        launch_main(largs[0], cfg.get("launcher"), train_overrides=train_overrides)
         return
     cfg = parse_args_and_load_config(args)
     # `platform: {force_cpu_devices: N}` — run the recipe on an N-device

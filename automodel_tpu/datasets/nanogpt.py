@@ -88,9 +88,14 @@ class NanogptBinDataset:
         entries = []
         for si, shard in enumerate(self.shards):
             if config.bos_token_id is not None:
-                bos = np.flatnonzero(
-                    np.asarray(shard) == config.bos_token_id
-                ).astype(np.int64)
+                # scan in blocks: finding BOS needs one sequential pass, but
+                # never materialize a whole multi-GB shard at once
+                block = 1 << 22
+                parts = [
+                    np.flatnonzero(shard[o : o + block] == config.bos_token_id) + o
+                    for o in range(0, shard.shape[0], block)
+                ]
+                bos = np.concatenate(parts).astype(np.int64)
                 starts_l = []
                 cursor = -1
                 for p in bos:

@@ -33,8 +33,11 @@ def initialize_distributed(
     if _INITIALIZED:
         return
     coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
-    num_processes = num_processes or _int_env("JAX_NUM_PROCESSES")
-    process_id = process_id if process_id is not None else _int_env("JAX_PROCESS_ID")
+    num_processes = num_processes or _int_env("JAX_NUM_PROCESSES") or _int_env("SLURM_NTASKS")
+    if process_id is None:
+        process_id = _int_env("JAX_PROCESS_ID")
+    if process_id is None:
+        process_id = _int_env("SLURM_PROCID")  # srun task rank (launcher path)
 
     # single-slice multi-host pods advertise their peers via
     # TPU_WORKER_HOSTNAMES; >1 entry → argless autodetect rendezvous

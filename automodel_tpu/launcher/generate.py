@@ -86,6 +86,8 @@ def render_slurm_script(cfg: LauncherConfig, config_path: str) -> str:
         *directives,
         "",
         "# JAX multi-host rendezvous: coordinator = first allocated node.",
+        "# Per-task rank comes from SLURM_PROCID, which the recipe's",
+        "# distributed/init_utils reads directly — no wrapper shell needed.",
         'HOSTS=$(scontrol show hostnames "$SLURM_JOB_NODELIST")',
         "export JAX_COORDINATOR_ADDRESS=$(echo \"$HOSTS\" | head -n1):8476",
         "export JAX_NUM_PROCESSES=$SLURM_JOB_NUM_NODES",
@@ -93,8 +95,7 @@ def render_slurm_script(cfg: LauncherConfig, config_path: str) -> str:
         "# forward SIGUSR1 so the recipe checkpoints before the wall clock",
         "trap 'kill -TERM $SRUN_PID 2>/dev/null' USR1",
         "",
-        f"{srun} bash -c 'export JAX_PROCESS_ID=$SLURM_PROCID; "
-        f"{_train_command(config_path, cfg.extra_args)}' &",
+        f"{srun} {_train_command(config_path, cfg.extra_args)} &",
         "SRUN_PID=$!",
         "# first wait returns when USR1 interrupts it; wait again so the",
         "# batch script stays alive while the recipe checkpoints and exits",
@@ -106,46 +107,57 @@ def render_slurm_script(cfg: LauncherConfig, config_path: str) -> str:
 
 def render_gke_jobset(cfg: LauncherConfig, config_path: str) -> str:
     """JobSet-style manifest (XPK pattern): completions==parallelism==hosts,
-    TPU topology via node selectors; the GKE TPU webhook injects the
-    rendezvous env that distributed/init_utils autodetects."""
+    Indexed completion (required for multi-host TPU webhook identity), TPU
+    topology via node selectors; the webhook injects the rendezvous env
+    that distributed/init_utils autodetects. Built as a dict and dumped —
+    command strings are YAML-escaped by construction."""
+    import yaml
+
     cmd = _train_command(config_path, cfg.extra_args)
-    return f"""apiVersion: jobset.x-k8s.io/v1alpha2
-kind: JobSet
-metadata:
-  name: {cfg.job_name}
-  namespace: {cfg.namespace}
-spec:
-  replicatedJobs:
-    - name: workers
-      replicas: 1
-      template:
-        spec:
-          parallelism: {cfg.nodes}
-          completions: {cfg.nodes}
-          completionMode: Indexed
-          backoffLimit: 0
-          template:
-            spec:
-              restartPolicy: Never
-              nodeSelector:
-                cloud.google.com/gke-tpu-accelerator: {cfg.tpu_type}
-                cloud.google.com/gke-tpu-topology: {cfg.tpu_topology}
-              containers:
-                - name: automodel
-                  image: {cfg.image}
-                  workingDir: {cfg.workdir}
-                  command: ["bash", "-c"]
-                  args: ["{cmd}"]
-                  resources:
-                    requests:
-                      google.com/tpu: {cfg.tpu_chips_per_host}
-                    limits:
-                      google.com/tpu: {cfg.tpu_chips_per_host}
-"""
+    doc = {
+        "apiVersion": "jobset.x-k8s.io/v1alpha2",
+        "kind": "JobSet",
+        "metadata": {"name": cfg.job_name, "namespace": cfg.namespace},
+        "spec": {"replicatedJobs": [{
+            "name": "workers",
+            "replicas": 1,
+            "template": {"spec": {
+                "parallelism": cfg.nodes,
+                "completions": cfg.nodes,
+                "completionMode": "Indexed",
+                "backoffLimit": 0,
+                "template": {"spec": {
+                    "restartPolicy": "Never",
+                    "nodeSelector": {
+                        "cloud.google.com/gke-tpu-accelerator": cfg.tpu_type,
+                        "cloud.google.com/gke-tpu-topology": cfg.tpu_topology,
+                    },
+                    "containers": [{
+                        "name": "automodel",
+                        "image": cfg.image,
+                        "workingDir": cfg.workdir,
+                        "command": ["bash", "-c"],
+                        "args": [cmd],
+                        "resources": {
+                            "requests": {"google.com/tpu": cfg.tpu_chips_per_host},
+                            "limits": {"google.com/tpu": cfg.tpu_chips_per_host},
+                        },
+                    }],
+                }},
+            }},
+        }]},
+    }
+    return yaml.safe_dump(doc, sort_keys=False)
 
 
-def launch_main(config_path: str, launcher_node, submit_override: bool | None = None) -> str:
-    """Generate (and optionally submit) the job spec. Returns the spec path."""
+def launch_main(
+    config_path: str,
+    launcher_node,
+    submit_override: bool | None = None,
+    train_overrides: str = "",
+) -> str:
+    """Generate (and optionally submit) the job spec. Returns the spec path.
+    `train_overrides` (CLI dotted overrides) join the rendered command."""
     def coerce(field, v):
         t = type(field.default)
         if field.default is None or v is None:
@@ -164,6 +176,8 @@ def launch_main(config_path: str, launcher_node, submit_override: bool | None = 
     cfg = LauncherConfig(**kwargs)
     if submit_override is not None:
         cfg.submit = submit_override
+    if train_overrides:
+        cfg.extra_args = f"{cfg.extra_args} {train_overrides}".strip()
 
     os.makedirs(cfg.output_dir, exist_ok=True)
     if cfg.backend == "slurm":

@@ -1,0 +1,76 @@
+"""Compare a training.jsonl against a reference golden JSONL.
+
+The loss-curve half of the parity protocol (reference:
+tests/ci_tests/golden_values/**/*.jsonl + the reference's
+assert_finite_train_metrics.py): align step-by-step and report per-step
+loss/grad-norm deltas plus curve-level statistics. (Throughput fields are
+hardware-bound and intentionally not compared; ours `tps_per_device` ≙
+reference `tps_per_gpu`, `mfu_pct` ≙ `mfu`.)
+
+    python scripts/compare_golden.py ours.jsonl reference.jsonl \
+        [--loss-rtol 0.02] [--steps N]
+
+Exit code 1 when the loss curve diverges beyond tolerance. See
+docs/PARITY.md for the full protocol (data order, init, fp32 reductions).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+def load(path: str) -> dict[int, dict]:
+    rows = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            r = json.loads(line)
+            if "step" in r and "loss" in r:
+                rows[int(r["step"])] = r
+    return rows
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("ours")
+    ap.add_argument("reference")
+    ap.add_argument("--loss-rtol", type=float, default=0.02)
+    ap.add_argument("--steps", type=int, default=None, help="compare first N common steps")
+    args = ap.parse_args()
+
+    ours, ref = load(args.ours), load(args.reference)
+    # the reference logs step 0; this framework starts at 1 — align by order
+    o_steps, r_steps = sorted(ours), sorted(ref)
+    n = min(len(o_steps), len(r_steps), args.steps or 10**9)
+    if n == 0:
+        print("no comparable steps")
+        return 1
+
+    worst = 0.0
+    print(f"{'step':>6} {'loss(ours)':>12} {'loss(ref)':>12} {'rel_diff':>10} {'gnorm_rel':>10}")
+    for i in range(n):
+        o, r = ours[o_steps[i]], ref[r_steps[i]]
+        lo, lr_ = float(o["loss"]), float(r["loss"])
+        rel = abs(lo - lr_) / max(abs(lr_), 1e-8)
+        g_rel = float("nan")
+        if "grad_norm" in o and "grad_norm" in r:
+            g_rel = abs(float(o["grad_norm"]) - float(r["grad_norm"])) / max(
+                abs(float(r["grad_norm"])), 1e-8
+            )
+        worst = max(worst, rel)
+        print(f"{o_steps[i]:>6} {lo:>12.5f} {lr_:>12.5f} {rel:>10.4f} {g_rel:>10.4f}")
+
+    final_o = float(ours[o_steps[n - 1]]["loss"])
+    final_r = float(ref[r_steps[n - 1]]["loss"])
+    print(f"\ncompared {n} steps; worst per-step loss rel diff {worst:.4f}; "
+          f"final loss {final_o:.5f} vs {final_r:.5f}")
+    ok = worst <= args.loss_rtol
+    print("PARITY OK" if ok else f"PARITY FAIL (rtol {args.loss_rtol})")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,8 +2,11 @@
 launcher/* — here the launcher GENERATES one-process-per-host job specs;
 jax.distributed handles rendezvous, no torchrun re-exec)."""
 
+import os
 import subprocess
 import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pytest
 import yaml
@@ -25,7 +28,8 @@ def test_slurm_script_fields():
     assert "#SBATCH -N 8" in s
     assert "#SBATCH --ntasks-per-node=1" in s
     assert "#SBATCH -A acct" in s and "#SBATCH -p tpu" in s
-    assert "JAX_COORDINATOR_ADDRESS" in s and "JAX_PROCESS_ID=$SLURM_PROCID" in s
+    # rank comes from SLURM_PROCID, read directly by distributed/init_utils
+    assert "JAX_COORDINATOR_ADDRESS" in s and "JAX_NUM_PROCESSES" in s
     assert "python -m automodel_tpu examples/llm_finetune/tiny_llama_mock_smoke.yaml" in s
     assert "--signal=B:USR1@300" in s  # checkpoint-then-exit grace
 
@@ -59,7 +63,7 @@ def test_cli_launch_writes_spec(tmp_path):
          "examples/llm_finetune/tiny_llama_mock_smoke.yaml",
          "--launcher.backend=gke", "--launcher.nodes=2",
          f"--launcher.output_dir={tmp_path}", "--launcher.job_name=smoke"],
-        capture_output=True, text=True, timeout=120, cwd="/root/repo",
+        capture_output=True, text=True, timeout=120, cwd=REPO_ROOT,
     )
     assert out.returncode == 0, out.stderr[-800:]
     spec = (tmp_path / "smoke.yaml").read_text()
