@@ -57,7 +57,10 @@ def print_capabilities() -> None:
 
     from automodel_tpu.utils.hostplatform import force_cpu_devices
 
-    force_cpu_devices(1)
+    try:
+        force_cpu_devices(1)
+    except RuntimeError:
+        pass  # a backend is already live in this process — query that one
 
     import jax
 
@@ -97,8 +100,10 @@ def main(argv=None) -> None:
 
         largs = args[1:]
         cfg = parse_args_and_load_config(largs)
+        import shlex
+
         train_overrides = " ".join(
-            a for a in largs[1:]
+            shlex.quote(a) for a in largs[1:]
             if not a.startswith("--launcher.") and not a.startswith("--platform.")
         )
         launch_main(largs[0], cfg.get("launcher"), train_overrides=train_overrides)

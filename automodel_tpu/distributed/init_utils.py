@@ -33,11 +33,14 @@ def initialize_distributed(
     if _INITIALIZED:
         return
     coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
-    num_processes = num_processes or _int_env("JAX_NUM_PROCESSES") or _int_env("SLURM_NTASKS")
+    num_processes = num_processes or _int_env("JAX_NUM_PROCESSES")
     if process_id is None:
         process_id = _int_env("JAX_PROCESS_ID")
-    if process_id is None:
-        process_id = _int_env("SLURM_PROCID")  # srun task rank (launcher path)
+    # SLURM fallback ONLY for processes actually launched by srun (PROCID is
+    # set per task); a bare python in an salloc shell must stay single-process
+    if process_id is None and _int_env("SLURM_PROCID") is not None:
+        process_id = _int_env("SLURM_PROCID")
+        num_processes = num_processes or _int_env("SLURM_NTASKS")
 
     # single-slice multi-host pods advertise their peers via
     # TPU_WORKER_HOSTNAMES; >1 entry → argless autodetect rendezvous

@@ -152,6 +152,8 @@ def forward(
     return_hidden: bool = False,
     token_mask: jnp.ndarray | None = None,  # (B,S) bool; False = pad tokens
     return_stats: bool = False,
+    return_routing: bool = False,           # stats["routing"] (Lm, B*S, K)
+    routing_override: jnp.ndarray | None = None,  # replay a captured routing
 ) -> tuple:
     """Returns (logits-or-hidden, aux_loss[, stats]).
 
@@ -159,6 +161,12 @@ def forward(
     `apply_gate_bias_update` after the optimizer step for DeepSeek aux-free
     balancing (reference: train_ft.py:1164 `update_moe_gate_bias`) and to
     moe load-balance metrics.
+
+    Routing replay (R3, reference: components/moe/router_replay.py): run
+    once with `return_routing=True`, pass stats["routing"] back as
+    `routing_override` on the training forward — the discrete expert
+    selection is pinned while scores/weights recompute from live router
+    weights (RL rollout/training mismatch removal).
     """
     from automodel_tpu.models.common.layers import cast_params
 
@@ -178,27 +186,36 @@ def forward(
     Lm, E = cfg.num_moe_layers, cfg.moe.n_routed_experts
 
     def dense_layer(carry, lp, window):
-        h, aux, stats = carry
+        h, *rest = carry
         h = attention_block(h, lp, cfg, positions, segment_ids, inv_freq, constrain, window, mesh_ctx)
         h = mlp_block(h, lp, cfg, constrain)
-        return (h, aux, stats)
+        return (h, *rest)
+
+    K = cfg.moe.experts_per_token
+    replay = routing_override is not None
 
     def moe_layer(carry, xs, window):
-        h, aux, stats = carry
+        h, aux, stats, routing = carry
         lp, idx = xs
         h = attention_block(h, lp, cfg, positions, segment_ids, inv_freq, constrain, window, mesh_ctx)
         x = rms_norm(h, lp["post_attn_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
+        forced = routing_override[idx] if replay else None
         moe_out, layer_aux, layer_stats = moe_forward(
-            lp["moe"], cfg.moe, x, constrain, token_mask=token_mask, mesh_ctx=mesh_ctx
+            lp["moe"], cfg.moe, x, constrain, token_mask=token_mask,
+            mesh_ctx=mesh_ctx, forced_indices=forced,
         )
         h = constrain(h + moe_out, ("act_batch", "act_seq", "act_embed"))
         stats = jax.lax.dynamic_update_index_in_dim(
             stats, layer_stats["tokens_per_expert"], idx, 0
         )
-        return (h, aux + layer_aux, stats)
+        routing = jax.lax.dynamic_update_index_in_dim(
+            routing, layer_stats["indices"], idx, 0
+        )
+        return (h, aux + layer_aux, stats, routing)
 
     stats0 = jnp.zeros((Lm, E), jnp.float32)
-    carry = (h, jnp.float32(0.0), stats0)
+    routing0 = jnp.zeros((Lm, B * S, K), jnp.int32)
+    carry = (h, jnp.float32(0.0), stats0, routing0)
     if cfg.first_k_dense > 0:
         carry = scan_layers_windowed(
             dense_layer, carry, params["dense_layers"], windows[: cfg.first_k_dense],
@@ -210,12 +227,15 @@ def forward(
         windows[cfg.first_k_dense :],
         remat_policy=cfg.remat_policy, unroll=cfg.scan_unroll,
     )
-    h, aux_loss, tokens_per_expert = carry
+    h, aux_loss, tokens_per_expert, routing = carry
 
     h = rms_norm(h, params["final_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
     out = h if return_hidden else unembed(params, cfg, h)
     if return_stats:
-        return out, aux_loss, {"tokens_per_expert": tokens_per_expert}
+        stats_out = {"tokens_per_expert": tokens_per_expert}
+        if return_routing:
+            stats_out["routing"] = routing
+        return out, aux_loss, stats_out
     return out, aux_loss
 
 

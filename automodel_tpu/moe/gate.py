@@ -59,8 +59,15 @@ def gate_forward(
     cfg: MoEConfig,
     x: jnp.ndarray,  # (T, H)
     token_mask: jnp.ndarray | None = None,  # (T,) bool; False = pad/ignored
+    forced_indices: jnp.ndarray | None = None,  # (T,K) — routing replay (R3)
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, dict]:
     """Route tokens. Returns (weights (T,K), indices (T,K), aux_loss, stats).
+
+    `forced_indices` replays a previously captured top-k selection
+    (reference: components/moe/router_replay.py — rollout/training routing
+    mismatch in RL): only the DISCRETE selection is replayed; scores and
+    weights are recomputed from the live router, so router gradients flow.
+    Entries == E (the invalid slot from a masked capture) stay invalid.
 
     aux_loss is the switch-style load-balancing loss
     E * sum_e(fraction_tokens_e * mean_prob_e), matching the reference's
@@ -105,8 +112,16 @@ def gate_forward(
         gmask = _group_limited_mask(select_scores, cfg)
         select_scores = jnp.where(gmask > 0, select_scores, -jnp.inf)
 
-    _, indices = jax.lax.top_k(select_scores, K)          # (T, K)
+    if forced_indices is not None:
+        indices = jnp.clip(forced_indices.astype(jnp.int32), 0, E - 1)
+        replay_invalid = forced_indices >= E
+    else:
+        _, indices = jax.lax.top_k(select_scores, K)      # (T, K)
+        replay_invalid = None
     weights = jnp.take_along_axis(scores, indices, axis=-1)  # weight by raw score
+    if replay_invalid is not None:
+        weights = jnp.where(replay_invalid, 0.0, weights)
+        indices = jnp.where(replay_invalid, E, indices)  # keep the invalid slot
     if cfg.norm_topk_prob:
         weights = weights / jnp.maximum(weights.sum(-1, keepdims=True), 1e-20)
     weights = weights * cfg.route_scale

@@ -77,12 +77,17 @@ def moe_forward(
     constrain=None,
     token_mask: jnp.ndarray | None = None,  # (B, S) bool
     mesh_ctx=None,
+    forced_indices: jnp.ndarray | None = None,  # (B*S, K) routing replay
 ) -> tuple[jnp.ndarray, jnp.ndarray, dict]:
-    """Returns (out (B,S,H), aux_loss scalar, stats)."""
+    """Returns (out (B,S,H), aux_loss scalar, stats). stats["indices"] is
+    the (T,K) selection — capture it for routing replay (R3)."""
     B, S, H = x.shape
     flat = x.reshape(B * S, H)
     flat_mask = token_mask.reshape(B * S) if token_mask is not None else None
-    weights, indices, aux_loss, stats = gate_forward(params["gate"], cfg, flat, flat_mask)
+    weights, indices, aux_loss, stats = gate_forward(
+        params["gate"], cfg, flat, flat_mask, forced_indices
+    )
+    stats = {**stats, "indices": indices}
     if cfg.dispatcher == "dropless":
         if mesh_ctx is not None and mesh_ctx.sizes["ep"] > 1:
             routed = experts_forward_dropless_ep(

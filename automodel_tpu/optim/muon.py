@@ -107,23 +107,25 @@ class MuonConfig:
             mask=lambda p: jax.tree.map(lambda x: x.ndim >= 2, p),
         )
 
-        def labeler(params):
-            flat = jax.tree_util.tree_flatten_with_path(params)[0]
-            labels = {}
-            for path, leaf in flat:
-                keys = [str(getattr(k, "key", k)) for k in path]
-                is_matrix = leaf.ndim >= 2
-                # any embedding-like table (embed/pos_embed/patch_embed/
-                # lm_head/…) stays on AdamW, per Muon's exclusions
-                is_embed = any(("embed" in k) or k == "lm_head" for k in keys)
-                labels["/".join(keys)] = (
-                    "muon" if (is_matrix and not is_embed) else "adamw"
-                )
-            # rebuild tree structure
-            tree = jax.tree_util.tree_unflatten(
-                jax.tree_util.tree_structure(params),
-                [labels["/".join(str(getattr(k, "key", k)) for k in p)] for p, _ in flat],
-            )
-            return tree
+        return optax.multi_transform(
+            {"muon": muon_tx, "adamw": adamw_tx},
+            lambda p: matrix_param_labeler(p, "muon"),
+        )
 
-        return optax.multi_transform({"muon": muon_tx, "adamw": adamw_tx}, labeler)
+
+def matrix_param_labeler(params, matrix_label: str = "muon"):
+    """`matrix_label` for ndim≥2 non-embedding params, 'adamw' otherwise —
+    the Muon/Dion split (embedding-like tables and lm_head excluded per
+    the Muon authors; shared with optim/dion.py). The label doubles as an
+    optimizer-state pytree key, so each optimizer keeps its own name for
+    checkpoint compatibility."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    labels = []
+    for path, leaf in flat:
+        keys = [str(getattr(k, "key", k)) for k in path]
+        is_matrix = leaf.ndim >= 2
+        is_embed = any(("embed" in k) or k == "lm_head" for k in keys)
+        labels.append(matrix_label if (is_matrix and not is_embed) else "adamw")
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(params), labels
+    )

@@ -30,12 +30,20 @@ class OptimizerConfig:
     eps: float = 1e-8
     weight_decay: float = 0.1
     momentum: float = 0.9  # sgd only
-    adamw_lr: float = 3e-4  # muon only: lr for the non-matrix (adamw) params
+    adamw_lr: float = 3e-4  # muon/dion: lr for the non-matrix (adamw) params
+    dion_rank: int = 16     # dion only: power-iteration rank
     decay_mask: Optional[Callable] = dataclasses.field(default=None, repr=False)
+    # per-group hyperparameter overrides, first match wins (the analog of the
+    # reference's param-group machinery, optim/optimizer.py:80):
+    #   param_groups: [{pattern: "embed", lr_mult: 0.1, weight_decay: 0.0}]
+    # `pattern` is a substring/regex over the slash-joined param path.
+    param_groups: tuple = ()
 
     def build(self, lr_schedule: "float | Callable" = None) -> optax.GradientTransformation:
         lr = lr_schedule if lr_schedule is not None else self.lr
         mask = self.decay_mask or default_weight_decay_mask
+        if self.param_groups:
+            return self._build_grouped(lr)
         if self.name in ("adamw", "fused_adamw", "flash_adamw"):
             return optax.adamw(
                 lr, b1=self.betas[0], b2=self.betas[1], eps=self.eps,
@@ -50,15 +58,22 @@ class OptimizerConfig:
         if self.name == "lion":
             return optax.lion(lr, b1=self.betas[0], b2=self.betas[1], weight_decay=self.weight_decay)
         if self.name in ("muon", "dion"):
-            from automodel_tpu.optim.muon import MuonConfig
-
             # the adamw half (embeddings/norms/biases) follows the SAME
-            # schedule shape, rescaled from the muon peak lr to adamw_lr
+            # schedule shape, rescaled from the matrix peak lr to adamw_lr
             if callable(lr):
                 ratio = self.adamw_lr / self.lr
                 adamw_sched = lambda step: lr(step) * ratio
             else:
                 adamw_sched = self.adamw_lr
+            if self.name == "dion":
+                from automodel_tpu.optim.dion import DionConfig
+
+                return DionConfig(
+                    lr=self.lr, rank=self.dion_rank, adamw_lr=self.adamw_lr,
+                    weight_decay=self.weight_decay, betas=self.betas,
+                ).build(lr_schedule=lr, adamw_schedule=adamw_sched)
+            from automodel_tpu.optim.muon import MuonConfig
+
             return MuonConfig(
                 lr=self.lr,
                 adamw_lr=self.adamw_lr,
@@ -66,6 +81,47 @@ class OptimizerConfig:
                 betas=self.betas,
             ).build(lr_schedule=lr, adamw_schedule=adamw_sched)
         raise ValueError(f"Unknown optimizer '{self.name}'")
+
+    def _build_grouped(self, lr) -> optax.GradientTransformation:
+        """Per-group lr/weight-decay overrides via multi_transform."""
+        import re
+
+        groups = [
+            g.to_dict() if hasattr(g, "to_dict") else dict(g)
+            for g in self.param_groups
+        ]
+        for g in groups:
+            if not g.get("pattern"):
+                raise ValueError(
+                    "optimizer.param_groups entries require a non-empty "
+                    f"'pattern' (got {g})"
+                )
+        txs = {"__default__": dataclasses.replace(self, param_groups=()).build(lr)}
+        for i, g in enumerate(groups):
+            lr_mult = float(g.get("lr_mult", 1.0))
+            glr = (lambda s, m=lr_mult: lr(s) * m) if callable(lr) else lr * lr_mult
+            base = dataclasses.replace(
+                self, param_groups=(),
+                weight_decay=float(g.get("weight_decay", self.weight_decay)),
+            )
+            txs[f"g{i}"] = base.build(glr)
+
+        def labeler(params):
+            flat = jax.tree_util.tree_flatten_with_path(params)[0]
+            labels = []
+            for path, _leaf in flat:
+                name = "/".join(str(getattr(k, "key", k)) for k in path)
+                label = "__default__"
+                for i, g in enumerate(groups):
+                    if re.search(str(g.get("pattern", "")), name):
+                        label = f"g{i}"
+                        break
+                labels.append(label)
+            return jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(params), labels
+            )
+
+        return optax.multi_transform(txs, labeler)
 
 
 @dataclasses.dataclass

@@ -27,7 +27,8 @@ import jax.numpy as jnp
 
 @dataclasses.dataclass(frozen=True)
 class LoRAConfig:
-    """(reference: _peft/lora.py:44 PeftConfig)."""
+    """(reference: _peft/lora.py:44 PeftConfig, quantization/qlora.py,
+    DoRA per arXiv:2402.09353)."""
 
     r: int = 16
     alpha: float = 32.0
@@ -35,10 +36,22 @@ class LoRAConfig:
     # regex alternative to target_modules (module-matcher DSL analog)
     match_pattern: str | None = None
     dtype: Any = jnp.float32
+    # DoRA: decompose W' = m · (W + ΔW)/‖W + ΔW‖_col with trainable
+    # per-output magnitudes m (init ‖W‖_col)
+    dora: bool = False
+    # QLoRA: store the frozen base weights int8 (absmax per output channel)
+    # and dequantize inside the jitted merge — base memory ÷4 vs fp32
+    quantize_base: str | None = None  # None | "int8"
 
     @property
     def scale(self) -> float:
         return self.alpha / self.r
+
+    def __post_init__(self):
+        if self.quantize_base not in (None, "int8"):
+            raise ValueError(
+                f"quantize_base must be None or 'int8', got {self.quantize_base}"
+            )
 
 
 def _path_str(path) -> str:
@@ -72,6 +85,11 @@ def init_lora(base_params: Any, cfg: LoRAConfig, rng: jax.Array) -> dict:
         )
         b = jnp.zeros((*lead, cfg.r, fan_out), cfg.dtype)
         lora[ps] = {"a": a, "b": b}
+        if cfg.dora:
+            # trainable magnitude = the base kernel's per-output column norm
+            lora[ps]["m"] = jnp.linalg.norm(
+                leaf.astype(jnp.float32), axis=-2
+            ).astype(cfg.dtype)
     if not lora:
         raise ValueError(
             f"LoRA matched no parameters (targets={cfg.target_modules}, "
@@ -101,23 +119,61 @@ def lora_param_shardings(lora: dict, base_shardings: Any, mesh_ctx) -> dict:
             "a": NamedSharding(mesh_ctx.mesh, PartitionSpec(*lead, in_ax, None)),
             "b": NamedSharding(mesh_ctx.mesh, PartitionSpec(*lead, None, out_ax)),
         }
+        if "m" in ab:  # DoRA magnitude: (*lead, out)
+            out[ps]["m"] = NamedSharding(
+                mesh_ctx.mesh, PartitionSpec(*lead, out_ax)
+            )
     return out
+
+
+def quantize_base(base_params: Any, cfg: LoRAConfig) -> Any:
+    """QLoRA base storage: every ndim≥2 kernel becomes {"q8", "sc"} — int8
+    absmax-quantized per output channel (reference: quantization/qlora.py;
+    nf4 replaced by the TPU-friendly int8 layout ops/quant.py uses)."""
+    if cfg.quantize_base is None:
+        return base_params
+
+    def walk(path, leaf):
+        if getattr(leaf, "ndim", 0) < 2 or not _path_str(path).endswith("kernel"):
+            return leaf
+        absmax = jnp.max(jnp.abs(leaf.astype(jnp.float32)), axis=-2, keepdims=True)
+        sc = jnp.maximum(absmax, 1e-12) / 127.0
+        q = jnp.clip(jnp.round(leaf / sc), -127, 127).astype(jnp.int8)
+        return {"q8": q, "sc": sc.astype(jnp.float32)}
+
+    return jax.tree_util.tree_map_with_path(walk, base_params)
+
+
+def _is_q8(x) -> bool:
+    return isinstance(x, dict) and set(x) == {"q8", "sc"}
 
 
 def merge_lora(base_params: Any, lora: dict, cfg: LoRAConfig) -> Any:
     """base + scale·A@B for every adapted kernel (einsum keeps stacked
-    leading layer dims intact). Runs under jit — fused with the bf16 cast."""
+    leading layer dims intact). Runs under jit — fused with the bf16 cast.
+    int8-quantized base leaves dequantize in the same fusion; DoRA
+    renormalizes columns and applies the trainable magnitude."""
     scale = cfg.scale
 
     def walk(path, leaf):
         ps = _path_str(path)
+        if _is_q8(leaf):
+            leaf = (leaf["q8"].astype(jnp.float32) * leaf["sc"]).astype(cfg.dtype)
         if ps not in lora:
             return leaf
         a, b = lora[ps]["a"], lora[ps]["b"]
         delta = jnp.einsum("...ir,...ro->...io", a, b) * scale
-        return leaf + delta.astype(leaf.dtype)
+        merged = leaf + delta.astype(leaf.dtype)
+        if cfg.dora:
+            norm = jnp.linalg.norm(merged.astype(jnp.float32), axis=-2, keepdims=True)
+            merged = (
+                lora[ps]["m"][..., None, :] * merged / jnp.maximum(norm, 1e-8)
+            ).astype(leaf.dtype)
+        return merged
 
-    return jax.tree_util.tree_map_with_path(walk, base_params)
+    return jax.tree_util.tree_map_with_path(
+        walk, base_params, is_leaf=_is_q8
+    )
 
 
 def merged_state_dict(base_params: Any, lora: dict, cfg: LoRAConfig) -> Any:

@@ -227,8 +227,13 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                 self.peft_cfg = dataclasses.replace(
                     self.peft_cfg, target_modules=tuple(peft_node.get("target_modules"))
                 )
-            self.base_params = params  # frozen, outside the optimizer
             lora = init_lora(params, self.peft_cfg, self.rng.next_key())
+            if self.peft_cfg.quantize_base:
+                from automodel_tpu.peft.lora import quantize_base
+
+                params = quantize_base(params, self.peft_cfg)
+                logger.info("QLoRA: base weights stored %s", self.peft_cfg.quantize_base)
+            self.base_params = params  # frozen, outside the optimizer
             lora_sh = lora_param_shardings(lora, self.param_shardings, self.mesh_ctx)
             params = jax.device_put(lora, lora_sh)
             n_lora = sum(p.size for p in jax.tree.leaves(params))

@@ -604,3 +604,49 @@ def test_dropless_ep_full_decoder_train_step():
     assert np.isfinite(float(val))
     for leaf in jax.tree.leaves(g):
         assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_router_replay_pins_selection():
+    """R3 (reference: moe/router_replay.py): capture the routing on one
+    forward, replay it on another — selection identical even after the
+    router weights change, weights recomputed live, grads flow."""
+    params = moe_decoder.init(MOE_LM, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(9), (2, 8), 0, 64)
+
+    _, _, stats = moe_decoder.forward(params, MOE_LM, ids, return_stats=True,
+                                      return_routing=True)
+    routing = stats["routing"]
+    assert routing.shape[0] == MOE_LM.num_moe_layers
+
+    # replay on the same weights: identical logits
+    out0, _ = moe_decoder.forward(params, MOE_LM, ids)
+    out1, _, st1 = moe_decoder.forward(
+        params, MOE_LM, ids, return_stats=True, return_routing=True,
+        routing_override=routing,
+    )
+    np.testing.assert_allclose(np.asarray(out0), np.asarray(out1), rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(st1["routing"]), np.asarray(routing))
+
+    # perturb the router weights hard: natural routing changes, replay doesn't
+    p2 = jax.tree.map(lambda x: x, params)
+    gate = p2["moe_layers"]["moe"]["gate"]
+    p2["moe_layers"]["moe"] = {
+        **p2["moe_layers"]["moe"],
+        "gate": {**gate, "weight": gate["weight"][..., ::-1] * 3.0},
+    }
+    _, _, nat = moe_decoder.forward(p2, MOE_LM, ids, return_stats=True, return_routing=True)
+    assert not np.array_equal(np.asarray(nat["routing"]), np.asarray(routing))
+    _, _, rep = moe_decoder.forward(
+        p2, MOE_LM, ids, return_stats=True, return_routing=True,
+        routing_override=routing,
+    )
+    np.testing.assert_array_equal(np.asarray(rep["routing"]), np.asarray(routing))
+
+    # gradients still reach the router under replay
+    def loss(p):
+        out, aux = moe_decoder.forward(p, MOE_LM, ids, routing_override=routing)
+        return jnp.mean(out**2) + aux
+
+    g = jax.grad(loss)(params)
+    gw = g["moe_layers"]["moe"]["gate"]["weight"]
+    assert float(jnp.abs(gw).max()) > 0
