@@ -31,6 +31,8 @@ RECIPE_ALIASES = {
     "llm_seq_cls": "automodel_tpu.recipes.llm.train_seq_cls.TrainSeqClsRecipe",
     "retrieval_bi_encoder": "automodel_tpu.recipes.retrieval.train_bi_encoder.TrainBiEncoderRecipe",
     "retrieval_cross_encoder": "automodel_tpu.recipes.retrieval.train_cross_encoder.TrainCrossEncoderRecipe",
+    "retrieval_distill_bi_encoder": "automodel_tpu.recipes.retrieval.distill_bi_encoder.DistillBiEncoderRecipe",
+    "retrieval_mine_hard_negatives": "automodel_tpu.recipes.retrieval.mine_hard_negatives.MineHardNegativesRecipe",
 }
 
 

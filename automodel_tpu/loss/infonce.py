@@ -39,3 +39,10 @@ def mean_pool(hidden: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
     """Masked mean pooling (B,S,H) → (B,H)."""
     m = mask.astype(hidden.dtype)[..., None]
     return jnp.sum(hidden * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
+
+
+def normalized_mean_pool(hidden: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """mean_pool + L2 normalization — the shared embedding head of the
+    bi-encoder recipes (train/distill/mining use ONE definition)."""
+    e = mean_pool(hidden, mask)
+    return e / jnp.maximum(jnp.linalg.norm(e, axis=-1, keepdims=True), 1e-8)

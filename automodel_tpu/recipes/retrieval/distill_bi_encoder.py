@@ -1,0 +1,129 @@
+"""Bi-encoder distillation: student embeddings match a frozen teacher's
+in-batch similarity distributions.
+
+The analog of the reference recipe (reference: nemo_automodel/recipes/
+retrieval/distill_bi_encoder.py): both encoders embed the same
+query/document batch; the loss is KL(teacher‖student) between the row-wise
+softmaxed similarity matrices at their respective temperatures, optionally
+mixed with the hard InfoNCE objective. The teacher rides the jitted step
+as a pass-through extra arg like the KD teacher.
+
+YAML adds (on top of the bi-encoder recipe):
+
+    teacher_model: {hf_config: {...} | pretrained_path, dtype: ...}
+    distill: {weight: 1.0, teacher_temperature: 0.05, infonce_weight: 0.0}
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.checkpoint import HFCheckpointReader, get_adapter
+from automodel_tpu.config import ConfigNode
+from automodel_tpu.loss.infonce import info_nce_loss, normalized_mean_pool
+from automodel_tpu.models.registry import get_model_spec
+from automodel_tpu.parallel import logical_to_shardings
+from automodel_tpu.recipes.llm.train_ft import _DTYPES
+from automodel_tpu.recipes.retrieval.train_bi_encoder import TrainBiEncoderRecipe
+
+logger = logging.getLogger(__name__)
+
+
+class DistillBiEncoderRecipe(TrainBiEncoderRecipe):
+    def _build_model(self) -> None:
+        if self.cfg.get("peft") is not None:
+            raise NotImplementedError(
+                "distill_bi_encoder + PEFT not supported: the teacher occupies "
+                "the step's extra-args slot the LoRA base weights would use"
+            )
+        super()._build_model()
+        cfg = self.cfg
+        tcfg = cfg.get("teacher_model")
+        if tcfg is None:
+            raise ValueError("distill recipe requires a `teacher_model:` section")
+        dtype = _DTYPES[tcfg.get("dtype", "float32")]
+        pretrained = tcfg.get("pretrained_path", None)
+        if pretrained:
+            reader = HFCheckpointReader(pretrained)
+            hf_config = reader.hf_config()
+        else:
+            reader = None
+            hf_config = tcfg.get("hf_config")
+            hf_config = (
+                hf_config.to_dict() if isinstance(hf_config, ConfigNode) else dict(hf_config)
+            )
+        self.teacher_spec = get_model_spec(hf_config)
+        self.teacher_cfg = self.teacher_spec.config_from_hf(
+            hf_config, dtype=dtype, remat_policy=tcfg.get("remat_policy", "none")
+        )
+        if getattr(self.teacher_cfg, "moe", None) is not None:
+            raise NotImplementedError("MoE teacher encoders not wired yet")
+        import dataclasses
+
+        if self.teacher_cfg.causal:
+            self.teacher_cfg = dataclasses.replace(self.teacher_cfg, causal=False)
+        module = self.teacher_spec.module
+        shapes = jax.eval_shape(lambda: module.init(self.teacher_cfg, jax.random.key(0)))
+        shardings = logical_to_shardings(
+            module.param_specs(self.teacher_cfg), self.mesh_ctx,
+            shapes=jax.tree.map(lambda p: p.shape, shapes),
+        )
+        if reader is not None:
+            adapter = get_adapter(self.teacher_spec.adapter_name, self.teacher_cfg)
+            self.teacher_params = adapter.from_hf(reader, shardings=shardings)
+        else:
+            self.teacher_params = jax.jit(
+                lambda k: module.init(self.teacher_cfg, k), out_shardings=shardings
+            )(jax.random.key(int(cfg.get("teacher_seed", 7))))
+        self.teacher_params = jax.tree.map(
+            lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p,
+            self.teacher_params,
+        )
+
+    def _make_loss_fn(self):
+        cfg = self.cfg
+        module = self.model_spec.module
+        model_cfg = self.model_cfg
+        t_module = self.teacher_spec.module
+        t_cfg = self.teacher_cfg
+        mesh_ctx = self.mesh_ctx
+        temperature = float(cfg.get("retrieval.temperature", 0.05))
+        t_temp = float(cfg.get("distill.teacher_temperature", 0.05))
+        distill_w = float(cfg.get("distill.weight", 1.0))
+        infonce_w = float(cfg.get("distill.infonce_weight", 0.0))
+
+        def embed(mod, mcfg, p, ids, mask):
+            hidden = mod.forward(
+                p, mcfg, ids, segment_ids=mask.astype(jnp.int32),
+                return_hidden=True, mesh_ctx=mesh_ctx,
+            )
+            return normalized_mean_pool(hidden, mask)
+
+        def loss_fn(params, batch, rng, teacher_params):
+            ids = jnp.concatenate([batch["query_ids"], batch["doc_ids"]], axis=0)
+            mask = jnp.concatenate([batch["query_mask"], batch["doc_mask"]], axis=0)
+            B = batch["query_ids"].shape[0]
+
+            s = embed(module, model_cfg, params, ids, mask)
+            t = jax.lax.stop_gradient(
+                embed(t_module, t_cfg, teacher_params, ids, mask)
+            )
+            sq, sd = s[:B], s[B:]
+            tq, td = t[:B], t[B:]
+
+            s_logits = (sq @ sd.T) / temperature          # (B, B)
+            t_probs = jax.nn.softmax((tq @ td.T) / t_temp, axis=-1)
+            kl = -jnp.sum(t_probs * jax.nn.log_softmax(s_logits, axis=-1), -1)
+            loss = distill_w * jnp.sum(kl)
+            if infonce_w > 0.0:
+                hard, _ = info_nce_loss(sq, sd, temperature=temperature)
+                loss = loss + infonce_w * hard
+            return loss, {"num_label_tokens": jnp.float32(B)}
+
+        return loss_fn
+
+    def _step_extra(self) -> tuple:
+        return (self.teacher_params,)

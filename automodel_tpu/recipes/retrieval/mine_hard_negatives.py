@@ -1,0 +1,230 @@
+"""Hard-negative mining for bi-encoder training data.
+
+The analog of the reference `MineHardNegativesRecipe` (reference:
+nemo_automodel/recipes/retrieval/mine_hard_negatives.py:140): embed every
+query and corpus passage with a (trained) bi-encoder, score corpus chunks
+against all queries on-device, and keep the top-k most similar passages
+that are not positives and fall below the positive-score margin
+("abs": score < pos − margin; "perc": score < pos · margin), writing an
+augmented training JSONL.
+
+YAML:
+
+    recipe: retrieval_mine_hard_negatives
+    mining:
+      train_qa_file_path: qa.jsonl        # {query, pos_doc} per line
+      corpus_file_path: corpus.jsonl      # {doc} per line (fallback: pos docs)
+      train_file_output_path: out.jsonl
+      hard_negatives_to_mine: 4
+      hard_neg_margin: 0.95
+      hard_neg_margin_type: perc          # perc | abs
+      query_prefix: ""                    # e.g. "query: " (e5-style)
+      passage_prefix: ""
+      max_length: 256
+      batch_size: 32
+      corpus_chunk_size: 4096
+    model: {hf_config | pretrained_path, ...}
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from automodel_tpu.loss.infonce import normalized_mean_pool
+
+logger = logging.getLogger(__name__)
+
+
+class MineHardNegativesRecipe:
+    def __init__(self, cfg):
+        self.cfg = cfg
+
+    # -- setup ----------------------------------------------------------
+    def setup(self) -> None:
+        import dataclasses
+
+        from automodel_tpu.checkpoint import HFCheckpointReader, get_adapter
+        from automodel_tpu.config import ConfigNode
+        from automodel_tpu.distributed import MeshConfig
+        from automodel_tpu.loggers.metric_logger import setup_logging
+        from automodel_tpu.models.auto_tokenizer import build_tokenizer
+        from automodel_tpu.models.registry import get_model_spec
+        from automodel_tpu.parallel import logical_to_shardings
+        from automodel_tpu.recipes.llm.train_ft import _DTYPES
+
+        setup_logging()
+        cfg = self.cfg
+        m = cfg.get("mining")
+        if m is None or not m.get("train_qa_file_path") or not m.get("train_file_output_path"):
+            raise ValueError(
+                "mining.train_qa_file_path and mining.train_file_output_path are required"
+            )
+        self.m = m
+        self.mesh_ctx = MeshConfig.from_config(cfg.get("distributed")).build()
+
+        mcfg = cfg.get("model")
+        dtype = _DTYPES[mcfg.get("dtype", "float32")]
+        pretrained = mcfg.get("pretrained_path", None)
+        if pretrained:
+            reader = HFCheckpointReader(pretrained)
+            hf_config = reader.hf_config()
+        else:
+            reader = None
+            hf_config = mcfg.get("hf_config")
+            hf_config = hf_config.to_dict() if isinstance(hf_config, ConfigNode) else dict(hf_config)
+        self.spec = get_model_spec(hf_config)
+        self.model_cfg = self.spec.config_from_hf(hf_config, dtype=dtype, remat_policy="none")
+        if getattr(self.model_cfg, "moe", None) is not None:
+            raise NotImplementedError("mining with MoE encoders not wired yet")
+        if self.model_cfg.causal:
+            self.model_cfg = dataclasses.replace(self.model_cfg, causal=False)
+        module = self.spec.module
+        shapes = jax.eval_shape(lambda: module.init(self.model_cfg, jax.random.key(0)))
+        sh = logical_to_shardings(
+            module.param_specs(self.model_cfg), self.mesh_ctx,
+            shapes=jax.tree.map(lambda p: p.shape, shapes),
+        )
+        if reader is not None:
+            self.params = get_adapter(
+                self.spec.adapter_name, self.model_cfg, **self.spec.adapter_kwargs
+            ).from_hf(reader, shardings=sh)
+        else:
+            self.params = jax.jit(
+                lambda k: module.init(self.model_cfg, k), out_shardings=sh
+            )(jax.random.key(int(cfg.get("seed", 0))))
+        tok_path = cfg.get("tokenizer.pretrained_path", None) or m.get(
+            "tokenizer_name_or_path", None
+        ) or mcfg.get("pretrained_path", None)
+        if tok_path is None:
+            raise ValueError(
+                "mining requires tokenizer.pretrained_path (or "
+                "mining.tokenizer_name_or_path)"
+            )
+        self.tokenizer = build_tokenizer(tok_path)
+
+        @jax.jit
+        def _embed(params, ids, mask):
+            hidden = module.forward(
+                params, self.model_cfg, ids,
+                segment_ids=mask.astype(jnp.int32),
+                return_hidden=True, mesh_ctx=self.mesh_ctx,
+            )
+            return normalized_mean_pool(hidden, mask)
+
+        self._embed = _embed
+
+    # -- embedding ------------------------------------------------------
+    def _encode(self, texts: list, prefix: str, max_len: int, bs: int) -> np.ndarray:
+        outs = []
+        for i in range(0, len(texts), bs):
+            chunk = [prefix + t for t in texts[i : i + bs]]
+            pad = bs - len(chunk)
+            chunk = chunk + [""] * pad
+            tok = self.tokenizer(
+                chunk, padding="max_length", truncation=True,
+                max_length=max_len, return_tensors="np",
+            )
+            e = self._embed(
+                self.params,
+                jnp.asarray(tok["input_ids"], jnp.int32),
+                jnp.asarray(tok["attention_mask"], jnp.int32),
+            )
+            outs.append(np.asarray(e)[: bs - pad])
+        return np.concatenate(outs) if outs else np.zeros((0, 1))
+
+    # -- mining ---------------------------------------------------------
+    def run(self) -> str:
+        m = self.m
+        k = int(m.get("hard_negatives_to_mine", 4))
+        margin = m.get("hard_neg_margin", None)
+        margin_type = str(m.get("hard_neg_margin_type", "perc")).lower()
+        if margin is not None and margin_type not in ("perc", "abs"):
+            raise ValueError(f"hard_neg_margin_type must be perc|abs, got {margin_type}")
+        bs = int(m.get("batch_size", 32))
+        max_len = int(m.get("max_length", 256))
+        qp = str(m.get("query_prefix", "") or "")
+        pp = str(m.get("passage_prefix", "") or "")
+        chunk_size = int(m.get("corpus_chunk_size", 4096))
+
+        rows = [json.loads(line) for line in open(m.get("train_qa_file_path")) if line.strip()]
+        queries = [r["query"] for r in rows]
+        positives = [r.get("pos_doc", r.get("doc", "")) for r in rows]
+        corpus_path = m.get("corpus_file_path", None)
+        if corpus_path:
+            corpus = [json.loads(line)["doc"] for line in open(corpus_path) if line.strip()]
+        else:
+            corpus = list(dict.fromkeys(positives))  # dedup, keep order
+        logger.info("mining: %d queries, %d corpus passages", len(queries), len(corpus))
+
+        q_emb = self._encode(queries, qp, max_len, bs)
+
+        # Text-identity groups: excluding by a single index would mine exact
+        # duplicate passages of the positive as "hard negatives". Positives
+        # present in the corpus also reuse the chunk embeddings (no double
+        # encode); only corpus-absent positives are encoded separately.
+        text_gid: dict = {}
+        corpus_gid = np.asarray([text_gid.setdefault(t, len(text_gid)) for t in corpus])
+        pos_gid = np.asarray([text_gid.get(t, -1) for t in positives])
+        Q = len(queries)
+        pos_scores = np.full((Q,), -np.inf, np.float32)
+        missing = [i for i in range(Q) if pos_gid[i] < 0]
+        if missing:
+            p_emb = self._encode([positives[i] for i in missing], pp, max_len, bs)
+            pos_scores[missing] = np.sum(q_emb[missing] * p_emb, axis=-1)
+
+        best = np.full((Q, k), -np.inf, np.float32)
+        best_idx = np.full((Q, k), -1, np.int64)
+        # pass 1: embed once (the reference's embedding cache, in memory) and
+        # resolve positive scores; pass 2: sims recompute per chunk — memory
+        # stays O(corpus·H + Q·chunk), never O(Q·corpus)
+        chunk_embs = []
+        for start in range(0, len(corpus), chunk_size):
+            c_emb = self._encode(corpus[start : start + chunk_size], pp, max_len, bs)
+            idx = np.arange(start, start + c_emb.shape[0])
+            sims = q_emb @ c_emb.T                          # (Q, C)
+            is_pos = pos_gid[:, None] == corpus_gid[idx][None, :]
+            pos_hits = np.where(is_pos, sims, -np.inf).max(axis=1)
+            pos_scores = np.maximum(pos_scores, pos_hits)
+            chunk_embs.append((idx, c_emb))
+
+        for idx, c_emb in chunk_embs:
+            sims = q_emb @ c_emb.T
+            sims = np.where(
+                pos_gid[:, None] == corpus_gid[idx][None, :], -np.inf, sims
+            )
+            if margin is not None:
+                cap = (
+                    pos_scores * float(margin)
+                    if margin_type == "perc"
+                    else pos_scores - float(margin)
+                )
+                sims = np.where(sims >= cap[:, None], -np.inf, sims)
+            cat_s = np.concatenate([best, sims], axis=1)
+            cat_i = np.concatenate([best_idx, np.broadcast_to(idx, (Q, len(idx)))], axis=1)
+            top = np.argpartition(-cat_s, kth=min(k - 1, cat_s.shape[1] - 1), axis=1)[:, :k]
+            best = np.take_along_axis(cat_s, top, axis=1)
+            best_idx = np.take_along_axis(cat_i, top, axis=1)
+
+        out_path = m.get("train_file_output_path")
+        n_written = 0
+        with open(out_path, "w") as f:
+            for qi, row in enumerate(rows):
+                negs = [
+                    corpus[int(ci)]
+                    for ci, sc in sorted(
+                        zip(best_idx[qi], best[qi]), key=lambda t: -t[1]
+                    )
+                    if ci >= 0 and np.isfinite(sc)
+                ]
+                f.write(json.dumps({**row, "neg_docs": negs}) + "\n")
+                n_written += 1
+        logger.info("wrote %d rows with hard negatives to %s", n_written, out_path)
+        return out_path
+
+    def run_train_validation_loop(self) -> None:  # CLI entry contract
+        self.run()

@@ -145,3 +145,84 @@ def test_skip_nonfinite_updates():
     s2, m2 = step(s1, bad, jax.random.key(0))
     assert m2["skipped_nonfinite"] == 1.0
     np.testing.assert_array_equal(np.asarray(s2.params["w"]), np.asarray(s1.params["w"]))
+
+
+def test_distill_bi_encoder_matches_teacher(tmp_path):
+    """Distillation (reference: recipes/retrieval/distill_bi_encoder.py):
+    KL between in-batch similarity rows decreases as the student learns."""
+    cfg = _base(tmp_path, "retrieval_distill_bi_encoder")
+    cfg.set("dataset", {
+        "_target_": "automodel_tpu.datasets.mock.MockRetrievalDatasetConfig",
+        "num_samples": 64, "seq_len": 16, "vocab_size": 512,
+    })
+    cfg.set("teacher_model", {
+        "hf_config": {
+            "architectures": ["LlamaForCausalLM"],
+            "vocab_size": 512, "hidden_size": 32, "intermediate_size": 64,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "num_key_value_heads": 2,
+        },
+        "dtype": "float32",
+    })
+    cfg.set("distill", {"weight": 1.0, "teacher_temperature": 0.05})
+    cfg.set("step_scheduler.max_steps", 12)
+    cfg.set("step_scheduler.num_epochs", 4)
+    r = resolve_recipe_class(cfg)(cfg)
+    r.setup()
+    assert not r.teacher_cfg.causal
+    r.run_train_validation_loop()
+    recs = [json.loads(l) for l in open(tmp_path / "training.jsonl")]
+    assert recs[-1]["loss"] < recs[0]["loss"]
+
+
+def test_mine_hard_negatives_logic(tmp_path):
+    """Margin + top-k + own-positive exclusion with synthetic embeddings."""
+    import numpy as np
+
+    from automodel_tpu.config import ConfigNode
+    from automodel_tpu.recipes.retrieval.mine_hard_negatives import (
+        MineHardNegativesRecipe,
+    )
+
+    qa = tmp_path / "qa.jsonl"
+    corpus = tmp_path / "corpus.jsonl"
+    out = tmp_path / "out.jsonl"
+    docs = [f"doc{i}" for i in range(8)]
+    qa.write_text("\n".join(
+        json.dumps({"query": f"q{i}", "pos_doc": docs[i]}) for i in range(3)
+    ))
+    corpus.write_text("\n".join(json.dumps({"doc": d}) for d in docs))
+
+    r = MineHardNegativesRecipe(ConfigNode({
+        "mining": {
+            "train_qa_file_path": str(qa),
+            "corpus_file_path": str(corpus),
+            "train_file_output_path": str(out),
+            "hard_negatives_to_mine": 2,
+            "hard_neg_margin": 0.99,
+            "hard_neg_margin_type": "perc",
+            "corpus_chunk_size": 3,
+        },
+    }))
+    r.m = r.cfg.get("mining")
+
+    # deterministic embeddings: query i ≡ doc i; similarity = dot
+    emb = np.eye(8, 4, dtype=np.float32)
+    emb = emb + 0.1 * np.arange(8)[:, None] * np.ones((8, 4), np.float32)
+    emb = emb / np.linalg.norm(emb, axis=-1, keepdims=True)
+    table = {f"q{i}": emb[i] for i in range(3)}
+    table.update({d: emb[i] for i, d in enumerate(docs)})
+
+    r._encode = lambda texts, prefix, max_len, bs: np.stack(
+        [table[t] for t in texts]
+    )
+    r.run()
+    rows = [json.loads(l) for l in open(out)]
+    assert len(rows) == 3
+    for i, row in enumerate(rows):
+        assert len(row["neg_docs"]) <= 2
+        assert docs[i] not in row["neg_docs"]  # own positive excluded
+        # margin: every mined negative scores below 0.99 * positive score
+        pos = float(table[f"q{i}"] @ table[docs[i]])
+        for nd in row["neg_docs"]:
+            assert float(table[f"q{i}"] @ table[nd]) < 0.99 * pos
