@@ -1,0 +1,394 @@
+"""Mamba2 (SSD) selective-state-space family.
+
+The hybrid-Mamba building block of the reference's nemotron families
+(reference: nemo_automodel/components/models/nemotron_v3/layers.py mamba
+mixers; HF transformers Mamba2ForCausalLM is the numerical oracle).
+TPU-native: the mixer's selective scan runs as a `lax.scan` over the
+sequence carrying the (B, H, P, N) fp32 state
+
+    S_t = exp(Δ_t·A_h)·S_{t-1} + Δ_t · x_t ⊗ B_t
+    y_t = S_t C_t + D_h · x_t
+
+with the depthwise causal conv over the fused x|B|C channels and the
+gated RMSNorm (y·silu(z), then normalize) before out_proj. (The chunked
+SSD block form is the planned perf upgrade; the scan is the correctness
+baseline with static shapes.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.layers import dense_init
+from automodel_tpu.ops.norms import rms_norm
+
+
+@dataclasses.dataclass
+class Mamba2Config:
+    vocab_size: int
+    hidden_size: int
+    num_layers: int
+    state_size: int = 128
+    num_heads: int = 8
+    head_dim: int = 64
+    n_groups: int = 1
+    conv_kernel: int = 4
+    expand: int = 2
+    use_conv_bias: bool = True
+    use_bias: bool = False
+    residual_in_fp32: bool = True
+    time_step_limit: tuple = (0.0, float("inf"))
+    rms_norm_eps: float = 1e-5
+    tie_word_embeddings: bool = True
+    logits_soft_cap: Optional[float] = None
+    dtype: jnp.dtype = jnp.float32
+    remat_policy: Optional[str] = "full"
+    scan_unroll: int = 1
+    mtp_num_layers: int = 0  # chassis compatibility
+
+    @property
+    def intermediate_size(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def conv_dim(self) -> int:
+        return self.intermediate_size + 2 * self.n_groups * self.state_size
+
+    def flops_per_token(self, seq_len: int) -> float:
+        H, I = self.hidden_size, self.intermediate_size
+        per_layer = (
+            H * (2 * I + 2 * self.n_groups * self.state_size + self.num_heads)
+            + I * H
+            + 2 * I * self.state_size  # state update + readout
+        )
+        n = self.vocab_size * H * (1 if self.tie_word_embeddings else 2)
+        return 6.0 * (n + self.num_layers * per_layer)
+
+
+def from_hf_config(hf: dict, dtype=jnp.float32, remat_policy="full", **overrides) -> Mamba2Config:
+    overrides = {
+        k: v for k, v in overrides.items()
+        if k in {f.name for f in dataclasses.fields(Mamba2Config)}
+    }
+    tsl = hf.get("time_step_limit") or (0.0, float("inf"))
+    return Mamba2Config(
+        vocab_size=int(hf["vocab_size"]),
+        hidden_size=int(hf["hidden_size"]),
+        num_layers=int(hf["num_hidden_layers"]),
+        state_size=int(hf.get("state_size", 128)),
+        num_heads=int(hf.get("num_heads", 8)),
+        head_dim=int(hf.get("head_dim", 64)),
+        n_groups=int(hf.get("n_groups", 1)),
+        conv_kernel=int(hf.get("conv_kernel", 4)),
+        expand=int(hf.get("expand", 2)),
+        use_conv_bias=bool(hf.get("use_conv_bias", True)),
+        use_bias=bool(hf.get("use_bias", False)),
+        residual_in_fp32=bool(hf.get("residual_in_fp32", True)),
+        time_step_limit=tuple(tsl),
+        rms_norm_eps=float(hf.get("layer_norm_epsilon", hf.get("rms_norm_eps", 1e-5))),
+        tie_word_embeddings=bool(hf.get("tie_word_embeddings", True)),
+        dtype=dtype,
+        remat_policy=remat_policy,
+        **overrides,
+    )
+
+
+# ---------------------------------------------------------------------------
+# init / specs
+# ---------------------------------------------------------------------------
+def init(cfg: Mamba2Config, rng: jax.Array) -> dict:
+    H, I, Hd = cfg.hidden_size, cfg.intermediate_size, cfg.num_heads
+    L = cfg.num_layers
+    ks = jax.random.split(rng, 4)
+
+    def stack(k, shape):
+        return jnp.stack([dense_init(kk, shape) for kk in jax.random.split(k, L)])
+
+    proj_out = 2 * I + 2 * cfg.n_groups * cfg.state_size + Hd
+    layers = {
+        "norm": {"scale": jnp.ones((L, H))},
+        "in_proj": {"kernel": stack(ks[0], (H, proj_out))},
+        "conv": {"kernel": 0.2 * jax.random.normal(ks[1], (L, cfg.conv_kernel, cfg.conv_dim))},
+        "dt_bias": jnp.zeros((L, Hd)),
+        "A_log": jnp.log(jnp.broadcast_to(jnp.arange(1, Hd + 1, dtype=jnp.float32), (L, Hd))),
+        "D": jnp.ones((L, Hd)),
+        "gated_norm": {"scale": jnp.ones((L, I))},
+        "out_proj": {"kernel": stack(ks[2], (I, H))},
+    }
+    if cfg.use_conv_bias:
+        layers["conv"]["bias"] = jnp.zeros((L, cfg.conv_dim))
+    if cfg.use_bias:
+        layers["in_proj"]["bias"] = jnp.zeros((L, proj_out))
+        layers["out_proj"]["bias"] = jnp.zeros((L, H))
+    params = {
+        "embed": {"embedding": 0.02 * jax.random.normal(ks[3], (cfg.vocab_size, H))},
+        "layers": layers,
+        "final_norm": {"scale": jnp.ones((H,))},
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = {"kernel": dense_init(jax.random.fold_in(rng, 9), (H, cfg.vocab_size))}
+    return params
+
+
+def param_specs(cfg: Mamba2Config) -> dict:
+    layers = {
+        "norm": {"scale": ("layers", "norm")},
+        "in_proj": {"kernel": ("layers", "embed", "heads")},
+        "conv": {"kernel": ("layers", None, "heads")},
+        "dt_bias": ("layers", "heads"),
+        "A_log": ("layers", "heads"),
+        "D": ("layers", "heads"),
+        "gated_norm": {"scale": ("layers", "norm")},
+        "out_proj": {"kernel": ("layers", "heads", "embed")},
+    }
+    if cfg.use_conv_bias:
+        layers["conv"]["bias"] = ("layers", "heads")
+    if cfg.use_bias:
+        layers["in_proj"]["bias"] = ("layers", "heads")
+        layers["out_proj"]["bias"] = ("layers", "norm")
+    specs = {
+        "embed": {"embedding": ("vocab", "embed")},
+        "layers": layers,
+        "final_norm": {"scale": ("norm",)},
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = {"kernel": ("embed", "vocab")}
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# mixer
+# ---------------------------------------------------------------------------
+def selective_scan(x, dt, A, B, C, D, reset=None):
+    """Sequential SSD recurrence (HF `torch_forward` oracle semantics).
+
+    x (Bz,S,H,P); dt (Bz,S,H) post-softplus; A (H,) negative; B,C
+    (Bz,S,H,N) group-expanded; reset (Bz,S) bool zeroes the carried state
+    at packed-document heads. Returns (Bz,S,H,P) fp32.
+    """
+    Bz, S, Hd, P = x.shape
+    if reset is None:
+        reset = jnp.zeros((Bz, S), bool)
+
+    def step(state, xs):  # state (Bz,H,P,N)
+        x_t, dt_t, b_t, c_t, r_t = xs
+        state = jnp.where(r_t[:, None, None, None], 0.0, state)
+        da = jnp.exp(dt_t * A)[..., None, None]            # (Bz,H,1,1)
+        dbx = (dt_t[..., None] * x_t)[..., :, None] * b_t[..., None, :]
+        state = state * da + dbx
+        y = jnp.einsum("bhpn,bhn->bhp", state, c_t)
+        return state, y
+
+    xs = jax.tree.map(lambda v: jnp.moveaxis(v, 1, 0), (x, dt, B, C, reset))
+    s0 = jnp.zeros((Bz, Hd, P, C.shape[-1]), jnp.float32)
+    _, ys = jax.lax.scan(step, s0, xs)
+    y = jnp.moveaxis(ys, 0, 1)                             # (Bz,S,H,P)
+    return y + x * D[None, None, :, None]
+
+
+def _mixer(h, lp, cfg: Mamba2Config, segment_ids=None):
+    Bz, S, H = h.shape
+    I, N, G, Hd = cfg.intermediate_size, cfg.state_size, cfg.n_groups, cfg.num_heads
+    dtype = h.dtype
+
+    proj = h @ lp["in_proj"]["kernel"].astype(dtype)
+    if "bias" in lp["in_proj"]:
+        proj = proj + lp["in_proj"]["bias"].astype(dtype)
+    gate = proj[..., :I]
+    xbc = proj[..., I : I + cfg.conv_dim]
+    dt = proj[..., I + cfg.conv_dim :]                     # (Bz,S,Hd)
+
+    conv_w = lp["conv"]["kernel"].astype(dtype)            # (K, C)
+    if segment_ids is None:
+        xbc = jax.lax.conv_general_dilated(
+            xbc, conv_w[:, None, :], (1,), [(cfg.conv_kernel - 1, 0)],
+            dimension_numbers=("NWC", "WIO", "NWC"),
+            feature_group_count=cfg.conv_dim,
+        )
+    else:
+        # packed docs: the conv window must not reach into the previous
+        # document — unrolled K-tap form with a per-tap same-segment mask
+        # (the seq_idx-aware causal_conv1d of the reference)
+        K = cfg.conv_kernel
+        acc = xbc * conv_w[K - 1][None, None, :]
+        for j in range(1, K):
+            shifted = jnp.pad(xbc, ((0, 0), (j, 0), (0, 0)))[:, :S]
+            seg_j = jnp.pad(segment_ids, ((0, 0), (j, 0)))[:, :S]
+            same = (seg_j == segment_ids)[..., None].astype(dtype)
+            acc = acc + shifted * same * conv_w[K - 1 - j][None, None, :]
+        xbc = acc
+    if "bias" in lp["conv"]:
+        xbc = xbc + lp["conv"]["bias"].astype(dtype)
+    xbc = jax.nn.silu(xbc)
+
+    x = xbc[..., :I].reshape(Bz, S, Hd, cfg.head_dim).astype(jnp.float32)
+    B = xbc[..., I : I + G * N].reshape(Bz, S, G, N).astype(jnp.float32)
+    C = xbc[..., I + G * N :].reshape(Bz, S, G, N).astype(jnp.float32)
+    B = jnp.repeat(B, Hd // G, axis=2)
+    C = jnp.repeat(C, Hd // G, axis=2)
+
+    dt = jax.nn.softplus(dt.astype(jnp.float32) + lp["dt_bias"].astype(jnp.float32))
+    dt = jnp.clip(dt, cfg.time_step_limit[0], cfg.time_step_limit[1])
+    A = -jnp.exp(lp["A_log"].astype(jnp.float32))          # (Hd,)
+
+    reset = None
+    if segment_ids is not None:
+        prev = jnp.pad(segment_ids, ((0, 0), (1, 0)), constant_values=-1)[:, :S]
+        reset = segment_ids != prev
+    y = selective_scan(x, dt, A, B, C, lp["D"].astype(jnp.float32), reset)
+    y = y.reshape(Bz, S, I)
+    # HF MambaRMSNormGated: gate first, then normalize
+    y = y * jax.nn.silu(gate.astype(jnp.float32))
+    y = rms_norm(y, lp["gated_norm"]["scale"], cfg.rms_norm_eps)
+    out = y.astype(dtype) @ lp["out_proj"]["kernel"].astype(dtype)
+    if "bias" in lp["out_proj"]:
+        out = out + lp["out_proj"]["bias"].astype(dtype)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def forward(
+    params: dict,
+    cfg: Mamba2Config,
+    input_ids: jnp.ndarray,
+    *,
+    positions=None,
+    segment_ids=None,
+    mesh_ctx=None,
+    rules=None,
+    return_hidden: bool = False,
+) -> jnp.ndarray:
+    from automodel_tpu.models.common.layers import cast_params, maybe_remat
+
+    fp32 = {k: params["layers"][k] for k in ("A_log", "dt_bias", "D")}
+    params = cast_params(params, cfg.dtype)
+    params["layers"] = {**params["layers"], **fp32}
+
+    res_dtype = jnp.float32 if cfg.residual_in_fp32 else cfg.dtype
+    h = jnp.take(params["embed"]["embedding"], input_ids, axis=0).astype(res_dtype)
+
+    def body(c, lp):
+        x = rms_norm(c, lp["norm"]["scale"], cfg.rms_norm_eps).astype(cfg.dtype)
+        return c + _mixer(x, lp, cfg, segment_ids).astype(res_dtype), None
+
+    h, _ = jax.lax.scan(
+        maybe_remat(body, cfg.remat_policy), h, params["layers"],
+        unroll=cfg.scan_unroll,
+    )
+    h = rms_norm(h, params["final_norm"]["scale"], cfg.rms_norm_eps).astype(cfg.dtype)
+    if return_hidden:
+        return h
+    kernel = (
+        params["embed"]["embedding"].T
+        if cfg.tie_word_embeddings
+        else params["lm_head"]["kernel"]
+    )
+    return jnp.einsum(
+        "bsh,hv->bsv", h, kernel.astype(h.dtype), preferred_element_type=jnp.float32
+    )
+
+
+# ---------------------------------------------------------------------------
+# HF adapter (Mamba2ForCausalLM: backbone.* key layout)
+# ---------------------------------------------------------------------------
+class Mamba2Adapter:
+    def __init__(self, cfg: Mamba2Config):
+        self.cfg = cfg
+
+    def from_hf(self, read, shardings=None) -> dict:
+        import numpy as np
+
+        from automodel_tpu.checkpoint.hf_adapter import _get, _set
+
+        cfg = self.cfg
+        params: dict = {}
+
+        def put(path, value):
+            sh = _get(shardings, path) if shardings is not None else None
+            _set(params, path, jax.device_put(value, sh) if sh is not None else jnp.asarray(value))
+
+        put(("embed", "embedding"), read("backbone.embeddings.weight"))
+        put(("final_norm", "scale"), read("backbone.norm_f.weight"))
+        if not cfg.tie_word_embeddings:
+            put(("lm_head", "kernel"), np.ascontiguousarray(read("lm_head.weight").T))
+
+        L = cfg.num_layers
+        b = "backbone.layers.{}."
+
+        def stackT(fmt):
+            return np.stack([np.ascontiguousarray(read(fmt.format(i)).T) for i in range(L)])
+
+        def stack_(fmt):
+            return np.stack([read(fmt.format(i)) for i in range(L)])
+
+        put(("layers", "norm", "scale"), stack_(b + "norm.weight"))
+        put(("layers", "in_proj", "kernel"), stackT(b + "mixer.in_proj.weight"))
+        put(("layers", "conv", "kernel"), np.stack([
+            np.ascontiguousarray(read((b + "mixer.conv1d.weight").format(i))[:, 0, :].T)
+            for i in range(L)
+        ]))
+        if cfg.use_conv_bias:
+            put(("layers", "conv", "bias"), stack_(b + "mixer.conv1d.bias"))
+        if cfg.use_bias:
+            put(("layers", "in_proj", "bias"), stack_(b + "mixer.in_proj.bias"))
+            put(("layers", "out_proj", "bias"), stack_(b + "mixer.out_proj.bias"))
+        put(("layers", "dt_bias"), stack_(b + "mixer.dt_bias"))
+        put(("layers", "A_log"), stack_(b + "mixer.A_log"))
+        put(("layers", "D"), stack_(b + "mixer.D"))
+        put(("layers", "gated_norm", "scale"), stack_(b + "mixer.norm.weight"))
+        put(("layers", "out_proj", "kernel"), stackT(b + "mixer.out_proj.weight"))
+        return params
+
+    def to_hf(self, params):
+        """Yield (hf_name, tensor) — the inverse of from_hf (unstack layers,
+        transpose kernels, re-insert the conv depthwise axis)."""
+        import numpy as np
+
+        cfg = self.cfg
+
+        def g(*path):
+            node = params
+            for p in path:
+                node = node[p]
+            return np.asarray(jax.device_get(node))
+
+        yield "backbone.embeddings.weight", g("embed", "embedding")
+        yield "backbone.norm_f.weight", g("final_norm", "scale")
+        if not cfg.tie_word_embeddings:
+            yield "lm_head.weight", np.ascontiguousarray(g("lm_head", "kernel").T)
+        b = "backbone.layers.{}."
+        for i in range(cfg.num_layers):
+            yield (b + "norm.weight").format(i), g("layers", "norm", "scale")[i]
+            yield (b + "mixer.in_proj.weight").format(i), np.ascontiguousarray(
+                g("layers", "in_proj", "kernel")[i].T
+            )
+            yield (b + "mixer.conv1d.weight").format(i), np.ascontiguousarray(
+                g("layers", "conv", "kernel")[i].T
+            )[:, None, :]
+            if cfg.use_conv_bias:
+                yield (b + "mixer.conv1d.bias").format(i), g("layers", "conv", "bias")[i]
+            if cfg.use_bias:
+                yield (b + "mixer.in_proj.bias").format(i), g("layers", "in_proj", "bias")[i]
+                yield (b + "mixer.out_proj.bias").format(i), g("layers", "out_proj", "bias")[i]
+            yield (b + "mixer.dt_bias").format(i), g("layers", "dt_bias")[i]
+            yield (b + "mixer.A_log").format(i), g("layers", "A_log")[i]
+            yield (b + "mixer.D").format(i), g("layers", "D")[i]
+            yield (b + "mixer.norm.weight").format(i), g("layers", "gated_norm", "scale")[i]
+            yield (b + "mixer.out_proj.weight").format(i), np.ascontiguousarray(
+                g("layers", "out_proj", "kernel")[i].T
+            )
+
+
+def _register():
+    from automodel_tpu.checkpoint.hf_adapter import ADAPTERS
+
+    ADAPTERS["mamba2"] = Mamba2Adapter
+
+
+_register()

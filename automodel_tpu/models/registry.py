@@ -11,6 +11,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Callable, Mapping
 
+from automodel_tpu.models.hybrid import mamba2 as mamba2_module
 from automodel_tpu.models.hybrid import qwen3_next as qwen3_next_module
 from automodel_tpu.models.llm import decoder, families
 from automodel_tpu.models.moe_lm import decoder as moe_decoder
@@ -55,6 +56,9 @@ MODEL_ARCH_MAPPING: dict[str, ModelSpec] = {
     ),
     "LlamaBidirectionalForSequenceClassification": ModelSpec(
         "llama_bidirectional", families.llama_bidirectional_config, decoder
+    ),
+    "Mamba2ForCausalLM": ModelSpec(
+        "mamba2", mamba2_module.from_hf_config, mamba2_module, adapter_name="mamba2"
     ),
     "Qwen3NextForCausalLM": ModelSpec(
         "qwen3_next", qwen3_next_module.from_hf_config, qwen3_next_module,

@@ -200,3 +200,58 @@ def test_qwen3_next_sharded_matches_single_device():
     )
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=2e-3, atol=2e-3)
     np.testing.assert_allclose(float(ref_aux), float(aux), rtol=1e-4, atol=1e-6)
+
+
+def test_mamba2_logits_match_hf(tmp_path):
+    """Mamba2 SSD mixer (conv + selective scan + gated norm) vs the HF
+    torch oracle's naive SSD path."""
+    from transformers import Mamba2Config, Mamba2ForCausalLM
+
+    config = Mamba2Config(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        state_size=16, num_heads=4, head_dim=16, n_groups=2,
+        conv_kernel=4, expand=2, use_conv_bias=True, use_bias=False,
+        tie_word_embeddings=False,  # HF save_pretrained chokes on mamba2 tying
+    )
+    torch.manual_seed(11)
+    model = Mamba2ForCausalLM(config)
+    _save_hf_model(model, config, tmp_path)
+    ids = np.random.default_rng(11).integers(0, 128, (2, 12))
+    _compare(tmp_path, model, ids, atol=5e-4)
+
+
+def test_mamba2_segment_isolation_and_roundtrip(tmp_path):
+    """Packed docs: the SSM state and conv window reset at segment heads —
+    per-document outputs equal running each document alone. Plus a
+    to_hf→from_hf roundtrip."""
+    from automodel_tpu.models.hybrid import mamba2 as m2
+
+    hf = dict(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2, state_size=8,
+        num_heads=4, head_dim=16, n_groups=2, conv_kernel=4,
+        tie_word_embeddings=True,
+    )
+    cfg = m2.from_hf_config(hf, dtype=jnp.float32, remat_policy="none")
+    params = m2.init(cfg, jax.random.key(0))
+    rng = np.random.default_rng(2)
+    a = jnp.asarray(rng.integers(1, 64, (1, 6)), jnp.int32)
+    b = jnp.asarray(rng.integers(1, 64, (1, 10)), jnp.int32)
+    packed = jnp.concatenate([a, b], axis=1)
+    seg = jnp.asarray([[0] * 6 + [1] * 10], jnp.int32)
+
+    out_packed = m2.forward(params, cfg, packed, segment_ids=seg)
+    out_a = m2.forward(params, cfg, a)
+    out_b = m2.forward(params, cfg, b)
+    np.testing.assert_allclose(
+        np.asarray(out_packed[:, :6]), np.asarray(out_a), rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_packed[:, 6:]), np.asarray(out_b), rtol=1e-4, atol=1e-5
+    )
+
+    # adapter roundtrip: to_hf → dict reader → from_hf → identical logits
+    adapter = m2.Mamba2Adapter(cfg)
+    sd = {k: v for k, v in adapter.to_hf(params)}
+    params2 = adapter.from_hf(lambda name: sd[name])
+    out2 = m2.forward(params2, cfg, a)
+    np.testing.assert_allclose(np.asarray(out_a), np.asarray(out2), rtol=1e-6)
