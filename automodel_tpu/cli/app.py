@@ -27,6 +27,7 @@ RECIPE_ALIASES = {
     "llm_kd": "automodel_tpu.recipes.llm.kd.KDRecipeForNextTokenPrediction",
     "llm_train_eagle3": "automodel_tpu.recipes.llm.train_eagle3.TrainEagle3Recipe",
     "dllm_train_ft": "automodel_tpu.recipes.dllm.train_ft.DiffusionLMSFTRecipe",
+    "diffusion_train": "automodel_tpu.recipes.diffusion.train.TrainDiffusionRecipe",
     "vlm_finetune": "automodel_tpu.recipes.vlm.finetune.FinetuneRecipeForVLM",
     "llm_seq_cls": "automodel_tpu.recipes.llm.train_seq_cls.TrainSeqClsRecipe",
     "retrieval_bi_encoder": "automodel_tpu.recipes.retrieval.train_bi_encoder.TrainBiEncoderRecipe",

@@ -169,3 +169,42 @@ class MockRerankDataset:
             "pair_ids": np.stack(pairs),
             "pair_mask": np.ones((c.group_size, c.seq_len), np.int32),
         }
+
+
+@dataclasses.dataclass
+class MockLatentDatasetConfig:
+    """Synthetic diffusion latents — a fixed bank of patterns plus noise, so
+    a flow-matching model has real structure to learn (the mock analog of
+    the reference's cached-latent diffusion datasets)."""
+
+    num_samples: int = 512
+    latent_size: int = 16
+    channels: int = 4
+    num_classes: int = 0
+    num_patterns: int = 8
+    seed: int = 0
+
+    def build(self) -> "MockLatentDataset":
+        return MockLatentDataset(self)
+
+
+class MockLatentDataset:
+    def __init__(self, config: MockLatentDatasetConfig):
+        self.config = config
+        rng = np.random.default_rng(config.seed)
+        self.patterns = rng.normal(
+            0, 1, (config.num_patterns, config.latent_size, config.latent_size, config.channels)
+        ).astype(np.float32)
+
+    def __len__(self) -> int:
+        return self.config.num_samples
+
+    def __getitem__(self, idx: int) -> dict:
+        c = self.config
+        rng = np.random.default_rng(c.seed * 77003 + idx)
+        pid = idx % c.num_patterns
+        lat = self.patterns[pid] + 0.05 * rng.normal(0, 1, self.patterns[pid].shape)
+        out = {"latents": lat.astype(np.float32)}
+        if c.num_classes > 0:
+            out["class_labels"] = np.int32(pid % c.num_classes)
+        return out

@@ -1,0 +1,7 @@
+from automodel_tpu.diffusion.flow_matching import (  # noqa: F401
+    euler_sample,
+    flow_matching_loss,
+    interpolate,
+    sample_sigmas,
+    time_shift,
+)

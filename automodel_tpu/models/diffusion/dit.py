@@ -1,0 +1,212 @@
+"""DiT — diffusion transformer with adaLN-zero conditioning.
+
+The denoiser backbone for the diffusion recipe (the role the reference
+fills with diffusers transformers behind its flow-matching adapters,
+reference: components/flow_matching/adapters/, _diffusers/
+auto_diffusion_pipeline.py). TPU-native, same params-pytree + stacked-
+layer-scan shape as every model here:
+
+- patchify latents → tokens; learned pos embedding
+- conditioning vector c = MLP(sinusoidal(σ·1000)) [+ class embedding]
+- per block, adaLN-zero: (shift, scale, gate)×2 from c, gates zero-init so
+  every block starts as identity and the model output starts at zero
+- final adaLN + linear → unpatchify to the velocity field
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.layers import dense_init, maybe_remat
+from automodel_tpu.ops.attention import dot_product_attention
+
+
+@dataclasses.dataclass
+class DiTConfig:
+    input_size: int = 32          # latent H=W
+    patch_size: int = 2
+    in_channels: int = 4
+    hidden_size: int = 384
+    num_layers: int = 6
+    num_heads: int = 6
+    mlp_ratio: float = 4.0
+    num_classes: int = 0          # 0 = unconditional
+    dtype: jnp.dtype = jnp.float32
+    remat_policy: Optional[str] = "full"
+    scan_unroll: int = 1
+
+    @property
+    def num_patches(self) -> int:
+        return (self.input_size // self.patch_size) ** 2
+
+    @property
+    def mlp_dim(self) -> int:
+        return int(self.hidden_size * self.mlp_ratio)
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * self.in_channels
+
+    def flops_per_token(self, seq_len: int) -> float:
+        H = self.hidden_size
+        per_layer = 4 * H * H + 2 * H * self.mlp_dim + 6 * H * H  # attn+mlp+mod
+        return 6.0 * self.num_layers * per_layer
+
+
+def init(cfg: DiTConfig, rng: jax.Array) -> dict:
+    H, L, M = cfg.hidden_size, cfg.num_layers, cfg.mlp_dim
+    ks = jax.random.split(rng, 10)
+
+    def stack(k, shape):
+        return jnp.stack([dense_init(kk, shape) for kk in jax.random.split(k, L)])
+
+    params = {
+        "patch_embed": {
+            "kernel": dense_init(ks[0], (cfg.patch_dim, H)),
+            "bias": jnp.zeros((H,)),
+        },
+        "pos_embed": 0.02 * jax.random.normal(ks[1], (cfg.num_patches, H)),
+        "time_mlp": {
+            "w1": {"kernel": dense_init(ks[2], (256, H)), "bias": jnp.zeros((H,))},
+            "w2": {"kernel": dense_init(ks[3], (H, H)), "bias": jnp.zeros((H,))},
+        },
+        "layers": {
+            "qkv": {"kernel": stack(ks[4], (H, 3 * H))},
+            "attn_out": {"kernel": stack(ks[5], (H, H))},
+            "mlp_in": {"kernel": stack(ks[6], (H, M))},
+            "mlp_out": {"kernel": stack(ks[7], (M, H))},
+            # adaLN-zero modulation: 6H (shift/scale/gate ×2), zero-init
+            "mod": {
+                "kernel": jnp.zeros((L, H, 6 * H)),
+                "bias": jnp.zeros((L, 6 * H)),
+            },
+        },
+        "final": {
+            "mod": {"kernel": jnp.zeros((H, 2 * H)), "bias": jnp.zeros((2 * H,))},
+            "out": {"kernel": jnp.zeros((H, cfg.patch_dim)), "bias": jnp.zeros((cfg.patch_dim,))},
+        },
+    }
+    if cfg.num_classes > 0:
+        params["class_embed"] = {
+            "embedding": 0.02 * jax.random.normal(ks[8], (cfg.num_classes + 1, H))
+        }  # +1 = the CFG null class
+    return params
+
+
+def param_specs(cfg: DiTConfig) -> dict:
+    specs = {
+        "patch_embed": {"kernel": ("embed", None), "bias": (None,)},
+        "pos_embed": (None, "embed"),
+        "time_mlp": {
+            "w1": {"kernel": (None, "embed"), "bias": (None,)},
+            "w2": {"kernel": ("embed", None), "bias": (None,)},
+        },
+        "layers": {
+            "qkv": {"kernel": ("layers", "embed", "heads")},
+            "attn_out": {"kernel": ("layers", "heads", "embed")},
+            "mlp_in": {"kernel": ("layers", "embed", "mlp")},
+            "mlp_out": {"kernel": ("layers", "mlp", "embed")},
+            "mod": {"kernel": ("layers", "embed", None), "bias": ("layers", None)},
+        },
+        "final": {
+            "mod": {"kernel": ("embed", None), "bias": (None,)},
+            "out": {"kernel": ("embed", None), "bias": (None,)},
+        },
+    }
+    if cfg.num_classes > 0:
+        specs["class_embed"] = {"embedding": (None, "embed")}
+    return specs
+
+
+def _timestep_embedding(sigma: jnp.ndarray, dim: int = 256) -> jnp.ndarray:
+    """Sinusoidal embedding of σ·1000 (DiT convention)."""
+    t = sigma.astype(jnp.float32) * 1000.0
+    half = dim // 2
+    freqs = jnp.exp(-jnp.log(10000.0) * jnp.arange(half) / half)
+    ang = t[:, None] * freqs[None, :]
+    return jnp.concatenate([jnp.cos(ang), jnp.sin(ang)], axis=-1)
+
+
+def _ln(x, eps=1e-6):
+    """Parameter-free LayerNorm (adaLN supplies the affine)."""
+    m = jnp.mean(x, -1, keepdims=True)
+    v = jnp.var(x, -1, keepdims=True)
+    return (x - m) * jax.lax.rsqrt(v + eps)
+
+
+def _patchify(x: jnp.ndarray, p: int) -> jnp.ndarray:
+    B, Hh, Ww, C = x.shape
+    x = x.reshape(B, Hh // p, p, Ww // p, p, C)
+    return jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(B, (Hh // p) * (Ww // p), p * p * C)
+
+
+def _unpatchify(x: jnp.ndarray, p: int, hw: int, c: int) -> jnp.ndarray:
+    B, N, _ = x.shape
+    g = hw // p
+    x = x.reshape(B, g, g, p, p, c)
+    return jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(B, hw, hw, c)
+
+
+def forward(
+    params: dict,
+    cfg: DiTConfig,
+    latents: jnp.ndarray,         # (B, H, W, C) noisy input x_σ
+    sigma: jnp.ndarray,           # (B,)
+    class_labels: jnp.ndarray | None = None,  # (B,) int; num_classes = null
+    mesh_ctx=None,
+) -> jnp.ndarray:
+    """Predict the velocity field, same shape as `latents`."""
+    from automodel_tpu.models.common.layers import cast_params
+
+    params = cast_params(params, cfg.dtype)
+    B = latents.shape[0]
+    Hn = cfg.num_heads
+    D = cfg.hidden_size // Hn
+
+    x = _patchify(latents.astype(cfg.dtype), cfg.patch_size)
+    x = x @ params["patch_embed"]["kernel"] + params["patch_embed"]["bias"]
+    x = x + params["pos_embed"][None]
+
+    t = _timestep_embedding(sigma)
+    tm = params["time_mlp"]
+    c = jax.nn.silu(t.astype(cfg.dtype) @ tm["w1"]["kernel"] + tm["w1"]["bias"])
+    c = c @ tm["w2"]["kernel"] + tm["w2"]["bias"]
+    if cfg.num_classes > 0:
+        labels = (
+            class_labels
+            if class_labels is not None
+            else jnp.full((B,), cfg.num_classes, jnp.int32)
+        )
+        c = c + jnp.take(params["class_embed"]["embedding"], labels, axis=0)
+    c = jax.nn.silu(c)
+
+    def block(h, lp):
+        mod = c @ lp["mod"]["kernel"] + lp["mod"]["bias"]          # (B, 6H)
+        s1, sc1, g1, s2, sc2, g2 = jnp.split(mod[:, None, :], 6, axis=-1)
+        a_in = _ln(h) * (1 + sc1) + s1
+        qkv = (a_in @ lp["qkv"]["kernel"]).reshape(B, -1, 3, Hn, D)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = dot_product_attention(q, k, v, causal=False, impl="xla")
+        h = h + g1 * (attn.reshape(B, -1, Hn * D) @ lp["attn_out"]["kernel"])
+        m_in = _ln(h) * (1 + sc2) + s2
+        mlp = jax.nn.gelu(m_in @ lp["mlp_in"]["kernel"], approximate=True)
+        h = h + g2 * (mlp @ lp["mlp_out"]["kernel"])
+        return h, None
+
+    x, _ = jax.lax.scan(
+        maybe_remat(block, cfg.remat_policy), x, params["layers"],
+        unroll=cfg.scan_unroll,
+    )
+
+    fm = params["final"]
+    mod = c @ fm["mod"]["kernel"] + fm["mod"]["bias"]
+    s, sc = jnp.split(mod[:, None, :], 2, axis=-1)
+    x = _ln(x) * (1 + sc) + s
+    x = x @ fm["out"]["kernel"] + fm["out"]["bias"]
+    return _unpatchify(
+        x.astype(jnp.float32), cfg.patch_size, cfg.input_size, cfg.in_channels
+    )
