@@ -39,8 +39,6 @@ logger = logging.getLogger(__name__)
 class DiffusionLMSFTRecipe(TrainFinetuneRecipeForNextTokenPrediction):
     def _build_model(self) -> None:
         super()._build_model()
-        if self.is_moe:
-            raise NotImplementedError("dLLM over MoE backbones not wired yet")
         # bidirectional: the denoiser sees the whole noisy canvas
         import dataclasses
 
@@ -66,10 +64,15 @@ class DiffusionLMSFTRecipe(TrainFinetuneRecipeForNextTokenPrediction):
         )
 
     def _make_loss_fn(self):
+        from automodel_tpu.loss.utils import combine_losses
+        from automodel_tpu.recipes.llm.train_ft import make_hidden_forward
+
         cfg = self.cfg
-        module = self.model_spec.module
         model_cfg = self.model_cfg
-        mesh_ctx = self.mesh_ctx
+        peft_cfg = self.peft_cfg
+        fwd = make_hidden_forward(
+            self.model_spec.module, model_cfg, self.mesh_ctx, peft_cfg
+        )
         chunk = int(cfg.get("loss.chunk_size", 1024))
         mode = self.dllm_mode
         eps = self.dllm_eps
@@ -101,8 +104,10 @@ class DiffusionLMSFTRecipe(TrainFinetuneRecipeForNextTokenPrediction):
             for k in ("positions", "segment_ids"):
                 if k in batch:
                     kw[k] = batch[k]
-            hidden = module.forward(
-                params, model_cfg, noisy, return_hidden=True, mesh_ctx=mesh_ctx, **kw
+            base_params = extra[0] if peft_cfg is not None else None
+            params, hidden, aux, stats = fwd(
+                params, noisy,
+                base_params=base_params, token_mask=loss_mask, **kw,
             )
             kernel = (
                 params["embed"]["embedding"].T
@@ -116,11 +121,13 @@ class DiffusionLMSFTRecipe(TrainFinetuneRecipeForNextTokenPrediction):
             masked_frac = jnp.sum(noise_mask) / jnp.maximum(
                 jnp.sum(loss_mask.astype(jnp.float32)), 1.0
             )
+            total, n = combine_losses(ce_sum, n, aux)
             # scalar metrics are summed over grad-accum microbatches by the
             # train step; pre-divide so the logged value is the mean
-            return ce_sum, {
+            return total, {
                 "num_label_tokens": n,
                 "masked_fraction": masked_frac / accum,
+                **stats,
             }
 
         return loss_fn

@@ -35,13 +35,6 @@ logger = logging.getLogger(__name__)
 
 
 class KDRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPrediction):
-    def setup(self) -> None:
-        if self.cfg.get("peft") is not None:
-            raise NotImplementedError("KD+PEFT in one run is not supported yet")
-        super().setup()
-        if self.is_moe:
-            raise NotImplementedError("MoE students not wired into KD yet")
-
     # -- teacher -----------------------------------------------------------
     def _build_model(self) -> None:
         super()._build_model()
@@ -62,8 +55,6 @@ class KDRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPrediction):
         self.teacher_cfg = self.teacher_spec.config_from_hf(
             hf_config, dtype=dtype, remat_policy=tcfg.get("remat_policy", "full")
         )
-        if getattr(self.teacher_cfg, "moe", None) is not None:
-            raise NotImplementedError("MoE teachers not wired yet")
         module = self.teacher_spec.module
         shapes = jax.eval_shape(lambda: module.init(self.teacher_cfg, jax.random.key(0)))
         shardings = logical_to_shardings(
@@ -86,31 +77,41 @@ class KDRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPrediction):
 
     # -- loss --------------------------------------------------------------
     def _make_loss_fn(self):
+        from automodel_tpu.loss.utils import combine_losses
+        from automodel_tpu.recipes.llm.train_ft import make_hidden_forward
+
         cfg = self.cfg
         kd_ratio = float(cfg.get("kd.ratio", 0.5))
         temperature = float(cfg.get("kd.temperature", 1.0))
         chunk = int(cfg.get("loss.chunk_size", 1024))
-        student_module = self.model_spec.module
         student_cfg = self.model_cfg
-        teacher_module = self.teacher_spec.module
         teacher_cfg = self.teacher_cfg
-        mesh_ctx = self.mesh_ctx
+        peft_cfg = self.peft_cfg
+        student_fwd = make_hidden_forward(
+            self.model_spec.module, student_cfg, self.mesh_ctx, peft_cfg
+        )
+        teacher_fwd = make_hidden_forward(
+            self.teacher_spec.module, teacher_cfg, self.mesh_ctx
+        )
 
-        def kd_loss_fn(params, batch, rng, teacher_params):
+        def kd_loss_fn(params, batch, rng, *extra):
+            if peft_cfg is not None:
+                base_params, teacher_params = extra
+            else:
+                base_params, (teacher_params,) = None, extra
             kw = {}
             for k in ("positions", "segment_ids"):
                 if k in batch:
                     kw[k] = batch[k]
-            s_hidden = student_module.forward(
-                params, student_cfg, batch["input_ids"],
-                return_hidden=True, mesh_ctx=mesh_ctx, **kw,
+            token_mask = batch["labels"] != -100
+            params, s_hidden, s_aux, stats = student_fwd(
+                params, batch["input_ids"],
+                base_params=base_params, token_mask=token_mask, **kw,
             )
-            t_hidden = jax.lax.stop_gradient(
-                teacher_module.forward(
-                    teacher_params, teacher_cfg, batch["input_ids"],
-                    return_hidden=True, mesh_ctx=mesh_ctx, **kw,
-                )
+            _, t_hidden, _, _ = teacher_fwd(
+                teacher_params, batch["input_ids"], token_mask=token_mask, **kw
             )
+            t_hidden = jax.lax.stop_gradient(t_hidden)
             s_kernel = (
                 params["embed"]["embedding"].T
                 if student_cfg.tie_word_embeddings
@@ -127,9 +128,12 @@ class KDRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPrediction):
                 student_soft_cap=student_cfg.logits_soft_cap,
                 teacher_soft_cap=teacher_cfg.logits_soft_cap,
             )
-            return total, {"num_label_tokens": n}
+            total, n = combine_losses(total, n, s_aux)
+            return total, {"num_label_tokens": n, **stats}
 
         return kd_loss_fn
 
     def _step_extra(self) -> tuple:
+        if self.peft_cfg is not None:
+            return (self.base_params, self.teacher_params)
         return (self.teacher_params,)

@@ -70,6 +70,45 @@ logger = logging.getLogger(__name__)
 _DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32, "float16": jnp.float16}
 
 
+def make_hidden_forward(module, model_cfg, mesh_ctx, peft_cfg=None):
+    """Uniform backbone forward for recipes.
+
+    Hides the two signature forks every recipe otherwise has to handle —
+    the LoRA merge (PEFT trainable tree + frozen base) and the MoE forward
+    (aux loss + expert stats) — so PEFT × MoE composes in every recipe
+    instead of each one growing its own fences (the reference reaches the
+    same matrix through NeMoAutoModel wrappers, reference:
+    nemo_automodel/_transformers/auto_model.py).
+
+    Returns fwd(params, ids, base_params=None, token_mask=None, **kw)
+      -> (merged_params, hidden, moe_aux_or_None, extra_metrics)
+
+    merged_params is the EFFECTIVE parameter tree (post LoRA merge) — use it
+    for lm-head/embedding lookups so tied heads see the adapted weights.
+    """
+    is_moe = getattr(model_cfg, "moe", None) is not None
+
+    def fwd(params, ids, *, base_params=None, token_mask=None, **kw):
+        if peft_cfg is not None:
+            from automodel_tpu.peft.lora import merge_lora
+
+            params = merge_lora(base_params, params, peft_cfg)
+        if is_moe:
+            hidden, aux, stats = module.forward(
+                params, model_cfg, ids, return_hidden=True, return_stats=True,
+                mesh_ctx=mesh_ctx, token_mask=token_mask, **kw,
+            )
+            return params, hidden, aux, {
+                "tokens_per_expert": stats["tokens_per_expert"]
+            }
+        hidden = module.forward(
+            params, model_cfg, ids, return_hidden=True, mesh_ctx=mesh_ctx, **kw
+        )
+        return params, hidden, None, {}
+
+    return fwd
+
+
 def _dataclass_from_cfg(cls, node, **extra):
     kwargs = dict(extra)
     if node is not None:
@@ -290,30 +329,19 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         is_moe = self.is_moe
         peft_cfg = self.peft_cfg
 
-        def loss_fn(params, batch, rng, *extra):
-            if peft_cfg is not None:
-                from automodel_tpu.peft.lora import merge_lora
+        fwd = make_hidden_forward(module, model_cfg, mesh_ctx, peft_cfg)
 
-                (base_params,) = extra
-                params = merge_lora(base_params, params, peft_cfg)
+        def loss_fn(params, batch, rng, *extra):
+            base_params = extra[0] if peft_cfg is not None else None
             kw = {}
             for k in ("positions", "segment_ids"):
                 if k in batch:
                     kw[k] = batch[k]
-            extra = {}
-            if is_moe:
-                kw["token_mask"] = batch["labels"] != -100
-                hidden, aux, stats = module.forward(
-                    params, model_cfg, batch["input_ids"],
-                    return_hidden=True, return_stats=True, mesh_ctx=mesh_ctx, **kw,
-                )
-                extra["tokens_per_expert"] = stats["tokens_per_expert"]
-            else:
-                hidden = module.forward(
-                    params, model_cfg, batch["input_ids"],
-                    return_hidden=True, mesh_ctx=mesh_ctx, **kw,
-                )
-                aux = None
+            token_mask = (batch["labels"] != -100) if is_moe else None
+            params, hidden, aux, extra = fwd(
+                params, batch["input_ids"],
+                base_params=base_params, token_mask=token_mask, **kw,
+            )
             kernel = (
                 params["embed"]["embedding"].T
                 if model_cfg.tie_word_embeddings

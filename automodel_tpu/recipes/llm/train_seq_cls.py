@@ -28,10 +28,6 @@ logger = logging.getLogger(__name__)
 class TrainSeqClsRecipe(TrainFinetuneRecipeForNextTokenPrediction):
     def _build_model(self) -> None:
         super()._build_model()
-        if self.peft_cfg is not None:
-            raise NotImplementedError("seq-cls + PEFT lands next round")
-        if self.is_moe:
-            raise NotImplementedError("seq-cls with MoE backbones lands next round")
         num_labels = int(self.cfg.get("seq_cls.num_labels", 2))
         self.num_labels = num_labels
         head = dense_init(
@@ -43,19 +39,23 @@ class TrainSeqClsRecipe(TrainFinetuneRecipeForNextTokenPrediction):
         }
 
     def _make_loss_fn(self):
-        cfg = self.cfg
-        module = self.model_spec.module
-        model_cfg = self.model_cfg
-        mesh_ctx = self.mesh_ctx
+        from automodel_tpu.loss.utils import combine_losses
+        from automodel_tpu.recipes.llm.train_ft import make_hidden_forward
+
+        peft_cfg = self.peft_cfg
+        fwd = make_hidden_forward(
+            self.model_spec.module, self.model_cfg, self.mesh_ctx, peft_cfg
+        )
 
         def loss_fn(params, batch, rng, *extra):
+            base_params = extra[0] if peft_cfg is not None else None
             backbone = {k: v for k, v in params.items() if k != "score_head"}
-            hidden = module.forward(
-                backbone, model_cfg, batch["input_ids"],
-                return_hidden=True, mesh_ctx=mesh_ctx,
+            mask = batch.get("attention_mask", jnp.ones_like(batch["input_ids"]))
+            _, hidden, aux, stats = fwd(
+                backbone, batch["input_ids"],
+                base_params=base_params, token_mask=mask.astype(bool),
             )
             # last non-pad token per row (attention_mask: 1 = real token)
-            mask = batch.get("attention_mask", jnp.ones_like(batch["input_ids"]))
             last = jnp.maximum(jnp.sum(mask, axis=-1) - 1, 0)  # (B,)
             pooled = jnp.take_along_axis(hidden, last[:, None, None], axis=1)[:, 0]
             logits = (
@@ -67,7 +67,8 @@ class TrainSeqClsRecipe(TrainFinetuneRecipeForNextTokenPrediction):
             loss_sum = jnp.sum(lse - picked)
             acc = jnp.sum((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
             n = jnp.float32(labels.shape[0])
-            return loss_sum, {"num_label_tokens": n, "num_correct": acc}
+            total, n = combine_losses(loss_sum, n, aux)
+            return total, {"num_label_tokens": n, "num_correct": acc, **stats}
 
         return loss_fn
 

@@ -34,11 +34,6 @@ logger = logging.getLogger(__name__)
 
 class DistillBiEncoderRecipe(TrainBiEncoderRecipe):
     def _build_model(self) -> None:
-        if self.cfg.get("peft") is not None:
-            raise NotImplementedError(
-                "distill_bi_encoder + PEFT not supported: the teacher occupies "
-                "the step's extra-args slot the LoRA base weights would use"
-            )
         super()._build_model()
         cfg = self.cfg
         tcfg = cfg.get("teacher_model")
@@ -59,8 +54,6 @@ class DistillBiEncoderRecipe(TrainBiEncoderRecipe):
         self.teacher_cfg = self.teacher_spec.config_from_hf(
             hf_config, dtype=dtype, remat_policy=tcfg.get("remat_policy", "none")
         )
-        if getattr(self.teacher_cfg, "moe", None) is not None:
-            raise NotImplementedError("MoE teacher encoders not wired yet")
         import dataclasses
 
         if self.teacher_cfg.causal:
@@ -84,33 +77,42 @@ class DistillBiEncoderRecipe(TrainBiEncoderRecipe):
         )
 
     def _make_loss_fn(self):
+        from automodel_tpu.loss.utils import combine_losses
+        from automodel_tpu.recipes.llm.train_ft import make_hidden_forward
+
         cfg = self.cfg
-        module = self.model_spec.module
-        model_cfg = self.model_cfg
-        t_module = self.teacher_spec.module
-        t_cfg = self.teacher_cfg
-        mesh_ctx = self.mesh_ctx
+        peft_cfg = self.peft_cfg
         temperature = float(cfg.get("retrieval.temperature", 0.05))
         t_temp = float(cfg.get("distill.teacher_temperature", 0.05))
         distill_w = float(cfg.get("distill.weight", 1.0))
         infonce_w = float(cfg.get("distill.infonce_weight", 0.0))
+        student_fwd = make_hidden_forward(
+            self.model_spec.module, self.model_cfg, self.mesh_ctx, peft_cfg
+        )
+        teacher_fwd = make_hidden_forward(
+            self.teacher_spec.module, self.teacher_cfg, self.mesh_ctx
+        )
 
-        def embed(mod, mcfg, p, ids, mask):
-            hidden = mod.forward(
-                p, mcfg, ids, segment_ids=mask.astype(jnp.int32),
-                return_hidden=True, mesh_ctx=mesh_ctx,
-            )
-            return normalized_mean_pool(hidden, mask)
-
-        def loss_fn(params, batch, rng, teacher_params):
+        def loss_fn(params, batch, rng, *extra):
+            if peft_cfg is not None:
+                base_params, teacher_params = extra
+            else:
+                base_params, (teacher_params,) = None, extra
             ids = jnp.concatenate([batch["query_ids"], batch["doc_ids"]], axis=0)
             mask = jnp.concatenate([batch["query_mask"], batch["doc_mask"]], axis=0)
             B = batch["query_ids"].shape[0]
 
-            s = embed(module, model_cfg, params, ids, mask)
-            t = jax.lax.stop_gradient(
-                embed(t_module, t_cfg, teacher_params, ids, mask)
+            _, s_hidden, s_aux, stats = student_fwd(
+                params, ids,
+                base_params=base_params, token_mask=mask.astype(bool),
+                segment_ids=mask.astype(jnp.int32),
             )
+            s = normalized_mean_pool(s_hidden, mask)
+            _, t_hidden, _, _ = teacher_fwd(
+                teacher_params, ids,
+                token_mask=mask.astype(bool), segment_ids=mask.astype(jnp.int32),
+            )
+            t = jax.lax.stop_gradient(normalized_mean_pool(t_hidden, mask))
             sq, sd = s[:B], s[B:]
             tq, td = t[:B], t[B:]
 
@@ -121,9 +123,12 @@ class DistillBiEncoderRecipe(TrainBiEncoderRecipe):
             if infonce_w > 0.0:
                 hard, _ = info_nce_loss(sq, sd, temperature=temperature)
                 loss = loss + infonce_w * hard
-            return loss, {"num_label_tokens": jnp.float32(B)}
+            total, n = combine_losses(loss, jnp.float32(B), s_aux)
+            return total, {"num_label_tokens": n, **stats}
 
         return loss_fn
 
     def _step_extra(self) -> tuple:
+        if self.peft_cfg is not None:
+            return (self.base_params, self.teacher_params)
         return (self.teacher_params,)

@@ -79,8 +79,6 @@ class MineHardNegativesRecipe:
             hf_config = hf_config.to_dict() if isinstance(hf_config, ConfigNode) else dict(hf_config)
         self.spec = get_model_spec(hf_config)
         self.model_cfg = self.spec.config_from_hf(hf_config, dtype=dtype, remat_policy="none")
-        if getattr(self.model_cfg, "moe", None) is not None:
-            raise NotImplementedError("mining with MoE encoders not wired yet")
         if self.model_cfg.causal:
             self.model_cfg = dataclasses.replace(self.model_cfg, causal=False)
         module = self.spec.module
@@ -107,12 +105,15 @@ class MineHardNegativesRecipe:
             )
         self.tokenizer = build_tokenizer(tok_path)
 
+        from automodel_tpu.recipes.llm.train_ft import make_hidden_forward
+
+        fwd = make_hidden_forward(module, self.model_cfg, self.mesh_ctx)
+
         @jax.jit
         def _embed(params, ids, mask):
-            hidden = module.forward(
-                params, self.model_cfg, ids,
-                segment_ids=mask.astype(jnp.int32),
-                return_hidden=True, mesh_ctx=self.mesh_ctx,
+            _, hidden, _, _ = fwd(
+                params, ids,
+                token_mask=mask.astype(bool), segment_ids=mask.astype(jnp.int32),
             )
             return normalized_mean_pool(hidden, mask)
 

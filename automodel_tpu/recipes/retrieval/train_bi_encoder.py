@@ -30,30 +30,33 @@ logger = logging.getLogger(__name__)
 class TrainBiEncoderRecipe(TrainFinetuneRecipeForNextTokenPrediction):
     def _build_model(self) -> None:
         super()._build_model()
-        if self.is_moe:
-            raise NotImplementedError("bi-encoder with MoE backbones lands next round")
         if self.model_cfg.causal:
             # flip the backbone to bidirectional attention
             self.model_cfg = dataclasses.replace(self.model_cfg, causal=False)
 
     def _make_loss_fn(self):
+        from automodel_tpu.loss.utils import combine_losses
+        from automodel_tpu.recipes.llm.train_ft import make_hidden_forward
+
         cfg = self.cfg
-        module = self.model_spec.module
-        model_cfg = self.model_cfg
-        mesh_ctx = self.mesh_ctx
+        peft_cfg = self.peft_cfg
         temperature = float(cfg.get("retrieval.temperature", 0.05))
         symmetric = bool(cfg.get("retrieval.symmetric", True))
+        fwd = make_hidden_forward(
+            self.model_spec.module, self.model_cfg, self.mesh_ctx, peft_cfg
+        )
 
         def loss_fn(params, batch, rng, *extra):
+            base_params = extra[0] if peft_cfg is not None else None
             # one concatenated forward (2B batch) for MXU utilization; pad
             # tokens are isolated via segment ids (pads = segment 0, real
             # tokens = segment 1) so bidirectional attention never mixes them
             ids = jnp.concatenate([batch["query_ids"], batch["doc_ids"]], axis=0)
             mask = jnp.concatenate([batch["query_mask"], batch["doc_mask"]], axis=0)
-            hidden = module.forward(
-                params, model_cfg, ids,
+            _, hidden, aux, stats = fwd(
+                params, ids,
+                base_params=base_params, token_mask=mask.astype(bool),
                 segment_ids=mask.astype(jnp.int32),
-                return_hidden=True, mesh_ctx=mesh_ctx,
             )
             pooled = mean_pool(hidden, mask)
             B = batch["query_ids"].shape[0]
@@ -61,7 +64,8 @@ class TrainBiEncoderRecipe(TrainFinetuneRecipeForNextTokenPrediction):
             loss_sum, n = info_nce_loss(
                 q, d, temperature=temperature, symmetric=symmetric
             )
-            return loss_sum, {"num_label_tokens": n}
+            total, n = combine_losses(loss_sum, n, aux)
+            return total, {"num_label_tokens": n, **stats}
 
         return loss_fn
 

@@ -27,8 +27,6 @@ logger = logging.getLogger(__name__)
 class TrainCrossEncoderRecipe(TrainFinetuneRecipeForNextTokenPrediction):
     def _build_model(self) -> None:
         super()._build_model()
-        if self.is_moe or self.peft_cfg is not None:
-            raise NotImplementedError("cross-encoder: dense full-FT backbones (r1)")
         head = dense_init(self.rng.next_key(), (self.model_cfg.hidden_size, 1))
         self._init_params = {
             **self._init_params,
@@ -36,20 +34,25 @@ class TrainCrossEncoderRecipe(TrainFinetuneRecipeForNextTokenPrediction):
         }
 
     def _make_loss_fn(self):
-        module = self.model_spec.module
-        model_cfg = self.model_cfg
-        mesh_ctx = self.mesh_ctx
+        from automodel_tpu.loss.utils import combine_losses
+        from automodel_tpu.recipes.llm.train_ft import make_hidden_forward
+
+        peft_cfg = self.peft_cfg
+        fwd = make_hidden_forward(
+            self.model_spec.module, self.model_cfg, self.mesh_ctx, peft_cfg
+        )
 
         def loss_fn(params, batch, rng, *extra):
+            base_params = extra[0] if peft_cfg is not None else None
             ids = batch["pair_ids"]      # (B, G, S)
             mask = batch["pair_mask"]    # (B, G, S)
             B, G, S = ids.shape
             backbone = {k: v for k, v in params.items() if k != "score_head"}
-            hidden = module.forward(
-                backbone, model_cfg, ids.reshape(B * G, S),
-                return_hidden=True, mesh_ctx=mesh_ctx,
-            )
             flat_mask = mask.reshape(B * G, S)
+            _, hidden, aux, stats = fwd(
+                backbone, ids.reshape(B * G, S),
+                base_params=base_params, token_mask=flat_mask.astype(bool),
+            )
             last = jnp.maximum(jnp.sum(flat_mask, axis=-1) - 1, 0)
             pooled = jnp.take_along_axis(hidden, last[:, None, None], axis=1)[:, 0]
             scores = (
@@ -59,9 +62,11 @@ class TrainCrossEncoderRecipe(TrainFinetuneRecipeForNextTokenPrediction):
             lse = jax.scipy.special.logsumexp(scores, axis=-1)
             loss_sum = jnp.sum(lse - scores[:, 0])
             acc = jnp.sum((jnp.argmax(scores, -1) == 0).astype(jnp.float32))
-            return loss_sum, {
-                "num_label_tokens": jnp.float32(B),
+            total, n = combine_losses(loss_sum, jnp.float32(B), aux)
+            return total, {
+                "num_label_tokens": n,
                 "num_correct": acc,
+                **stats,
             }
 
         return loss_fn
