@@ -52,9 +52,12 @@ class DiTConfig:
         return self.patch_size * self.patch_size * self.in_channels
 
     def flops_per_token(self, seq_len: int) -> float:
+        """seq_len = tokens per sample, i.e. (input_size/patch_size)²."""
         H = self.hidden_size
         per_layer = 4 * H * H + 2 * H * self.mlp_dim + 6 * H * H  # attn+mlp+mod
-        return 6.0 * self.num_layers * per_layer
+        # bidirectional attention scores + AV: 2 matmuls × 2S·H flops/token
+        attn = 4 * self.num_layers * seq_len * H
+        return 6.0 * self.num_layers * per_layer + 3.0 * attn
 
 
 def init(cfg: DiTConfig, rng: jax.Array) -> dict:

@@ -1,0 +1,647 @@
+"""NemotronH / Nemotron-V3: the hybrid Mamba2 + attention + MLP (+ MoE)
+family.
+
+TPU-native re-design of the reference family (reference: nemo_automodel/
+components/models/nemotron_v3/layers.py `NemotronV3Block` — block pattern
+'M' mamba / '*' attention / '-' mlp / 'E' moe; model.py `NemotronV3Model`;
+HF transformers NemotronHForCausalLM is the layout oracle for dense
+checkpoints). Architecture facts this file encodes:
+
+- every layer is ONE pre-norm mixer block: h += mixer(rmsnorm(h))
+  (no attention+MLP pair — the pattern interleaves the sublayer kinds)
+- the mamba mixer is exactly the Mamba2 SSD mixer (shared implementation,
+  models/hybrid/mamba2.py `_mixer` — lax.scan recurrence, fp32 state)
+- attention is plain GQA with NO positional embedding (positions come from
+  the mamba recurrences; reference layers.py `NemotronV3Attention` "no
+  RoPE")
+- dense MLP blocks are non-gated relu² (reference moe/layers.py MLP with
+  activation="relu2")
+- the MoE variant routes with the DeepSeek-style sigmoid grouped gate,
+  1 non-gated relu² shared expert, no aux loss, routed scaling
+  (reference model.py:92-113 moe_defaults)
+
+Like qwen3_next, layer params are stacked PER TYPE (mamba/attn/mlp/moe
+stacks) with the interleaving preserved by the static pattern tuple, so
+each stack shards uniformly over the mesh and remat applies per layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.layers import dense_init
+from automodel_tpu.models.hybrid.mamba2 import Mamba2Config, _mixer
+from automodel_tpu.moe.config import MoEConfig
+from automodel_tpu.moe.layer import init_moe, moe_forward, moe_param_specs
+from automodel_tpu.ops.norms import rms_norm
+
+
+@dataclasses.dataclass
+class NemotronHConfig:
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    block_pattern: tuple  # per layer: "mamba" | "attention" | "mlp" | "moe"
+    # attention
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    attention_bias: bool = False
+    # mamba (names mirror Mamba2Config)
+    mamba_num_heads: int = 8
+    mamba_head_dim: int = 64
+    ssm_state_size: int = 128
+    n_groups: int = 8
+    conv_kernel: int = 4
+    use_conv_bias: bool = True
+    use_mamba_bias: bool = False
+    time_step_limit: tuple = (0.0, float("inf"))
+    # mlp / moe
+    mlp_bias: bool = False
+    moe: Optional[MoEConfig] = None
+    residual_in_fp32: bool = True
+    rms_norm_eps: float = 1e-5
+    tie_word_embeddings: bool = False
+    logits_soft_cap: Optional[float] = None
+    dtype: jnp.dtype = jnp.float32
+    remat_policy: Optional[str] = "full"
+    scan_unroll: int = 1
+    mtp_num_layers: int = 0  # chassis compatibility
+
+    def __post_init__(self):
+        assert len(self.block_pattern) == self.num_layers
+        bad = set(self.block_pattern) - {"mamba", "attention", "mlp", "moe"}
+        assert not bad, f"unknown block types {bad}"
+
+    @property
+    def mamba_cfg(self) -> Mamba2Config:
+        """Internal Mamba2Config view so the SSD mixer is shared verbatim."""
+        return Mamba2Config(
+            vocab_size=1,  # unused by the mixer
+            hidden_size=self.hidden_size,
+            num_layers=1,
+            state_size=self.ssm_state_size,
+            num_heads=self.mamba_num_heads,
+            head_dim=self.mamba_head_dim,
+            n_groups=self.n_groups,
+            conv_kernel=self.conv_kernel,
+            use_conv_bias=self.use_conv_bias,
+            use_bias=self.use_mamba_bias,
+            time_step_limit=self.time_step_limit,
+            rms_norm_eps=self.rms_norm_eps,
+            dtype=self.dtype,
+        )
+
+    def _counts(self):
+        p = self.block_pattern
+        return (
+            sum(1 for t in p if t == "mamba"),
+            sum(1 for t in p if t == "attention"),
+            sum(1 for t in p if t == "mlp"),
+            sum(1 for t in p if t == "moe"),
+        )
+
+    def flops_per_token(self, seq_len: int) -> float:
+        H = self.hidden_size
+        n_m, n_a, n_d, n_e = self._counts()
+        I_m = self.mamba_num_heads * self.mamba_head_dim
+        conv_dim = I_m + 2 * self.n_groups * self.ssm_state_size
+        mamba_p = H * (2 * I_m + 2 * self.n_groups * self.ssm_state_size + self.mamba_num_heads) + I_m * H + 2 * I_m * self.ssm_state_size
+        attn_p = H * (self.num_heads + 2 * self.num_kv_heads) * self.head_dim + self.num_heads * self.head_dim * H
+        mlp_p = 2 * H * self.intermediate_size
+        moe_p = 0.0
+        if self.moe is not None:
+            moe_p = 2 * H * self.moe.moe_intermediate_size * self.moe.experts_per_token
+            if self.moe.n_shared_experts:
+                moe_p += 2 * H * self.moe.shared_intermediate
+        n_params = (
+            self.vocab_size * H * (1 if self.tie_word_embeddings else 2)
+            + n_m * mamba_p + n_a * attn_p + n_d * mlp_p + n_e * moe_p
+        )
+        return 6.0 * n_params + 6 * n_a * self.num_heads * self.head_dim * seq_len
+
+
+_PATTERN_CHARS = {"M": "mamba", "*": "attention", "-": "mlp", "E": "moe"}
+
+
+def from_hf_config(hf: dict, dtype=jnp.float32, remat_policy="full", **overrides) -> NemotronHConfig:
+    """Build from an HF NemotronHConfig dict. Accepts both the
+    `hybrid_override_pattern` string ("M-M*-…") and an explicit
+    `layers_block_type` list (reference layers.py:666)."""
+    overrides = {
+        k: v for k, v in overrides.items()
+        if k in {f.name for f in dataclasses.fields(NemotronHConfig)}
+    }
+    L = int(hf["num_hidden_layers"])
+    pattern = hf.get("layers_block_type")
+    if pattern is None:
+        s = hf.get("hybrid_override_pattern")
+        if s is None:
+            raise ValueError(
+                "NemotronH config needs hybrid_override_pattern or layers_block_type"
+            )
+        unknown = set(s) - set(_PATTERN_CHARS)
+        if unknown:
+            raise ValueError(
+                f"hybrid_override_pattern has unknown block chars {sorted(unknown)}; "
+                f"known: {sorted(_PATTERN_CHARS)} (M=mamba, *=attention, -=mlp, E=moe)"
+            )
+        pattern = [_PATTERN_CHARS[c] for c in s]
+    pattern = [
+        {"M": "mamba", "*": "attention", "-": "mlp"}.get(t, t) for t in pattern
+    ]
+    moe = None
+    if int(hf.get("n_routed_experts", 0) or 0) > 0:
+        moe = MoEConfig(
+            n_routed_experts=int(hf["n_routed_experts"]),
+            experts_per_token=int(hf.get("num_experts_per_tok", 8)),
+            n_groups=int(hf.get("n_group", 1) or 1),
+            topk_groups=int(hf.get("topk_group", 1) or 1),
+            score_func="sigmoid",
+            route_scale=float(hf.get("routed_scaling_factor", 1.0) or 1.0),
+            norm_topk_prob=bool(hf.get("norm_topk_prob", True)),
+            aux_loss_coeff=0.0,
+            moe_intermediate_size=int(hf["moe_intermediate_size"]),
+            n_shared_experts=1,
+            shared_expert_intermediate_size=int(
+                hf.get("moe_shared_expert_intermediate_size")
+                or hf["moe_intermediate_size"]
+            ),
+            expert_activation="relu2",
+            shared_expert_activation="relu2",
+            expert_bias=bool(hf.get("mlp_bias", False)),
+            dispatcher="dropless",
+        )
+    tsl = hf.get("time_step_limit") or (0.0, float("inf"))
+    return NemotronHConfig(
+        vocab_size=int(hf["vocab_size"]),
+        hidden_size=int(hf["hidden_size"]),
+        intermediate_size=int(hf["intermediate_size"]),
+        num_layers=L,
+        block_pattern=tuple(pattern),
+        num_heads=int(hf["num_attention_heads"]),
+        num_kv_heads=int(hf.get("num_key_value_heads", hf["num_attention_heads"])),
+        head_dim=int(
+            hf.get("attention_head_dim")
+            or hf.get("head_dim")
+            or hf["hidden_size"] // hf["num_attention_heads"]
+        ),
+        attention_bias=bool(hf.get("attention_bias", False)),
+        mamba_num_heads=int(hf.get("mamba_num_heads", 8)),
+        mamba_head_dim=int(hf.get("mamba_head_dim", 64)),
+        ssm_state_size=int(hf.get("ssm_state_size", 128)),
+        n_groups=int(hf.get("n_groups", 8)),
+        conv_kernel=int(hf.get("conv_kernel", 4)),
+        use_conv_bias=bool(hf.get("use_conv_bias", True)),
+        use_mamba_bias=bool(hf.get("use_bias", False)),
+        time_step_limit=tuple(tsl),
+        mlp_bias=bool(hf.get("mlp_bias", False)),
+        moe=moe,
+        residual_in_fp32=bool(hf.get("residual_in_fp32", True)),
+        rms_norm_eps=float(hf.get("layer_norm_epsilon", 1e-5)),
+        tie_word_embeddings=bool(hf.get("tie_word_embeddings", False)),
+        dtype=dtype,
+        remat_policy=remat_policy,
+        **overrides,
+    )
+
+
+# ---------------------------------------------------------------------------
+# init / specs
+# ---------------------------------------------------------------------------
+def _stack(k, shape, n):
+    return jnp.stack([dense_init(kk, shape) for kk in jax.random.split(k, n)])
+
+
+def _init_mamba_stack(cfg: NemotronHConfig, rng, n) -> dict:
+    m = cfg.mamba_cfg
+    H, I, Hd = cfg.hidden_size, m.intermediate_size, m.num_heads
+    ks = jax.random.split(rng, 3)
+    proj_out = 2 * I + 2 * m.n_groups * m.state_size + Hd
+    layers = {
+        "in_proj": {"kernel": _stack(ks[0], (H, proj_out), n)},
+        "conv": {"kernel": 0.2 * jax.random.normal(ks[1], (n, m.conv_kernel, m.conv_dim))},
+        "dt_bias": jnp.zeros((n, Hd)),
+        "A_log": jnp.log(jnp.broadcast_to(jnp.arange(1, Hd + 1, dtype=jnp.float32), (n, Hd))),
+        "D": jnp.ones((n, Hd)),
+        "gated_norm": {"scale": jnp.ones((n, I))},
+        "out_proj": {"kernel": _stack(ks[2], (I, H), n)},
+    }
+    if m.use_conv_bias:
+        layers["conv"]["bias"] = jnp.zeros((n, m.conv_dim))
+    if m.use_bias:
+        layers["in_proj"]["bias"] = jnp.zeros((n, proj_out))
+        layers["out_proj"]["bias"] = jnp.zeros((n, H))
+    return layers
+
+
+def _mamba_specs(cfg: NemotronHConfig) -> dict:
+    m = cfg.mamba_cfg
+    specs = {
+        "in_proj": {"kernel": ("layers", "embed", "heads")},
+        "conv": {"kernel": ("layers", None, "heads")},
+        "dt_bias": ("layers", "heads"),
+        "A_log": ("layers", "heads"),
+        "D": ("layers", "heads"),
+        "gated_norm": {"scale": ("layers", "norm")},
+        "out_proj": {"kernel": ("layers", "heads", "embed")},
+    }
+    if m.use_conv_bias:
+        specs["conv"]["bias"] = ("layers", "heads")
+    if m.use_bias:
+        specs["in_proj"]["bias"] = ("layers", "heads")
+        specs["out_proj"]["bias"] = ("layers", "norm")
+    return specs
+
+
+def _init_attn_stack(cfg: NemotronHConfig, rng, n) -> dict:
+    H, D = cfg.hidden_size, cfg.head_dim
+    ks = jax.random.split(rng, 4)
+    layers = {
+        "q_proj": {"kernel": _stack(ks[0], (H, cfg.num_heads * D), n)},
+        "k_proj": {"kernel": _stack(ks[1], (H, cfg.num_kv_heads * D), n)},
+        "v_proj": {"kernel": _stack(ks[2], (H, cfg.num_kv_heads * D), n)},
+        "o_proj": {"kernel": _stack(ks[3], (cfg.num_heads * D, H), n)},
+    }
+    if cfg.attention_bias:
+        layers["q_proj"]["bias"] = jnp.zeros((n, cfg.num_heads * D))
+        layers["k_proj"]["bias"] = jnp.zeros((n, cfg.num_kv_heads * D))
+        layers["v_proj"]["bias"] = jnp.zeros((n, cfg.num_kv_heads * D))
+        layers["o_proj"]["bias"] = jnp.zeros((n, H))
+    return layers
+
+
+def _attn_specs(cfg: NemotronHConfig) -> dict:
+    specs = {
+        "q_proj": {"kernel": ("layers", "embed", "heads")},
+        "k_proj": {"kernel": ("layers", "embed", "kv_heads")},
+        "v_proj": {"kernel": ("layers", "embed", "kv_heads")},
+        "o_proj": {"kernel": ("layers", "heads", "embed")},
+    }
+    if cfg.attention_bias:
+        specs["q_proj"]["bias"] = ("layers", "heads")
+        specs["k_proj"]["bias"] = ("layers", "kv_heads")
+        specs["v_proj"]["bias"] = ("layers", "kv_heads")
+        specs["o_proj"]["bias"] = ("layers", "norm")
+    return specs
+
+
+def _init_mlp_stack(cfg: NemotronHConfig, rng, n) -> dict:
+    H, I = cfg.hidden_size, cfg.intermediate_size
+    ks = jax.random.split(rng, 2)
+    layers = {
+        "up_proj": {"kernel": _stack(ks[0], (H, I), n)},
+        "down_proj": {"kernel": _stack(ks[1], (I, H), n)},
+    }
+    if cfg.mlp_bias:
+        layers["up_proj"]["bias"] = jnp.zeros((n, I))
+        layers["down_proj"]["bias"] = jnp.zeros((n, H))
+    return layers
+
+
+def _mlp_specs(cfg: NemotronHConfig) -> dict:
+    specs = {
+        "up_proj": {"kernel": ("layers", "embed", "mlp")},
+        "down_proj": {"kernel": ("layers", "mlp", "embed")},
+    }
+    if cfg.mlp_bias:
+        specs["up_proj"]["bias"] = ("layers", "mlp")
+        specs["down_proj"]["bias"] = ("layers", "norm")
+    return specs
+
+
+def init(cfg: NemotronHConfig, rng: jax.Array) -> dict:
+    n_m, n_a, n_d, n_e = cfg._counts()
+    ks = jax.random.split(rng, 7)
+    # each per-type stack keeps a 1-layer dummy when absent so the pytree
+    # structure (and its shardings) is pattern-independent
+    params = {
+        "embed": {"embedding": 0.02 * jax.random.normal(ks[0], (cfg.vocab_size, cfg.hidden_size))},
+        "mamba_layers": _init_mamba_stack(cfg, ks[1], max(n_m, 1)),
+        "attn_layers": _init_attn_stack(cfg, ks[2], max(n_a, 1)),
+        "mlp_layers": _init_mlp_stack(cfg, ks[3], max(n_d, 1)),
+        "norms": {"scale": jnp.ones((cfg.num_layers, cfg.hidden_size))},
+        "final_norm": {"scale": jnp.ones((cfg.hidden_size,))},
+    }
+    if n_e or cfg.moe is not None:
+        moe_cfg = cfg.moe or MoEConfig()
+        params["moe_layers"] = jax.tree.map(
+            lambda *xs: jnp.stack(xs),
+            *[
+                init_moe(moe_cfg, cfg.hidden_size, jax.random.fold_in(ks[4], i))
+                for i in range(max(n_e, 1))
+            ],
+        )
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = {"kernel": dense_init(ks[5], (cfg.hidden_size, cfg.vocab_size))}
+    return params
+
+
+def param_specs(cfg: NemotronHConfig) -> dict:
+    specs = {
+        "embed": {"embedding": ("vocab", "embed")},
+        "mamba_layers": _mamba_specs(cfg),
+        "attn_layers": _attn_specs(cfg),
+        "mlp_layers": _mlp_specs(cfg),
+        "norms": {"scale": ("layers", "norm")},
+        "final_norm": {"scale": ("norm",)},
+    }
+    if cfg.moe is not None:
+        inner = moe_param_specs(cfg.moe)
+        specs["moe_layers"] = jax.tree.map(
+            lambda s: ("layers",) + s,
+            inner,
+            is_leaf=lambda x: isinstance(x, tuple) and all(isinstance(a, (str, type(None))) for a in x),
+        )
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = {"kernel": ("embed", "vocab")}
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def _attn_block(x, lp, cfg: NemotronHConfig, positions, segment_ids):
+    from automodel_tpu.ops.attention import dot_product_attention
+
+    B, S, H = x.shape
+    D = cfg.head_dim
+    dtype = x.dtype
+
+    def proj(name, nh):
+        y = x @ lp[name]["kernel"].astype(dtype)
+        if "bias" in lp[name]:
+            y = y + lp[name]["bias"].astype(dtype)
+        return y.reshape(B, S, nh, D)
+
+    q = proj("q_proj", cfg.num_heads)
+    k = proj("k_proj", cfg.num_kv_heads)
+    v = proj("v_proj", cfg.num_kv_heads)
+    # no RoPE: position information flows from the mamba recurrences
+    attn = dot_product_attention(
+        q, k, v, causal=True, segment_ids=segment_ids, positions=positions,
+    )
+    out = attn.reshape(B, S, cfg.num_heads * D) @ lp["o_proj"]["kernel"].astype(dtype)
+    if "bias" in lp["o_proj"]:
+        out = out + lp["o_proj"]["bias"].astype(dtype)
+    return out
+
+
+def _mlp_block(x, lp, cfg: NemotronHConfig):
+    dtype = x.dtype
+    u = x @ lp["up_proj"]["kernel"].astype(dtype)
+    if "bias" in lp["up_proj"]:
+        u = u + lp["up_proj"]["bias"].astype(dtype)
+    y = jnp.square(jax.nn.relu(u)) @ lp["down_proj"]["kernel"].astype(dtype)
+    if "bias" in lp["down_proj"]:
+        y = y + lp["down_proj"]["bias"].astype(dtype)
+    return y
+
+
+def forward(
+    params: dict,
+    cfg: NemotronHConfig,
+    input_ids: jnp.ndarray,
+    *,
+    positions: jnp.ndarray | None = None,
+    segment_ids: jnp.ndarray | None = None,
+    mesh_ctx=None,
+    rules=None,
+    return_hidden: bool = False,
+    return_stats: bool = False,
+    token_mask: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Returns logits (or hidden). With MoE, returns (out, aux_loss[, stats])."""
+    from automodel_tpu.models.common.layers import cast_params, maybe_remat
+
+    fp32_m = {k: params["mamba_layers"][k] for k in ("A_log", "dt_bias", "D")}
+    params = cast_params(params, cfg.dtype)
+    params["mamba_layers"] = {**params["mamba_layers"], **fp32_m}
+    mcfg = cfg.mamba_cfg
+
+    B, S = input_ids.shape
+    res_dtype = jnp.float32 if cfg.residual_in_fp32 else cfg.dtype
+    h = jnp.take(params["embed"]["embedding"], input_ids, axis=0).astype(res_dtype)
+
+    idx = {"mamba": 0, "attention": 0, "mlp": 0, "moe": 0}
+    aux_total = jnp.float32(0.0)
+    stats_list = []
+    for i, bt in enumerate(cfg.block_pattern):
+        ln = params["norms"]["scale"][i]
+
+        def one_layer(hh, _ps=params, _i=i, _bt=bt, _ti=idx[bt], _ln=ln):
+            x = rms_norm(hh, _ln, cfg.rms_norm_eps).astype(cfg.dtype)
+            if _bt == "mamba":
+                lp = jax.tree.map(lambda p: p[_ti], _ps["mamba_layers"])
+                return hh + _mixer(x, lp, mcfg, segment_ids).astype(res_dtype), None, None
+            if _bt == "attention":
+                lp = jax.tree.map(lambda p: p[_ti], _ps["attn_layers"])
+                return hh + _attn_block(x, lp, cfg, positions, segment_ids).astype(res_dtype), None, None
+            if _bt == "mlp":
+                lp = jax.tree.map(lambda p: p[_ti], _ps["mlp_layers"])
+                return hh + _mlp_block(x, lp, cfg).astype(res_dtype), None, None
+            mp = jax.tree.map(lambda p: p[_ti], _ps["moe_layers"])
+            out, aux, st = moe_forward(
+                mp, cfg.moe, x, token_mask=token_mask, mesh_ctx=mesh_ctx
+            )
+            return hh + out.astype(res_dtype), aux, st
+
+        h, aux, st = maybe_remat(lambda hh: one_layer(hh), cfg.remat_policy)(h)
+        if aux is not None:
+            aux_total = aux_total + aux
+            stats_list.append(st["tokens_per_expert"])
+        idx[bt] += 1
+
+    h = rms_norm(h, params["final_norm"]["scale"], cfg.rms_norm_eps).astype(cfg.dtype)
+    if return_hidden:
+        out = h
+    else:
+        kernel = (
+            params["embed"]["embedding"].T
+            if cfg.tie_word_embeddings
+            else params["lm_head"]["kernel"]
+        )
+        out = jnp.einsum(
+            "bsh,hv->bsv", h, kernel.astype(h.dtype), preferred_element_type=jnp.float32
+        )
+    if cfg.moe is not None:
+        if return_stats:
+            return out, aux_total, {"tokens_per_expert": jnp.stack(stats_list)}
+        return out, aux_total
+    return out
+
+
+# ---------------------------------------------------------------------------
+# HF state-dict adapter (NemotronHForCausalLM backbone.* layout, with the
+# same mixer key shapes as Mamba2; attention/mlp/moe mixers keyed per type)
+# ---------------------------------------------------------------------------
+class NemotronHAdapter:
+    def __init__(self, cfg: NemotronHConfig):
+        self.cfg = cfg
+
+    def from_hf(self, read, shardings=None) -> dict:
+        import numpy as np
+
+        from automodel_tpu.checkpoint.hf_adapter import _get, _set
+
+        cfg = self.cfg
+        params: dict = {}
+
+        def put(path, value):
+            sh = _get(shardings, path) if shardings is not None else None
+            _set(params, path, jax.device_put(value, sh) if sh is not None else jnp.asarray(value))
+
+        put(("embed", "embedding"), read("backbone.embeddings.weight"))
+        put(("final_norm", "scale"), read("backbone.norm_f.weight"))
+        if not cfg.tie_word_embeddings:
+            put(("lm_head", "kernel"), np.ascontiguousarray(read("lm_head.weight").T))
+
+        L = cfg.num_layers
+        b = "backbone.layers.{}."
+        put(("norms", "scale"), np.stack([read((b + "norm.weight").format(i)) for i in range(L)]))
+
+        ids = {
+            t: [i for i, bt in enumerate(cfg.block_pattern) if bt == t]
+            for t in ("mamba", "attention", "mlp", "moe")
+        }
+
+        def stackT(fmt, idxs):
+            return np.stack([np.ascontiguousarray(read(fmt.format(i)).T) for i in idxs])
+
+        def stack_(fmt, idxs):
+            return np.stack([read(fmt.format(i)) for i in idxs])
+
+        m = b + "mixer."
+        if ids["mamba"]:
+            put(("mamba_layers", "in_proj", "kernel"), stackT(m + "in_proj.weight", ids["mamba"]))
+            put(("mamba_layers", "conv", "kernel"), np.stack([
+                np.ascontiguousarray(read((m + "conv1d.weight").format(i))[:, 0, :].T)
+                for i in ids["mamba"]
+            ]))
+            if cfg.use_conv_bias:
+                put(("mamba_layers", "conv", "bias"), stack_(m + "conv1d.bias", ids["mamba"]))
+            if cfg.use_mamba_bias:
+                put(("mamba_layers", "in_proj", "bias"), stack_(m + "in_proj.bias", ids["mamba"]))
+                put(("mamba_layers", "out_proj", "bias"), stack_(m + "out_proj.bias", ids["mamba"]))
+            put(("mamba_layers", "dt_bias"), stack_(m + "dt_bias", ids["mamba"]))
+            put(("mamba_layers", "A_log"), stack_(m + "A_log", ids["mamba"]))
+            put(("mamba_layers", "D"), stack_(m + "D", ids["mamba"]))
+            put(("mamba_layers", "gated_norm", "scale"), stack_(m + "norm.weight", ids["mamba"]))
+            put(("mamba_layers", "out_proj", "kernel"), stackT(m + "out_proj.weight", ids["mamba"]))
+        else:
+            params["mamba_layers"] = init(cfg, jax.random.key(0))["mamba_layers"]
+
+        if ids["attention"]:
+            for p in ("q_proj", "k_proj", "v_proj", "o_proj"):
+                put(("attn_layers", p, "kernel"), stackT(m + p + ".weight", ids["attention"]))
+                if cfg.attention_bias:
+                    put(("attn_layers", p, "bias"), stack_(m + p + ".bias", ids["attention"]))
+        else:
+            params["attn_layers"] = init(cfg, jax.random.key(0))["attn_layers"]
+
+        if ids["mlp"]:
+            for p in ("up_proj", "down_proj"):
+                put(("mlp_layers", p, "kernel"), stackT(m + p + ".weight", ids["mlp"]))
+                if cfg.mlp_bias:
+                    put(("mlp_layers", p, "bias"), stack_(m + p + ".bias", ids["mlp"]))
+        else:
+            params["mlp_layers"] = init(cfg, jax.random.key(0))["mlp_layers"]
+
+        if cfg.moe is not None and ids["moe"]:
+            E = cfg.moe.n_routed_experts
+            put(("moe_layers", "gate", "weight"), stackT(m + "gate.weight", ids["moe"]))
+            for proj in ("up_proj", "down_proj"):
+                w = np.stack([
+                    np.stack([
+                        np.ascontiguousarray(
+                            read(f"backbone.layers.{i}.mixer.experts.{e}.{proj}.weight").T
+                        )
+                        for e in range(E)
+                    ])
+                    for i in ids["moe"]
+                ])
+                put(("moe_layers", "experts", proj, "kernel"), w)
+            for proj in ("up_proj", "down_proj"):
+                put(
+                    ("moe_layers", "shared", proj, "kernel"),
+                    stackT(m + f"shared_experts.{proj}.weight", ids["moe"]),
+                )
+        elif cfg.moe is not None:
+            params["moe_layers"] = init(cfg, jax.random.key(0))["moe_layers"]
+
+        return params
+
+    def to_hf(self, params):
+        """Yield (hf_name, tensor) — inverse of from_hf for the dense blocks
+        (MoE export mirrors from_hf's key layout)."""
+        import numpy as np
+
+        cfg = self.cfg
+
+        def g(*path):
+            node = params
+            for p in path:
+                node = node[p]
+            return np.asarray(jax.device_get(node))
+
+        yield "backbone.embeddings.weight", g("embed", "embedding")
+        yield "backbone.norm_f.weight", g("final_norm", "scale")
+        if not cfg.tie_word_embeddings:
+            yield "lm_head.weight", np.ascontiguousarray(g("lm_head", "kernel").T)
+        b = "backbone.layers.{}."
+        idx = {"mamba": 0, "attention": 0, "mlp": 0, "moe": 0}
+        for i, bt in enumerate(cfg.block_pattern):
+            yield (b + "norm.weight").format(i), g("norms", "scale")[i]
+            m = (b + "mixer.").format(i)
+            t = idx[bt]
+            if bt == "mamba":
+                yield m + "in_proj.weight", np.ascontiguousarray(g("mamba_layers", "in_proj", "kernel")[t].T)
+                yield m + "conv1d.weight", np.ascontiguousarray(g("mamba_layers", "conv", "kernel")[t].T)[:, None, :]
+                if cfg.use_conv_bias:
+                    yield m + "conv1d.bias", g("mamba_layers", "conv", "bias")[t]
+                if cfg.use_mamba_bias:
+                    yield m + "in_proj.bias", g("mamba_layers", "in_proj", "bias")[t]
+                    yield m + "out_proj.bias", g("mamba_layers", "out_proj", "bias")[t]
+                yield m + "dt_bias", g("mamba_layers", "dt_bias")[t]
+                yield m + "A_log", g("mamba_layers", "A_log")[t]
+                yield m + "D", g("mamba_layers", "D")[t]
+                yield m + "norm.weight", g("mamba_layers", "gated_norm", "scale")[t]
+                yield m + "out_proj.weight", np.ascontiguousarray(g("mamba_layers", "out_proj", "kernel")[t].T)
+            elif bt == "attention":
+                for p in ("q_proj", "k_proj", "v_proj", "o_proj"):
+                    yield m + p + ".weight", np.ascontiguousarray(g("attn_layers", p, "kernel")[t].T)
+                    if cfg.attention_bias:
+                        yield m + p + ".bias", g("attn_layers", p, "bias")[t]
+            elif bt == "mlp":
+                for p in ("up_proj", "down_proj"):
+                    yield m + p + ".weight", np.ascontiguousarray(g("mlp_layers", p, "kernel")[t].T)
+                    if cfg.mlp_bias:
+                        yield m + p + ".bias", g("mlp_layers", p, "bias")[t]
+            else:
+                yield m + "gate.weight", np.ascontiguousarray(g("moe_layers", "gate", "weight")[t].T)
+                E = cfg.moe.n_routed_experts
+                for e in range(E):
+                    for p in ("up_proj", "down_proj"):
+                        yield (
+                            m + f"experts.{e}.{p}.weight",
+                            np.ascontiguousarray(g("moe_layers", "experts", p, "kernel")[t][e].T),
+                        )
+                for p in ("up_proj", "down_proj"):
+                    yield m + f"shared_experts.{p}.weight", np.ascontiguousarray(
+                        g("moe_layers", "shared", p, "kernel")[t].T
+                    )
+            idx[bt] += 1
+
+
+def _register():
+    from automodel_tpu.checkpoint.hf_adapter import ADAPTERS
+
+    ADAPTERS["nemotron_h"] = NemotronHAdapter
+
+
+_register()

@@ -12,6 +12,7 @@ import dataclasses
 from typing import Any, Callable, Mapping
 
 from automodel_tpu.models.hybrid import mamba2 as mamba2_module
+from automodel_tpu.models.hybrid import nemotron_h as nemotron_h_module
 from automodel_tpu.models.hybrid import qwen3_next as qwen3_next_module
 from automodel_tpu.models.llm import decoder, families
 from automodel_tpu.models.moe_lm import decoder as moe_decoder
@@ -59,6 +60,14 @@ MODEL_ARCH_MAPPING: dict[str, ModelSpec] = {
     ),
     "Mamba2ForCausalLM": ModelSpec(
         "mamba2", mamba2_module.from_hf_config, mamba2_module, adapter_name="mamba2"
+    ),
+    "NemotronHForCausalLM": ModelSpec(
+        "nemotron_h", nemotron_h_module.from_hf_config, nemotron_h_module,
+        adapter_name="nemotron_h",
+    ),
+    "NemotronHForCausalLMV3": ModelSpec(
+        "nemotron_h", nemotron_h_module.from_hf_config, nemotron_h_module,
+        adapter_name="nemotron_h",
     ),
     "Qwen3NextForCausalLM": ModelSpec(
         "qwen3_next", qwen3_next_module.from_hf_config, qwen3_next_module,

@@ -27,13 +27,17 @@ class MoEConfig:
     route_scale: float = 1.0
     aux_loss_coeff: float = 0.0
     gate_bias_update_speed: float = 0.0  # deepseek aux-free balancing
-    expert_activation: str = "silu"   # silu | geglu | quick_geglu | relu2 | swigluoai
+    # silu | geglu | quick_geglu | swigluoai are GATED (3-matrix) MLPs;
+    # relu2 is NON-gated (up/down only, inner = relu(u)²) — matching the
+    # reference's is_gated_activation split (moe/layers.py:46-82)
+    expert_activation: str = "silu"
     expert_bias: bool = False         # gpt-oss experts carry projection biases
     swiglu_limit: float = 7.0         # swigluoai clamp (HF swiglu_limit)
     router_bias: bool = False         # gpt-oss router linear has a bias
     moe_intermediate_size: int = 512
     shared_expert_intermediate_size: Optional[int] = None
     shared_expert_gated: bool = False  # qwen3-next: sigmoid(gate(x))·shared(x)
+    shared_expert_activation: str = "silu"  # nemotron: relu2 (non-gated)
     capacity_factor: float = 1.25    # static-shape dispatch headroom
     # "capacity": einsum dispatch with padding (EP-friendly; GSPMD A2A)
     # "dropless": sort + ragged grouped GEMM (no drops; ep=1 meshes)
@@ -47,6 +51,20 @@ class MoEConfig:
                 f"Unknown MoE dispatcher '{self.dispatcher}' "
                 "(expected 'capacity' or 'dropless')"
             )
+        known_acts = ("silu", "geglu", "quick_geglu", "relu2", "swigluoai")
+        for field in ("expert_activation", "shared_expert_activation"):
+            if getattr(self, field) not in known_acts:
+                raise ValueError(
+                    f"Unknown {field} '{getattr(self, field)}' (expected one of {known_acts})"
+                )
+
+    @property
+    def gated_experts(self) -> bool:
+        return self.expert_activation != "relu2"
+
+    @property
+    def shared_expert_is_gated(self) -> bool:
+        return self.shared_expert_activation != "relu2"
 
     @property
     def shared_intermediate(self) -> int:

@@ -49,27 +49,33 @@ def init_experts(cfg: MoEConfig, hidden_size: int, rng: jax.Array) -> dict:
     k1, k2, k3 = jax.random.split(rng, 3)
     std_in, std_out = H ** -0.5, I ** -0.5
     params = {
-        "gate_proj": {"kernel": std_in * jax.random.truncated_normal(k1, -3, 3, (E, H, I))},
         "up_proj": {"kernel": std_in * jax.random.truncated_normal(k2, -3, 3, (E, H, I))},
         "down_proj": {"kernel": std_out * jax.random.truncated_normal(k3, -3, 3, (E, I, H))},
     }
+    if cfg.gated_experts:
+        params["gate_proj"] = {
+            "kernel": std_in * jax.random.truncated_normal(k1, -3, 3, (E, H, I))
+        }
     if cfg.expert_bias:
-        params["gate_proj"]["bias"] = jnp.zeros((E, I))
         params["up_proj"]["bias"] = jnp.zeros((E, I))
         params["down_proj"]["bias"] = jnp.zeros((E, H))
+        if cfg.gated_experts:
+            params["gate_proj"]["bias"] = jnp.zeros((E, I))
     return params
 
 
 def expert_param_specs(cfg: MoEConfig) -> dict:
     specs = {
-        "gate_proj": {"kernel": ("expert", "expert_embed", "expert_mlp")},
         "up_proj": {"kernel": ("expert", "expert_embed", "expert_mlp")},
         "down_proj": {"kernel": ("expert", "expert_mlp", "expert_embed")},
     }
+    if cfg.gated_experts:
+        specs["gate_proj"] = {"kernel": ("expert", "expert_embed", "expert_mlp")}
     if cfg.expert_bias:
-        specs["gate_proj"]["bias"] = ("expert", "expert_mlp")
         specs["up_proj"]["bias"] = ("expert", "expert_mlp")
         specs["down_proj"]["bias"] = ("expert", "expert_embed")
+        if cfg.gated_experts:
+            specs["gate_proj"]["bias"] = ("expert", "expert_mlp")
     return specs
 
 
@@ -148,12 +154,16 @@ def experts_forward_dropless(
     # masked tokens carry the sentinel index E (see gate_forward) — clip once
     # for the bias gathers; their rows are zero-weighted in the combine anyway
     safe_expert = jnp.clip(expert_of, 0, E - 1)
-    g = jax.lax.ragged_dot(xs, params["gate_proj"]["kernel"].astype(dtype), group_sizes)
     u = jax.lax.ragged_dot(xs, params["up_proj"]["kernel"].astype(dtype), group_sizes)
-    if "bias" in params["gate_proj"]:
-        g = g + jnp.take(params["gate_proj"]["bias"].astype(dtype), safe_expert, axis=0)
+    if "bias" in params["up_proj"]:
         u = u + jnp.take(params["up_proj"]["bias"].astype(dtype), safe_expert, axis=0)
-    h_in = gated_combine(g, u, cfg.expert_activation, cfg.swiglu_limit)
+    if cfg.gated_experts:
+        g = jax.lax.ragged_dot(xs, params["gate_proj"]["kernel"].astype(dtype), group_sizes)
+        if "bias" in params["gate_proj"]:
+            g = g + jnp.take(params["gate_proj"]["bias"].astype(dtype), safe_expert, axis=0)
+        h_in = gated_combine(g, u, cfg.expert_activation, cfg.swiglu_limit)
+    else:
+        h_in = _EXPERT_ACT[cfg.expert_activation](u)
     y = jax.lax.ragged_dot(h_in, params["down_proj"]["kernel"].astype(dtype), group_sizes)
     if "bias" in params["down_proj"]:
         y = y + jnp.take(params["down_proj"]["bias"].astype(dtype), safe_expert, axis=0)
@@ -226,12 +236,16 @@ def _dropless_ep_local(params, cfg, x, weights, indices, *, axis_name, bucket):
     group_sizes = jnp.bincount(key, length=E_loc + 1)[:E_loc].astype(jnp.int32)
     safe_le = jnp.clip(jnp.take(key, sort2), 0, E_loc - 1)
 
-    g = lax.ragged_dot(xs2, params["gate_proj"]["kernel"].astype(dtype), group_sizes)
     u = lax.ragged_dot(xs2, params["up_proj"]["kernel"].astype(dtype), group_sizes)
-    if "bias" in params["gate_proj"]:
-        g = g + jnp.take(params["gate_proj"]["bias"].astype(dtype), safe_le, axis=0)
+    if "bias" in params["up_proj"]:
         u = u + jnp.take(params["up_proj"]["bias"].astype(dtype), safe_le, axis=0)
-    h_in = gated_combine(g, u, cfg.expert_activation, cfg.swiglu_limit)
+    if cfg.gated_experts:
+        g = lax.ragged_dot(xs2, params["gate_proj"]["kernel"].astype(dtype), group_sizes)
+        if "bias" in params["gate_proj"]:
+            g = g + jnp.take(params["gate_proj"]["bias"].astype(dtype), safe_le, axis=0)
+        h_in = gated_combine(g, u, cfg.expert_activation, cfg.swiglu_limit)
+    else:
+        h_in = _EXPERT_ACT[cfg.expert_activation](u)
     y2 = lax.ragged_dot(h_in, params["down_proj"]["kernel"].astype(dtype), group_sizes)
     if "bias" in params["down_proj"]:
         y2 = y2 + jnp.take(params["down_proj"]["bias"].astype(dtype), safe_le, axis=0)
@@ -273,7 +287,11 @@ def experts_forward_dropless_ep(
 
     tok = P(("dp_replicate", "dp_shard", "ep", "cp"), None)
     tok_k = tok
-    eparams = {proj: params[proj] for proj in ("gate_proj", "up_proj", "down_proj")}
+    eparams = {
+        proj: params[proj]
+        for proj in ("gate_proj", "up_proj", "down_proj")
+        if proj in params
+    }
     espec = {
         proj: {k: P("ep", *([None] * (v.ndim - 1))) for k, v in eparams[proj].items()}
         for proj in eparams
@@ -310,12 +328,16 @@ def experts_forward(
     # tokens → expert-major: XLA inserts the A2A here when ep-sharded
     xe = jnp.einsum("tec,th->ech", dispatch.astype(dtype), x)
     xe = c(xe, ("act_expert", None, "act_embed"))
-    g = jnp.einsum("ech,ehi->eci", xe, params["gate_proj"]["kernel"].astype(dtype))
     u = jnp.einsum("ech,ehi->eci", xe, params["up_proj"]["kernel"].astype(dtype))
-    if "bias" in params["gate_proj"]:
-        g = g + params["gate_proj"]["bias"].astype(dtype)[:, None, :]
+    if "bias" in params["up_proj"]:
         u = u + params["up_proj"]["bias"].astype(dtype)[:, None, :]
-    h_in = gated_combine(g, u, cfg.expert_activation, cfg.swiglu_limit)
+    if cfg.gated_experts:
+        g = jnp.einsum("ech,ehi->eci", xe, params["gate_proj"]["kernel"].astype(dtype))
+        if "bias" in params["gate_proj"]:
+            g = g + params["gate_proj"]["bias"].astype(dtype)[:, None, :]
+        h_in = gated_combine(g, u, cfg.expert_activation, cfg.swiglu_limit)
+    else:
+        h_in = _EXPERT_ACT[cfg.expert_activation](u)
     y = jnp.einsum("eci,eih->ech", h_in, params["down_proj"]["kernel"].astype(dtype))
     if "bias" in params["down_proj"]:
         y = y + params["down_proj"]["bias"].astype(dtype)[:, None, :]

@@ -41,10 +41,13 @@ def init_moe(cfg: MoEConfig, hidden_size: int, rng: jax.Array) -> dict:
         std_in, std_out = hidden_size ** -0.5, Hs ** -0.5
         k1, k2, k3 = jax.random.split(ks, 3)
         params["shared"] = {
-            "gate_proj": {"kernel": std_in * jax.random.truncated_normal(k1, -3, 3, (hidden_size, Hs))},
             "up_proj": {"kernel": std_in * jax.random.truncated_normal(k2, -3, 3, (hidden_size, Hs))},
             "down_proj": {"kernel": std_out * jax.random.truncated_normal(k3, -3, 3, (Hs, hidden_size))},
         }
+        if cfg.shared_expert_is_gated:
+            params["shared"]["gate_proj"] = {
+                "kernel": std_in * jax.random.truncated_normal(k1, -3, 3, (hidden_size, Hs))
+            }
         if cfg.shared_expert_gated:
             params["shared"]["gate"] = {
                 "kernel": std_in * jax.random.truncated_normal(
@@ -61,10 +64,11 @@ def moe_param_specs(cfg: MoEConfig) -> dict:
     }
     if cfg.n_shared_experts > 0:
         specs["shared"] = {
-            "gate_proj": {"kernel": ("embed", "mlp")},
             "up_proj": {"kernel": ("embed", "mlp")},
             "down_proj": {"kernel": ("mlp", "embed")},
         }
+        if cfg.shared_expert_is_gated:
+            specs["shared"]["gate_proj"] = {"kernel": ("embed", "mlp")}
         if cfg.shared_expert_gated:
             specs["shared"]["gate"] = {"kernel": ("embed", None)}
     return specs
@@ -101,11 +105,17 @@ def moe_forward(
         routed = experts_forward(params["experts"], cfg, flat, dispatch, combine, constrain)
     out = routed
     if cfg.n_shared_experts > 0:
+        from automodel_tpu.moe.experts import _EXPERT_ACT, gated_combine
+
         sp = params["shared"]
         dtype = x.dtype
-        g = jax.nn.silu(flat @ sp["gate_proj"]["kernel"].astype(dtype))
         u = flat @ sp["up_proj"]["kernel"].astype(dtype)
-        shared_out = (g * u) @ sp["down_proj"]["kernel"].astype(dtype)
+        if cfg.shared_expert_is_gated:
+            g = flat @ sp["gate_proj"]["kernel"].astype(dtype)
+            inner = gated_combine(g, u, cfg.shared_expert_activation, cfg.swiglu_limit)
+        else:
+            inner = _EXPERT_ACT[cfg.shared_expert_activation](u)
+        shared_out = inner @ sp["down_proj"]["kernel"].astype(dtype)
         if cfg.shared_expert_gated:
             shared_out = shared_out * jax.nn.sigmoid(
                 flat @ sp["gate"]["kernel"].astype(dtype)

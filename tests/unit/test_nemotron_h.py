@@ -1,0 +1,131 @@
+"""NemotronH hybrid (mamba + attention + mlp + moe) family tests.
+
+No HF oracle exists in the installed transformers (no nemotron_h module),
+so parity is pinned structurally: causality through the mixed stack,
+packed-document isolation through the mamba conv+scan, adapter roundtrip
+identity, and the full train recipe over an EP mesh (reference:
+nemo_automodel/components/models/nemotron_v3/, tests/unit_tests/models/).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu.models.hybrid import nemotron_h as nh
+
+DENSE_HF = {
+    "architectures": ["NemotronHForCausalLM"],
+    "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+    "num_hidden_layers": 4, "hybrid_override_pattern": "M*-M",
+    "num_attention_heads": 4, "num_key_value_heads": 2, "attention_head_dim": 8,
+    "mamba_num_heads": 4, "mamba_head_dim": 8, "ssm_state_size": 16,
+    "n_groups": 2,
+}
+
+MOE_HF = dict(
+    DENSE_HF,
+    architectures=["NemotronHForCausalLM"],
+    hybrid_override_pattern="ME*E",
+    n_routed_experts=4, num_experts_per_tok=2, moe_intermediate_size=16,
+    moe_shared_expert_intermediate_size=16,
+)
+
+
+def test_pattern_parsing_and_registry():
+    from automodel_tpu.models.registry import get_model_spec
+
+    spec = get_model_spec(DENSE_HF)
+    cfg = spec.config_from_hf(DENSE_HF)
+    assert cfg.block_pattern == ("mamba", "attention", "mlp", "mamba")
+    cfg2 = nh.from_hf_config(MOE_HF)
+    assert cfg2.block_pattern == ("mamba", "moe", "attention", "moe")
+    assert cfg2.moe is not None
+    assert cfg2.moe.expert_activation == "relu2"
+    assert not cfg2.moe.gated_experts  # relu2 experts are non-gated
+    assert cfg2.moe.score_func == "sigmoid"
+
+
+def test_dense_causality_and_grads():
+    cfg = nh.from_hf_config(DENSE_HF)
+    p = nh.init(cfg, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (2, 16), 0, 128)
+    out = nh.forward(p, cfg, ids)
+    assert bool(jnp.isfinite(out).all())
+    # causality through every mixer kind: flipping the last token must not
+    # change earlier logits
+    ids2 = ids.at[:, -1].set((ids[:, -1] + 1) % 128)
+    out2 = nh.forward(p, cfg, ids2)
+    np.testing.assert_allclose(
+        np.asarray(out[:, :-1]), np.asarray(out2[:, :-1]), atol=1e-5
+    )
+
+    def loss(pp):
+        return jnp.mean(
+            jax.nn.logsumexp(nh.forward(pp, cfg, ids), axis=-1)
+        )
+
+    grads = jax.grad(loss)(p)
+    assert all(bool(jnp.isfinite(g).all()) for g in jax.tree.leaves(grads))
+
+
+def test_packed_segment_isolation():
+    """Concatenating two docs with segment ids must reproduce each doc run
+    alone — the conv taps and the SSD state reset at doc boundaries, and
+    attention masks across segments."""
+    cfg = nh.from_hf_config(DENSE_HF)
+    p = nh.init(cfg, jax.random.key(0))
+    a = jax.random.randint(jax.random.key(1), (1, 8), 0, 128)
+    b = jax.random.randint(jax.random.key(2), (1, 8), 0, 128)
+    packed = jnp.concatenate([a, b], axis=1)
+    seg = jnp.concatenate(
+        [jnp.zeros((1, 8), jnp.int32), jnp.ones((1, 8), jnp.int32)], axis=1
+    )
+    pos = jnp.concatenate([jnp.arange(8), jnp.arange(8)])[None]
+    out_packed = nh.forward(p, cfg, packed, segment_ids=seg, positions=pos)
+    out_a = nh.forward(p, cfg, a)
+    out_b = nh.forward(p, cfg, b)
+    np.testing.assert_allclose(
+        np.asarray(out_packed[:, :8]), np.asarray(out_a), atol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_packed[:, 8:]), np.asarray(out_b), atol=2e-4
+    )
+
+
+def test_adapter_roundtrip():
+    cfg = nh.from_hf_config(dict(MOE_HF, hybrid_override_pattern="M*-E"))
+    p = nh.init(cfg, jax.random.key(0))
+    ad = nh.NemotronHAdapter(cfg)
+    sd = dict(ad.to_hf(p))
+    # HF-style key layout present
+    assert "backbone.layers.0.mixer.A_log" in sd
+    assert "backbone.layers.1.mixer.q_proj.weight" in sd
+    assert "backbone.layers.2.mixer.up_proj.weight" in sd
+    assert "backbone.layers.3.mixer.experts.0.up_proj.weight" in sd
+    p2 = ad.from_hf(lambda k: sd[k])
+    ids = jax.random.randint(jax.random.key(1), (2, 16), 0, 128)
+    o1, _ = nh.forward(p, cfg, ids)
+    o2, _ = nh.forward(p2, cfg, ids)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), atol=1e-5)
+
+
+def test_nemotron_h_recipe_ep_mesh(tmp_path):
+    from automodel_tpu.cli.app import resolve_recipe_class
+    from tests.unit.test_recipe import _smoke_cfg
+
+    cfg = _smoke_cfg(tmp_path)
+    cfg.set("model.hf_config", MOE_HF)
+    cfg.set("distributed", {"dp_shard": -1, "ep": 2})
+    cfg.set("checkpoint.enabled", False)
+    cfg.set("step_scheduler.max_steps", 3)
+    r = resolve_recipe_class(cfg)(cfg)
+    r.setup()
+    assert r.is_moe
+    r.run_train_validation_loop()
+    recs = [json.loads(l) for l in open(tmp_path / "training.jsonl") if l.strip()]
+    assert len(recs) == 3
+    assert all(np.isfinite(x["loss"]) for x in recs)
+    assert "moe_load_imbalance" in recs[-1]
