@@ -7,8 +7,10 @@ batched pass over the prompt collecting per-layer K/V as scan outputs;
 decode is a `lax.scan` over new tokens with an inner layer scan — the whole
 generate call is one jit with no dynamic shapes.
 
-Scope: the dense GQA decoder (models/llm/decoder). Greedy or temperature
-sampling. MoE/MLA decode and batched beam search are next-round work.
+Scope: the dense GQA decoder (models/llm/decoder), including sliding
+windows (global/alternating per-layer patterns — gemma2/gpt-oss style) and
+attention sinks. Greedy or temperature sampling. MoE/MLA decode and batched
+beam search are next-round work.
 """
 
 from __future__ import annotations
@@ -40,9 +42,13 @@ class GenerateConfig:
     eos_token_id: int | None = None
 
 
-def _attend(q, keys, values, mask_len, cfg, *, q_positions):
+def _attend(q, keys, values, mask_len, cfg, *, q_positions, window=None, sinks=None):
     """q (B,Sq,Hq,D) vs cache keys/values (B,T,Hkv,D); attend to < mask_len
-    (per-query causal when q spans several positions)."""
+    (per-query causal when q spans several positions).
+
+    `window` is a (possibly traced) per-layer sliding window size (0 =
+    global); `sinks` the (Hq,) learned sink logits (gpt-oss). Both ride the
+    layer scan so alternating-window / sinked models decode in one jit."""
     B, Sq, Hq, D = q.shape
     T, Hkv = keys.shape[1], keys.shape[2]
     G = Hq // Hkv
@@ -55,13 +61,24 @@ def _attend(q, keys, values, mask_len, cfg, *, q_positions):
     kv_idx = jnp.arange(T)
     mask = kv_idx[None, :] <= q_positions[:, :, None]  # (B, Sq, T) causal
     mask = jnp.logical_and(mask, (kv_idx < mask_len)[None, None, :])
+    if window is not None:
+        # window==0 → global; else attend only the last `window` positions
+        dist = q_positions[:, :, None] - kv_idx[None, None, :]
+        mask = jnp.logical_and(mask, (window == 0) | (dist < window))
     s = jnp.where(mask[:, None, None, :, :], s, NEG_INF)
+    if sinks is not None:
+        sink = jnp.broadcast_to(
+            sinks.astype(jnp.float32).reshape(1, Hkv, G, 1, 1), (B, Hkv, G, Sq, 1)
+        )
+        s = jnp.concatenate([s, sink], axis=-1)
     p = jax.nn.softmax(s, axis=-1)
+    if sinks is not None:
+        p = p[..., :-1]
     o = jnp.einsum("bkgst,btkd->bskgd", p.astype(values.dtype), values)
     return o.reshape(B, Sq, Hq, D)
 
 
-def _layer_with_cache(h, lp, cfg, positions, inv_freq, cache_k, cache_v, write_at, attend_len):
+def _layer_with_cache(h, lp, cfg, positions, inv_freq, cache_k, cache_v, write_at, attend_len, window=None):
     """Run one decoder layer, writing this chunk's K/V into the cache at
     `write_at` and attending over cache[:attend_len]."""
     B, Sq, _ = h.shape
@@ -69,7 +86,10 @@ def _layer_with_cache(h, lp, cfg, positions, inv_freq, cache_k, cache_v, write_a
     q, k, v = project_qkv(x, lp, cfg, positions, inv_freq)
     cache_k = jax.lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype), (0, write_at, 0, 0))
     cache_v = jax.lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype), (0, write_at, 0, 0))
-    attn = _attend(q, cache_k, cache_v, attend_len, cfg, q_positions=positions)
+    attn = _attend(
+        q, cache_k, cache_v, attend_len, cfg, q_positions=positions,
+        window=window, sinks=lp.get("sinks"),
+    )
     attn = attn.reshape(B, Sq, cfg.num_heads * cfg.resolved_head_dim)
     attn_out = _dense(attn, lp["o_proj"])
     if cfg.use_post_norms:
@@ -98,14 +118,23 @@ def generate(
     gen: GenerateConfig = GenerateConfig(),
 ) -> jnp.ndarray:
     """Returns (B, S_prompt + max_new_tokens) token ids."""
-    if cfg.sliding_window is not None or cfg.attention_type != "gqa":
-        raise NotImplementedError("generate: dense global-attention GQA only (r1)")
+    if cfg.attention_type != "gqa":
+        raise NotImplementedError("generate: MLA decode cache lands with DSA (r3)")
     params = cast_params(params, cfg.dtype)
     B, S = input_ids.shape
     T = S + gen.max_new_tokens
     D = cfg.resolved_head_dim
     inv_freq = rope_frequencies(cfg.rope_dim, cfg.rope_theta, cfg.rope_scaling)
     L = jax.tree.leaves(params["layers"])[0].shape[0]
+
+    from automodel_tpu.models.llm.decoder import layer_windows
+
+    # per-layer sliding windows ride the layer scans as an (L,) array
+    # (0 = global) so alternating-window models (gemma2/gpt-oss) decode
+    # without per-layer python dispatch
+    windows = jnp.asarray(
+        [w or 0 for w in layer_windows(cfg, L)], jnp.int32
+    )
 
     cache_shape = (L, B, T, cfg.num_kv_heads, D)
     cache_k = jnp.zeros(cache_shape, cfg.dtype)
@@ -117,12 +146,14 @@ def generate(
 
     def prefill_layer(carry, xs):
         h, = carry
-        lp, ck, cv = xs
-        h, ck, cv = _layer_with_cache(h, lp, cfg, positions, inv_freq, ck, cv, 0, S)
+        lp, ck, cv, win = xs
+        h, ck, cv = _layer_with_cache(
+            h, lp, cfg, positions, inv_freq, ck, cv, 0, S, window=win
+        )
         return (h,), (ck, cv)
 
     (h,), (cache_k, cache_v) = jax.lax.scan(
-        prefill_layer, (h,), (params["layers"], cache_k, cache_v)
+        prefill_layer, (h,), (params["layers"], cache_k, cache_v, windows)
     )
     h_last = rms_norm(h[:, -1:], params["final_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
     logits = unembed(params, cfg, h_last)[:, 0]
@@ -147,14 +178,14 @@ def generate(
 
         def layer(carry, xs):
             h, = carry
-            lp, ck, cv = xs
+            lp, ck, cv, win = xs
             h, ck, cv = _layer_with_cache(
-                h, lp, cfg, positions, inv_freq, ck, cv, pos, pos + 1
+                h, lp, cfg, positions, inv_freq, ck, cv, pos, pos + 1, window=win
             )
             return (h,), (ck, cv)
 
         (h,), (cache_k, cache_v) = jax.lax.scan(
-            layer, (h,), (params["layers"], cache_k, cache_v)
+            layer, (h,), (params["layers"], cache_k, cache_v, windows)
         )
         h = rms_norm(h, params["final_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
         logits = unembed(params, cfg, h)[:, 0]

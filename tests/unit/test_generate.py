@@ -51,13 +51,46 @@ def test_temperature_sampling_valid_and_varied():
     assert not np.array_equal(np.asarray(a), np.asarray(b))  # keys differ
 
 
-def test_generate_rejects_unsupported():
+def test_generate_rejects_mla():
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        CFG, attention_type="mla", mla_kv_lora_rank=16,
+        mla_qk_nope_head_dim=8, mla_qk_rope_head_dim=8, mla_v_head_dim=8,
+    )
+    params = decoder.init(cfg, jax.random.key(0))
+    with pytest.raises(NotImplementedError):
+        generate(params, cfg, jnp.zeros((1, 4), jnp.int32), jax.random.key(0))
+
+
+def test_sliding_window_matches_naive():
     import dataclasses
 
     cfg = dataclasses.replace(CFG, sliding_window=4)
     params = decoder.init(cfg, jax.random.key(0))
-    with pytest.raises(NotImplementedError):
-        generate(params, cfg, jnp.zeros((1, 4), jnp.int32), jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(9), (2, 7), 0, 64)
+    fast = generate(params, cfg, prompt, jax.random.key(2), GenerateConfig(max_new_tokens=6))
+    slow = _naive_greedy(params, cfg, prompt, 6)
+    np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
+
+
+def test_alternating_windows_and_sinks_match_naive():
+    """gemma2/gpt-oss shape: per-layer sliding/global pattern + sinks."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        CFG, sliding_window=4, layer_types=("sliding", "global"),
+        attention_sinks=True,
+    )
+    params = decoder.init(cfg, jax.random.key(0))
+    # non-zero sinks so the path is actually exercised
+    params["layers"]["sinks"] = 0.5 + 0.1 * jax.random.normal(
+        jax.random.key(11), params["layers"]["sinks"].shape
+    )
+    prompt = jax.random.randint(jax.random.key(10), (2, 7), 0, 64)
+    fast = generate(params, cfg, prompt, jax.random.key(2), GenerateConfig(max_new_tokens=6))
+    slow = _naive_greedy(params, cfg, prompt, 6)
+    np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
 
 
 def test_eos_early_stop_pads_with_eos():
