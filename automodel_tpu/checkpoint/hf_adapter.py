@@ -135,6 +135,17 @@ class DenseDecoderAdapter:
             ]
         else:
             e.append(("self_attn.q_proj.weight", ("q_proj", "kernel"), True, "q_rope"))
+        if getattr(cfg, "dsa_index_topk", None) is not None:
+            # DSA lightning indexer — OUR uncompressed parameterization
+            # (reference DSv4 checkpoints carry the compressed
+            # wkv/wq_b/weights_proj form, which is not layout-compatible;
+            # those keys are absent here, the loaders treat indexer entries
+            # as optional, and the recipe backfills + warns)
+            e += [
+                ("self_attn.indexer.wq.weight", ("indexer", "wq", "kernel"), True),
+                ("self_attn.indexer.wk.weight", ("indexer", "wk", "kernel"), True),
+                ("self_attn.indexer.wgate.weight", ("indexer", "wgate", "kernel"), True),
+            ]
         # note: MLA models pair with the MoE adapter; MLP entries come from
         # the dense path only for the first-k dense layers
         e += [
@@ -219,12 +230,17 @@ class DenseDecoderAdapter:
                     continue
                 raise
         for suffix, path, transpose, tr in self._layer_entries():
-            stacked = np.stack(
-                [
-                    one(f"model.layers.{i}.{suffix}", transpose, tr)
-                    for i in range(self.cfg.num_layers)
-                ]
-            )
+            try:
+                stacked = np.stack(
+                    [
+                        one(f"model.layers.{i}.{suffix}", transpose, tr)
+                        for i in range(self.cfg.num_layers)
+                    ]
+                )
+            except KeyError:
+                if path[0] == "indexer":  # optional: see _mla_layer_entries
+                    continue
+                raise
             put(("layers",) + path, stacked)
         return out
 
@@ -350,17 +366,27 @@ class MoEDecoderAdapter:
         fk = cfg.first_k_dense
         if fk:
             for suffix, path, transpose, tr in dense._layer_entries():
-                stacked = np.stack(
-                    [one(f"model.layers.{i}.{suffix}", transpose, tr) for i in range(fk)]
-                )
+                try:
+                    stacked = np.stack(
+                        [one(f"model.layers.{i}.{suffix}", transpose, tr) for i in range(fk)]
+                    )
+                except KeyError:
+                    if path[0] == "indexer":  # optional: see _mla_layer_entries
+                        continue
+                    raise
                 put(("dense_layers",) + path, stacked)
         for suffix, path, transpose, tr in self._attn_entries():
-            stacked = np.stack(
-                [
-                    one(f"model.layers.{fk + li}.{suffix}", transpose, tr)
-                    for li in range(cfg.num_moe_layers)
-                ]
-            )
+            try:
+                stacked = np.stack(
+                    [
+                        one(f"model.layers.{fk + li}.{suffix}", transpose, tr)
+                        for li in range(cfg.num_moe_layers)
+                    ]
+                )
+            except KeyError:
+                if path[0] == "indexer":  # optional: see _mla_layer_entries
+                    continue
+                raise
             put(("moe_layers",) + path, stacked)
         put(
             ("moe_layers", "moe", "gate", "weight"),

@@ -73,6 +73,12 @@ class TransformerConfig:
     mla_qk_nope_head_dim: int = 128
     mla_qk_rope_head_dim: int = 64
     mla_v_head_dim: int = 128
+    # DSA (DeepSeek sparse attention, V3.2/V4): lightning-indexer top-k
+    # sparse MLA. None → dense MLA. (reference: deepseek_v4/layers.py)
+    dsa_index_topk: Optional[int] = None
+    dsa_index_n_heads: int = 4
+    dsa_index_head_dim: int = 64
+    dsa_indexer_loss_coeff: float = 0.01
     # execution knobs
     dtype: Any = jnp.bfloat16
     remat_policy: str = "full"

@@ -63,7 +63,26 @@ def init_mla_layers(cfg, rng: jax.Array, L: int) -> dict:
         }
     else:
         layers["q_proj"] = {"kernel": _stack(dense_init, ks[5], (H, n * qk), L)}
+    if cfg.dsa_index_topk is not None:
+        layers["indexer"] = init_indexer(cfg, jax.random.fold_in(rng, 1234), L)
     return layers
+
+
+def init_indexer(cfg, rng: jax.Array, L: int) -> dict:
+    """Fresh lightning-indexer stack — also used to backfill checkpoints
+    that predate DSA (reference: deepseek_v4 checkpoints carry indexer.*
+    keys; V3-style ones do not)."""
+    from automodel_tpu.models.llm.decoder import _stack
+    from automodel_tpu.models.common.layers import dense_init
+
+    H = cfg.hidden_size
+    Hi, Di = cfg.dsa_index_n_heads, cfg.dsa_index_head_dim
+    ki = jax.random.split(rng, 3)
+    return {
+        "wq": {"kernel": _stack(dense_init, ki[0], (H, Hi * Di), L)},
+        "wk": {"kernel": _stack(dense_init, ki[1], (H, Di), L)},
+        "wgate": {"kernel": _stack(dense_init, ki[2], (H, Hi), L)},
+    }
 
 
 def mla_layer_specs(cfg) -> dict:
@@ -81,19 +100,23 @@ def mla_layer_specs(cfg) -> dict:
         layers["q_up_proj"] = {"kernel": ("layers", None, "heads")}
     else:
         layers["q_proj"] = {"kernel": ("layers", "embed", "heads")}
+    if cfg.dsa_index_topk is not None:
+        layers["indexer"] = {
+            "wq": {"kernel": ("layers", "embed", "heads")},
+            "wk": {"kernel": ("layers", "embed", None)},
+            "wgate": {"kernel": ("layers", "embed", None)},
+        }
     return layers
 
 
-def mla_attention_block(h, lp, cfg, positions, segment_ids, inv_freq, constrain, sliding_window, mesh_ctx=None):
-    """Pre-norm MLA attention with residual (drop-in for attention_block)."""
-    B, S, H = h.shape
-    n = cfg.num_heads
-    dn, dr, dv = cfg.mla_qk_nope_head_dim, cfg.mla_qk_rope_head_dim, cfg.mla_v_head_dim
-
+def _mla_qkv(x, lp, cfg, positions, constrain, inv_freq):
+    """Project normed input to MLA q/k/v (B,S,n,·) and the logit scale."""
     from automodel_tpu.ops.quant import matmul as _mm
 
+    B, S, H = x.shape
+    n = cfg.num_heads
+    dn, dr, dv = cfg.mla_qk_nope_head_dim, cfg.mla_qk_rope_head_dim, cfg.mla_v_head_dim
     prec = cfg.linear_precision
-    x = rms_norm(h, lp["input_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
 
     if cfg.mla_q_lora_rank:
         q_lat = rms_norm(_mm(x, lp["q_down_proj"]["kernel"], prec), lp["q_norm"]["scale"], cfg.rms_norm_eps)
@@ -116,8 +139,67 @@ def mla_attention_block(h, lp, cfg, positions, segment_ids, inv_freq, constrain,
     k = jnp.concatenate([k_nope, jnp.broadcast_to(k_rope, (B, S, n, dr))], axis=-1)
     k = constrain(k, ("act_batch", "act_seq", "act_heads", None))
     v = constrain(v, ("act_batch", "act_seq", "act_heads", None))
-
     scale = cfg.attn_scale if cfg.attn_scale is not None else (dn + dr) ** -0.5
+    return q, k, v, scale
+
+
+def mla_sparse_attention_block(h, lp, cfg, positions, segment_ids, inv_freq, constrain, token_mask=None):
+    """DSA: lightning-indexer top-k sparse MLA (reference:
+    deepseek_v4/layers.py; mask-based like its SDPA fallback path).
+
+    Returns (h_out, indexer_kl_aux) — the aux rides the MoE decoder's loss
+    carry; it is the ONLY gradient path into the indexer (hard top-k).
+    `token_mask` (B,S) excludes pad queries from the indexer KL."""
+    from automodel_tpu.ops.attention import NEG_INF, make_attention_mask
+    from automodel_tpu.ops.dsa import indexer_kl_loss, indexer_scores, topk_select_mask
+    from automodel_tpu.ops.rope import rope_frequencies
+
+    B, S, H = h.shape
+    x = rms_norm(h, lp["input_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
+    q, k, v, scale = _mla_qkv(x, lp, cfg, positions, constrain, inv_freq)
+
+    base_mask = make_attention_mask(
+        S, S, causal=cfg.causal,
+        q_segment_ids=segment_ids, kv_segment_ids=segment_ids,
+        q_positions=positions, kv_positions=positions,
+    )
+    if base_mask is None:
+        base_mask = jnp.ones((1, S, S), bool)
+
+    # same rope scaling as the main path — a yarn-scaled model's indexer
+    # must agree with its attention about long-context positions
+    inv_freq_idx = rope_frequencies(
+        cfg.dsa_index_head_dim, cfg.rope_theta, cfg.rope_scaling
+    )
+    scores = indexer_scores(
+        x, lp["indexer"], cfg.dsa_index_n_heads, cfg.dsa_index_head_dim,
+        positions, inv_freq_idx,
+    )
+    sel = topk_select_mask(scores, base_mask, cfg.dsa_index_topk)
+
+    logits = jnp.einsum("bsnd,btnd->bnst", q, k, preferred_element_type=jnp.float32) * scale
+    logits = jnp.where(sel[:, None, :, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bnst,btnv->bsnv", probs.astype(v.dtype), v)
+
+    aux = cfg.dsa_indexer_loss_coeff * indexer_kl_loss(
+        scores, jnp.mean(probs, axis=1), sel, token_mask=token_mask
+    )
+
+    attn = out.reshape(B, S, cfg.num_heads * cfg.mla_v_head_dim)
+    h = h + _dense(attn, {"kernel": lp["o_proj"]["kernel"]}, cfg.linear_precision)
+    return constrain(h, ("act_batch", "act_seq", "act_embed")), aux
+
+
+def mla_attention_block(h, lp, cfg, positions, segment_ids, inv_freq, constrain, sliding_window, mesh_ctx=None):
+    """Pre-norm MLA attention with residual (drop-in for attention_block)."""
+    B, S, H = h.shape
+    n = cfg.num_heads
+    dv = cfg.mla_v_head_dim
+
+    x = rms_norm(h, lp["input_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
+    q, k, v, scale = _mla_qkv(x, lp, cfg, positions, constrain, inv_freq)
+
     if mesh_ctx is not None and mesh_ctx.sizes["cp"] > 1:
         from automodel_tpu.parallel.cp import ring_dot_product_attention
 
@@ -140,5 +222,5 @@ def mla_attention_block(h, lp, cfg, positions, segment_ids, inv_freq, constrain,
             impl="xla",  # asymmetric qk/v dims — flash MLA kernel is future work
         )
     attn = attn.reshape(B, S, n * dv)
-    h = h + _dense(attn, {"kernel": lp["o_proj"]["kernel"]}, prec)
+    h = h + _dense(attn, {"kernel": lp["o_proj"]["kernel"]}, cfg.linear_precision)
     return constrain(h, ("act_batch", "act_seq", "act_embed"))

@@ -185,11 +185,28 @@ def forward(
     windows = layer_windows(cfg)
     Lm, E = cfg.num_moe_layers, cfg.moe.n_routed_experts
 
+    # DSA: lightning-indexer sparse MLA returns an indexer-KL aux that rides
+    # the same loss carry as the MoE balance loss (reference: deepseek_v4)
+    use_dsa = cfg.attention_type == "mla" and cfg.dsa_index_topk is not None
+
+    def _attn(h, lp, window):
+        if use_dsa:
+            from automodel_tpu.models.llm.mla import mla_sparse_attention_block
+
+            return mla_sparse_attention_block(
+                h, lp, cfg, positions, segment_ids, inv_freq, constrain,
+                token_mask=token_mask,
+            )
+        h = attention_block(
+            h, lp, cfg, positions, segment_ids, inv_freq, constrain, window, mesh_ctx
+        )
+        return h, jnp.float32(0.0)
+
     def dense_layer(carry, lp, window):
-        h, *rest = carry
-        h = attention_block(h, lp, cfg, positions, segment_ids, inv_freq, constrain, window, mesh_ctx)
+        h, aux, *rest = carry
+        h, idx_aux = _attn(h, lp, window)
         h = mlp_block(h, lp, cfg, constrain)
-        return (h, *rest)
+        return (h, aux + idx_aux, *rest)
 
     K = cfg.moe.experts_per_token
     replay = routing_override is not None
@@ -197,7 +214,8 @@ def forward(
     def moe_layer(carry, xs, window):
         h, aux, stats, routing = carry
         lp, idx = xs
-        h = attention_block(h, lp, cfg, positions, segment_ids, inv_freq, constrain, window, mesh_ctx)
+        h, idx_aux = _attn(h, lp, window)
+        aux = aux + idx_aux
         x = rms_norm(h, lp["post_attn_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
         forced = routing_override[idx] if replay else None
         moe_out, layer_aux, layer_stats = moe_forward(

@@ -86,6 +86,26 @@ def deepseek_v3_moe_config(hf: Mapping[str, Any], **overrides) -> MoETransformer
     return MoETransformerConfig(moe=moe_overrides or moe, first_k_dense=first_k, **kw)
 
 
+def deepseek_v4_config(hf: Mapping[str, Any], **overrides) -> MoETransformerConfig:
+    """DeepseekV4ForCausalLM: the V3 MoE+MLA body plus DSA — the lightning
+    indexer's top-k sparse attention (reference: components/models/
+    deepseek_v4/layers.py Indexer, kernels/sparse_attention.py; index_topk /
+    index_n_heads / index_head_dim are the HF config fields).
+
+    Uncompressed indexer (compress_ratio=0 path); the pooled-KV compressor
+    is a later-round addition. Indexer weights initialize fresh when absent
+    from the checkpoint.
+    """
+    dsa = {}
+    if hf.get("index_topk"):
+        dsa = dict(
+            dsa_index_topk=int(hf["index_topk"]),
+            dsa_index_n_heads=int(hf.get("index_n_heads", 4)),
+            dsa_index_head_dim=int(hf.get("index_head_dim", 64)),
+        )
+    return deepseek_v3_moe_config(hf, **dsa, **overrides)
+
+
 def gpt_oss_config(hf: Mapping[str, Any], **overrides) -> MoETransformerConfig:
     """GptOssForCausalLM: alternating sliding/full attention with learnable
     sinks, biased router, fused-gate_up experts with biases and the clamped

@@ -48,6 +48,10 @@ MODEL_ARCH_MAPPING: dict[str, ModelSpec] = {
         "deepseek_v3", moe_families.deepseek_v3_moe_config, moe_decoder,
         adapter_name="moe_decoder", adapter_kwargs={"style": "deepseek"},
     ),
+    "DeepseekV4ForCausalLM": ModelSpec(
+        "deepseek_v4", moe_families.deepseek_v4_config, moe_decoder,
+        adapter_name="moe_decoder", adapter_kwargs={"style": "deepseek"},
+    ),
     "GptOssForCausalLM": ModelSpec(
         "gpt_oss", moe_families.gpt_oss_config, moe_decoder,
         adapter_name="moe_decoder", adapter_kwargs={"style": "gpt_oss"},

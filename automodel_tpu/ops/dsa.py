@@ -1,0 +1,99 @@
+"""DSA — DeepSeek Sparse Attention (the V3.2/V4 lightning indexer).
+
+The TPU-native analog of the reference's DSA stack (reference:
+nemo_automodel/components/models/deepseek_v4/layers.py Indexer /
+dsv4_indexer_scores; kernels/sparse_attention.py TileLang sparse MLA).
+Design: the mask-based formulation the reference itself uses on its SDPA
+fallback path (`_build_indexer_topk_compressed_mask`, layers.py:670) —
+
+1. lightning indexer scores every (query, key) pair through a few tiny
+   ReLU heads:  I[t,s] = Σ_h w[t,h] · ReLU(q_idx[t,h,:] · k_idx[s,:])
+2. per query, the top-k keys under the causal/segment mask are selected
+3. main MLA attention runs with the selection as an additive mask — XLA
+   keeps everything static-shape and fuses the mask into the softmax
+   (a gather-based Pallas sparse kernel is the later-round optimization;
+   this path is the correctness oracle it will be tested against)
+
+The hard top-k passes no gradient, so the indexer learns from a KL term
+against the main attention's head-averaged distribution (stop-gradient on
+the target), returned as an aux loss the recipe folds into the total.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.ops.attention import NEG_INF
+
+
+def indexer_scores(
+    x: jnp.ndarray,          # (B, S, H) normed layer input, compute dtype
+    ip: dict,                # {"wq","wk","wgate"} kernels (+ optional rope)
+    n_heads: int,
+    head_dim: int,
+    positions: jnp.ndarray,  # (B, S)
+    inv_freq: jnp.ndarray | None,
+) -> jnp.ndarray:
+    """Lightning indexer scores (B, S, S) fp32 (queries × keys)."""
+    from automodel_tpu.ops.rope import apply_rope
+
+    B, S, H = x.shape
+    q = (x @ ip["wq"]["kernel"].astype(x.dtype)).reshape(B, S, n_heads, head_dim)
+    k = (x @ ip["wk"]["kernel"].astype(x.dtype)).reshape(B, S, 1, head_dim)
+    if inv_freq is not None:
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+    w = x @ ip["wgate"]["kernel"].astype(x.dtype)  # (B, S, n_heads)
+    dots = jnp.einsum(
+        "bthd,bsd->bhts", q, k[:, :, 0, :], preferred_element_type=jnp.float32
+    )  # (B, Hi, S, S)
+    dots = jax.nn.relu(dots) * (head_dim ** -0.5)
+    return jnp.einsum("bth,bhts->bts", w.astype(jnp.float32), dots)
+
+
+def topk_select_mask(
+    scores: jnp.ndarray,        # (B, S, S) fp32 indexer scores
+    base_mask: jnp.ndarray,     # (B?, S, S) bool causal/segment mask
+    k: int,
+) -> jnp.ndarray:
+    """Boolean (B, S, S) selection: per query, the top-k admissible keys.
+
+    When fewer than k keys are admissible (early queries under causality)
+    every admissible key is selected — matching the reference's clamping of
+    indexer_topk to the valid prefix."""
+    if base_mask.ndim == 2:
+        base_mask = base_mask[None]
+    masked = jnp.where(base_mask, scores, -jnp.inf)
+    S = scores.shape[-1]
+    k = min(k, S)
+    # threshold = k-th largest admissible score per query
+    thresh = jax.lax.top_k(masked, k)[0][..., -1:]  # (B, S, 1)
+    sel = masked >= thresh
+    return jnp.logical_and(sel, base_mask)
+
+
+def indexer_kl_loss(
+    scores: jnp.ndarray,      # (B, S, S) fp32 indexer scores
+    main_probs: jnp.ndarray,  # (B, S, S) fp32 head-averaged attention probs
+    select_mask: jnp.ndarray, # (B, S, S) bool selected set
+    token_mask: jnp.ndarray | None = None,  # (B, S) bool; False = pad query
+) -> jnp.ndarray:
+    """KL(p_main ‖ p_indexer) over the selected set, mean per real query.
+
+    Both distributions renormalize over the selected keys; the main
+    attention target is stop-gradiented so only the indexer learns from
+    this term (reference: the DSA indexer training objective). Pad queries
+    (token_mask False) are excluded — they would otherwise train the
+    indexer on garbage distributions."""
+    neg = jnp.float32(NEG_INF)
+    s = jnp.where(select_mask, scores, neg)
+    logq = jax.nn.log_softmax(s, axis=-1)
+    p = jnp.where(select_mask, jax.lax.stop_gradient(main_probs), 0.0)
+    p = p / jnp.maximum(jnp.sum(p, -1, keepdims=True), 1e-9)
+    logp = jnp.log(jnp.maximum(p, 1e-9))
+    kl = jnp.sum(p * (logp - logq), axis=-1)  # (B, S)
+    if token_mask is None:
+        return jnp.mean(kl)
+    m = token_mask.astype(jnp.float32)
+    return jnp.sum(kl * m) / jnp.maximum(jnp.sum(m), 1.0)

@@ -233,6 +233,22 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             )
             params = adapter.from_hf(self._hf_reader, shardings=self.param_shardings)
             params = jax.tree.map(lambda p: jnp.asarray(p, jnp.float32), params)
+            if getattr(self.model_cfg, "dsa_index_topk", None) is not None:
+                # V3-style checkpoints predate DSA — backfill fresh indexers
+                from automodel_tpu.models.llm.mla import init_indexer
+
+                for stack_key in ("dense_layers", "moe_layers", "layers"):
+                    if stack_key in params and "indexer" not in params[stack_key]:
+                        logger.warning(
+                            "checkpoint carries no compatible DSA indexer "
+                            "weights for %s — initializing fresh (top-k "
+                            "selection starts untrained)", stack_key,
+                        )
+                        L_stack = jax.tree.leaves(params[stack_key])[0].shape[0]
+                        params[stack_key]["indexer"] = jax.device_put(
+                            init_indexer(self.model_cfg, self.rng.next_key(), L_stack),
+                            self.param_shardings[stack_key]["indexer"],
+                        )
             if self.is_moe and getattr(self.model_cfg, "mtp_num_layers", 0) > 0 and "mtp" not in params:
                 # MTP weights are training-only and not part of HF
                 # checkpoints — initialize them fresh
