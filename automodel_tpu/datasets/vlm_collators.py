@@ -1,0 +1,160 @@
+"""Real VLM SFT datasets/collators: image preprocessing + chat layout.
+
+The analog of the reference's per-family VLM collators (reference:
+nemo_automodel/components/datasets/vlm/collate_fns.py
+`make_*_collate_fns`, datasets.py) without the HF-processor dependency:
+images are resized/normalized here (CLIP statistics by default), the
+`<image>` marker in the conversation expands to the vision tower's patch
+count, and labels supervise assistant responses only — matching the llava
+contract in models/vlm/llava.py (image embeds scatter into the positions
+holding `image_token_id`).
+
+Rows (JSONL, `data_path`):
+
+    {"image": "path.png" | "path.npy" | [[...]] inline array,
+     "prompt": "describe the image",
+     "response": "a cat on a mat"}
+
+or multi-turn:
+
+    {"image": ..., "conversations": [{"role": "user", "content": "..."},
+                                     {"role": "assistant", "content": "..."}]}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+
+IGNORE_INDEX = -100
+
+# CLIP/SigLIP normalization (reference: vlm collators' processor defaults)
+CLIP_MEAN = np.array([0.48145466, 0.4578275, 0.40821073], np.float32)
+CLIP_STD = np.array([0.26862954, 0.26130258, 0.27577711], np.float32)
+
+
+def load_image(spec, base_dir: str = "") -> np.ndarray:
+    """image spec → float32 (H, W, C) in [0, 1]."""
+    if isinstance(spec, (list, tuple)):
+        arr = np.asarray(spec, np.float32)
+    elif isinstance(spec, np.ndarray):
+        arr = spec.astype(np.float32)
+    else:
+        path = os.path.join(base_dir, spec) if base_dir else spec
+        if path.endswith(".npy"):
+            arr = np.load(path).astype(np.float32)
+        else:
+            from PIL import Image
+
+            with Image.open(path) as im:
+                arr = np.asarray(im.convert("RGB"), np.float32) / 255.0
+    if arr.ndim == 2:
+        arr = np.repeat(arr[..., None], 3, axis=-1)
+    if arr.max() > 1.5:  # 0-255 range
+        arr = arr / 255.0
+    return arr
+
+
+def resize_bilinear(img: np.ndarray, size: int) -> np.ndarray:
+    """(H, W, C) → (size, size, C) bilinear — numpy-only, deterministic."""
+    H, W, C = img.shape
+    if H == size and W == size:
+        return img
+    ys = (np.arange(size) + 0.5) * H / size - 0.5
+    xs = (np.arange(size) + 0.5) * W / size - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, H - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, W - 1)
+    y1 = np.clip(y0 + 1, 0, H - 1)
+    x1 = np.clip(x0 + 1, 0, W - 1)
+    wy = np.clip(ys - y0, 0.0, 1.0)[:, None, None]
+    wx = np.clip(xs - x0, 0.0, 1.0)[None, :, None]
+    top = img[y0][:, x0] * (1 - wx) + img[y0][:, x1] * wx
+    bot = img[y1][:, x0] * (1 - wx) + img[y1][:, x1] * wx
+    return (top * (1 - wy) + bot * wy).astype(np.float32)
+
+
+def preprocess_image(
+    spec, size: int, base_dir: str = "",
+    mean: np.ndarray = CLIP_MEAN, std: np.ndarray = CLIP_STD,
+) -> np.ndarray:
+    img = resize_bilinear(load_image(spec, base_dir), size)
+    return (img - mean) / std
+
+
+@dataclasses.dataclass
+class VLMSFTDatasetConfig:
+    """JSONL image+text SFT (the reference's `make_vlm_dataset` analog)."""
+
+    data_path: str = ""
+    image_size: int = 336
+    num_patches: int = 576      # must match the vision tower (size/patch)²
+    image_token_id: int = 32000
+    seq_len: int = 1024
+    pad_token_id: int = 0
+    base_dir: str = ""          # image paths resolve relative to this
+    # chat rendering (no HF chat-template dependency; the reference's
+    # plain llava conversation format)
+    user_prefix: str = "USER: "
+    assistant_prefix: str = " ASSISTANT: "
+    turn_suffix: str = ""
+
+    def build(self, tokenizer) -> "VLMSFTDataset":
+        if not self.data_path:
+            raise ValueError("vlm dataset requires data_path (jsonl)")
+        return VLMSFTDataset(self, tokenizer)
+
+
+class VLMSFTDataset:
+    def __init__(self, config: VLMSFTDatasetConfig, tokenizer):
+        self.config = config
+        self.tokenizer = tokenizer
+        with open(config.data_path) as f:
+            self.rows = [json.loads(l) for l in f if l.strip()]
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def _turns(self, row) -> list:
+        if "conversations" in row:
+            return row["conversations"]
+        return [
+            {"role": "user", "content": row["prompt"]},
+            {"role": "assistant", "content": row["response"]},
+        ]
+
+    def _encode(self, text: str) -> list:
+        return list(self.tokenizer.encode(text, add_special_tokens=False))
+
+    def __getitem__(self, idx: int) -> dict:
+        c = self.config
+        row = self.rows[idx]
+        pixels = preprocess_image(row["image"], c.image_size, c.base_dir)
+
+        # layout: [image patch tokens][turn tokens...]; assistant-only labels
+        ids = [c.image_token_id] * c.num_patches
+        sup = [False] * c.num_patches
+        for turn in self._turns(row):
+            is_asst = turn["role"] == "assistant"
+            prefix = c.assistant_prefix if is_asst else c.user_prefix
+            toks = self._encode(prefix + turn["content"] + c.turn_suffix)
+            ids.extend(toks)
+            sup.extend([is_asst] * len(toks))
+        eos = getattr(self.tokenizer, "eos_token_id", None)
+        if eos is not None:
+            ids.append(eos)
+            sup.append(True)
+
+        ids = ids[: c.seq_len + 1]
+        sup = sup[: c.seq_len + 1]
+        pad = c.seq_len + 1 - len(ids)
+        ids = np.asarray(ids + [c.pad_token_id] * pad, np.int32)
+        sup = np.asarray(sup + [False] * pad, bool)
+        labels = np.where(sup[1:], ids[1:], IGNORE_INDEX).astype(np.int32)
+        return {
+            "input_ids": ids[:-1],
+            "labels": labels,
+            "pixel_values": pixels.astype(np.float32),
+        }

@@ -1,0 +1,147 @@
+"""Real VLM SFT collator tests (image preprocessing + chat layout).
+
+Hermetic: synthetic images (inline arrays / .npy), stub tokenizer — the
+analog of the reference's vlm collate_fns unit tier (reference:
+tests/unit_tests/datasets/vlm/)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from automodel_tpu.datasets.vlm_collators import (
+    CLIP_MEAN,
+    CLIP_STD,
+    IGNORE_INDEX,
+    VLMSFTDatasetConfig,
+    preprocess_image,
+    resize_bilinear,
+)
+
+
+class StubTokenizer:
+    eos_token_id = 2
+    pad_token_id = 0
+
+    def encode(self, text, add_special_tokens=False):
+        return [3 + (ord(c) % 50) for c in text]
+
+
+def test_resize_bilinear_identity_and_downscale():
+    img = np.random.default_rng(0).random((8, 8, 3)).astype(np.float32)
+    np.testing.assert_array_equal(resize_bilinear(img, 8), img)
+    small = resize_bilinear(img, 4)
+    assert small.shape == (4, 4, 3)
+    # downscale preserves the global mean approximately
+    assert abs(small.mean() - img.mean()) < 0.05
+
+
+def test_preprocess_normalizes_with_clip_stats(tmp_path):
+    img = np.ones((6, 6, 3), np.float32) * 0.5
+    p = tmp_path / "img.npy"
+    np.save(p, img)
+    out = preprocess_image(str(p), 6)
+    np.testing.assert_allclose(
+        out, np.broadcast_to((0.5 - CLIP_MEAN) / CLIP_STD, (6, 6, 3)), rtol=1e-5
+    )
+
+
+def test_vlm_sft_layout_and_masking(tmp_path):
+    rows = [
+        {"image": np.full((4, 4, 3), 0.3).tolist(),
+         "prompt": "what", "response": "cat"},
+        {"image": np.full((4, 4, 3), 0.7).tolist(),
+         "conversations": [
+             {"role": "user", "content": "a"},
+             {"role": "assistant", "content": "b"},
+             {"role": "user", "content": "c"},
+             {"role": "assistant", "content": "d"},
+         ]},
+    ]
+    p = tmp_path / "vlm.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows))
+    cfg = VLMSFTDatasetConfig(
+        data_path=str(p), image_size=8, num_patches=4, image_token_id=99,
+        seq_len=64,
+    )
+    ds = cfg.build(StubTokenizer())
+    assert len(ds) == 2
+
+    s = ds[0]
+    assert s["pixel_values"].shape == (8, 8, 3)
+    assert s["input_ids"].shape == (64,) and s["labels"].shape == (64,)
+    # image span: exactly num_patches image tokens at the front, unsupervised
+    assert (s["input_ids"][:4] == 99).all()
+    assert (s["labels"][:3] == IGNORE_INDEX).all()
+    # the user span is masked; the assistant span is supervised
+    n_sup = (s["labels"] != IGNORE_INDEX).sum()
+    assert n_sup > 0
+    # supervised tokens = assistant prefix+content + eos
+    asst_len = len(StubTokenizer().encode(" ASSISTANT: cat")) + 1
+    assert n_sup == asst_len
+
+    # multi-turn: both assistant turns supervised, both user turns masked
+    s2 = ds[1]
+    n_sup2 = (s2["labels"] != IGNORE_INDEX).sum()
+    a1 = len(StubTokenizer().encode(" ASSISTANT: b"))
+    a2 = len(StubTokenizer().encode(" ASSISTANT: d"))
+    assert n_sup2 == a1 + a2 + 1  # + eos
+
+
+def test_vlm_sft_feeds_recipe(tmp_path):
+    """End-to-end: the real collator drives the VLM finetune recipe."""
+    from automodel_tpu.cli.app import resolve_recipe_class
+    from automodel_tpu.config import ConfigNode
+
+    rows = [
+        {"image": (np.random.default_rng(i).random((10, 10, 3))).tolist(),
+         "prompt": f"q{i}", "response": f"answer {i}"}
+        for i in range(16)
+    ]
+    p = tmp_path / "vlm.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows))
+
+    cfg = ConfigNode({
+        "seed": 5, "recipe": "vlm_finetune", "run_dir": str(tmp_path),
+        "auto_resume": False,
+        "model": {
+            "hf_config": {
+                "architectures": ["LlavaForConditionalGeneration"],
+                "image_token_index": 99,
+                "text_config": {
+                    "architectures": ["LlamaForCausalLM"],
+                    "vocab_size": 128, "hidden_size": 32,
+                    "intermediate_size": 64, "num_hidden_layers": 2,
+                    "num_attention_heads": 4, "num_key_value_heads": 2,
+                },
+                "vision_config": {
+                    "hidden_size": 16, "intermediate_size": 32,
+                    "num_hidden_layers": 1, "num_attention_heads": 2,
+                    "image_size": 8, "patch_size": 4, "num_channels": 3,
+                },
+            },
+            "dtype": "float32", "remat_policy": "none",
+        },
+        "distributed": {"dp_shard": -1},
+        "dataset": {
+            "_target_": "automodel_tpu.datasets.vlm_collators.VLMSFTDatasetConfig",
+            "data_path": str(p), "image_size": 8, "num_patches": 4,
+            "image_token_id": 99, "seq_len": 32,
+        },
+        "tokenizer": None,
+        "dataloader": {"microbatch_size": 8, "grad_acc_steps": 1},
+        "optimizer": {"name": "adamw", "lr": 1e-3, "weight_decay": 0.0},
+        "lr_scheduler": {"style": "constant", "warmup_steps": 0},
+        "step_scheduler": {"max_steps": 2, "ckpt_every_steps": 1000},
+        "checkpoint": {"enabled": False},
+        "loss": {"chunk_size": 32},
+    })
+
+    r = resolve_recipe_class(cfg)(cfg)
+    # recipes build datasets through cfg; hand the stub tokenizer in directly
+    r._build_tokenizer = lambda: StubTokenizer()
+    r.setup()
+    r.run_train_validation_loop()
+    recs = [json.loads(l) for l in open(tmp_path / "training.jsonl") if l.strip()]
+    assert len(recs) == 2
+    assert all(np.isfinite(x["loss"]) for x in recs)
