@@ -71,9 +71,15 @@ def _permute_k_rope(kernel: np.ndarray, kv_rank: int, dr: int, inverse: bool) ->
 
 @dataclasses.dataclass
 class DenseDecoderAdapter:
-    """llama/mistral/qwen2/qwen3/gemma2 ↔ models/llm/decoder params."""
+    """llama/mistral/qwen2/qwen3/gemma2/glm4/ernie ↔ models/llm/decoder params.
+
+    `style="glm4"` switches to GLM-4 naming: the fused `mlp.gate_up_proj`
+    (first half gate, second half up — transformers modeling_glm4 Glm4MLP)
+    and the `post_self_attn/post_mlp_layernorm` sandwich-norm names.
+    """
 
     cfg: TransformerConfig
+    style: str = "llama"
 
     # -- name tables ---------------------------------------------------------
     def _layer_entries(self) -> list[tuple[str, tuple, bool]]:
@@ -86,18 +92,28 @@ class DenseDecoderAdapter:
             ("self_attn.k_proj.weight", ("k_proj", "kernel"), True),
             ("self_attn.v_proj.weight", ("v_proj", "kernel"), True),
             ("self_attn.o_proj.weight", ("o_proj", "kernel"), True),
-            ("mlp.gate_proj.weight", ("gate_proj", "kernel"), True),
-            ("mlp.up_proj.weight", ("up_proj", "kernel"), True),
             ("mlp.down_proj.weight", ("down_proj", "kernel"), True),
             ("input_layernorm.weight", ("input_norm", "scale"), False),
         ]
-        if cfg.use_post_norms:
-            # gemma2 4-norm naming
+        if self.style != "glm4":  # glm4 fuses these into mlp.gate_up_proj
             e += [
-                ("post_attention_layernorm.weight", ("post_attn_out_norm", "scale"), False),
-                ("pre_feedforward_layernorm.weight", ("post_attn_norm", "scale"), False),
-                ("post_feedforward_layernorm.weight", ("post_mlp_norm", "scale"), False),
+                ("mlp.gate_proj.weight", ("gate_proj", "kernel"), True),
+                ("mlp.up_proj.weight", ("up_proj", "kernel"), True),
             ]
+        if cfg.use_post_norms:
+            if self.style == "glm4":
+                e += [
+                    ("post_self_attn_layernorm.weight", ("post_attn_out_norm", "scale"), False),
+                    ("post_attention_layernorm.weight", ("post_attn_norm", "scale"), False),
+                    ("post_mlp_layernorm.weight", ("post_mlp_norm", "scale"), False),
+                ]
+            else:
+                # gemma2 4-norm naming
+                e += [
+                    ("post_attention_layernorm.weight", ("post_attn_out_norm", "scale"), False),
+                    ("pre_feedforward_layernorm.weight", ("post_attn_norm", "scale"), False),
+                    ("post_feedforward_layernorm.weight", ("post_mlp_norm", "scale"), False),
+                ]
         else:
             e.append(("post_attention_layernorm.weight", ("post_attn_norm", "scale"), False))
         if cfg.attention_bias:
@@ -106,11 +122,17 @@ class DenseDecoderAdapter:
                 ("self_attn.k_proj.bias", ("k_proj", "bias"), False),
                 ("self_attn.v_proj.bias", ("v_proj", "bias"), False),
             ]
-        if cfg.qk_norm:
-            e += [
-                ("self_attn.q_norm.weight", ("q_norm", "scale"), False),
-                ("self_attn.k_norm.weight", ("k_norm", "scale"), False),
-            ]
+        if cfg.qk_norm or getattr(cfg, "qk_norm_flat", False):
+            if self.style == "hunyuan":
+                e += [
+                    ("self_attn.query_layernorm.weight", ("q_norm", "scale"), False),
+                    ("self_attn.key_layernorm.weight", ("k_norm", "scale"), False),
+                ]
+            else:
+                e += [
+                    ("self_attn.q_norm.weight", ("q_norm", "scale"), False),
+                    ("self_attn.k_norm.weight", ("k_norm", "scale"), False),
+                ]
         if getattr(cfg, "o_proj_bias", False):
             e.append(("self_attn.o_proj.bias", ("o_proj", "bias"), False))
         if getattr(cfg, "attention_sinks", False):
@@ -192,6 +214,13 @@ class DenseDecoderAdapter:
                 x = np.asarray(_get(layers, path)[i])
                 x = self._transform(x, tr, inverse=True)
                 yield f"model.layers.{i}.{suffix}", (_t(x) if transpose else x)
+            if self.style == "glm4":
+                g = np.asarray(layers["gate_proj"]["kernel"][i])  # (H, I)
+                u = np.asarray(layers["up_proj"]["kernel"][i])
+                yield (
+                    f"model.layers.{i}.mlp.gate_up_proj.weight",
+                    _t(np.concatenate([g, u], axis=1)),
+                )
 
     # -- import --------------------------------------------------------------
     def from_hf(self, read: Reader, shardings: Any = None) -> dict:
@@ -242,6 +271,16 @@ class DenseDecoderAdapter:
                     continue
                 raise
             put(("layers",) + path, stacked)
+        if self.style == "glm4":
+            fused = np.stack(
+                [
+                    _t(read_any(f"model.layers.{i}.mlp.gate_up_proj.weight"))
+                    for i in range(self.cfg.num_layers)
+                ]
+            )  # (L, H, 2I)
+            I = self.cfg.intermediate_size
+            put(("layers", "gate_proj", "kernel"), fused[..., :I])
+            put(("layers", "up_proj", "kernel"), fused[..., I:])
         return out
 
 
@@ -257,7 +296,7 @@ class MoEDecoderAdapter:
     style: str = "qwen3_moe"  # or "mixtral"
 
     def _expert_names(self, i: int, e: int) -> dict:
-        if self.style == "mixtral":
+        if self.style in ("mixtral", "minimax"):
             base = f"model.layers.{i}.block_sparse_moe.experts.{e}"
             return {
                 "gate_proj": f"{base}.w1.weight",
@@ -268,14 +307,32 @@ class MoEDecoderAdapter:
         return {k: f"{base}.{k}.weight" for k in ("gate_proj", "up_proj", "down_proj")}
 
     def _gate_name(self, i: int) -> str:
-        if self.style == "mixtral":
+        if self.style in ("mixtral", "minimax"):
             return f"model.layers.{i}.block_sparse_moe.gate.weight"
         if self.style == "gpt_oss":
             return f"model.layers.{i}.mlp.router.weight"
+        if self.style == "hunyuan":
+            return f"model.layers.{i}.mlp.gate.wg.weight"
         return f"model.layers.{i}.mlp.gate.weight"
 
+    def _shared_base(self, i: int) -> str:
+        if self.style == "hunyuan":
+            return f"model.layers.{i}.mlp.shared_mlp"
+        return f"model.layers.{i}.mlp.shared_experts"
+
+    def _escore_name(self, i: int) -> str:
+        # ernie stores the aux-free bias under moe_statics with a leading
+        # groups dim of 1 (transformers Ernie4_5_MoeStatics)
+        if self.style == "ernie":
+            return f"model.layers.{i}.mlp.moe_statics.e_score_correction_bias"
+        if self.style == "minimax":
+            return f"model.layers.{i}.block_sparse_moe.e_score_correction_bias"
+        return f"model.layers.{i}.mlp.gate.e_score_correction_bias"
+
     def _dense(self) -> DenseDecoderAdapter:
-        return DenseDecoderAdapter(self.cfg)
+        # styles the dense adapter understands (attention/norm naming)
+        style = self.style if self.style in ("glm4", "hunyuan") else "llama"
+        return DenseDecoderAdapter(self.cfg, style=style)
 
     def _attn_entries(self):
         mlp_keys = ("gate_proj", "up_proj", "down_proj")
@@ -335,15 +392,14 @@ class MoEDecoderAdapter:
                 )
                 continue
             if "e_score_bias" in moe["gate"]:
-                yield f"model.layers.{i}.mlp.gate.e_score_correction_bias", np.asarray(
-                    moe["gate"]["e_score_bias"][li]
-                )
+                b = np.asarray(moe["gate"]["e_score_bias"][li])
+                yield self._escore_name(i), (b[None] if self.style == "ernie" else b)
             for e in range(cfg.moe.n_routed_experts):
                 names = self._expert_names(i, e)
                 for proj in ("gate_proj", "up_proj", "down_proj"):
                     yield names[proj], _t(np.asarray(moe["experts"][proj]["kernel"][li, e]))
             if cfg.moe.n_shared_experts > 0:
-                base = f"model.layers.{i}.mlp.shared_experts"
+                base = self._shared_base(i)
                 for proj in ("gate_proj", "up_proj", "down_proj"):
                     yield f"{base}.{proj}.weight", _t(np.asarray(moe["shared"][proj]["kernel"][li]))
 
@@ -431,9 +487,7 @@ class MoEDecoderAdapter:
         if cfg.moe.gate_bias_update_speed > 0:
             def read_bias(li):
                 try:
-                    return np.asarray(
-                        read(f"model.layers.{fk + li}.mlp.gate.e_score_correction_bias")
-                    )
+                    return np.asarray(read(self._escore_name(fk + li))).reshape(-1)
                 except KeyError:
                     return np.zeros((cfg.moe.n_routed_experts,), np.float32)
 
@@ -458,7 +512,7 @@ class MoEDecoderAdapter:
             for proj in ("gate_proj", "up_proj", "down_proj"):
                 stacked = np.stack(
                     [
-                        _t(read(f"model.layers.{fk + li}.mlp.shared_experts.{proj}.weight"))
+                        _t(read(f"{self._shared_base(fk + li)}.{proj}.weight"))
                         for li in range(cfg.num_moe_layers)
                     ]
                 )

@@ -133,22 +133,47 @@ class VLMSFTDataset:
         row = self.rows[idx]
         pixels = preprocess_image(row["image"], c.image_size, c.base_dir)
 
-        # layout: [image patch tokens][turn tokens...]; assistant-only labels
-        ids = [c.image_token_id] * c.num_patches
-        sup = [False] * c.num_patches
-        for turn in self._turns(row):
+        # layout: turn tokens with the `<image>` marker expanded in place to
+        # num_patches image tokens (unsupervised); rows without a marker get
+        # the patch block prepended. Assistant-only labels either way.
+        turns = self._turns(row)
+        has_marker = any("<image>" in t["content"] for t in turns)
+        ids: list = []
+        sup: list = []
+        if not has_marker:
+            ids += [c.image_token_id] * c.num_patches
+            sup += [False] * c.num_patches
+        for turn in turns:
             is_asst = turn["role"] == "assistant"
             prefix = c.assistant_prefix if is_asst else c.user_prefix
-            toks = self._encode(prefix + turn["content"] + c.turn_suffix)
-            ids.extend(toks)
-            sup.extend([is_asst] * len(toks))
+            pieces = (prefix + turn["content"] + c.turn_suffix).split("<image>")
+            for j, piece in enumerate(pieces):
+                if j > 0:
+                    ids += [c.image_token_id] * c.num_patches
+                    sup += [False] * c.num_patches
+                toks = self._encode(piece)
+                ids.extend(toks)
+                sup.extend([is_asst] * len(toks))
         eos = getattr(self.tokenizer, "eos_token_id", None)
         if eos is not None:
             ids.append(eos)
-            sup.append(True)
+            # only teach EOS after a supervised (assistant) final turn —
+            # same contract as datasets/chat.py
+            sup.append(bool(turns) and turns[-1]["role"] == "assistant")
 
         ids = ids[: c.seq_len + 1]
         sup = sup[: c.seq_len + 1]
+        # the llava embed-merge scatters exactly num_patches image embeds
+        # into the placeholder positions; a truncated or duplicated image
+        # block would silently mis-align image and text
+        n_img = sum(1 for t in ids if t == c.image_token_id)
+        if n_img != c.num_patches:
+            raise ValueError(
+                f"row {idx}: {n_img} image tokens after truncation to "
+                f"seq_len={c.seq_len} (need exactly num_patches="
+                f"{c.num_patches}; check seq_len headroom and that the row "
+                "has at most one <image> marker)"
+            )
         pad = c.seq_len + 1 - len(ids)
         ids = np.asarray(ids + [c.pad_token_id] * pad, np.int32)
         sup = np.asarray(sup + [False] * pad, bool)

@@ -125,6 +125,13 @@ def generate(
     T = S + gen.max_new_tokens
     D = cfg.resolved_head_dim
     inv_freq = rope_frequencies(cfg.rope_dim, cfg.rope_theta, cfg.rope_scaling)
+    if cfg.rope_local_theta is not None:
+        # gemma3: sliding layers rotate with the unscaled local theta; the
+        # selection is traced per layer off the scanned (L,) window array
+        inv_freq_local = rope_frequencies(cfg.rope_dim, cfg.rope_local_theta, None)
+        freq_for_win = lambda win: jnp.where(win > 0, inv_freq_local, inv_freq)
+    else:
+        freq_for_win = lambda win: inv_freq
     L = jax.tree.leaves(params["layers"])[0].shape[0]
 
     from automodel_tpu.models.llm.decoder import layer_windows
@@ -148,7 +155,7 @@ def generate(
         h, = carry
         lp, ck, cv, win = xs
         h, ck, cv = _layer_with_cache(
-            h, lp, cfg, positions, inv_freq, ck, cv, 0, S, window=win
+            h, lp, cfg, positions, freq_for_win(win), ck, cv, 0, S, window=win
         )
         return (h,), (ck, cv)
 
@@ -180,7 +187,7 @@ def generate(
             h, = carry
             lp, ck, cv, win = xs
             h, ck, cv = _layer_with_cache(
-                h, lp, cfg, positions, inv_freq, ck, cv, pos, pos + 1, window=win
+                h, lp, cfg, positions, freq_for_win(win), ck, cv, pos, pos + 1, window=win
             )
             return (h,), (ck, cv)
 

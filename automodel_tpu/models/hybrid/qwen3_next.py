@@ -33,7 +33,7 @@ from automodel_tpu.models.common.layers import dense_init
 from automodel_tpu.moe.config import MoEConfig
 from automodel_tpu.moe.layer import init_moe, moe_forward, moe_param_specs
 from automodel_tpu.ops.norms import rms_norm
-from automodel_tpu.ops.rope import rope_frequencies
+from automodel_tpu.ops.rope import apply_rope, rope_frequencies
 
 
 @dataclasses.dataclass
@@ -389,14 +389,6 @@ def _gdn_block(x, lp, cfg: Qwen3NextConfig):
     return core @ lp["out_proj"]["kernel"].astype(dtype)
 
 
-def _partial_rope(x, positions, inv_freq, rot_dim):
-    """RoPE over the first rot_dim dims of the head; rest pass through."""
-    from automodel_tpu.ops.rope import apply_rope
-
-    xr, xp = x[..., :rot_dim], x[..., rot_dim:]
-    return jnp.concatenate([apply_rope(xr, positions, inv_freq), xp], axis=-1)
-
-
 def _attn_block(x, lp, cfg: Qwen3NextConfig, positions, segment_ids, inv_freq, mesh_ctx):
     from automodel_tpu.ops.attention import dot_product_attention
 
@@ -409,8 +401,9 @@ def _attn_block(x, lp, cfg: Qwen3NextConfig, positions, segment_ids, inv_freq, m
     v = (x @ lp["v_proj"]["kernel"].astype(dtype)).reshape(B, S, cfg.num_kv_heads, D)
     q = rms_norm(q, lp["q_norm"]["scale"], cfg.rms_norm_eps, zero_centered=True)
     k = rms_norm(k, lp["k_norm"]["scale"], cfg.rms_norm_eps, zero_centered=True)
-    q = _partial_rope(q, positions, inv_freq, cfg.rotary_dim)
-    k = _partial_rope(k, positions, inv_freq, cfg.rotary_dim)
+    # apply_rope rotates only the first 2*len(inv_freq)=rotary_dim channels
+    q = apply_rope(q, positions, inv_freq)
+    k = apply_rope(k, positions, inv_freq)
     attn = dot_product_attention(
         q, k, v, causal=True, segment_ids=segment_ids, positions=positions,
         impl="xla",

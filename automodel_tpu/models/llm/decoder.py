@@ -49,6 +49,20 @@ class TransformerConfig:
     rms_norm_eps: float = 1e-5
     attention_bias: bool = False
     qk_norm: bool = False  # qwen3-style per-head-dim RMSNorm on q/k
+    # hunyuan applies the per-head qk-norm AFTER rotary instead of before
+    qk_norm_after_rope: bool = False
+    # MiniMax-M2: RMSNorm over the FLATTENED q/k projections (num_heads*D)
+    # before the head reshape, instead of per-head-dim
+    # (reference: models/minimax_m2/layers.py:78 "HF MiniMax applies RMSNorm
+    # over flattened q/k projection dims before head reshape")
+    qk_norm_flat: bool = False
+    # GLM/Nemotron partial rotary: rotate only this fraction of head_dim
+    partial_rotary_factor: float = 1.0
+    # GLM-4 dense rotates interleaved even/odd pairs instead of split halves
+    rope_interleaved: bool = False
+    # gemma3: sliding-window layers use this rope theta (no scaling) while
+    # global layers use rope_theta + rope_scaling
+    rope_local_theta: Optional[float] = None
     attn_scale: Optional[float] = None  # None → head_dim**-0.5 (gemma2 overrides)
     sliding_window: Optional[int] = None
     # per-layer "sliding"/"global" types; None → sliding_window on all layers
@@ -97,7 +111,8 @@ class TransformerConfig:
     def rope_dim(self) -> int:
         if self.attention_type == "mla":
             return self.mla_qk_rope_head_dim
-        return self.resolved_head_dim
+        d = round(self.resolved_head_dim * self.partial_rotary_factor)
+        return d - (d % 2)
 
     def attn_params_per_layer(self) -> int:
         """Projection parameter count of one attention block."""
@@ -147,6 +162,20 @@ def layer_windows(cfg: "TransformerConfig", num_layers: int | None = None) -> tu
     )
 
 
+def make_freq_for(cfg: "TransformerConfig", inv_freq):
+    """Per-layer-window rope frequency selector.
+
+    gemma3 (`rope_local_base_freq`, reference: transformers
+    Gemma3TextConfig): sliding-window layers rotate with a LOCAL unscaled
+    theta while global layers use rope_theta + rope_scaling. Window
+    grouping is static (scan_layers_windowed groups layers by window), so
+    this is a python-level selection with no traced branching."""
+    if cfg.rope_local_theta is None:
+        return lambda window: inv_freq
+    local = rope_frequencies(cfg.rope_dim, cfg.rope_local_theta, None)
+    return lambda window: local if window is not None else inv_freq
+
+
 ACTIVATIONS = {
     "silu": jax.nn.silu,
     "gelu": jax.nn.gelu,
@@ -189,6 +218,9 @@ def init_attention_layers(cfg: TransformerConfig, rng: jax.Array, L: int) -> dic
     if cfg.qk_norm:
         layers["q_norm"] = {"scale": jnp.ones((L, D))}
         layers["k_norm"] = {"scale": jnp.ones((L, D))}
+    if cfg.qk_norm_flat:
+        layers["q_norm"] = {"scale": jnp.ones((L, cfg.num_heads * D))}
+        layers["k_norm"] = {"scale": jnp.ones((L, cfg.num_kv_heads * D))}
     if cfg.use_post_norms:
         layers["post_attn_out_norm"] = {"scale": jnp.ones((L, H))}
         layers["post_mlp_norm"] = {"scale": jnp.ones((L, H))}
@@ -216,7 +248,7 @@ def attention_layer_specs(cfg: TransformerConfig) -> dict:
         layers["v_proj"]["bias"] = ("layers", "kv_heads")
     if cfg.o_proj_bias:
         layers["o_proj"]["bias"] = ("layers", "norm")
-    if cfg.qk_norm:
+    if cfg.qk_norm or cfg.qk_norm_flat:
         layers["q_norm"] = {"scale": ("layers", "norm")}
         layers["k_norm"] = {"scale": ("layers", "norm")}
     if cfg.use_post_norms:
@@ -323,6 +355,7 @@ def forward(
     h = constrain(h, ("act_batch", "act_seq", "act_embed"))
 
     inv_freq = rope_frequencies(cfg.rope_dim, cfg.rope_theta, cfg.rope_scaling)
+    freq_for = make_freq_for(cfg, inv_freq)
 
     if mesh_ctx is not None and mesh_ctx.sizes["pp"] > 1:
         from automodel_tpu.parallel.pp import pipeline_layers
@@ -364,7 +397,7 @@ def forward(
 
         def pl_layer(hh, lp, pos, sg):
             return _decoder_layer(
-                hh, lp, cfg_pl, pos, sg, inv_freq, lambda x, axes: x,
+                hh, lp, cfg_pl, pos, sg, freq_for(windows[0]), lambda x, axes: x,
                 windows[0], mesh_ctx, manual=True,
             )
 
@@ -377,7 +410,8 @@ def forward(
 
         def layer(h, lp, window):
             return _decoder_layer(
-                h, lp, cfg, positions, segment_ids, inv_freq, constrain, window, mesh_ctx
+                h, lp, cfg, positions, segment_ids, freq_for(window), constrain,
+                window, mesh_ctx,
             )
 
         if return_aux_hidden is not None:
@@ -435,14 +469,23 @@ def project_qkv(x, lp, cfg: TransformerConfig, positions, inv_freq):
     shared by training attention and the KV-cache generate path."""
     B, S, _ = x.shape
     D = cfg.resolved_head_dim
-    q = _dense(x, lp["q_proj"], cfg.linear_precision).reshape(B, S, cfg.num_heads, D)
-    k = _dense(x, lp["k_proj"], cfg.linear_precision).reshape(B, S, cfg.num_kv_heads, D)
-    v = _dense(x, lp["v_proj"], cfg.linear_precision).reshape(B, S, cfg.num_kv_heads, D)
-    if cfg.qk_norm:
+    q = _dense(x, lp["q_proj"], cfg.linear_precision)
+    k = _dense(x, lp["k_proj"], cfg.linear_precision)
+    v = _dense(x, lp["v_proj"], cfg.linear_precision)
+    if cfg.qk_norm_flat:
         q = rms_norm(q, lp["q_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
         k = rms_norm(k, lp["k_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
-    q = apply_rope(q, positions, inv_freq)
-    k = apply_rope(k, positions, inv_freq)
+    q = q.reshape(B, S, cfg.num_heads, D)
+    k = k.reshape(B, S, cfg.num_kv_heads, D)
+    v = v.reshape(B, S, cfg.num_kv_heads, D)
+    if cfg.qk_norm and not cfg.qk_norm_after_rope:
+        q = rms_norm(q, lp["q_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
+        k = rms_norm(k, lp["k_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
+    q = apply_rope(q, positions, inv_freq, cfg.rope_interleaved)
+    k = apply_rope(k, positions, inv_freq, cfg.rope_interleaved)
+    if cfg.qk_norm and cfg.qk_norm_after_rope:
+        q = rms_norm(q, lp["q_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
+        k = rms_norm(k, lp["k_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
     return q, k, v
 
 

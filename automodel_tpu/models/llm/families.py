@@ -87,6 +87,82 @@ def qwen3_config(hf: Mapping[str, Any], **overrides) -> TransformerConfig:
     return TransformerConfig(**kw)
 
 
+def glm4_config(hf: Mapping[str, Any], **overrides) -> TransformerConfig:
+    """Glm4ForCausalLM — partial interleaved rotary, sandwich norms
+    (post_self_attn/post_mlp_layernorm) and a fused gate_up MLP handled by
+    the glm4 adapter style (reference: transformers modeling_glm4; the
+    reference framework ships GLM via glm4_moe — components/models/glm4_moe)."""
+    kw = _base_kwargs(hf)
+    kw["attention_bias"] = bool(hf.get("attention_bias", True))
+    kw["partial_rotary_factor"] = float(hf.get("partial_rotary_factor", 0.5))
+    kw["rope_interleaved"] = True
+    kw["use_post_norms"] = True
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def ernie4_5_config(hf: Mapping[str, Any], **overrides) -> TransformerConfig:
+    """Ernie4_5ForCausalLM — llama-shaped with GLM-style INTERLEAVED rotary
+    (full head_dim), `use_bias` qkv flag, tied embeddings by default
+    (reference: models/ernie4_5)."""
+    kw = _base_kwargs(hf)
+    kw["rope_interleaved"] = True
+    kw["attention_bias"] = bool(hf.get("use_bias", False))
+    kw["tie_word_embeddings"] = bool(hf.get("tie_word_embeddings", True))
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def gemma3_config(hf: Mapping[str, Any], **overrides) -> TransformerConfig:
+    """Gemma3ForCausalLM (text tower) — gemma2's zero-centered sandwich
+    norms + qk-norm, 5:1 sliding/global layer pattern, and a separate
+    unscaled rope theta for sliding layers (`rope_local_base_freq`).
+    Reference: the gemma family dirs (gemma4_moe is its successor)."""
+    kw = _base_kwargs(hf)
+    kw["activation"] = "gelu_tanh"
+    kw["zero_centered_norm"] = True
+    kw["use_post_norms"] = True
+    kw["qk_norm"] = True
+    kw["embed_scale"] = float(kw["hidden_size"]) ** 0.5
+    kw["rms_norm_eps"] = float(hf.get("rms_norm_eps", 1e-6))
+    kw["tie_word_embeddings"] = bool(hf.get("tie_word_embeddings", True))
+    if hf.get("query_pre_attn_scalar"):
+        kw["attn_scale"] = float(hf["query_pre_attn_scalar"]) ** -0.5
+    if hf.get("final_logit_softcapping"):
+        kw["logits_soft_cap"] = float(hf["final_logit_softcapping"])
+    if hf.get("sliding_window"):
+        kw["sliding_window"] = int(hf["sliding_window"])
+        n_layers = kw["num_layers"]
+        if hf.get("layer_types"):
+            kw["layer_types"] = tuple(
+                "sliding" if t == "sliding_attention" else "global"
+                for t in hf["layer_types"]
+            )
+        else:
+            # gemma3 default: every 6th layer global, the rest sliding
+            pattern = int(hf.get("sliding_window_pattern", 6))
+            kw["layer_types"] = tuple(
+                "global" if (i + 1) % pattern == 0 else "sliding"
+                for i in range(n_layers)
+            )
+        if hf.get("rope_local_base_freq"):
+            kw["rope_local_theta"] = float(hf["rope_local_base_freq"])
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def hunyuan_dense_config(hf: Mapping[str, Any], **overrides) -> TransformerConfig:
+    """HunYuanDenseV1ForCausalLM (reference: models/hy_mt2/hy_v3 family):
+    llama-shaped with an unconditional per-head qk-norm applied AFTER
+    rotary (query/key_layernorm)."""
+    kw = _base_kwargs(hf)
+    kw["attention_bias"] = bool(hf.get("attention_bias", False))
+    kw["qk_norm"] = True
+    kw["qk_norm_after_rope"] = True
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
 def gemma2_config(hf: Mapping[str, Any], **overrides) -> TransformerConfig:
     """Gemma2: zero-centered 4-norm layers, embed scaling, soft caps,
     query_pre_attn_scalar attention scale, alternating sliding/global."""

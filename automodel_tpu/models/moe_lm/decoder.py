@@ -31,6 +31,7 @@ from automodel_tpu.models.llm.decoder import (
     attention_layer_specs,
     init_attention_layers,
     layer_windows,
+    make_freq_for,
     mlp_block,
     unembed,
     _make_constrain,
@@ -182,6 +183,7 @@ def forward(
     h = constrain(h, ("act_batch", "act_seq", "act_embed"))
 
     inv_freq = rope_frequencies(cfg.rope_dim, cfg.rope_theta, cfg.rope_scaling)
+    freq_for = make_freq_for(cfg, inv_freq)
     windows = layer_windows(cfg)
     Lm, E = cfg.num_moe_layers, cfg.moe.n_routed_experts
 
@@ -198,7 +200,8 @@ def forward(
                 token_mask=token_mask,
             )
         h = attention_block(
-            h, lp, cfg, positions, segment_ids, inv_freq, constrain, window, mesh_ctx
+            h, lp, cfg, positions, segment_ids, freq_for(window), constrain,
+            window, mesh_ctx,
         )
         return h, jnp.float32(0.0)
 

@@ -37,6 +37,15 @@ MODEL_ARCH_MAPPING: dict[str, ModelSpec] = {
     "Qwen2ForCausalLM": ModelSpec("qwen2", families.qwen2_config, decoder),
     "Qwen3ForCausalLM": ModelSpec("qwen3", families.qwen3_config, decoder),
     "Gemma2ForCausalLM": ModelSpec("gemma2", families.gemma2_config, decoder),
+    "Gemma3ForCausalLM": ModelSpec("gemma3", families.gemma3_config, decoder),
+    "Glm4ForCausalLM": ModelSpec(
+        "glm4", families.glm4_config, decoder, adapter_kwargs={"style": "glm4"}
+    ),
+    "Ernie4_5ForCausalLM": ModelSpec("ernie4_5", families.ernie4_5_config, decoder),
+    "HunYuanDenseV1ForCausalLM": ModelSpec(
+        "hunyuan_dense", families.hunyuan_dense_config, decoder,
+        adapter_kwargs={"style": "hunyuan"},
+    ),
     "Qwen3MoeForCausalLM": ModelSpec(
         "qwen3_moe", moe_families.qwen3_moe_config, moe_decoder, adapter_name="moe_decoder"
     ),
@@ -55,6 +64,34 @@ MODEL_ARCH_MAPPING: dict[str, ModelSpec] = {
     "GptOssForCausalLM": ModelSpec(
         "gpt_oss", moe_families.gpt_oss_config, moe_decoder,
         adapter_name="moe_decoder", adapter_kwargs={"style": "gpt_oss"},
+    ),
+    "Glm4MoeForCausalLM": ModelSpec(
+        "glm4_moe", moe_families.glm4_moe_config, moe_decoder,
+        adapter_name="moe_decoder",
+    ),
+    "Ernie4_5_MoeForCausalLM": ModelSpec(
+        "ernie4_5_moe", moe_families.ernie4_5_moe_config, moe_decoder,
+        adapter_name="moe_decoder", adapter_kwargs={"style": "ernie"},
+    ),
+    "HunYuanMoEV1ForCausalLM": ModelSpec(
+        "hunyuan_moe", moe_families.hunyuan_moe_config, moe_decoder,
+        adapter_name="moe_decoder", adapter_kwargs={"style": "hunyuan"},
+    ),
+    "MiniMaxM2ForCausalLM": ModelSpec(
+        "minimax_m2", moe_families.minimax_m2_config, moe_decoder,
+        adapter_name="moe_decoder", adapter_kwargs={"style": "minimax"},
+    ),
+    # kimi_k2 is checkpoint-compatible with DeepSeek-V3 (reference:
+    # components/models/kimi_k2/__init__.py — a 34-LoC alias of deepseek_v3)
+    "KimiK2ForCausalLM": ModelSpec(
+        "kimi_k2", moe_families.deepseek_v3_moe_config, moe_decoder,
+        adapter_name="moe_decoder", adapter_kwargs={"style": "deepseek"},
+    ),
+    # DeepSeek-V3.2 = the V3 body + DSA sparse attention (reference:
+    # components/models/deepseek_v32 — carries index_topk in its config)
+    "DeepseekV32ForCausalLM": ModelSpec(
+        "deepseek_v32", moe_families.deepseek_v4_config, moe_decoder,
+        adapter_name="moe_decoder", adapter_kwargs={"style": "deepseek"},
     ),
     "LlamaBidirectionalModel": ModelSpec(
         "llama_bidirectional", families.llama_bidirectional_config, decoder

@@ -115,18 +115,38 @@ def apply_rope(
     x: jnp.ndarray,
     positions: jnp.ndarray,
     inv_freq: jnp.ndarray,
+    interleaved: bool = False,
 ) -> jnp.ndarray:
     """Rotate (..., seq, heads, head_dim) by per-token positions.
 
-    Uses the HF "half-split" convention: the head_dim is split into two
-    halves rotated against each other (matches llama/qwen checkpoints).
+    Default is the HF "half-split" convention: the head_dim is split into
+    two halves rotated against each other (matches llama/qwen checkpoints).
+    `interleaved=True` rotates adjacent even/odd pairs instead (GLM-4
+    convention, reference: transformers modeling_glm4 rotate_half).
+
+    Partial rotary (GLM/Nemotron `partial_rotary_factor`): when
+    2*len(inv_freq) < head_dim only the first 2*len(inv_freq) channels are
+    rotated and the tail passes through unchanged.
     positions: (..., seq) int32.
     """
     orig_dtype = x.dtype
+    rot = 2 * inv_freq.shape[-1]
+    x_pass = None
+    if rot < x.shape[-1]:
+        x, x_pass = x[..., :rot], x[..., rot:]
     angles = positions[..., :, None].astype(jnp.float32) * inv_freq  # (..., S, D/2)
     cos = jnp.cos(angles)[..., :, None, :]  # (..., S, 1, D/2)
     sin = jnp.sin(angles)[..., :, None, :]
     x = x.astype(jnp.float32)
-    x1, x2 = jnp.split(x, 2, axis=-1)
-    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
-    return out.astype(orig_dtype)
+    if interleaved:
+        x1, x2 = x[..., 0::2], x[..., 1::2]
+        out = jnp.stack(
+            [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+        ).reshape(x.shape)
+    else:
+        x1, x2 = jnp.split(x, 2, axis=-1)
+        out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    out = out.astype(orig_dtype)
+    if x_pass is not None:
+        out = jnp.concatenate([out, x_pass], axis=-1)
+    return out

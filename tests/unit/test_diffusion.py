@@ -16,6 +16,10 @@ from automodel_tpu.diffusion import (
 from automodel_tpu.models.diffusion import dit
 from automodel_tpu.models.diffusion.dit import DiTConfig
 
+import pytest
+
+pytestmark = pytest.mark.recipe
+
 CFG = DiTConfig(
     input_size=8, patch_size=2, in_channels=2, hidden_size=64,
     num_layers=2, num_heads=4, num_classes=3, remat_policy="none",

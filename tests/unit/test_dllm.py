@@ -13,6 +13,10 @@ from automodel_tpu.dllm import corrupt_blockwise, corrupt_uniform
 from automodel_tpu.dllm.mdlm import mdlm_loss_from_hidden
 from automodel_tpu.dllm.sampler import generate_mdlm
 
+import pytest
+
+pytestmark = pytest.mark.recipe
+
 MASK = 99
 
 

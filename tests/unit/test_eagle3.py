@@ -11,6 +11,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import pytest
+
+pytestmark = pytest.mark.recipe
+
 from automodel_tpu.speculative import (
     Eagle3Config,
     build_vocab_mapping,

@@ -8,6 +8,8 @@ import os
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.parity
+
 from automodel_tpu.cli.app import resolve_recipe_class
 from tests.golden_config import GOLDEN_DIR, golden_cfg
 

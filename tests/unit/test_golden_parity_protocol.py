@@ -17,6 +17,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.parity
+
 torch = pytest.importorskip("torch")
 
 STEPS = 20

@@ -14,6 +14,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.parity
+
 torch = pytest.importorskip("torch")
 
 from automodel_tpu.checkpoint import HFCheckpointReader, get_adapter
@@ -120,6 +122,189 @@ def test_qwen3_next_logits_match_hf(tmp_path):
     _save_hf_model(model, config, tmp_path)
     ids = np.random.default_rng(7).integers(0, 128, (2, 12))
     _compare(tmp_path, model, ids, atol=5e-4)
+
+
+def test_glm4_logits_match_hf(tmp_path):
+    """GLM-4 dense: partial INTERLEAVED rotary, sandwich norms, fused
+    gate_up MLP (adapter style glm4)."""
+    from transformers import Glm4Config, Glm4ForCausalLM
+
+    config = Glm4Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        partial_rotary_factor=0.5, attention_bias=True,
+        max_position_embeddings=64, tie_word_embeddings=False,
+        pad_token_id=0, eos_token_id=1, attn_implementation="eager",
+    )
+    torch.manual_seed(21)
+    model = Glm4ForCausalLM(config)
+    _save_hf_model(model, config, tmp_path)
+    ids = np.random.default_rng(21).integers(0, 128, (2, 10))
+    _compare(tmp_path, model, ids)
+
+
+def test_glm4_moe_logits_match_hf(tmp_path):
+    """GLM-4.5 MoE: sigmoid grouped router + e-score bias + shared expert +
+    first-k-dense on partial-rotary GQA with qk-norm."""
+    from transformers import Glm4MoeConfig, Glm4MoeForCausalLM
+
+    config = Glm4MoeConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, partial_rotary_factor=0.5, use_qk_norm=True,
+        n_routed_experts=4, num_experts_per_tok=2, moe_intermediate_size=32,
+        n_shared_experts=1, first_k_dense_replace=1, n_group=2, topk_group=1,
+        norm_topk_prob=True, routed_scaling_factor=1.5,
+        max_position_embeddings=64, tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(22)
+    model = Glm4MoeForCausalLM(config)
+    # give the e-score bias real values so the selection path is exercised
+    with torch.no_grad():
+        for layer in model.model.layers[1:]:
+            layer.mlp.gate.e_score_correction_bias.uniform_(-0.05, 0.05)
+    _save_hf_model(model, config, tmp_path)
+    ids = np.random.default_rng(22).integers(0, 128, (2, 8))
+    _compare(tmp_path, model, ids, atol=5e-4)
+
+
+def test_ernie4_5_logits_match_hf(tmp_path):
+    from transformers import Ernie4_5Config, Ernie4_5ForCausalLM
+
+    config = Ernie4_5Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=8, use_bias=True, max_position_embeddings=64,
+        tie_word_embeddings=True, attn_implementation="eager",
+    )
+    torch.manual_seed(23)
+    model = Ernie4_5ForCausalLM(config)
+    _save_hf_model(model, config, tmp_path)
+    ids = np.random.default_rng(23).integers(0, 128, (1, 9))
+    _compare(tmp_path, model, ids)
+
+
+def test_ernie4_5_moe_logits_match_hf(tmp_path):
+    """ERNIE-4.5 MoE: softmax scores with the moe_statics correction bias
+    applied for selection only, fused shared-experts MLP, first dense
+    layer via moe_layer_start_index."""
+    from transformers import Ernie4_5_MoeConfig, Ernie4_5_MoeForCausalLM
+
+    config = Ernie4_5_MoeConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=8, moe_num_experts=4, moe_k=2, moe_intermediate_size=32,
+        moe_num_shared_experts=1, moe_layer_start_index=1,
+        moe_layer_interval=1, moe_layer_end_index=2,
+        max_position_embeddings=64, tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(24)
+    model = Ernie4_5_MoeForCausalLM(config)
+    with torch.no_grad():
+        for layer in model.model.layers[1:]:
+            layer.mlp.moe_statics.e_score_correction_bias.uniform_(-0.05, 0.05)
+    _save_hf_model(model, config, tmp_path)
+    ids = np.random.default_rng(24).integers(0, 128, (2, 8))
+    _compare(tmp_path, model, ids, atol=5e-4)
+
+
+def test_gemma3_logits_match_hf(tmp_path):
+    """Gemma3 text: qk-norm + zero-centered sandwich norms + 5:1
+    sliding/global pattern with a SEPARATE local rope theta on sliding
+    layers (rope_local_base_freq)."""
+    from transformers import Gemma3ForCausalLM, Gemma3TextConfig
+
+    config = Gemma3TextConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, query_pre_attn_scalar=16,
+        sliding_window=4, layer_types=[
+            "sliding_attention", "sliding_attention",
+            "full_attention", "sliding_attention",
+        ],
+        rope_theta=1_000_000.0, rope_local_base_freq=10_000.0,
+        max_position_embeddings=64, tie_word_embeddings=True,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(25)
+    model = Gemma3ForCausalLM(config)
+    _save_hf_model(model, config, tmp_path)
+    ids = np.random.default_rng(25).integers(0, 128, (2, 12))
+    _compare(tmp_path, model, ids, atol=5e-4)
+
+
+def test_hunyuan_dense_logits_match_hf(tmp_path):
+    """HunYuan dense: per-head qk-norm applied AFTER rotary."""
+    from transformers import HunYuanDenseV1Config, HunYuanDenseV1ForCausalLM
+
+    config = HunYuanDenseV1Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=8, max_position_embeddings=64, tie_word_embeddings=False,
+        pad_token_id=0, eos_token_id=1, attn_implementation="eager",
+    )
+    torch.manual_seed(27)
+    model = HunYuanDenseV1ForCausalLM(config)
+    _save_hf_model(model, config, tmp_path)
+    ids = np.random.default_rng(27).integers(0, 128, (2, 10))
+    _compare(tmp_path, model, ids)
+
+
+def test_hunyuan_moe_logits_match_hf(tmp_path):
+    """HunYuan MoE: softmax top-k router + always-on shared MLP with the
+    gate at mlp.gate.wg and shared experts at mlp.shared_mlp."""
+    from transformers import HunYuanMoEV1Config, HunYuanMoEV1ForCausalLM
+
+    config = HunYuanMoEV1Config(
+        vocab_size=128, hidden_size=32, intermediate_size=32,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_experts=4, moe_topk=2, head_dim=8,
+        max_position_embeddings=64, tie_word_embeddings=False,
+        pad_token_id=0, eos_token_id=1, attn_implementation="eager",
+    )
+    torch.manual_seed(28)
+    model = HunYuanMoEV1ForCausalLM(config)
+    _save_hf_model(model, config, tmp_path)
+    ids = np.random.default_rng(28).integers(0, 128, (2, 8))
+    _compare(tmp_path, model, ids, atol=5e-4)
+
+
+def test_minimax_m2_adapter_roundtrip():
+    """MiniMax-M2 (no torch class in this transformers build): flat qk-norm
+    + partial rotary + e-score-biased MoE through a full to_hf → from_hf
+    adapter roundtrip with mixtral-style block_sparse_moe names."""
+    from automodel_tpu.checkpoint.hf_adapter import get_adapter
+    from automodel_tpu.models.registry import get_model_spec
+
+    hf = dict(
+        architectures=["MiniMaxM2ForCausalLM"],
+        vocab_size=128, hidden_size=32, intermediate_size=32,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, rotary_dim=8, use_qk_norm=True, scoring_func="sigmoid",
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=64,
+    )
+    spec = get_model_spec(hf)
+    cfg = spec.config_from_hf(hf, dtype=jnp.float32, remat_policy="none")
+    assert cfg.qk_norm_flat and abs(cfg.partial_rotary_factor - 0.5) < 1e-9
+    params = spec.module.init(cfg, jax.random.key(3))
+    adapter = get_adapter(spec.adapter_name, cfg, **spec.adapter_kwargs)
+    sd = dict(adapter.to_hf(params))
+    assert "model.layers.1.block_sparse_moe.experts.0.w1.weight" in sd
+    assert "model.layers.1.block_sparse_moe.e_score_correction_bias" in sd
+
+    def read(name):
+        if name not in sd:
+            raise KeyError(name)
+        return sd[name]
+
+    params2 = adapter.from_hf(read)
+    ids = jnp.asarray(np.random.default_rng(26).integers(0, 128, (1, 8)))
+    out1, _ = spec.module.forward(params, cfg, ids)
+    out2, _ = spec.module.forward(params2, cfg, ids)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), rtol=1e-6)
 
 
 def test_llama_bidirectional_loads_and_attends_both_ways(tmp_path):

@@ -7,6 +7,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.recipe
+
 from automodel_tpu.loss.kd_loss import fused_kd_cross_entropy, soft_cross_entropy_sum
 from automodel_tpu.loss.masked_ce import IGNORE_INDEX, cross_entropy_sum
 

@@ -14,6 +14,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.parity
+
 from automodel_tpu.models.hybrid import nemotron_h as nh
 
 DENSE_HF = {

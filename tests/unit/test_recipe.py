@@ -8,6 +8,8 @@ import jax
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.recipe
+
 from automodel_tpu.cli.app import main, resolve_recipe_class
 from automodel_tpu.config import ConfigNode
 

@@ -12,6 +12,8 @@ import json
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.recipe
+
 from automodel_tpu.cli.app import resolve_recipe_class
 from automodel_tpu.config import ConfigNode
 

@@ -7,6 +7,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.recipe
+
 from automodel_tpu.cli.app import resolve_recipe_class
 from automodel_tpu.config import ConfigNode
 from automodel_tpu.loss.infonce import info_nce_loss, mean_pool

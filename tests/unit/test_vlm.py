@@ -7,6 +7,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.recipe
+
 from automodel_tpu.checkpoint import HFCheckpointReader, get_adapter, save_hf_checkpoint
 from automodel_tpu.models.vision import vit
 from automodel_tpu.models.vlm import llava

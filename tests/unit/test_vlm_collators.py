@@ -88,6 +88,32 @@ def test_vlm_sft_layout_and_masking(tmp_path):
     assert n_sup2 == a1 + a2 + 1  # + eos
 
 
+def test_vlm_sft_image_marker_expands_in_place(tmp_path):
+    """A `<image>` marker inside the prompt expands to num_patches image
+    tokens AT THAT POSITION (not prepended), unsupervised."""
+    rows = [{
+        "image": np.full((4, 4, 3), 0.2).tolist(),
+        "prompt": "look <image> here", "response": "ok",
+    }]
+    p = tmp_path / "vlm.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows))
+    cfg = VLMSFTDatasetConfig(
+        data_path=str(p), image_size=8, num_patches=4, image_token_id=99,
+        seq_len=64,
+    )
+    s = cfg.build(StubTokenizer())[0]
+    ids = s["input_ids"]
+    # patch block sits after the encoded "USER: look " prefix
+    pre = len(StubTokenizer().encode("USER: look "))
+    assert (ids[:pre] != 99).all()
+    assert (ids[pre:pre + 4] == 99).all()
+    assert (ids[pre + 4:] != 99).all()
+    # and the literal marker text was never tokenized
+    marker_toks = StubTokenizer().encode("<image>")
+    window = list(ids[pre + 4: pre + 4 + len(marker_toks)])
+    assert window != marker_toks
+
+
 def test_vlm_sft_feeds_recipe(tmp_path):
     """End-to-end: the real collator drives the VLM finetune recipe."""
     from automodel_tpu.cli.app import resolve_recipe_class
