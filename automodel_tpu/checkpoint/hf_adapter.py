@@ -592,6 +592,55 @@ def save_hf_checkpoint(
             json.dump(hf_config, f, indent=2)
 
 
+def _dequant_fp8_block(
+    w: np.ndarray, scale_inv: np.ndarray, block: tuple = (128, 128)
+) -> np.ndarray:
+    """DeepSeek-V3 fp8 checkpoint dequant: weights are stored
+    float8_e4m3fn with one fp32 inverse scale per (bm × bn) tile
+    (reference: models/deepseek_v3/state_dict_adapter.py:96
+    `_weight_dequant_kernel` — a Triton kernel there; plain numpy
+    broadcast here, load-time only)."""
+    M, N = w.shape
+    bm, bn = block
+    s = np.asarray(scale_inv, np.float32)
+    expect = (-(-M // bm), -(-N // bn))
+    if s.shape != expect:
+        raise ValueError(
+            f"fp8 scale_inv grid {s.shape} does not match weight {w.shape} "
+            f"at block size {block} (expected {expect}); check "
+            "quantization_config.weight_block_size in config.json"
+        )
+    s = np.repeat(np.repeat(s, bm, 0), bn, 1)
+    return (w.astype(np.float32) * s[:M, :N]).astype(np.float32)
+
+
+def _read_fp8_slice(path: str, name: str) -> np.ndarray:
+    """Read one (possibly fp8) tensor straight from a safetensors file.
+
+    The numpy framework of `safetensors` cannot represent float8 dtypes;
+    parse the header manually and reinterpret the raw bytes with
+    ml_dtypes (shipped with jax)."""
+    import struct
+
+    import ml_dtypes
+
+    dtypes = {
+        "F8_E4M3": ml_dtypes.float8_e4m3fn,
+        "F8_E5M2": ml_dtypes.float8_e5m2,
+        "BF16": ml_dtypes.bfloat16,
+        "F16": np.float16,
+        "F32": np.float32,
+    }
+    with open(path, "rb") as f:
+        (hlen,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(hlen))
+        meta = header[name]
+        start, end = meta["data_offsets"]
+        f.seek(8 + hlen + start)
+        buf = f.read(end - start)
+    return np.frombuffer(buf, dtype=dtypes[meta["dtype"]]).reshape(meta["shape"])
+
+
 class HFCheckpointReader:
     """Lazy per-tensor reader over a local HF checkpoint directory."""
 
@@ -623,7 +672,29 @@ class HFCheckpointReader:
     def __call__(self, name: str) -> np.ndarray:
         if name not in self._weight_map:
             raise KeyError(name)
-        return self._handle(self._weight_map[name]).get_tensor(name)
+        t = self._read_raw(name)
+        scale_name = f"{name}_scale_inv"
+        if scale_name in self._weight_map:
+            t = _dequant_fp8_block(t, self._read_raw(scale_name), self._fp8_block())
+        return t
+
+    def _fp8_block(self) -> tuple:
+        """Block size of fp8-quantized checkpoints, from config.json's
+        quantization_config.weight_block_size (DSv3 convention: [128, 128])."""
+        cfg = self.hf_config() or {}
+        bs = (cfg.get("quantization_config") or {}).get("weight_block_size")
+        return (int(bs[0]), int(bs[1])) if bs else (128, 128)
+
+    def _read_raw(self, name: str) -> np.ndarray:
+        h = self._handle(self._weight_map[name])
+        try:
+            return h.get_tensor(name)
+        except (TypeError, ValueError, KeyError, AttributeError):
+            # fp8 dtypes are outside the numpy framework's type table —
+            # re-read the raw buffer and reinterpret via ml_dtypes
+            return _read_fp8_slice(
+                os.path.join(self._dir, self._weight_map[name]), name
+            )
 
     def hf_config(self) -> dict | None:
         p = os.path.join(self._dir, "config.json")

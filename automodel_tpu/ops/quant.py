@@ -1,17 +1,25 @@
-"""FP8 / INT8 quantized matmul — the torchao Float8Linear analog.
+"""FP8 / INT8 quantized matmul + QAT fake-quant — the torchao analog.
 
 The reference quantizes linears via torchao `Float8Linear` with dynamic
 scaling plus TE FP8 autocast recipes (reference: nemo_automodel/components/
 quantization/fp8.py:130 `apply_fp8_to_model`, models/common/utils.py:100-155
-TEFp8Config). TPU-native form: a drop-in matmul with per-tensor dynamic
-scales, quantize → MXU dot in the low-precision dtype → rescale. Backward
-runs in bf16 against the dequantized operands (delayed-scaling-style
-training), via custom_vjp. Models opt in with
-`TransformerConfig.linear_precision = "fp8" | "int8"`.
+TEFp8Config) and trains quantization-aware via torchao QAT fake-quant with
+delayed enabling (reference: quantization/qat.py, recipes/llm/train_ft.py:861
+`_maybe_enable_fake_quant`). TPU-native forms:
+
+- `quantized_matmul`: drop-in matmul with PER-CHANNEL dynamic scales
+  (rows of x over K, columns of w), quantize → MXU dot in the
+  low-precision dtype → rescale. Backward runs in bf16 against the
+  original operands (delayed-scaling-style training), via custom_vjp.
+  Models opt in with `TransformerConfig.linear_precision = "fp8"|"int8"`.
+- `fake_quantize` / `QATConfig.make_param_transform`: straight-through
+  quantize-dequantize of weight kernels inside the train step, enabled
+  once `step >= start_step` (delayed fake-quant).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 
 import jax
@@ -21,8 +29,18 @@ FP8_MAX = 448.0   # float8_e4m3fn
 INT8_MAX = 127.0
 
 
-def _quantize(x, qdtype, qmax):
-    scale = jnp.max(jnp.abs(x)).astype(jnp.float32) / qmax + 1e-12
+def _qparams(precision: str):
+    if precision == "int8":
+        return jnp.int8, INT8_MAX
+    return jnp.float8_e4m3fn, FP8_MAX
+
+
+def _quantize(x, qdtype, qmax, axis=None):
+    """axis=None → per-tensor scale; else per-channel over `axis` reduced."""
+    scale = (
+        jnp.max(jnp.abs(x), axis=axis, keepdims=axis is not None)
+        .astype(jnp.float32) / qmax + 1e-12
+    )
     q = (x.astype(jnp.float32) / scale)
     if qdtype == jnp.int8:
         q = jnp.round(q)
@@ -32,19 +50,18 @@ def _quantize(x, qdtype, qmax):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def quantized_matmul(x, w, precision: str = "fp8"):
-    """x (..., K) @ w (K, N) with per-tensor dynamic quantization."""
+    """x (..., K) @ w (K, N) with per-channel dynamic quantization:
+    one scale per x row (over K) and per w output column."""
     return _qmm_fwd(x, w, precision)[0]
 
 
 def _qmm_fwd(x, w, precision):
-    qdtype, qmax = (
-        (jnp.int8, INT8_MAX) if precision == "int8" else (jnp.float8_e4m3fn, FP8_MAX)
-    )
-    qx, sx = _quantize(x, qdtype, qmax)
-    qw, sw = _quantize(w, qdtype, qmax)
+    qdtype, qmax = _qparams(precision)
+    qx, sx = _quantize(x, qdtype, qmax, axis=-1)   # (..., 1)
+    qw, sw = _quantize(w, qdtype, qmax, axis=0)    # (1, N)
     out = jnp.einsum(
         "...k,kn->...n", qx, qw, preferred_element_type=jnp.float32
-    ) * (sx * sw)
+    ) * (sx * sw[0])
     return out.astype(x.dtype), (x, w)
 
 
@@ -71,3 +88,49 @@ def matmul(x, kernel, precision: str | None = None):
     if precision in ("fp8", "int8"):
         return quantized_matmul(x, kernel, precision)
     return x @ kernel
+
+
+# ---------------------------------------------------------------------------
+# QAT (reference: components/quantization/qat.py + train_ft.py:861)
+# ---------------------------------------------------------------------------
+def fake_quantize(x, precision: str = "int8"):
+    """Straight-through quantize-dequantize: forward sees the quantized
+    grid, gradients pass through unchanged (STE). Per-channel scales over
+    the last (output) dim — reduce over the second-to-last axis so stacked
+    (L, in, out) kernels get per-layer-per-column scales."""
+    qdtype, qmax = _qparams(precision)
+    axis = -2 if x.ndim >= 2 else None
+    q, scale = _quantize(x, qdtype, qmax, axis=axis)
+    qdq = (q.astype(jnp.float32) * scale).astype(x.dtype)
+    return x + jax.lax.stop_gradient(qdq - x)
+
+
+@dataclasses.dataclass(frozen=True)
+class QATConfig:
+    """Quantization-aware training: fake-quant the weight kernels inside
+    the train step. `start_step` delays enabling (the reference's delayed
+    fake-quant: train in high precision first, then adapt to the grid)."""
+
+    enabled: bool = False
+    precision: str = "int8"  # int8 | fp8
+    start_step: int = 0
+
+    def make_param_transform(self):
+        """(params, step) -> params with kernels fake-quantized when
+        step >= start_step. Only leaves named 'kernel' (linear weights)
+        quantize — embeddings, norms and biases stay high precision."""
+        if not self.enabled:
+            return None
+
+        def transform(params, step):
+            on = step >= self.start_step
+
+            def fq(path, x):
+                key = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+                if key != "kernel":
+                    return x
+                return jnp.where(on, fake_quantize(x, self.precision), x)
+
+            return jax.tree_util.tree_map_with_path(fq, params)
+
+        return transform

@@ -323,8 +323,25 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             max_grad_norm=self.cfg.get("max_grad_norm", 1.0),
             skip_nonfinite_updates=bool(self.cfg.get("skip_nonfinite_updates", False)),
         )
+        # QAT: `qat: {enabled: true, precision: int8, start_step: N}`
+        # (reference: quantization/qat.py + train_ft.py:861 delayed enable)
+        from automodel_tpu.ops.quant import QATConfig
+
+        qat_cfg = _dataclass_from_cfg(QATConfig, self.cfg.get("qat"))
+        if qat_cfg.enabled and self.cfg.get("peft") is not None:
+            # the trainable tree is the LoRA pytree (leaves a/b/m, no
+            # 'kernel'); the transform would silently fake-quant nothing.
+            # Quantized-base PEFT is the QLoRA path (peft.base_precision).
+            raise ValueError(
+                "qat.enabled does not compose with peft (the transform only "
+                "sees LoRA params); use peft.quantize_base=int8 (QLoRA) for "
+                "a quantized base model instead"
+            )
         self._train_step = jax.jit(
-            make_train_step(loss_fn, self.tx, self.lr_schedule, step_cfg),
+            make_train_step(
+                loss_fn, self.tx, self.lr_schedule, step_cfg,
+                param_transform=qat_cfg.make_param_transform(),
+            ),
             donate_argnums=0,
         )
 

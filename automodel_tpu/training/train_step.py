@@ -52,6 +52,7 @@ def make_train_step(
     tx: optax.GradientTransformation,
     lr_schedule: Callable | None = None,
     config: TrainStepConfig | None = None,
+    param_transform: Callable | None = None,  # (params, step) -> params (QAT)
 ) -> Callable:
     """Build `train_step(state, batch, rng) -> (state, metrics)`.
 
@@ -64,10 +65,15 @@ def make_train_step(
     """
     config = config or TrainStepConfig()
 
-    def grad_one(params, mb, rng, *extra):
-        (ce, aux), grads = jax.value_and_grad(
-            lambda p: loss_fn(p, mb, rng, *extra), has_aux=True
-        )(params)
+    def grad_one(params, step, mb, rng, *extra):
+        # QAT fake-quant runs INSIDE the differentiated function so the
+        # straight-through estimator routes gradients to the master weights
+        def fwd(p):
+            if param_transform is not None:
+                p = param_transform(p, step)
+            return loss_fn(p, mb, rng, *extra)
+
+        (ce, aux), grads = jax.value_and_grad(fwd, has_aux=True)(params)
         if not isinstance(aux, dict):
             aux = {"num_label_tokens": aux}
         return grads, ce, aux
@@ -78,7 +84,9 @@ def make_train_step(
         def micro(carry, xs):
             idx, mb = xs
             g_acc, ce_acc, aux_acc = carry
-            g, ce, aux = grad_one(state.params, mb, jax.random.fold_in(rng, idx), *extra)
+            g, ce, aux = grad_one(
+                state.params, state.step, mb, jax.random.fold_in(rng, idx), *extra
+            )
             return (
                 jax.tree.map(jnp.add, g_acc, g),
                 ce_acc + ce,
@@ -88,7 +96,8 @@ def make_train_step(
         zero_grads = jax.tree.map(jnp.zeros_like, state.params)
         # shape-only probe for the aux accumulator structure (no compute)
         _, _, aux_shapes = jax.eval_shape(
-            grad_one, state.params, jax.tree.map(lambda x: x[0], batch), rng, *extra
+            grad_one, state.params, state.step,
+            jax.tree.map(lambda x: x[0], batch), rng, *extra,
         )
         aux0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), aux_shapes)
         (grads, ce_sum, aux_sum), _ = jax.lax.scan(

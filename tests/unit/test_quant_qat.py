@@ -1,0 +1,177 @@
+"""FP8-checkpoint dequant at load + QAT fake-quant training.
+
+Reference anchors: models/deepseek_v3/state_dict_adapter.py:96 (block-wise
+fp8 dequant of DSv3 checkpoints at load) and quantization/qat.py +
+recipes/llm/train_ft.py:861 (torchao fake-quant with delayed enabling).
+"""
+
+import json
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu.ops.quant import (
+    QATConfig,
+    fake_quantize,
+    matmul,
+    quantized_matmul,
+)
+
+
+def test_fp8_checkpoint_dequant_at_load(tmp_path):
+    torch = pytest.importorskip("torch")
+    from safetensors.torch import save_file
+
+    from automodel_tpu.checkpoint.hf_adapter import HFCheckpointReader
+
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(160, 96)).astype(np.float32)  # not a multiple of 128
+    scale_inv = rng.uniform(0.5, 2.0, size=(2, 1)).astype(np.float32)
+    wq = torch.tensor(w).to(torch.float8_e4m3fn)
+    save_file(
+        {
+            "model.layers.0.mlp.up_proj.weight": wq,
+            "model.layers.0.mlp.up_proj.weight_scale_inv": torch.tensor(scale_inv),
+            "model.norm.weight": torch.ones(96),
+        },
+        str(tmp_path / "model.safetensors"),
+    )
+    read = HFCheckpointReader(str(tmp_path))
+    got = read("model.layers.0.mlp.up_proj.weight")
+    assert got.dtype == np.float32
+    # expected: fp8-rounded w times the block scale
+    w8 = wq.to(torch.float32).numpy()
+    exp = w8 * np.repeat(np.repeat(scale_inv, 128, 0), 128, 1)[:160, :96]
+    np.testing.assert_allclose(got, exp, rtol=1e-6)
+    # non-quantized tensors read unchanged
+    np.testing.assert_array_equal(read("model.norm.weight"), np.ones(96, np.float32))
+
+
+def test_fake_quantize_ste_gradient_and_grid():
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(8, 16)), jnp.float32)
+    y = fake_quantize(x, "int8")
+    # on the int8 grid: per-column scale, values land on multiples of it
+    scale = np.abs(np.asarray(x)).max(0, keepdims=True) / 127.0 + 1e-12
+    steps = np.asarray(y) / scale
+    np.testing.assert_allclose(steps, np.round(steps), atol=1e-3)
+    # STE: gradient of sum(fake_quantize(x)) is exactly ones
+    g = jax.grad(lambda t: fake_quantize(t, "int8").sum())(x)
+    np.testing.assert_array_equal(np.asarray(g), np.ones_like(x))
+
+
+def test_qat_transform_delayed_enable_and_kernel_only():
+    kernel = jnp.asarray(np.random.default_rng(2).normal(size=(4, 4)), jnp.float32)
+    params = {
+        "layers": {
+            "q_proj": {"kernel": kernel, "bias": jnp.full((4,), 0.333, jnp.float32)},
+            "norm": {"scale": jnp.full((4,), 0.333, jnp.float32)},
+        }
+    }
+    tr = QATConfig(enabled=True, precision="int8", start_step=5).make_param_transform()
+    before = tr(params, jnp.int32(0))
+    after = tr(params, jnp.int32(5))
+    # before start_step: identity
+    np.testing.assert_array_equal(
+        np.asarray(before["layers"]["q_proj"]["kernel"]), np.asarray(kernel)
+    )
+    # after: kernel snapped to the grid, bias/norm untouched
+    k = np.asarray(after["layers"]["q_proj"]["kernel"])
+    assert not np.allclose(k, np.asarray(kernel))
+    np.testing.assert_array_equal(np.asarray(after["layers"]["q_proj"]["bias"]), np.full(4, 0.333, np.float32))
+    np.testing.assert_array_equal(np.asarray(after["layers"]["norm"]["scale"]), np.full(4, 0.333, np.float32))
+    assert QATConfig(enabled=False).make_param_transform() is None
+
+
+def test_train_step_with_qat_transform_trains():
+    """A tiny regression under make_train_step with QAT on from step 0:
+    loss must decrease and gradients must reach the master weights."""
+    import optax
+
+    from automodel_tpu.training import init_train_state, make_train_step
+
+    rng = np.random.default_rng(3)
+    X = jnp.asarray(rng.normal(size=(4, 8, 16)), jnp.float32)  # (accum, B, D)
+    w_true = jnp.asarray(rng.normal(size=(16, 1)), jnp.float32)
+    Y = jnp.einsum("abd,do->abo", X, w_true)
+
+    def loss_fn(p, mb, rng_):
+        pred = mb["x"] @ p["head"]["kernel"]
+        return jnp.sum((pred - mb["y"]) ** 2), jnp.float32(mb["x"].shape[0])
+
+    params = {"head": {"kernel": jnp.zeros((16, 1))}}
+    tx = optax.sgd(5e-2)
+    state = init_train_state(params, tx)
+    step = make_train_step(
+        loss_fn, tx,
+        param_transform=QATConfig(enabled=True, precision="int8").make_param_transform(),
+    )
+    batch = {"x": X, "y": Y}
+    losses = []
+    for i in range(30):
+        state, m = step(state, batch, jax.random.key(i))
+        losses.append(float(m["loss"]))
+    # int8 grid error floors the loss — expect substantial but not exact fit
+    assert losses[-1] < 0.5 * losses[0]
+
+
+def test_fp8_dequant_rejects_mismatched_scale_grid():
+    from automodel_tpu.checkpoint.hf_adapter import _dequant_fp8_block
+
+    w = np.zeros((160, 96), np.float32)
+    with pytest.raises(ValueError, match="scale_inv grid"):
+        _dequant_fp8_block(w, np.ones((3, 2), np.float32), (128, 128))
+    # a [64, 64] block checkpoint works when the config says so
+    out = _dequant_fp8_block(w + 1.0, 2.0 * np.ones((3, 2), np.float32), (64, 64))
+    np.testing.assert_array_equal(out, np.full((160, 96), 2.0, np.float32))
+
+
+def test_qat_with_peft_raises():
+    """QAT's kernel transform cannot see LoRA trees — the recipe must
+    refuse the combination loudly instead of silently not quantizing."""
+    from automodel_tpu.config.loader import ConfigNode
+    from automodel_tpu.recipes.llm.train_ft import (
+        TrainFinetuneRecipeForNextTokenPrediction,
+    )
+
+    cfg = ConfigNode({
+        "run_dir": "/tmp/am_qat_peft",
+        "model": {"hf_config": {
+            "architectures": ["LlamaForCausalLM"], "vocab_size": 64,
+            "hidden_size": 32, "intermediate_size": 64,
+            "num_hidden_layers": 1, "num_attention_heads": 4,
+            "num_key_value_heads": 2,
+        }, "dtype": "float32", "remat_policy": "none"},
+        "dataset": {
+            "_target_": "automodel_tpu.datasets.mock.MockDatasetConfig",
+            "num_samples": 8, "seq_len": 16, "vocab_size": 64,
+        },
+        "dataloader": {"microbatch_size": 2, "grad_acc_steps": 1},
+        "step_scheduler": {"max_steps": 1},
+        "checkpoint": {"enabled": False},
+        "peft": {"rank": 2},
+        "qat": {"enabled": True},
+    })
+    r = TrainFinetuneRecipeForNextTokenPrediction(cfg)
+    with pytest.raises(ValueError, match="does not compose with peft"):
+        r.setup()
+
+
+def test_quantized_matmul_per_channel_accuracy():
+    """Per-channel scales keep error small when channels differ in scale
+    by orders of magnitude (per-tensor scaling would destroy the small
+    channel)."""
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(size=(32, 64)), jnp.float32)
+    w = np.asarray(rng.normal(size=(64, 2)), np.float32)
+    w[:, 0] *= 1000.0
+    w[:, 1] *= 0.001
+    w = jnp.asarray(w)
+    exact = x @ w
+    got = quantized_matmul(x, w, "int8")
+    rel = np.abs(np.asarray(got - exact)) / (np.abs(np.asarray(exact)) + 1e-9)
+    assert np.median(rel[:, 0]) < 0.05 and np.median(rel[:, 1]) < 0.05
+    # matmul dispatcher: None passes through exactly
+    np.testing.assert_array_equal(np.asarray(matmul(x, w, None)), np.asarray(exact))
