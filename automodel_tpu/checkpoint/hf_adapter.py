@@ -610,18 +610,31 @@ def _dequant_fp8_block(
             f"at block size {block} (expected {expect}); check "
             "quantization_config.weight_block_size in config.json"
         )
-    s = np.repeat(np.repeat(s, bm, 0), bn, 1)
-    return (w.astype(np.float32) * s[:M, :N]).astype(np.float32)
+    # block-row-wise multiply: no weight-sized scale temporary (a DSv3
+    # 7168×18432 weight would otherwise allocate a ~500MB scale matrix)
+    out = np.empty((M, N), np.float32)
+    for bi in range(expect[0]):
+        r0, r1 = bi * bm, min((bi + 1) * bm, M)
+        row_scale = np.repeat(s[bi], bn)[:N]  # (N,)
+        out[r0:r1] = w[r0:r1].astype(np.float32) * row_scale
+    return out
 
 
-def _read_fp8_slice(path: str, name: str) -> np.ndarray:
+def _read_safetensors_header(path: str) -> tuple:
+    """(header_len, parsed header dict) of one safetensors file."""
+    import struct
+
+    with open(path, "rb") as f:
+        (hlen,) = struct.unpack("<Q", f.read(8))
+        return hlen, json.loads(f.read(hlen))
+
+
+def _read_fp8_slice(path: str, name: str, header: tuple | None = None) -> np.ndarray:
     """Read one (possibly fp8) tensor straight from a safetensors file.
 
     The numpy framework of `safetensors` cannot represent float8 dtypes;
     parse the header manually and reinterpret the raw bytes with
     ml_dtypes (shipped with jax)."""
-    import struct
-
     import ml_dtypes
 
     dtypes = {
@@ -631,11 +644,10 @@ def _read_fp8_slice(path: str, name: str) -> np.ndarray:
         "F16": np.float16,
         "F32": np.float32,
     }
+    hlen, meta_map = header if header is not None else _read_safetensors_header(path)
+    meta = meta_map[name]
+    start, end = meta["data_offsets"]
     with open(path, "rb") as f:
-        (hlen,) = struct.unpack("<Q", f.read(8))
-        header = json.loads(f.read(hlen))
-        meta = header[name]
-        start, end = meta["data_offsets"]
         f.seek(8 + hlen + start)
         buf = f.read(end - start)
     return np.frombuffer(buf, dtype=dtypes[meta["dtype"]]).reshape(meta["shape"])
@@ -649,6 +661,8 @@ class HFCheckpointReader:
 
         self._dir = ckpt_dir
         self._handles: dict[str, Any] = {}
+        self._header_cache: dict[str, tuple] = {}
+        self._fp8_block_cache: tuple | None = None
         index_path = os.path.join(ckpt_dir, "model.safetensors.index.json")
         if os.path.exists(index_path):
             with open(index_path) as f:
@@ -680,10 +694,13 @@ class HFCheckpointReader:
 
     def _fp8_block(self) -> tuple:
         """Block size of fp8-quantized checkpoints, from config.json's
-        quantization_config.weight_block_size (DSv3 convention: [128, 128])."""
-        cfg = self.hf_config() or {}
-        bs = (cfg.get("quantization_config") or {}).get("weight_block_size")
-        return (int(bs[0]), int(bs[1])) if bs else (128, 128)
+        quantization_config.weight_block_size (DSv3 convention: [128, 128]).
+        Cached — this is consulted once per quantized tensor."""
+        if self._fp8_block_cache is None:
+            cfg = self.hf_config() or {}
+            bs = (cfg.get("quantization_config") or {}).get("weight_block_size")
+            self._fp8_block_cache = (int(bs[0]), int(bs[1])) if bs else (128, 128)
+        return self._fp8_block_cache
 
     def _read_raw(self, name: str) -> np.ndarray:
         h = self._handle(self._weight_map[name])
@@ -692,8 +709,13 @@ class HFCheckpointReader:
         except (TypeError, ValueError, KeyError, AttributeError):
             # fp8 dtypes are outside the numpy framework's type table —
             # re-read the raw buffer and reinterpret via ml_dtypes
+            fname = self._weight_map[name]
+            if fname not in self._header_cache:
+                self._header_cache[fname] = _read_safetensors_header(
+                    os.path.join(self._dir, fname)
+                )
             return _read_fp8_slice(
-                os.path.join(self._dir, self._weight_map[name]), name
+                os.path.join(self._dir, fname), name, self._header_cache[fname]
             )
 
     def hf_config(self) -> dict | None:

@@ -29,6 +29,7 @@ RECIPE_ALIASES = {
     "dllm_train_ft": "automodel_tpu.recipes.dllm.train_ft.DiffusionLMSFTRecipe",
     "diffusion_train": "automodel_tpu.recipes.diffusion.train.TrainDiffusionRecipe",
     "vlm_finetune": "automodel_tpu.recipes.vlm.finetune.FinetuneRecipeForVLM",
+    "vlm_kd": "automodel_tpu.recipes.vlm.kd.KDRecipeForVLM",
     "llm_seq_cls": "automodel_tpu.recipes.llm.train_seq_cls.TrainSeqClsRecipe",
     "retrieval_bi_encoder": "automodel_tpu.recipes.retrieval.train_bi_encoder.TrainBiEncoderRecipe",
     "retrieval_cross_encoder": "automodel_tpu.recipes.retrieval.train_cross_encoder.TrainCrossEncoderRecipe",

@@ -32,7 +32,9 @@ INT8_MAX = 127.0
 def _qparams(precision: str):
     if precision == "int8":
         return jnp.int8, INT8_MAX
-    return jnp.float8_e4m3fn, FP8_MAX
+    if precision == "fp8":
+        return jnp.float8_e4m3fn, FP8_MAX
+    raise ValueError(f"Unknown quantization precision '{precision}' (int8|fp8)")
 
 
 def _quantize(x, qdtype, qmax, axis=None):

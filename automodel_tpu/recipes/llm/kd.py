@@ -34,46 +34,56 @@ from automodel_tpu.recipes.llm.train_ft import (
 logger = logging.getLogger(__name__)
 
 
+def build_teacher(recipe) -> None:
+    """Attach a frozen teacher (spec/cfg/params) to any train recipe from
+    its `teacher_model:` section. Shared by the LLM and VLM KD recipes
+    (reference: recipes/kd_utils.py builds teachers the same way for both)."""
+    cfg = recipe.cfg
+    tcfg = cfg.get("teacher_model")
+    if tcfg is None:
+        raise ValueError("KD recipe requires a `teacher_model:` section")
+    dtype = _DTYPES[tcfg.get("dtype", "bfloat16")]
+    pretrained = tcfg.get("pretrained_path", None)
+    if pretrained:
+        reader = HFCheckpointReader(pretrained)
+        hf_config = reader.hf_config()
+    else:
+        reader = None
+        hf_config = tcfg.get("hf_config")
+        hf_config = hf_config.to_dict() if isinstance(hf_config, ConfigNode) else dict(hf_config)
+    recipe.teacher_spec = get_model_spec(hf_config)
+    recipe.teacher_cfg = recipe.teacher_spec.config_from_hf(
+        hf_config, dtype=dtype, remat_policy=tcfg.get("remat_policy", "full")
+    )
+    module = recipe.teacher_spec.module
+    shapes = jax.eval_shape(lambda: module.init(recipe.teacher_cfg, jax.random.key(0)))
+    shardings = logical_to_shardings(
+        module.param_specs(recipe.teacher_cfg), recipe.mesh_ctx,
+        shapes=jax.tree.map(lambda p: p.shape, shapes),
+    )
+    if reader is not None:
+        adapter = get_adapter(
+            recipe.teacher_spec.adapter_name, recipe.teacher_cfg,
+            **recipe.teacher_spec.adapter_kwargs,
+        )
+        recipe.teacher_params = adapter.from_hf(reader, shardings=shardings)
+        logger.info("teacher loaded from %s", pretrained)
+    else:
+        recipe.teacher_params = jax.jit(
+            lambda k: module.init(recipe.teacher_cfg, k), out_shardings=shardings
+        )(jax.random.key(int(cfg.get("teacher_seed", 7))))
+    # teacher is inference-only: keep in compute dtype to halve memory
+    recipe.teacher_params = jax.tree.map(
+        lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p,
+        recipe.teacher_params,
+    )
+
+
 class KDRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPrediction):
     # -- teacher -----------------------------------------------------------
     def _build_model(self) -> None:
         super()._build_model()
-        cfg = self.cfg
-        tcfg = cfg.get("teacher_model")
-        if tcfg is None:
-            raise ValueError("KD recipe requires a `teacher_model:` section")
-        dtype = _DTYPES[tcfg.get("dtype", "bfloat16")]
-        pretrained = tcfg.get("pretrained_path", None)
-        if pretrained:
-            reader = HFCheckpointReader(pretrained)
-            hf_config = reader.hf_config()
-        else:
-            reader = None
-            hf_config = tcfg.get("hf_config")
-            hf_config = hf_config.to_dict() if isinstance(hf_config, ConfigNode) else dict(hf_config)
-        self.teacher_spec = get_model_spec(hf_config)
-        self.teacher_cfg = self.teacher_spec.config_from_hf(
-            hf_config, dtype=dtype, remat_policy=tcfg.get("remat_policy", "full")
-        )
-        module = self.teacher_spec.module
-        shapes = jax.eval_shape(lambda: module.init(self.teacher_cfg, jax.random.key(0)))
-        shardings = logical_to_shardings(
-            module.param_specs(self.teacher_cfg), self.mesh_ctx,
-            shapes=jax.tree.map(lambda p: p.shape, shapes),
-        )
-        if reader is not None:
-            adapter = get_adapter(self.teacher_spec.adapter_name, self.teacher_cfg)
-            self.teacher_params = adapter.from_hf(reader, shardings=shardings)
-            logger.info("teacher loaded from %s", pretrained)
-        else:
-            self.teacher_params = jax.jit(
-                lambda k: module.init(self.teacher_cfg, k), out_shardings=shardings
-            )(jax.random.key(int(cfg.get("teacher_seed", 7))))
-        # teacher is inference-only: keep in compute dtype to halve memory
-        self.teacher_params = jax.tree.map(
-            lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p,
-            self.teacher_params,
-        )
+        build_teacher(self)
 
     # -- loss --------------------------------------------------------------
     def _make_loss_fn(self):

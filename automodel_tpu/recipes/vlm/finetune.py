@@ -21,44 +21,58 @@ from automodel_tpu.recipes.llm.train_ft import TrainFinetuneRecipeForNextTokenPr
 logger = logging.getLogger(__name__)
 
 
+def vlm_lm_kernel(params, text_cfg):
+    """The language model's unembedding kernel (tied or separate)."""
+    lm = params["language_model"]
+    return (
+        lm["embed"]["embedding"].T
+        if text_cfg.tie_word_embeddings
+        else lm["lm_head"]["kernel"]
+    )
+
+
 class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
-    def _make_loss_fn(self):
-        cfg = self.cfg
+    def _make_student_forward(self):
+        """(params, batch, extra) -> (merged_params, hidden): PEFT merge,
+        vision-tower freeze, optional batch keys, forward to hidden —
+        the student preamble shared by the finetune and KD losses."""
         module = self.model_spec.module
         model_cfg = self.model_cfg
         mesh_ctx = self.mesh_ctx
-        chunk = int(cfg.get("loss.chunk_size", 1024))
         # NOTE: freezing is stop_gradient-based — pair with weight_decay: 0
         # (or a decay mask) so AdamW's decoupled decay cannot drift the
         # frozen tower; optimizer-exclusion freeze lands with multi-group
         # param handling next round.
-        freeze_vision = bool(cfg.get("freeze_vision_tower", False))
+        freeze_vision = bool(self.cfg.get("freeze_vision_tower", False))
         peft_cfg = self.peft_cfg
 
-        def loss_fn(params, batch, rng, *extra):
+        def student_forward(params, batch, extra):
             if peft_cfg is not None:
                 from automodel_tpu.peft.lora import merge_lora
 
-                (base_params,) = extra
+                base_params, extra = extra[0], extra[1:]
                 params = merge_lora(base_params, params, peft_cfg)
             if freeze_vision:
                 params = {**params, "vision_tower": jax.lax.stop_gradient(params["vision_tower"])}
-            kw = {}
-            for k in ("positions", "segment_ids"):
-                if k in batch:
-                    kw[k] = batch[k]
+            kw = {k: batch[k] for k in ("positions", "segment_ids") if k in batch}
             hidden = module.forward(
                 params, model_cfg, batch["input_ids"], batch["pixel_values"],
                 return_hidden=True, mesh_ctx=mesh_ctx, **kw,
             )
-            lm = params["language_model"]
-            kernel = (
-                lm["embed"]["embedding"].T
-                if model_cfg.text.tie_word_embeddings
-                else lm["lm_head"]["kernel"]
-            )
+            return params, hidden, extra, kw
+
+        return student_forward
+
+    def _make_loss_fn(self):
+        model_cfg = self.model_cfg
+        chunk = int(self.cfg.get("loss.chunk_size", 1024))
+        student_forward = self._make_student_forward()
+
+        def loss_fn(params, batch, rng, *extra):
+            params, hidden, _, _ = student_forward(params, batch, extra)
             ce, n = fused_linear_cross_entropy(
-                hidden, kernel, batch["labels"], chunk_size=chunk,
+                hidden, vlm_lm_kernel(params, model_cfg.text),
+                batch["labels"], chunk_size=chunk,
                 logits_soft_cap=model_cfg.text.logits_soft_cap,
             )
             return ce, {"num_label_tokens": n}

@@ -1,0 +1,75 @@
+"""VLM knowledge distillation: frozen VLM teacher → VLM student.
+
+The analog of the reference's VLM KD recipe (reference: nemo_automodel/
+recipes/vlm/kd.py — same structure as the LLM KD recipe with pixel_values
+flowing through both forward passes). The teacher sees the SAME images and
+token layout as the student; soft targets come from the teacher's fused
+lm-head CE over its own hidden states (no logits materialization on either
+side — loss/kd_loss.py).
+
+YAML: the `vlm_finetune` surface plus
+
+    teacher_model: {hf_config: {...} | pretrained_path: ..., dtype: bfloat16}
+    kd: {ratio: 0.5, temperature: 2.0}
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+
+from automodel_tpu.loss.kd_loss import fused_kd_cross_entropy
+from automodel_tpu.recipes.llm.kd import build_teacher
+from automodel_tpu.recipes.vlm.finetune import FinetuneRecipeForVLM, vlm_lm_kernel
+
+logger = logging.getLogger(__name__)
+
+
+class KDRecipeForVLM(FinetuneRecipeForVLM):
+    def _build_model(self) -> None:
+        super()._build_model()
+        build_teacher(self)
+        if not hasattr(self.teacher_cfg, "text"):
+            raise ValueError(
+                "vlm KD teacher must be a VLM architecture (got "
+                f"{self.teacher_spec.name}); use the llm_kd recipe for "
+                "text-only teachers"
+            )
+
+    def _make_loss_fn(self):
+        cfg = self.cfg
+        model_cfg = self.model_cfg
+        teacher_module = self.teacher_spec.module
+        teacher_cfg = self.teacher_cfg
+        mesh_ctx = self.mesh_ctx
+        kd_ratio = float(cfg.get("kd.ratio", 0.5))
+        temperature = float(cfg.get("kd.temperature", 1.0))
+        chunk = int(cfg.get("loss.chunk_size", 1024))
+        student_forward = self._make_student_forward()
+
+        def loss_fn(params, batch, rng, *extra):
+            params, s_hidden, extra_rest, kw = student_forward(params, batch, extra)
+            (teacher_params,) = extra_rest
+            t_hidden = teacher_module.forward(
+                teacher_params, teacher_cfg, batch["input_ids"],
+                batch["pixel_values"], return_hidden=True, mesh_ctx=mesh_ctx,
+                **kw,
+            )
+            t_hidden = jax.lax.stop_gradient(t_hidden)
+            total, n = fused_kd_cross_entropy(
+                s_hidden, vlm_lm_kernel(params, model_cfg.text),
+                t_hidden, vlm_lm_kernel(teacher_params, teacher_cfg.text),
+                batch["labels"],
+                kd_ratio=kd_ratio, temperature=temperature, chunk_size=chunk,
+                student_soft_cap=model_cfg.text.logits_soft_cap,
+                teacher_soft_cap=teacher_cfg.text.logits_soft_cap,
+            )
+            return total, {"num_label_tokens": n}
+
+        return loss_fn
+
+    def _step_extra(self) -> tuple:
+        if self.peft_cfg is not None:
+            return (self.base_params, self.teacher_params)
+        return (self.teacher_params,)

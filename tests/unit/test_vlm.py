@@ -127,6 +127,50 @@ def test_vlm_recipe_trains(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_vlm_kd_recipe_trains(tmp_path):
+    """VLM distillation: frozen llava teacher → llava student, pixel
+    values through BOTH forwards, fused hidden-space KD loss
+    (reference: recipes/vlm/kd.py)."""
+    from automodel_tpu.cli.app import resolve_recipe_class
+    from automodel_tpu.config import ConfigNode
+
+    cfg = ConfigNode({
+        "seed": 13,
+        "recipe": "vlm_kd",
+        "run_dir": str(tmp_path),
+        "auto_resume": False,
+        "model": {"hf_config": HF_VLM, "dtype": "float32", "remat_policy": "none"},
+        "teacher_model": {"hf_config": HF_VLM, "dtype": "float32"},
+        "kd": {"ratio": 0.5, "temperature": 2.0},
+        "distributed": {"dp_shard": -1},
+        "dataset": {
+            "_target_": "automodel_tpu.datasets.vlm.MockVLMDatasetConfig",
+            "num_samples": 32, "seq_len": 32, "vocab_size": 512,
+            "image_size": 28, "patch_size": 14, "image_token_id": 500,
+        },
+        "dataloader": {"microbatch_size": 8, "grad_acc_steps": 1},
+        "optimizer": {"name": "adamw", "lr": 1e-3, "weight_decay": 0.0},
+        "lr_scheduler": {"style": "constant", "warmup_steps": 0},
+        "step_scheduler": {"max_steps": 3, "ckpt_every_steps": 100},
+        "checkpoint": {"enabled": False},
+        "loss": {"chunk_size": 32},
+    })
+    recipe_cls = resolve_recipe_class(cfg)
+    assert recipe_cls.__name__ == "KDRecipeForVLM"
+    r = recipe_cls(cfg)
+    r.setup()
+    t_before = jax.tree.map(lambda x: np.asarray(x).copy(), r.teacher_params)
+    r.run_train_validation_loop()
+    recs = [json.loads(l) for l in open(tmp_path / "training.jsonl")]
+    assert len(recs) == 3 and all(np.isfinite(x["loss"]) for x in recs)
+    # teacher untouched
+    for a, b in zip(jax.tree.leaves(t_before), jax.tree.leaves(r.teacher_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # student == teacher init? no — different seeds; KD loss at temperature
+    # 2 with identical configs should still be finite and > 0
+    assert recs[0]["loss"] > 0
+
+
 def test_clip_style_tower_roundtrip(tmp_path):
     """CLIP variant: cls token, pre-LN, quick_gelu, penultimate feature layer."""
     hf = dict(HF_VLM)
