@@ -803,6 +803,43 @@ class LlavaAdapter:
         k = np.asarray(x).transpose(2, 3, 1, 0)  # (P, P, C, H)
         return np.ascontiguousarray(k.reshape(P * P * C, H))
 
+    def _vit_to_hf(self, vt: Mapping, prefix: str) -> Iterator[tuple[str, np.ndarray]]:
+        for name, path, kind in self._vit_top():
+            x = np.asarray(_get(vt, path))
+            if kind == "patch":
+                x = self._patch_kernel(x, to_hf=True)
+            yield f"{prefix}.{name}", x
+        for i in range(self.cfg.vision.num_layers):
+            for suffix, path, transpose in self._VIT_LAYER:
+                x = np.asarray(_get(vt["layers"], path)[i])
+                yield (
+                    f"{prefix}.vision_model.encoder.layers.{i}.{suffix}",
+                    (_t(x) if transpose else x),
+                )
+
+    def _vit_from_hf(self, read: Reader, prefix: str) -> dict:
+        vt: dict = {}
+        for name, path, kind in self._vit_top():
+            x = np.asarray(read(f"{prefix}.{name}"))
+            if kind == "patch":
+                x = self._patch_kernel(x, to_hf=False)
+            _set(vt, path, x)
+        layers: dict = {}
+        for suffix, path, transpose in self._VIT_LAYER:
+            stacked = np.stack(
+                [
+                    _t(read(f"{prefix}.vision_model.encoder.layers.{i}.{suffix}"))
+                    if transpose
+                    else np.asarray(
+                        read(f"{prefix}.vision_model.encoder.layers.{i}.{suffix}")
+                    )
+                    for i in range(self.cfg.vision.num_layers)
+                ]
+            )
+            _set(layers, path, stacked)
+        vt["layers"] = layers
+        return vt
+
     def to_hf(self, params: Mapping) -> Iterator[tuple[str, np.ndarray]]:
         for name, tensor in self._lm().to_hf(params["language_model"]):
             yield f"language_model.{name}", tensor
@@ -811,19 +848,7 @@ class LlavaAdapter:
         yield "multi_modal_projector.linear_1.bias", np.asarray(pj["fc1"]["bias"])
         yield "multi_modal_projector.linear_2.weight", _t(np.asarray(pj["fc2"]["kernel"]))
         yield "multi_modal_projector.linear_2.bias", np.asarray(pj["fc2"]["bias"])
-        vt = params["vision_tower"]
-        for name, path, kind in self._vit_top():
-            x = np.asarray(_get(vt, path))
-            if kind == "patch":
-                x = self._patch_kernel(x, to_hf=True)
-            yield f"vision_tower.{name}", x
-        for i in range(self.cfg.vision.num_layers):
-            for suffix, path, transpose in self._VIT_LAYER:
-                x = np.asarray(_get(vt["layers"], path)[i])
-                yield (
-                    f"vision_tower.vision_model.encoder.layers.{i}.{suffix}",
-                    (_t(x) if transpose else x),
-                )
+        yield from self._vit_to_hf(params["vision_tower"], "vision_tower")
 
     def from_hf(self, read: Reader, shardings: Any = None) -> dict:
         def sub_read(prefix):
@@ -843,28 +868,8 @@ class LlavaAdapter:
                 "bias": np.asarray(read("multi_modal_projector.linear_2.bias")),
             },
         }
-        vt: dict = {}
-        for name, path, kind in self._vit_top():
-            x = np.asarray(read(f"vision_tower.{name}"))
-            if kind == "patch":
-                x = self._patch_kernel(x, to_hf=False)
-            _set(vt, path, x)
-        layers: dict = {}
-        for suffix, path, transpose in self._VIT_LAYER:
-            stacked = np.stack(
-                [
-                    _t(read(f"vision_tower.vision_model.encoder.layers.{i}.{suffix}"))
-                    if transpose
-                    else np.asarray(
-                        read(f"vision_tower.vision_model.encoder.layers.{i}.{suffix}")
-                    )
-                    for i in range(self.cfg.vision.num_layers)
-                ]
-            )
-            _set(layers, path, stacked)
-        vt["layers"] = layers
         out["projector"] = pj
-        out["vision_tower"] = vt
+        out["vision_tower"] = self._vit_from_hf(read, "vision_tower")
         if shardings is not None:
             for key in ("projector", "vision_tower"):
                 out[key] = jax.tree.map(
@@ -874,3 +879,98 @@ class LlavaAdapter:
 
 
 ADAPTERS["llava"] = LlavaAdapter
+
+
+@dataclasses.dataclass
+class OmniAdapter:
+    """Omni (text·image·audio) ↔ models/omni/model params.
+
+    Naming follows the reference's nemotron_omni checkpoint structure
+    (reference: models/nemotron_omni/state_dict_adapter.py —
+    `vision_projection.*` / `sound_projection.{norm,linear1,linear2}` /
+    `sound_encoder.*` / `language_model.*`); the vision tower reuses the
+    llava CLIP naming, and the sound encoder's transformer layers use the
+    same encoder-layer suffixes with our conv front-end stored in its
+    native (K, in, out) layout."""
+
+    cfg: Any  # OmniConfig
+
+    _AUDIO_TOP = (
+        ("conv1.kernel", ("conv1", "kernel")),
+        ("conv1.bias", ("conv1", "bias")),
+        ("conv2.kernel", ("conv2", "kernel")),
+        ("conv2.bias", ("conv2", "bias")),
+        ("final_ln.weight", ("final_ln", "scale")),
+        ("final_ln.bias", ("final_ln", "bias")),
+    )
+
+    def _base(self) -> LlavaAdapter:
+        return LlavaAdapter(self.cfg)
+
+    def _proj_entries(self, key: str):
+        return (
+            (f"{key}.norm.weight", (key, "norm", "scale"), False),
+            (f"{key}.linear1.weight", (key, "linear1", "kernel"), True),
+            (f"{key}.linear2.weight", (key, "linear2", "kernel"), True),
+        )
+
+    def to_hf(self, params: Mapping) -> Iterator[tuple[str, np.ndarray]]:
+        base = self._base()
+        for name, tensor in base._lm().to_hf(params["language_model"]):
+            yield f"language_model.{name}", tensor
+        yield from base._vit_to_hf(params["vision_tower"], "vision_tower")
+        for key in ("vision_projection", "sound_projection"):
+            for name, path, transpose in self._proj_entries(key):
+                x = np.asarray(_get(params, path))
+                yield name, (_t(x) if transpose else x)
+        at = params["audio_tower"]
+        for suffix, path in self._AUDIO_TOP:
+            yield f"sound_encoder.{suffix}", np.asarray(_get(at, path))
+        for i in range(self.cfg.audio.num_layers):
+            for suffix, path, transpose in LlavaAdapter._VIT_LAYER:
+                x = np.asarray(_get(at["layers"], path)[i])
+                yield (
+                    f"sound_encoder.encoder.layers.{i}.{suffix}",
+                    (_t(x) if transpose else x),
+                )
+
+    def from_hf(self, read: Reader, shardings: Any = None) -> dict:
+        base = self._base()
+
+        def sub_read(prefix):
+            return lambda name: read(f"{prefix}.{name}")
+
+        lm_shardings = shardings["language_model"] if shardings is not None else None
+        out: dict = {
+            "language_model": base._lm().from_hf(sub_read("language_model"), lm_shardings),
+            "vision_tower": base._vit_from_hf(read, "vision_tower"),
+        }
+        for key in ("vision_projection", "sound_projection"):
+            for name, path, transpose in self._proj_entries(key):
+                x = _t(read(name)) if transpose else np.asarray(read(name))
+                _set(out, path, x)
+        at: dict = {}
+        for suffix, path in self._AUDIO_TOP:
+            _set(at, path, np.asarray(read(f"sound_encoder.{suffix}")))
+        layers: dict = {}
+        for suffix, path, transpose in LlavaAdapter._VIT_LAYER:
+            stacked = np.stack(
+                [
+                    _t(read(f"sound_encoder.encoder.layers.{i}.{suffix}"))
+                    if transpose
+                    else np.asarray(read(f"sound_encoder.encoder.layers.{i}.{suffix}"))
+                    for i in range(self.cfg.audio.num_layers)
+                ]
+            )
+            _set(layers, path, stacked)
+        at["layers"] = layers
+        out["audio_tower"] = at
+        if shardings is not None:
+            for key in ("vision_tower", "audio_tower", "vision_projection", "sound_projection"):
+                out[key] = jax.tree.map(
+                    lambda v, sh: jax.device_put(v, sh), out[key], shardings[key]
+                )
+        return out
+
+
+ADAPTERS["omni"] = OmniAdapter

@@ -1,0 +1,87 @@
+"""Omni (text·image·audio) datasets: mock samples for hermetic CI.
+
+The analog of the reference's multimodal/audio datasets (reference:
+nemo_automodel/components/datasets/multimodal/, datasets/audio/). Each
+sample carries pixel_values, audio mel features, and input_ids laid out
+[image patches][audio frames][text] with placeholder ids over the image
+and audio spans (the omni model scatters tower embeddings into those
+spans — models/omni/model.py)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+IGNORE_INDEX = -100
+
+
+@dataclasses.dataclass
+class MockOmniDatasetConfig:
+    num_samples: int = 64
+    seq_len: int = 128
+    vocab_size: int = 512
+    image_size: int = 56
+    patch_size: int = 14
+    num_channels: int = 3
+    image_token_id: int = 500
+    # mel-frame count BEFORE the encoder's time reduction; the stride must
+    # match the model's audio_config (AudioConfig.subsample_stride) or the
+    # placeholder count diverges from the encoder's output frames
+    audio_frames: int = 64
+    num_mel_bins: int = 80
+    audio_subsample_stride: int = 2
+    audio_token_id: int = 501
+    seed: int = 0
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def num_audio_tokens(self) -> int:
+        from automodel_tpu.models.audio.encoder import AudioConfig
+
+        return AudioConfig(
+            subsample_stride=self.audio_subsample_stride
+        ).out_frames(self.audio_frames)
+
+    def build(self) -> "MockOmniDataset":
+        return MockOmniDataset(self)
+
+
+class MockOmniDataset:
+    def __init__(self, config: MockOmniDatasetConfig):
+        self.config = config
+        need = config.num_patches + config.num_audio_tokens
+        assert need < config.seq_len, (
+            f"image+audio occupy {need} placeholder tokens but seq_len is "
+            f"only {config.seq_len}; raise seq_len"
+        )
+
+    def __len__(self) -> int:
+        return self.config.num_samples
+
+    def __getitem__(self, idx: int) -> dict:
+        c = self.config
+        rng = np.random.default_rng(c.seed * 77003 + idx)
+        pixels = rng.normal(
+            size=(c.image_size, c.image_size, c.num_channels)
+        ).astype(np.float32)
+        mel = rng.normal(size=(c.audio_frames, c.num_mel_bins)).astype(np.float32)
+        n_img, n_aud = c.num_patches, c.num_audio_tokens
+        n_text = c.seq_len - n_img - n_aud
+        text = rng.integers(1, min(c.image_token_id, c.audio_token_id), n_text, dtype=np.int32)
+        ids = np.concatenate([
+            np.full(n_img, c.image_token_id, np.int32),
+            np.full(n_aud, c.audio_token_id, np.int32),
+            text,
+        ])
+        labels = np.concatenate([ids[1:], [IGNORE_INDEX]]).astype(np.int32)
+        labels[: n_img + n_aud] = IGNORE_INDEX  # no supervision on media spans
+        return {
+            "input_ids": ids,
+            "labels": labels,
+            "pixel_values": pixels,
+            "audio_features": mel,
+        }

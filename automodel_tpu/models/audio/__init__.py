@@ -1,0 +1,3 @@
+from automodel_tpu.models.audio import encoder
+
+__all__ = ["encoder"]

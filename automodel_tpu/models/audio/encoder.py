@@ -1,0 +1,203 @@
+"""Audio (speech) encoder for omni models: mel features → frame embeddings.
+
+The analog of the reference's sound encoders inside its omni families
+(reference: nemo_automodel/components/models/nemotron_omni/model.py —
+Parakeet conformer via trust_remote_code; qwen2_5_omni's audio tower).
+TPU-native form: strided-conv time subsampling (×4) + a pre-LN
+bidirectional transformer over frames with sinusoidal positions
+(whisper-style) — conv front-ends and self-attention both map straight
+onto the MXU; the conformer's depthwise-conv blocks add little on TPU and
+are omitted by design. Functional pytree + stacked-layer scan like the
+vision tower (models/vision/vit.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.layers import dense_init, maybe_remat
+from automodel_tpu.ops.attention import dot_product_attention
+from automodel_tpu.ops.norms import layer_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class AudioConfig:
+    num_mel_bins: int = 80
+    hidden_size: int = 256
+    intermediate_size: int = 1024
+    num_layers: int = 4
+    num_heads: int = 4
+    conv_kernel: int = 3
+    # two stride-2 convs → frames/4; each output frame covers 4 mel frames
+    subsample_stride: int = 2
+    max_frames: int = 1500  # post-subsample positions (whisper: 1500)
+    layer_norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat_policy: str = "full"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def subsample_factor(self) -> int:
+        return self.subsample_stride ** 2
+
+    def out_frames(self, mel_frames: int) -> int:
+        s = self.subsample_stride
+        return ((mel_frames + s - 1) // s + s - 1) // s
+
+    def param_count(self) -> int:
+        H, I, L, K = self.hidden_size, self.intermediate_size, self.num_layers, self.conv_kernel
+        return (
+            K * self.num_mel_bins * H + K * H * H
+            + L * (4 * H * H + 2 * H * I)
+        )
+
+    @classmethod
+    def from_hf(cls, hf: dict, **overrides) -> "AudioConfig":
+        kw = dict(
+            num_mel_bins=int(hf.get("num_mel_bins", 80)),
+            hidden_size=int(hf.get("hidden_size", hf.get("d_model", 256))),
+            intermediate_size=int(
+                hf.get("intermediate_size", hf.get("encoder_ffn_dim", 1024))
+            ),
+            num_layers=int(hf.get("num_hidden_layers", hf.get("encoder_layers", 4))),
+            num_heads=int(
+                hf.get("num_attention_heads", hf.get("encoder_attention_heads", 4))
+            ),
+        )
+        kw.update(overrides)
+        return cls(**kw)
+
+
+def init(cfg: AudioConfig, rng: jax.Array) -> dict:
+    H, I, L, K = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers, cfg.conv_kernel
+    ks = jax.random.split(rng, 10)
+
+    def stack(key, shape):
+        keys = jax.random.split(key, L)
+        return jnp.stack([dense_init(k, shape) for k in keys])
+
+    return {
+        # conv kernels in (K, in, out) — lax.conv 'NWC'/'WIO' layout
+        "conv1": {
+            "kernel": dense_init(ks[0], (K * cfg.num_mel_bins, H)).reshape(K, cfg.num_mel_bins, H),
+            "bias": jnp.zeros((H,)),
+        },
+        "conv2": {
+            "kernel": dense_init(ks[1], (K * H, H)).reshape(K, H, H),
+            "bias": jnp.zeros((H,)),
+        },
+        "layers": {
+            "ln1": {"scale": jnp.ones((L, H)), "bias": jnp.zeros((L, H))},
+            "q_proj": {"kernel": stack(ks[2], (H, H)), "bias": jnp.zeros((L, H))},
+            "k_proj": {"kernel": stack(ks[3], (H, H)), "bias": jnp.zeros((L, H))},
+            "v_proj": {"kernel": stack(ks[4], (H, H)), "bias": jnp.zeros((L, H))},
+            "o_proj": {"kernel": stack(ks[5], (H, H)), "bias": jnp.zeros((L, H))},
+            "ln2": {"scale": jnp.ones((L, H)), "bias": jnp.zeros((L, H))},
+            "fc1": {"kernel": stack(ks[6], (H, I)), "bias": jnp.zeros((L, I))},
+            "fc2": {"kernel": stack(ks[7], (I, H)), "bias": jnp.zeros((L, H))},
+        },
+        "final_ln": {"scale": jnp.ones((H,)), "bias": jnp.zeros((H,))},
+    }
+
+
+def param_specs(cfg: AudioConfig) -> dict:
+    return {
+        "conv1": {"kernel": (None, None, "embed"), "bias": ("norm",)},
+        "conv2": {"kernel": (None, "embed", "embed"), "bias": ("norm",)},
+        "layers": {
+            "ln1": {"scale": ("layers", "norm"), "bias": ("layers", "norm")},
+            "q_proj": {"kernel": ("layers", "embed", "heads"), "bias": ("layers", "heads")},
+            "k_proj": {"kernel": ("layers", "embed", "heads"), "bias": ("layers", "heads")},
+            "v_proj": {"kernel": ("layers", "embed", "heads"), "bias": ("layers", "heads")},
+            "o_proj": {"kernel": ("layers", "heads", "embed"), "bias": ("layers", "norm")},
+            "ln2": {"scale": ("layers", "norm"), "bias": ("layers", "norm")},
+            "fc1": {"kernel": ("layers", "embed", "mlp"), "bias": ("layers", "mlp")},
+            "fc2": {"kernel": ("layers", "mlp", "embed"), "bias": ("layers", "norm")},
+        },
+        "final_ln": {"scale": ("norm",), "bias": ("norm",)},
+    }
+
+
+def sinusoidal_positions(n: int, dim: int) -> jnp.ndarray:
+    """Whisper-style fixed sinusoidal embeddings (n, dim), float32."""
+    half = dim // 2
+    freq = jnp.exp(-jnp.log(10000.0) * jnp.arange(half) / max(half - 1, 1))
+    angles = jnp.arange(n)[:, None] * freq[None, :]
+    return jnp.concatenate([jnp.sin(angles), jnp.cos(angles)], axis=-1)
+
+
+def _conv1d(x, kernel, bias, stride):
+    """(B, T, Cin) ⊛ (K, Cin, Cout) strided, SAME padding."""
+    y = jax.lax.conv_general_dilated(
+        x, kernel.astype(x.dtype), window_strides=(stride,), padding="SAME",
+        dimension_numbers=("NWC", "WIO", "NWC"),
+    )
+    return y + bias.astype(x.dtype)
+
+
+def forward(
+    params: dict,
+    cfg: AudioConfig,
+    mel: jnp.ndarray,  # (B, T, num_mel_bins) float
+    frame_mask: jnp.ndarray | None = None,  # (B, T) bool — True = real audio
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """mel → (frame embeddings (B, T', H), valid mask (B, T'))."""
+    from automodel_tpu.models.common.layers import cast_params
+
+    params = cast_params(params, cfg.dtype)
+    s = cfg.subsample_stride
+
+    def subsample_mask(m, stride):
+        pad = (-m.shape[1]) % stride
+        m = jnp.pad(m, ((0, 0), (0, pad)))
+        # a subsampled frame is valid if ANY source frame under it is
+        return m.reshape(m.shape[0], -1, stride).any(-1)
+
+    x = mel.astype(cfg.dtype)
+    mask = frame_mask
+    if mask is not None:
+        # zero padded frames before each conv so the SAME-padded strided
+        # kernels read deterministic zeros at the valid/padded boundary
+        x = x * mask[..., None].astype(cfg.dtype)
+    x = jax.nn.gelu(_conv1d(x, params["conv1"]["kernel"], params["conv1"]["bias"], s))
+    if mask is not None:
+        mask = subsample_mask(mask, s)[:, : x.shape[1]]
+        x = x * mask[..., None].astype(cfg.dtype)
+    x = jax.nn.gelu(_conv1d(x, params["conv2"]["kernel"], params["conv2"]["bias"], s))
+    B, T, H = x.shape
+    if mask is None:
+        out_mask = jnp.ones((B, T), bool)
+    else:
+        out_mask = subsample_mask(mask, s)[:, :T]
+        x = x * out_mask[..., None].astype(cfg.dtype)
+    x = x + sinusoidal_positions(T, H).astype(cfg.dtype)
+
+    nh, hd, eps = cfg.num_heads, cfg.head_dim, cfg.layer_norm_eps
+
+    def layer(x, lp):
+        y = layer_norm(x, lp["ln1"]["scale"], lp["ln1"]["bias"], eps)
+        q = (y @ lp["q_proj"]["kernel"] + lp["q_proj"]["bias"]).reshape(B, T, nh, hd)
+        k = (y @ lp["k_proj"]["kernel"] + lp["k_proj"]["bias"]).reshape(B, T, nh, hd)
+        v = (y @ lp["v_proj"]["kernel"] + lp["v_proj"]["bias"]).reshape(B, T, nh, hd)
+        # padded frames sit in segment 0, real audio in segment 1 — the
+        # segment mask keeps real frames from attending to padding
+        seg = out_mask.astype(jnp.int32)
+        a = dot_product_attention(
+            q, k, v, causal=False, impl="xla", segment_ids=seg
+        )
+        x = x + a.reshape(B, T, H) @ lp["o_proj"]["kernel"] + lp["o_proj"]["bias"]
+        y = layer_norm(x, lp["ln2"]["scale"], lp["ln2"]["bias"], eps)
+        y = jax.nn.gelu(y @ lp["fc1"]["kernel"] + lp["fc1"]["bias"], approximate=True)
+        return x + y @ lp["fc2"]["kernel"] + lp["fc2"]["bias"]
+
+    fn = maybe_remat(lambda c, lp: (layer(c, lp), None), cfg.remat_policy)
+    x, _ = jax.lax.scan(fn, x, params["layers"])
+    x = layer_norm(x, params["final_ln"]["scale"], params["final_ln"]["bias"], eps)
+    return x, out_mask

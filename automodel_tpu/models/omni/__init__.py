@@ -1,0 +1,3 @@
+from automodel_tpu.models.omni import model
+
+__all__ = ["model"]

@@ -1,0 +1,183 @@
+"""Omni (text · image · audio) model: towers + projectors + decoder LM.
+
+The analog of the reference's omni families
+(reference: nemo_automodel/components/models/nemotron_omni/model.py:240
+`NemotronOmniForConditionalGeneration` — vision encoder + Parakeet sound
+encoder + two RMSNorm→Linear→ReLU²→Linear projectors + LLM backbone;
+qwen2_5_omni is the same shape around a qwen2 decoder). TPU-native form:
+the existing ViT tower and the audio encoder feed modality projectors
+whose outputs scatter into the token stream at the image/audio
+placeholder ids (the llava merge, reused for both modalities), then the
+generic dense decoder runs on the merged embeddings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.audio import encoder as audio_encoder
+from automodel_tpu.models.common.layers import dense_init
+from automodel_tpu.models.llm import decoder as text_decoder
+from automodel_tpu.models.llm.families import llama_config, qwen2_config
+from automodel_tpu.models.vision import vit
+from automodel_tpu.models.vlm.llava import merge_image_embeddings
+from automodel_tpu.ops.norms import rms_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class OmniConfig:
+    vision: vit.VisionConfig = dataclasses.field(default_factory=vit.VisionConfig)
+    audio: audio_encoder.AudioConfig = dataclasses.field(
+        default_factory=audio_encoder.AudioConfig
+    )
+    text: Any = dataclasses.field(default_factory=text_decoder.TransformerConfig)
+    image_token_id: int = 32000
+    audio_token_id: int = 32001
+    projector_hidden_size: int = 0  # 0 → 4 * text hidden
+
+    @property
+    def dtype(self):
+        return self.text.dtype
+
+    @property
+    def proj_hidden(self) -> int:
+        return self.projector_hidden_size or 4 * self.text.hidden_size
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Text FLOPs/token + amortized tower costs (one image + one audio
+        clip per sample)."""
+        Ht = self.text.hidden_size
+        vision = 6.0 * self.vision.param_count() * self.vision.num_positions
+        audio = 6.0 * self.audio.param_count() * self.audio.max_frames
+        proj = 6.0 * self.proj_hidden * (
+            self.vision.hidden_size + self.audio.hidden_size + 2 * Ht
+        ) * seq_len * 0.1
+        return self.text.flops_per_token(seq_len) + (vision + audio + proj) / seq_len
+
+
+_TEXT_ADAPTERS = {"llama": llama_config, "qwen2": qwen2_config}
+
+
+def omni_config(hf: Mapping[str, Any], **overrides) -> OmniConfig:
+    """HF-style omni config: {text_config|llm_config, vision_config,
+    audio_config|sound_config, image_token_id, audio_token_id}."""
+    text_hf = dict(hf.get("text_config") or hf.get("llm_config"))
+    arch = (text_hf.get("architectures") or ["LlamaForCausalLM"])[0]
+    name = "qwen2" if "Qwen2" in arch else "llama"
+    text_overrides = {
+        k: overrides[k] for k in ("dtype", "remat_policy", "attn_impl") if k in overrides
+    }
+    text = _TEXT_ADAPTERS[name](text_hf, **text_overrides)
+    common = dict(dtype=text.dtype, remat_policy=text_overrides.get("remat_policy", "full"))
+    vision = vit.VisionConfig.from_hf(dict(hf["vision_config"]), **common)
+    audio = audio_encoder.AudioConfig.from_hf(
+        dict(hf.get("audio_config") or hf.get("sound_config")), **common
+    )
+    return OmniConfig(
+        vision=vision,
+        audio=audio,
+        text=text,
+        image_token_id=int(hf.get("image_token_id", hf.get("img_context_token_id", 32000))),
+        audio_token_id=int(hf.get("audio_token_id", hf.get("sound_context_token_id", 32001))),
+        projector_hidden_size=int(hf.get("projector_hidden_size", 0)),
+    )
+
+
+def _init_projector(rng, d_in: int, d_mid: int, d_out: int) -> dict:
+    """RMSNorm → Linear → ReLU² → Linear (reference: nemotron_omni
+    SoundProjection / VisionProjector, model.py:91,125)."""
+    k1, k2 = jax.random.split(rng)
+    return {
+        "norm": {"scale": jnp.ones((d_in,))},
+        "linear1": {"kernel": dense_init(k1, (d_in, d_mid))},
+        "linear2": {"kernel": dense_init(k2, (d_mid, d_out))},
+    }
+
+
+def _projector_specs() -> dict:
+    return {
+        "norm": {"scale": ("norm",)},
+        "linear1": {"kernel": ("embed", "mlp")},
+        "linear2": {"kernel": ("mlp", "embed")},
+    }
+
+
+def _project(p: dict, x: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    x = rms_norm(x, p["norm"]["scale"], eps)
+    x = x @ p["linear1"]["kernel"].astype(x.dtype)
+    x = jnp.square(jax.nn.relu(x))
+    return x @ p["linear2"]["kernel"].astype(x.dtype)
+
+
+def init(cfg: OmniConfig, rng: jax.Array) -> dict:
+    kv, ka, kt, kp1, kp2 = jax.random.split(rng, 5)
+    Ht = cfg.text.hidden_size
+    return {
+        "vision_tower": vit.init(cfg.vision, kv),
+        "audio_tower": audio_encoder.init(cfg.audio, ka),
+        "vision_projection": _init_projector(
+            kp1, cfg.vision.hidden_size, cfg.proj_hidden, Ht
+        ),
+        "sound_projection": _init_projector(
+            kp2, cfg.audio.hidden_size, cfg.proj_hidden, Ht
+        ),
+        "language_model": text_decoder.init(cfg.text, kt),
+    }
+
+
+def param_specs(cfg: OmniConfig) -> dict:
+    return {
+        "vision_tower": vit.param_specs(cfg.vision),
+        "audio_tower": audio_encoder.param_specs(cfg.audio),
+        "vision_projection": _projector_specs(),
+        "sound_projection": _projector_specs(),
+        "language_model": text_decoder.param_specs(cfg.text),
+    }
+
+
+def forward(
+    params: dict,
+    cfg: OmniConfig,
+    input_ids: jnp.ndarray,             # (B, S)
+    pixel_values: jnp.ndarray | None = None,   # (B, H, W, C)
+    audio_features: jnp.ndarray | None = None,  # (B, T, mel)
+    *,
+    audio_mask: jnp.ndarray | None = None,      # (B, T) bool
+    positions=None,
+    segment_ids=None,
+    mesh_ctx=None,
+    rules=None,
+    return_hidden: bool = False,
+):
+    """Merge image + audio embeddings into the token stream and run the
+    decoder. Placeholder layout is the caller's contract: the k-th image
+    patch fills the k-th image_token_id position, likewise audio frames
+    at audio_token_id positions (reference: nemotron_omni forward step 3
+    'Replace image token embeddings with vision embeddings')."""
+    lm = params["language_model"]
+    merged = jnp.take(lm["embed"]["embedding"], input_ids, axis=0).astype(cfg.dtype)
+
+    if pixel_values is not None:
+        feats = vit.forward(params["vision_tower"], cfg.vision, pixel_values)
+        if cfg.vision.use_cls_token:
+            feats = feats[:, 1:]
+        img = _project(params["vision_projection"], feats.astype(cfg.dtype))
+        merged = merge_image_embeddings(merged, img, input_ids == cfg.image_token_id)
+
+    if audio_features is not None:
+        frames, _ = audio_encoder.forward(
+            params["audio_tower"], cfg.audio, audio_features, audio_mask
+        )
+        snd = _project(params["sound_projection"], frames.astype(cfg.dtype))
+        merged = merge_image_embeddings(merged, snd, input_ids == cfg.audio_token_id)
+
+    return text_decoder.forward(
+        lm, cfg.text, input_ids,
+        positions=positions, segment_ids=segment_ids,
+        mesh_ctx=mesh_ctx, rules=rules,
+        return_hidden=return_hidden, inputs_embeds=merged,
+    )

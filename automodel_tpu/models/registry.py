@@ -17,6 +17,7 @@ from automodel_tpu.models.hybrid import qwen3_next as qwen3_next_module
 from automodel_tpu.models.llm import decoder, families
 from automodel_tpu.models.moe_lm import decoder as moe_decoder
 from automodel_tpu.models.moe_lm import families as moe_families
+from automodel_tpu.models.omni import model as omni_module
 from automodel_tpu.models.vlm import llava as llava_module
 
 
@@ -113,6 +114,11 @@ MODEL_ARCH_MAPPING: dict[str, ModelSpec] = {
     "Qwen3NextForCausalLM": ModelSpec(
         "qwen3_next", qwen3_next_module.from_hf_config, qwen3_next_module,
         adapter_name="qwen3_next",
+    ),
+    # omni (text·image·audio; reference: components/models/nemotron_omni,
+    # qwen2_5_omni) — towers + projectors around a dense decoder backbone
+    "OmniForConditionalGeneration": ModelSpec(
+        "omni", omni_module.omni_config, omni_module, adapter_name="omni"
     ),
     "LlavaForConditionalGeneration": ModelSpec(
         "llava", llava_module.llava_config, llava_module, adapter_name="llava"

@@ -1,0 +1,29 @@
+"""Multimodal (omni: text·image·audio) finetune recipe.
+
+The analog of the reference's multimodal recipes (reference:
+nemo_automodel/recipes/multimodal/{finetune,pretrain}.py around
+NemotronOmniForConditionalGeneration). Rides the VLM recipe end to end —
+audio mel features flow through the batch (sharded on the batch axis like
+images, see FinetuneRecipeForVLM.MEDIA_KEYS) into the omni model's sound
+tower; this subclass only adds the audio-tower freeze knob.
+
+YAML: the `vlm_finetune` surface with an OmniForConditionalGeneration
+model config (`text_config` + `vision_config` + `audio_config`) and
+optionally `freeze_audio_tower: true`.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from automodel_tpu.recipes.vlm.finetune import FinetuneRecipeForVLM
+
+logger = logging.getLogger(__name__)
+
+
+class FinetuneRecipeForOmni(FinetuneRecipeForVLM):
+    """The VLM recipe already handles omni models end to end: audio media
+    keys ride MEDIA_KEYS into the forward, and `freeze_audio_tower` is
+    covered by the TOWER_KEYS freeze loop. The subclass exists as the
+    named multimodal entry (`multimodal_finetune`) and a hook for
+    omni-only extensions (audio-specific metrics, pretrain variants)."""

@@ -32,10 +32,16 @@ def vlm_lm_kernel(params, text_cfg):
 
 
 class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
+    # stop_gradient-freezable encoder subtrees, keyed by `freeze_<name>`
+    # config flags; towers absent from the param tree are skipped
+    TOWER_KEYS = ("vision_tower", "audio_tower")
+
     def _make_student_forward(self):
-        """(params, batch, extra) -> (merged_params, hidden): PEFT merge,
-        vision-tower freeze, optional batch keys, forward to hidden —
-        the student preamble shared by the finetune and KD losses."""
+        """(params, batch, extra) -> (merged_params, hidden, extra, kw):
+        PEFT merge, tower freezes, optional batch keys, forward to hidden —
+        the student preamble shared by the finetune and KD losses. `kw`
+        carries everything a teacher forward needs to see the SAME inputs
+        (media + positions/segment_ids)."""
         module = self.model_spec.module
         model_cfg = self.model_cfg
         mesh_ctx = self.mesh_ctx
@@ -43,8 +49,14 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
         # (or a decay mask) so AdamW's decoupled decay cannot drift the
         # frozen tower; optimizer-exclusion freeze lands with multi-group
         # param handling next round.
-        freeze_vision = bool(self.cfg.get("freeze_vision_tower", False))
+        frozen = tuple(
+            key for key in self.TOWER_KEYS if self.cfg.get(f"freeze_{key}", False)
+        )
         peft_cfg = self.peft_cfg
+
+        extra_media = tuple(
+            k for k in self.MEDIA_KEYS if k not in ("pixel_values",)
+        )
 
         def student_forward(params, batch, extra):
             if peft_cfg is not None:
@@ -52,9 +64,11 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
 
                 base_params, extra = extra[0], extra[1:]
                 params = merge_lora(base_params, params, peft_cfg)
-            if freeze_vision:
-                params = {**params, "vision_tower": jax.lax.stop_gradient(params["vision_tower"])}
+            for key in frozen:
+                if key in params:
+                    params = {**params, key: jax.lax.stop_gradient(params[key])}
             kw = {k: batch[k] for k in ("positions", "segment_ids") if k in batch}
+            kw.update({k: batch[k] for k in extra_media if k in batch})
             hidden = module.forward(
                 params, model_cfg, batch["input_ids"], batch["pixel_values"],
                 return_hidden=True, mesh_ctx=mesh_ctx, **kw,
@@ -79,19 +93,23 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
 
         return loss_fn
 
+    # media tensors shard on the batch axis only (their inner dims are
+    # patch/frame grids, not the cp-sharded token sequence)
+    MEDIA_KEYS = ("pixel_values", "audio_features", "audio_mask")
+
     def _make_global(self, batch_np: dict):
-        """Sequence tensors shard (accum, batch, cp); images (accum, batch)."""
+        """Sequence tensors shard (accum, batch, cp); media (accum, batch)."""
         seq_sh = self.mesh_ctx.sharding(None, "batch", "cp")
-        img_sh = self.mesh_ctx.sharding(None, "batch")
+        media_sh = self.mesh_ctx.sharding(None, "batch")
         shardings = {
-            k: (img_sh if k == "pixel_values" else seq_sh) for k in batch_np
+            k: (media_sh if k in self.MEDIA_KEYS else seq_sh) for k in batch_np
         }
         return make_global_batch(batch_np, self.mesh_ctx, shardings)
 
     def _make_global_eval(self, batch_np: dict):
         seq_sh = self.mesh_ctx.sharding("batch", "cp")
-        img_sh = self.mesh_ctx.sharding("batch")
+        media_sh = self.mesh_ctx.sharding("batch")
         shardings = {
-            k: (img_sh if k == "pixel_values" else seq_sh) for k in batch_np
+            k: (media_sh if k in self.MEDIA_KEYS else seq_sh) for k in batch_np
         }
         return make_global_batch(batch_np, self.mesh_ctx, shardings)
