@@ -803,19 +803,41 @@ class LlavaAdapter:
         k = np.asarray(x).transpose(2, 3, 1, 0)  # (P, P, C, H)
         return np.ascontiguousarray(k.reshape(P * P * C, H))
 
+    @staticmethod
+    def _encoder_layers_to_hf(
+        layers: Mapping, prefix: str, n: int
+    ) -> Iterator[tuple[str, np.ndarray]]:
+        """Unstack the shared pre-LN encoder layer table (ViT + sound)."""
+        for i in range(n):
+            for suffix, path, transpose in LlavaAdapter._VIT_LAYER:
+                x = np.asarray(_get(layers, path)[i])
+                yield f"{prefix}.{i}.{suffix}", (_t(x) if transpose else x)
+
+    @staticmethod
+    def _encoder_layers_from_hf(read: Reader, prefix: str, n: int) -> dict:
+        layers: dict = {}
+        for suffix, path, transpose in LlavaAdapter._VIT_LAYER:
+            stacked = np.stack(
+                [
+                    _t(read(f"{prefix}.{i}.{suffix}"))
+                    if transpose
+                    else np.asarray(read(f"{prefix}.{i}.{suffix}"))
+                    for i in range(n)
+                ]
+            )
+            _set(layers, path, stacked)
+        return layers
+
     def _vit_to_hf(self, vt: Mapping, prefix: str) -> Iterator[tuple[str, np.ndarray]]:
         for name, path, kind in self._vit_top():
             x = np.asarray(_get(vt, path))
             if kind == "patch":
                 x = self._patch_kernel(x, to_hf=True)
             yield f"{prefix}.{name}", x
-        for i in range(self.cfg.vision.num_layers):
-            for suffix, path, transpose in self._VIT_LAYER:
-                x = np.asarray(_get(vt["layers"], path)[i])
-                yield (
-                    f"{prefix}.vision_model.encoder.layers.{i}.{suffix}",
-                    (_t(x) if transpose else x),
-                )
+        yield from self._encoder_layers_to_hf(
+            vt["layers"], f"{prefix}.vision_model.encoder.layers",
+            self.cfg.vision.num_layers,
+        )
 
     def _vit_from_hf(self, read: Reader, prefix: str) -> dict:
         vt: dict = {}
@@ -824,20 +846,9 @@ class LlavaAdapter:
             if kind == "patch":
                 x = self._patch_kernel(x, to_hf=False)
             _set(vt, path, x)
-        layers: dict = {}
-        for suffix, path, transpose in self._VIT_LAYER:
-            stacked = np.stack(
-                [
-                    _t(read(f"{prefix}.vision_model.encoder.layers.{i}.{suffix}"))
-                    if transpose
-                    else np.asarray(
-                        read(f"{prefix}.vision_model.encoder.layers.{i}.{suffix}")
-                    )
-                    for i in range(self.cfg.vision.num_layers)
-                ]
-            )
-            _set(layers, path, stacked)
-        vt["layers"] = layers
+        vt["layers"] = self._encoder_layers_from_hf(
+            read, f"{prefix}.vision_model.encoder.layers", self.cfg.vision.num_layers
+        )
         return vt
 
     def to_hf(self, params: Mapping) -> Iterator[tuple[str, np.ndarray]]:
@@ -926,13 +937,9 @@ class OmniAdapter:
         at = params["audio_tower"]
         for suffix, path in self._AUDIO_TOP:
             yield f"sound_encoder.{suffix}", np.asarray(_get(at, path))
-        for i in range(self.cfg.audio.num_layers):
-            for suffix, path, transpose in LlavaAdapter._VIT_LAYER:
-                x = np.asarray(_get(at["layers"], path)[i])
-                yield (
-                    f"sound_encoder.encoder.layers.{i}.{suffix}",
-                    (_t(x) if transpose else x),
-                )
+        yield from LlavaAdapter._encoder_layers_to_hf(
+            at["layers"], "sound_encoder.encoder.layers", self.cfg.audio.num_layers
+        )
 
     def from_hf(self, read: Reader, shardings: Any = None) -> dict:
         base = self._base()
@@ -952,18 +959,9 @@ class OmniAdapter:
         at: dict = {}
         for suffix, path in self._AUDIO_TOP:
             _set(at, path, np.asarray(read(f"sound_encoder.{suffix}")))
-        layers: dict = {}
-        for suffix, path, transpose in LlavaAdapter._VIT_LAYER:
-            stacked = np.stack(
-                [
-                    _t(read(f"sound_encoder.encoder.layers.{i}.{suffix}"))
-                    if transpose
-                    else np.asarray(read(f"sound_encoder.encoder.layers.{i}.{suffix}"))
-                    for i in range(self.cfg.audio.num_layers)
-                ]
-            )
-            _set(layers, path, stacked)
-        at["layers"] = layers
+        at["layers"] = LlavaAdapter._encoder_layers_from_hf(
+            read, "sound_encoder.encoder.layers", self.cfg.audio.num_layers
+        )
         out["audio_tower"] = at
         if shardings is not None:
             for key in ("vision_tower", "audio_tower", "vision_projection", "sound_projection"):

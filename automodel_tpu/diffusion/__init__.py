@@ -5,3 +5,7 @@ from automodel_tpu.diffusion.flow_matching import (  # noqa: F401
     sample_sigmas,
     time_shift,
 )
+from automodel_tpu.diffusion.pipeline import (  # noqa: F401
+    AutoDiffusionPipeline,
+    SchedulerConfig,
+)

@@ -1,0 +1,242 @@
+"""AutoDiffusionPipeline: diffusers-layout pipeline save/load + sampling.
+
+The analog of the reference's `NeMoAutoDiffusionPipeline`
+(reference: nemo_automodel/_diffusers/auto_diffusion_pipeline.py, 973 LoC
+— loads an HF Diffusers pipeline directory with per-component sharding).
+TPU-native form: the pipeline directory follows the diffusers layout —
+
+    model_index.json                      # component → [module, class]
+    transformer/config.json + model.safetensors   (DiT denoiser)
+    vae/config.json + model.safetensors           (optional AutoencoderKL-lite)
+    scheduler/scheduler_config.json               (flow-matching params)
+
+Components load into sharded jnp params (NamedShardings from the mesh
+context when given); sampling runs the rectified-flow Euler integrator
+with classifier-free guidance and decodes through the VAE when present.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from automodel_tpu.diffusion.flow_matching import euler_sample
+from automodel_tpu.models.diffusion import dit, vae
+
+_INDEX = "model_index.json"
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedulerConfig:
+    """Flow-matching sampler parameters (the scheduler component)."""
+
+    shift: float = 3.0
+    num_train_timesteps: int = 1000
+
+    def to_hf(self) -> dict:
+        return {
+            "_class_name": "FlowMatchEulerDiscreteScheduler",
+            "shift": self.shift,
+            "num_train_timesteps": self.num_train_timesteps,
+        }
+
+    @classmethod
+    def from_hf(cls, d: dict) -> "SchedulerConfig":
+        return cls(
+            shift=float(d.get("shift", 3.0)),
+            num_train_timesteps=int(d.get("num_train_timesteps", 1000)),
+        )
+
+
+def _dit_config_to_hf(cfg: dit.DiTConfig) -> dict:
+    return {
+        "_class_name": "DiTConfig",
+        "input_size": cfg.input_size,
+        "patch_size": cfg.patch_size,
+        "in_channels": cfg.in_channels,
+        "hidden_size": cfg.hidden_size,
+        "num_layers": cfg.num_layers,
+        "num_heads": cfg.num_heads,
+        "mlp_ratio": cfg.mlp_ratio,
+        "num_classes": cfg.num_classes,
+    }
+
+
+def _dit_config_from_hf(d: dict, **overrides) -> dit.DiTConfig:
+    kw = {
+        k: d[k]
+        for k in (
+            "input_size", "patch_size", "in_channels", "hidden_size",
+            "num_layers", "num_heads", "mlp_ratio", "num_classes",
+        )
+        if k in d
+    }
+    kw.update(overrides)
+    return dit.DiTConfig(**kw)
+
+
+def _flatten(tree, prefix=""):
+    for k, v in tree.items():
+        name = f"{prefix}{k}" if not prefix else f"{prefix}.{k}"
+        if isinstance(v, dict):
+            yield from _flatten(v, name)
+        else:
+            yield name, np.asarray(v)
+
+
+def _unflatten(flat: dict) -> dict:
+    out: dict = {}
+    for name, v in flat.items():
+        node = out
+        parts = name.split(".")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return out
+
+
+def _save_component(dirpath: str, config: dict, params=None, config_name="config.json"):
+    os.makedirs(dirpath, exist_ok=True)
+    with open(os.path.join(dirpath, config_name), "w") as f:
+        json.dump(config, f, indent=2)
+    if params is not None:
+        from safetensors.numpy import save_file
+
+        save_file(dict(_flatten(params)), os.path.join(dirpath, "model.safetensors"))
+
+
+def _load_tensors(dirpath: str) -> dict:
+    from safetensors.numpy import load_file
+
+    return _unflatten(load_file(os.path.join(dirpath, "model.safetensors")))
+
+
+@dataclasses.dataclass
+class AutoDiffusionPipeline:
+    """Transformer (DiT) + optional VAE + flow-matching scheduler."""
+
+    transformer_cfg: dit.DiTConfig
+    transformer_params: Any
+    scheduler: SchedulerConfig = dataclasses.field(default_factory=SchedulerConfig)
+    vae_cfg: Optional[vae.VAEConfig] = None
+    vae_params: Any = None
+
+    # -- persistence --------------------------------------------------------
+    def save_pretrained(self, out_dir: str) -> None:
+        os.makedirs(out_dir, exist_ok=True)
+        # component entries record REAL importable symbols (the functional
+        # modules' config dataclasses), keeping the diffusers convention of
+        # [module, class] resolvable
+        index = {
+            "_class_name": "AutoDiffusionPipeline",
+            "transformer": ["automodel_tpu.models.diffusion.dit", "DiTConfig"],
+            "scheduler": ["automodel_tpu.diffusion.pipeline", "SchedulerConfig"],
+        }
+        if self.vae_params is not None:
+            index["vae"] = ["automodel_tpu.models.diffusion.vae", "VAEConfig"]
+        with open(os.path.join(out_dir, _INDEX), "w") as f:
+            json.dump(index, f, indent=2)
+        _save_component(
+            os.path.join(out_dir, "transformer"),
+            _dit_config_to_hf(self.transformer_cfg),
+            self.transformer_params,
+        )
+        _save_component(
+            os.path.join(out_dir, "scheduler"), self.scheduler.to_hf(),
+            config_name="scheduler_config.json",
+        )
+        if self.vae_params is not None:
+            _save_component(
+                os.path.join(out_dir, "vae"), self.vae_cfg.to_hf(), self.vae_params
+            )
+
+    @classmethod
+    def from_pretrained(
+        cls, ckpt_dir: str, mesh_ctx=None, dtype=None
+    ) -> "AutoDiffusionPipeline":
+        with open(os.path.join(ckpt_dir, _INDEX)) as f:
+            index = json.load(f)
+        with open(os.path.join(ckpt_dir, "transformer", "config.json")) as f:
+            tcfg_d = json.load(f)
+        overrides = {"dtype": dtype} if dtype is not None else {}
+        tcfg = _dit_config_from_hf(tcfg_d, **overrides)
+        tparams = _load_tensors(os.path.join(ckpt_dir, "transformer"))
+        if mesh_ctx is not None:
+            from automodel_tpu.parallel import logical_to_shardings
+
+            sh = logical_to_shardings(
+                dit.param_specs(tcfg), mesh_ctx,
+                shapes=jax.tree.map(lambda p: p.shape, tparams),
+            )
+            tparams = jax.device_put(tparams, sh)
+        else:
+            tparams = jax.tree.map(jnp.asarray, tparams)
+
+        sched_path = os.path.join(ckpt_dir, "scheduler", "scheduler_config.json")
+        sched = SchedulerConfig()
+        if os.path.exists(sched_path):
+            with open(sched_path) as f:
+                sched = SchedulerConfig.from_hf(json.load(f))
+
+        vcfg, vparams = None, None
+        if "vae" in index and os.path.isdir(os.path.join(ckpt_dir, "vae")):
+            with open(os.path.join(ckpt_dir, "vae", "config.json")) as f:
+                vcfg = vae.VAEConfig.from_hf(json.load(f))
+            vparams = jax.tree.map(
+                jnp.asarray, _load_tensors(os.path.join(ckpt_dir, "vae"))
+            )
+        return cls(
+            transformer_cfg=tcfg, transformer_params=tparams,
+            scheduler=sched, vae_cfg=vcfg, vae_params=vparams,
+        )
+
+    # -- sampling -----------------------------------------------------------
+    def __call__(
+        self,
+        rng: jax.Array,
+        batch_size: int = 1,
+        *,
+        class_labels: jnp.ndarray | None = None,
+        guidance_scale: float = 1.0,
+        num_inference_steps: int = 16,
+        decode: bool = True,
+    ) -> jnp.ndarray:
+        """Sample latents (and decode to images when a VAE is attached).
+
+        Classifier-free guidance doubles the denoiser batch: conditional
+        and null-class velocities combine as v = v_u + g·(v_c - v_u)."""
+        cfg = self.transformer_cfg
+        shape = (batch_size, cfg.input_size, cfg.input_size, cfg.in_channels)
+        use_cfg = (
+            guidance_scale != 1.0 and class_labels is not None and cfg.num_classes > 0
+        )
+
+        def velocity(x, sigma):
+            if not use_cfg:
+                return dit.forward(
+                    self.transformer_params, cfg, x.astype(cfg.dtype), sigma,
+                    class_labels=class_labels,
+                ).astype(jnp.float32)
+            null = jnp.full_like(class_labels, cfg.num_classes)
+            v2 = dit.forward(
+                self.transformer_params, cfg,
+                jnp.concatenate([x, x]).astype(cfg.dtype),
+                jnp.concatenate([sigma, sigma]),
+                class_labels=jnp.concatenate([class_labels, null]),
+            ).astype(jnp.float32)
+            v_c, v_u = jnp.split(v2, 2)
+            return v_u + guidance_scale * (v_c - v_u)
+
+        latents = euler_sample(
+            velocity, rng, shape,
+            steps=num_inference_steps, shift=self.scheduler.shift,
+        )
+        if decode and self.vae_params is not None:
+            return vae.decode(self.vae_params, self.vae_cfg, latents)
+        return latents

@@ -1,0 +1,196 @@
+"""Convolutional image VAE (AutoencoderKL-style) for latent diffusion.
+
+The analog of the diffusers `AutoencoderKL` the reference loads through
+`NeMoAutoDiffusionPipeline` (reference: nemo_automodel/_diffusers/
+auto_diffusion_pipeline.py — vae component of the loaded pipeline).
+TPU-native form: plain lax convs in NHWC, group-norm + silu res blocks,
+stride-2 downsampling / nearest-neighbor upsampling, a diagonal-Gaussian
+latent with the diffusers `scaling_factor` convention. Functional pytree
+like every other model here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.layers import dense_init
+
+
+@dataclasses.dataclass(frozen=True)
+class VAEConfig:
+    in_channels: int = 3
+    latent_channels: int = 4
+    base_channels: int = 32
+    channel_mults: tuple = (1, 2)   # one stride-2 downsample between levels
+    num_res_blocks: int = 1
+    groups: int = 8
+    scaling_factor: float = 0.18215  # diffusers AutoencoderKL convention
+    dtype: Any = jnp.float32
+    remat_policy: str = "none"
+
+    @property
+    def downsample_factor(self) -> int:
+        return 2 ** (len(self.channel_mults) - 1)
+
+    @classmethod
+    def from_hf(cls, hf: dict, **overrides) -> "VAEConfig":
+        kw = dict(
+            in_channels=int(hf.get("in_channels", 3)),
+            latent_channels=int(hf.get("latent_channels", 4)),
+            scaling_factor=float(hf.get("scaling_factor", 0.18215)),
+        )
+        if hf.get("block_out_channels"):
+            boc = [int(c) for c in hf["block_out_channels"]]
+            kw["base_channels"] = boc[0]
+            kw["channel_mults"] = tuple(c // boc[0] for c in boc)
+        if hf.get("layers_per_block"):
+            kw["num_res_blocks"] = int(hf["layers_per_block"])
+        kw.update(overrides)
+        return cls(**kw)
+
+    def to_hf(self) -> dict:
+        return {
+            "_class_name": "VAEConfig",
+            "in_channels": self.in_channels,
+            "latent_channels": self.latent_channels,
+            "scaling_factor": self.scaling_factor,
+            "block_out_channels": [self.base_channels * m for m in self.channel_mults],
+            "layers_per_block": self.num_res_blocks,
+        }
+
+
+def _conv_init(rng, k, cin, cout):
+    return dense_init(rng, (k * k * cin, cout)).reshape(k, k, cin, cout)
+
+
+def _init_res_block(rng, cin, cout):
+    k1, k2, k3 = jax.random.split(rng, 3)
+    p = {
+        "norm1": {"scale": jnp.ones((cin,)), "bias": jnp.zeros((cin,))},
+        "conv1": {"kernel": _conv_init(k1, 3, cin, cout), "bias": jnp.zeros((cout,))},
+        "norm2": {"scale": jnp.ones((cout,)), "bias": jnp.zeros((cout,))},
+        "conv2": {"kernel": _conv_init(k2, 3, cout, cout), "bias": jnp.zeros((cout,))},
+    }
+    if cin != cout:
+        p["skip"] = {"kernel": _conv_init(k3, 1, cin, cout), "bias": jnp.zeros((cout,))}
+    return p
+
+
+def init(cfg: VAEConfig, rng: jax.Array) -> dict:
+    chans = [cfg.base_channels * m for m in cfg.channel_mults]
+    ks = iter(jax.random.split(rng, 64))
+    enc: dict = {
+        "conv_in": {
+            "kernel": _conv_init(next(ks), 3, cfg.in_channels, chans[0]),
+            "bias": jnp.zeros((chans[0],)),
+        }
+    }
+    c = chans[0]
+    for li, ch in enumerate(chans):
+        for bi in range(cfg.num_res_blocks):
+            enc[f"res_{li}_{bi}"] = _init_res_block(next(ks), c, ch)
+            c = ch
+        if li + 1 < len(chans):
+            enc[f"down_{li}"] = {
+                "kernel": _conv_init(next(ks), 3, c, c), "bias": jnp.zeros((c,))
+            }
+    enc["norm_out"] = {"scale": jnp.ones((c,)), "bias": jnp.zeros((c,))}
+    enc["conv_out"] = {
+        "kernel": _conv_init(next(ks), 3, c, 2 * cfg.latent_channels),
+        "bias": jnp.zeros((2 * cfg.latent_channels,)),
+    }
+
+    dec: dict = {
+        "conv_in": {
+            "kernel": _conv_init(next(ks), 3, cfg.latent_channels, c),
+            "bias": jnp.zeros((c,)),
+        }
+    }
+    for li, ch in enumerate(reversed(chans)):
+        for bi in range(cfg.num_res_blocks):
+            dec[f"res_{li}_{bi}"] = _init_res_block(next(ks), c, ch)
+            c = ch
+        if li + 1 < len(chans):
+            dec[f"up_{li}"] = {
+                "kernel": _conv_init(next(ks), 3, c, c), "bias": jnp.zeros((c,))
+            }
+    dec["norm_out"] = {"scale": jnp.ones((c,)), "bias": jnp.zeros((c,))}
+    dec["conv_out"] = {
+        "kernel": _conv_init(next(ks), 3, c, cfg.in_channels),
+        "bias": jnp.zeros((cfg.in_channels,)),
+    }
+    return {"encoder": enc, "decoder": dec}
+
+
+def param_specs(cfg: VAEConfig) -> dict:
+    """Conv towers are tiny relative to the denoiser: replicate."""
+    params = jax.eval_shape(lambda: init(cfg, jax.random.key(0)))
+    return jax.tree.map(lambda _: (None,), params)
+
+
+def _group_norm(x, scale, bias, groups, eps=1e-6):
+    B, H, W, C = x.shape
+    g = x.reshape(B, H, W, groups, C // groups).astype(jnp.float32)
+    mean = g.mean((1, 2, 4), keepdims=True)
+    var = g.var((1, 2, 4), keepdims=True)
+    g = (g - mean) * jax.lax.rsqrt(var + eps)
+    return (g.reshape(B, H, W, C) * scale + bias).astype(x.dtype)
+
+
+def _conv(x, p, stride=1):
+    y = jax.lax.conv_general_dilated(
+        x, p["kernel"].astype(x.dtype), window_strides=(stride, stride),
+        padding="SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return y + p["bias"].astype(x.dtype)
+
+
+def _res_block(x, p, groups):
+    h = _group_norm(x, p["norm1"]["scale"], p["norm1"]["bias"], groups)
+    h = _conv(jax.nn.silu(h), p["conv1"])
+    h = _group_norm(h, p["norm2"]["scale"], p["norm2"]["bias"], groups)
+    h = _conv(jax.nn.silu(h), p["conv2"])
+    skip = _conv(x, p["skip"]) if "skip" in p else x
+    return skip + h
+
+
+def encode(params: dict, cfg: VAEConfig, images: jnp.ndarray, rng=None):
+    """images (B, H, W, C) → latents (B, H/f, W/f, latent_channels),
+    scaled by scaling_factor. `rng` samples the posterior; None → mean."""
+    enc = params["encoder"]
+    chans = [cfg.base_channels * m for m in cfg.channel_mults]
+    x = _conv(images.astype(cfg.dtype), enc["conv_in"])
+    for li in range(len(chans)):
+        for bi in range(cfg.num_res_blocks):
+            x = _res_block(x, enc[f"res_{li}_{bi}"], cfg.groups)
+        if li + 1 < len(chans):
+            x = _conv(x, enc[f"down_{li}"], stride=2)
+    x = _group_norm(x, enc["norm_out"]["scale"], enc["norm_out"]["bias"], cfg.groups)
+    x = _conv(jax.nn.silu(x), enc["conv_out"])
+    mean, logvar = jnp.split(x, 2, axis=-1)
+    if rng is not None:
+        logvar = jnp.clip(logvar, -30.0, 20.0)
+        mean = mean + jnp.exp(0.5 * logvar) * jax.random.normal(
+            rng, mean.shape, mean.dtype
+        )
+    return mean * cfg.scaling_factor
+
+
+def decode(params: dict, cfg: VAEConfig, latents: jnp.ndarray) -> jnp.ndarray:
+    """latents (scaled) → images (B, H, W, C)."""
+    dec = params["decoder"]
+    chans = [cfg.base_channels * m for m in cfg.channel_mults]
+    x = _conv((latents / cfg.scaling_factor).astype(cfg.dtype), dec["conv_in"])
+    for li in range(len(chans)):
+        for bi in range(cfg.num_res_blocks):
+            x = _res_block(x, dec[f"res_{li}_{bi}"], cfg.groups)
+        if li + 1 < len(chans):
+            B, H, W, C = x.shape
+            x = jax.image.resize(x, (B, 2 * H, 2 * W, C), "nearest")
+            x = _conv(x, dec[f"up_{li}"])
+    x = _group_norm(x, dec["norm_out"]["scale"], dec["norm_out"]["bias"], cfg.groups)
+    return _conv(jax.nn.silu(x), dec["conv_out"])

@@ -169,10 +169,13 @@ def forward(
         merged = merge_image_embeddings(merged, img, input_ids == cfg.image_token_id)
 
     if audio_features is not None:
-        frames, _ = audio_encoder.forward(
+        frames, frame_mask = audio_encoder.forward(
             params["audio_tower"], cfg.audio, audio_features, audio_mask
         )
         snd = _project(params["sound_projection"], frames.astype(cfg.dtype))
+        # zero padding-derived frames so trailing audio placeholders carry
+        # no garbage when a clip is shorter than its placeholder span
+        snd = snd * frame_mask[..., None].astype(snd.dtype)
         merged = merge_image_embeddings(merged, snd, input_ids == cfg.audio_token_id)
 
     return text_decoder.forward(

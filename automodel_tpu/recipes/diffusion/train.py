@@ -22,9 +22,11 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from automodel_tpu.diffusion.flow_matching import (
     flow_matching_loss,
@@ -147,4 +149,20 @@ class TrainDiffusionRecipe(TrainFinetuneRecipeForNextTokenPrediction):
         return make_global_batch(batch_np, self.mesh_ctx, sh)
 
     def save_consolidated_hf(self, out_dir=None):
-        raise NotImplementedError("DiT export to diffusers format not implemented yet")
+        """Export the trained denoiser as a diffusers-layout pipeline dir
+        (model_index.json + transformer/ + scheduler/) loadable via
+        AutoDiffusionPipeline.from_pretrained."""
+        from automodel_tpu.diffusion.pipeline import (
+            AutoDiffusionPipeline,
+            SchedulerConfig,
+        )
+
+        out_dir = out_dir or os.path.join(str(self.cfg.get("run_dir")), "pipeline")
+        params = jax.tree.map(np.asarray, self.train_state.params)
+        AutoDiffusionPipeline(
+            transformer_cfg=self.model_cfg,
+            transformer_params=params,
+            scheduler=SchedulerConfig(shift=self.fm_shift),
+        ).save_pretrained(out_dir)
+        logger.info("pipeline exported to %s", out_dir)
+        return out_dir

@@ -47,14 +47,22 @@ class KDRecipeForVLM(FinetuneRecipeForVLM):
         temperature = float(cfg.get("kd.temperature", 1.0))
         chunk = int(cfg.get("loss.chunk_size", 1024))
         student_forward = self._make_student_forward()
+        # an omni student can distill into a media-narrower teacher (e.g.
+        # llava): pass only the kwargs the teacher's forward accepts
+        import inspect
+
+        teacher_kws = frozenset(
+            inspect.signature(teacher_module.forward).parameters
+        )
 
         def loss_fn(params, batch, rng, *extra):
             params, s_hidden, extra_rest, kw = student_forward(params, batch, extra)
             (teacher_params,) = extra_rest
+            t_kw = {k: v for k, v in kw.items() if k in teacher_kws}
             t_hidden = teacher_module.forward(
                 teacher_params, teacher_cfg, batch["input_ids"],
                 batch["pixel_values"], return_hidden=True, mesh_ctx=mesh_ctx,
-                **kw,
+                **t_kw,
             )
             t_hidden = jax.lax.stop_gradient(t_hidden)
             total, n = fused_kd_cross_entropy(

@@ -1,0 +1,119 @@
+"""AutoDiffusionPipeline + VAE tier.
+
+Reference anchor: _diffusers/auto_diffusion_pipeline.py (973 LoC) — the
+diffusers-layout pipeline loader; diffusers AutoencoderKL for the VAE
+semantics (scaling_factor, posterior sampling)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.recipe
+
+from automodel_tpu.diffusion.pipeline import AutoDiffusionPipeline, SchedulerConfig
+from automodel_tpu.models.diffusion import dit, vae
+
+DIT_CFG = dit.DiTConfig(
+    input_size=8, patch_size=2, in_channels=4, hidden_size=32,
+    num_layers=2, num_heads=4, num_classes=3,
+    dtype=jnp.float32, remat_policy="none",
+)
+VAE_CFG = vae.VAEConfig(
+    in_channels=3, latent_channels=4, base_channels=16, channel_mults=(1, 2),
+    num_res_blocks=1, groups=4, dtype=jnp.float32,
+)
+
+
+def test_vae_encode_decode_shapes_and_grad():
+    params = vae.init(VAE_CFG, jax.random.key(0))
+    img = jax.random.normal(jax.random.key(1), (2, 16, 16, 3))
+    z = vae.encode(params, VAE_CFG, img)
+    assert z.shape == (2, 8, 8, 4)  # one stride-2 level
+    out = vae.decode(params, VAE_CFG, z)
+    assert out.shape == (2, 16, 16, 3)
+    assert np.isfinite(np.asarray(out)).all()
+    # posterior sampling differs from the mean path
+    z2 = vae.encode(params, VAE_CFG, img, rng=jax.random.key(2))
+    assert not np.allclose(np.asarray(z), np.asarray(z2))
+    # reconstruction loss is differentiable end to end
+    g = jax.grad(
+        lambda p: jnp.mean((vae.decode(p, VAE_CFG, vae.encode(p, VAE_CFG, img)) - img) ** 2)
+    )(params)
+    assert all(np.isfinite(np.asarray(x)).all() for x in jax.tree.leaves(g))
+
+
+def test_pipeline_save_load_sample_roundtrip(tmp_path):
+    tparams = dit.init(DIT_CFG, jax.random.key(0))
+    vparams = vae.init(VAE_CFG, jax.random.key(1))
+    pipe = AutoDiffusionPipeline(
+        transformer_cfg=DIT_CFG, transformer_params=tparams,
+        scheduler=SchedulerConfig(shift=2.0),
+        vae_cfg=VAE_CFG, vae_params=vparams,
+    )
+    out = str(tmp_path / "pipe")
+    pipe.save_pretrained(out)
+    # diffusers layout on disk
+    index = json.loads(open(os.path.join(out, "model_index.json")).read())
+    assert "transformer" in index and "vae" in index
+    assert os.path.exists(os.path.join(out, "transformer", "model.safetensors"))
+    assert os.path.exists(os.path.join(out, "scheduler", "scheduler_config.json"))
+
+    loaded = AutoDiffusionPipeline.from_pretrained(out)
+    assert loaded.scheduler.shift == 2.0
+    assert loaded.transformer_cfg.num_classes == 3
+    for a, b in zip(jax.tree.leaves(tparams), jax.tree.leaves(loaded.transformer_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+    # sampling: CFG path decodes through the VAE to image space
+    labels = jnp.asarray([0, 2])
+    imgs = loaded(
+        jax.random.key(3), batch_size=2, class_labels=labels,
+        guidance_scale=2.0, num_inference_steps=3,
+    )
+    assert imgs.shape == (2, 16, 16, 3)
+    assert np.isfinite(np.asarray(imgs)).all()
+    # latent-only path
+    lat = loaded(jax.random.key(3), batch_size=2, decode=False,
+                 num_inference_steps=2)
+    assert lat.shape == (2, 8, 8, 4)
+
+
+def test_diffusion_recipe_exports_pipeline(tmp_path):
+    """End-to-end: train the DiT recipe briefly, export, reload, sample."""
+    from automodel_tpu.cli.app import resolve_recipe_class
+    from automodel_tpu.config import ConfigNode
+
+    cfg = ConfigNode({
+        "seed": 3,
+        "recipe": "diffusion_train",
+        "run_dir": str(tmp_path),
+        "auto_resume": False,
+        "dit": {
+            "input_size": 8, "patch_size": 2, "in_channels": 4,
+            "hidden_size": 32, "num_layers": 2, "num_heads": 4,
+            "num_classes": 3, "dtype": "float32", "remat_policy": "none",
+        },
+        "flow_matching": {"shift": 2.0, "cfg_drop_prob": 0.2},
+        "distributed": {"dp_shard": -1},
+        "dataset": {
+            "_target_": "automodel_tpu.datasets.mock.MockLatentDatasetConfig",
+            "num_samples": 32, "latent_size": 8, "channels": 4, "num_classes": 3,
+        },
+        "dataloader": {"microbatch_size": 8, "grad_acc_steps": 1},
+        "optimizer": {"name": "adamw", "lr": 1e-3, "weight_decay": 0.0},
+        "lr_scheduler": {"style": "constant", "warmup_steps": 0},
+        "step_scheduler": {"max_steps": 2, "ckpt_every_steps": 100},
+        "checkpoint": {"enabled": False},
+    })
+    r = resolve_recipe_class(cfg)(cfg)
+    r.setup()
+    r.run_train_validation_loop()
+    out = r.save_consolidated_hf()
+    pipe = AutoDiffusionPipeline.from_pretrained(out)
+    lat = pipe(jax.random.key(0), batch_size=2, decode=False, num_inference_steps=2)
+    assert lat.shape == (2, 8, 8, 4)
+    assert np.isfinite(np.asarray(lat)).all()

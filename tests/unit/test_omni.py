@@ -147,10 +147,21 @@ def test_multimodal_recipe_trains(tmp_path):
     at_before = jax.tree.map(
         lambda x: np.asarray(x).copy(), r.train_state.params["audio_tower"]
     )
+    sp_before = jax.tree.map(
+        lambda x: np.asarray(x).copy(), r.train_state.params["sound_projection"]
+    )
     r.run_train_validation_loop()
     recs = [json.loads(l) for l in open(tmp_path / "training.jsonl")]
     assert len(recs) == 3 and all(np.isfinite(x["loss"]) for x in recs)
-    # frozen audio tower unchanged; projector moved
+    # frozen audio tower unchanged; the sound projector actually moved
     for a, b in zip(jax.tree.leaves(at_before),
                     jax.tree.leaves(r.train_state.params["audio_tower"])):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    moved = any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jax.tree.leaves(sp_before),
+            jax.tree.leaves(r.train_state.params["sound_projection"]),
+        )
+    )
+    assert moved, "sound_projection did not train"
