@@ -151,13 +151,61 @@ def _flatten(d: dict, prefix: str = "") -> dict:
     return out
 
 
+class CometTracker:
+    """Comet experiment bridge (reference: loggers/comet_utils.py) — same
+    offline-safe JSONL fallback as the other trackers."""
+
+    def __init__(self, cfg: dict, run_dir: str):
+        self._exp = None
+        self._fallback = None
+        if jax.process_index() != 0:
+            return
+        try:
+            import comet_ml
+
+            self._exp = comet_ml.Experiment(
+                project_name=cfg.get("project", "automodel_tpu"),
+                workspace=cfg.get("workspace"),
+                disabled=bool(cfg.get("disabled", False)),
+            )
+            if cfg.get("name"):
+                self._exp.set_name(cfg["name"])
+        except Exception as e:  # library missing or no network
+            logger.warning("comet unavailable (%s) — local JSONL mirror", e)
+            self._fallback = _NullTracker(run_dir, "comet")
+
+    def log(self, metrics: dict, step: int | None = None) -> None:
+        if self._exp is not None:
+            self._exp.log_metrics(metrics, step=step)
+        elif self._fallback is not None:
+            self._fallback.log(metrics, step)
+
+    def log_config(self, config: dict) -> None:
+        if self._exp is not None:
+            self._exp.log_parameters(config)
+        elif self._fallback is not None:
+            self._fallback.log_config(config)
+
+    def finish(self, status: str = "FINISHED") -> None:
+        if self._exp is not None:
+            if status != "FINISHED":
+                self._exp.log_other("status", status)
+            self._exp.end()
+            self._exp = None
+        elif self._fallback is not None:
+            self._fallback.finish(status)
+
+
+_TRACKERS = {"wandb": WandbTracker, "mlflow": MLflowTracker, "comet": CometTracker}
+
+
 def build_trackers(cfg, run_dir: str) -> list:
     """Construct every tracker the YAML asks for."""
     trackers = []
-    if cfg.get("wandb") is not None:
-        node = cfg.get("wandb")
-        trackers.append(WandbTracker(node.to_dict() if hasattr(node, "to_dict") else dict(node), run_dir))
-    if cfg.get("mlflow") is not None:
-        node = cfg.get("mlflow")
-        trackers.append(MLflowTracker(node.to_dict() if hasattr(node, "to_dict") else dict(node), run_dir))
+    for key, cls in _TRACKERS.items():
+        node = cfg.get(key)
+        if node is not None:
+            trackers.append(
+                cls(node.to_dict() if hasattr(node, "to_dict") else dict(node), run_dir)
+            )
     return trackers

@@ -90,12 +90,21 @@ def dispatch_tensors(
     indices: jnp.ndarray,  # (T, K) int32
     weights: jnp.ndarray,  # (T, K) f32
     capacity: int,
+    dtype=jnp.float32,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Build dispatch (T,E,C) bool-ish and combine (T,E,C) f32 tensors.
+    """Build the dispatch one-hot (T,E,C) and combine weights (T,E).
 
     Position of token t within expert e's buffer = number of earlier
     (token, slot) pairs routed to e — a cumsum over the flattened (T*K)
     routing order, matching Megatron's capacity dispatcher semantics.
+
+    Memory note (the reference's DeepEP path never materializes per-slot
+    buffers; this is the GSPMD formulation's cost): ONE (T,E,C) tensor in
+    the COMPUTE dtype. The per-token combine weights factor as a (T,E)
+    matrix — `experts_forward` fuses it into the combine einsum instead of
+    materializing a second (T,E,C). For DSv3-scale expert counts prefer
+    `dispatcher: dropless` (sort + ragged_dot, EP-capable), which has no
+    (T,E,C) at all.
     """
     T, K = indices.shape
     E = cfg.n_routed_experts
@@ -103,20 +112,20 @@ def dispatch_tensors(
     onehot = jax.nn.one_hot(flat, E, dtype=jnp.int32)          # (T*K, E)
     pos = jnp.cumsum(onehot, axis=0) - onehot                   # (T*K, E)
     pos_in_expert = jnp.sum(pos * onehot, axis=-1).reshape(T, K)
-    keep = (pos_in_expert < capacity).astype(jnp.float32)       # (T, K)
+    keep = (pos_in_expert < capacity).astype(dtype)             # (T, K)
 
     # Accumulate per top-k slot so peak memory stays at one (T, E, C) tensor
     # (a (T*K, E, C) intermediate would be K× larger).
-    dispatch = jnp.zeros((T, E, capacity), jnp.float32)
-    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    dispatch = jnp.zeros((T, E, capacity), dtype)
+    combine_w = jnp.zeros((T, E), jnp.float32)
     idx_tk = indices.reshape(T, K)
     for k in range(K):
-        eh = jax.nn.one_hot(idx_tk[:, k], E, dtype=jnp.float32)          # (T, E)
-        ch = jax.nn.one_hot(pos_in_expert[:, k], capacity, dtype=jnp.float32)
-        contrib = (eh * keep[:, k : k + 1])[:, :, None] * ch[:, None, :]  # (T, E, C)
-        dispatch = dispatch + contrib
-        combine = combine + contrib * weights[:, k][:, None, None]
-    return dispatch, combine
+        eh = jax.nn.one_hot(idx_tk[:, k], E, dtype=dtype)                # (T, E)
+        ch = jax.nn.one_hot(pos_in_expert[:, k], capacity, dtype=dtype)
+        kept_e = eh * keep[:, k : k + 1]
+        dispatch = dispatch + kept_e[:, :, None] * ch[:, None, :]
+        combine_w = combine_w + kept_e.astype(jnp.float32) * weights[:, k][:, None]
+    return dispatch, combine_w
 
 
 def experts_forward_dropless(
@@ -318,8 +327,8 @@ def experts_forward(
     params: dict,
     cfg: MoEConfig,
     x: jnp.ndarray,        # (T, H)
-    dispatch: jnp.ndarray, # (T, E, C)
-    combine: jnp.ndarray,  # (T, E, C)
+    dispatch: jnp.ndarray, # (T, E, C) one-hot
+    combine_w: jnp.ndarray,  # (T, E) routing weights
     constrain=None,
 ) -> jnp.ndarray:
     """Dispatch → batched expert MLP → weighted combine. Returns (T, H)."""
@@ -342,5 +351,8 @@ def experts_forward(
     if "bias" in params["down_proj"]:
         y = y + params["down_proj"]["bias"].astype(dtype)[:, None, :]
     y = c(y, ("act_expert", None, "act_embed"))
-    # expert-major → tokens (the A2A back), weighted by routing probs
-    return jnp.einsum("tec,ech->th", combine.astype(dtype), y)
+    # expert-major → tokens (the A2A back); the per-token routing weight
+    # factors as (T,E) and fuses into the einsum — no second (T,E,C)
+    return jnp.einsum(
+        "tec,te,ech->th", dispatch.astype(dtype), combine_w.astype(dtype), y
+    )

@@ -63,9 +63,9 @@ def test_dispatch_combine_roundtrip():
     idx = jnp.asarray([[0, 1], [1, 2], [3, 0]], jnp.int32)
     w = jnp.full((3, 2), 0.5)
     cap = compute_capacity(MOE, 3)
-    disp, comb = dispatch_tensors(MOE, idx, w, cap)
+    disp, comb_w = dispatch_tensors(MOE, idx, w, cap)
     assert float(disp.sum()) == 6.0  # all (token, slot) pairs kept
-    np.testing.assert_allclose(np.asarray(comb.sum((1, 2))), 1.0)
+    np.testing.assert_allclose(np.asarray(comb_w.sum(1)), 1.0)
 
 
 def test_capacity_drop():
