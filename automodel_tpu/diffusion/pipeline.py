@@ -127,6 +127,14 @@ class AutoDiffusionPipeline:
     vae_cfg: Optional[vae.VAEConfig] = None
     vae_params: Any = None
 
+    def __post_init__(self) -> None:
+        if (self.vae_params is None) != (self.vae_cfg is None):
+            raise ValueError(
+                "vae_cfg and vae_params must be provided together (got "
+                f"vae_cfg={'set' if self.vae_cfg is not None else 'None'}, "
+                f"vae_params={'set' if self.vae_params is not None else 'None'})"
+            )
+
     # -- persistence --------------------------------------------------------
     def save_pretrained(self, out_dir: str) -> None:
         os.makedirs(out_dir, exist_ok=True)

@@ -49,6 +49,10 @@ class Mamba2Config:
     remat_policy: Optional[str] = "full"
     scan_unroll: int = 1
     mtp_num_layers: int = 0  # chassis compatibility
+    # SSD recurrence impl: "scan" (sequential oracle), "chunked" (block
+    # matmul form), or "auto" (chunked once S outgrows one chunk)
+    ssd_impl: str = "auto"
+    ssd_chunk: int = 128
 
     @property
     def intermediate_size(self) -> int:
@@ -190,6 +194,81 @@ def selective_scan(x, dt, A, B, C, D, reset=None):
     return y + x * D[None, None, :, None]
 
 
+def selective_scan_chunked(x, dt, A, B, C, D, reset=None, chunk: int = 128):
+    """Chunked (block-parallel) SSD — same semantics as `selective_scan`.
+
+    The Mamba2 SSD block decomposition (reference: nemotron_v3/layers.py
+    mamba mixers; the HF `torch_forward` sequential scan is the oracle):
+    within each chunk of Q tokens the recurrence is a (Q×Q) decay-masked
+    matmul (MXU work), chunk-boundary states are B-weighted sums, and only
+    the O(S/Q) inter-chunk recurrence remains sequential. Packed-document
+    resets fold into the per-token log-decay as a -inf-like additive term, so
+    exp(cum_t - cum_s) underflows to exactly 0 across any document boundary.
+
+    x (Bz,S,H,P) fp32; dt (Bz,S,H) post-softplus; A (H,) negative; B,C
+    (Bz,S,H,N); reset (Bz,S) bool. Returns (Bz,S,H,P) fp32.
+    """
+    Bz, S, Hd, P = x.shape
+    N = B.shape[-1]
+    pad = (-S) % chunk
+    if pad:
+        zpad = lambda a: jnp.pad(a, [(0, 0), (0, pad)] + [(0, 0)] * (a.ndim - 2))
+        x, B, C = zpad(x), zpad(B), zpad(C)
+        dt = jnp.pad(dt, ((0, 0), (0, pad), (0, 0)))
+        reset = jnp.pad(
+            reset if reset is not None else jnp.zeros((Bz, S), bool),
+            ((0, 0), (0, pad)),
+        )
+    T = S + pad
+    Nc, Q = T // chunk, chunk
+
+    loga = dt * A  # (Bz,T,H)
+    if reset is not None:
+        # a reset zeroes the carry INTO that position: decay → exp(-300) = 0
+        loga = loga + jnp.where(reset[..., None], -300.0, 0.0)
+
+    ch = lambda a: a.reshape((Bz, Nc, Q) + a.shape[2:])
+    xc, dtc, Bc, Cc, lac = ch(x), ch(dt), ch(B), ch(C), ch(loga)
+    cum = jnp.cumsum(lac, axis=2)                      # inclusive (Bz,Nc,Q,H)
+
+    # intra-chunk: y_t += sum_{s<=t} (C_t·B_s) exp(cum_t - cum_s) dt_s x_s
+    CB = jnp.einsum("bcqhn,bcshn->bchqs", Cc, Bc)
+    diff = cum[:, :, :, None, :] - cum[:, :, None, :, :]            # (b,c,q,s,h)
+    tri = jnp.tril(jnp.ones((Q, Q), bool))
+    # mask BEFORE exp: upper-triangle diffs are positive (and huge across a
+    # reset, where they reach +300·k and overflow to inf); exp-of-masked
+    # would be fwd-fine but its where-VJP emits 0·inf = NaN into d(cumsum)
+    decay = jnp.exp(jnp.where(tri[None, None, :, :, None], diff, -jnp.inf))
+    M = CB * jnp.moveaxis(decay, -1, 2)
+    M = M * jnp.moveaxis(dtc, -1, 2)[:, :, :, None, :]   # × dt_s
+    y_intra = jnp.einsum("bchqs,bcshp->bcqhp", M, xc)
+
+    # chunk-end states: S_c = sum_s exp(cum_end - cum_s) dt_s x_s ⊗ B_s
+    w_state = jnp.exp(cum[:, :, -1:, :] - cum) * dtc   # (Bz,Nc,Q,H)
+    states = jnp.einsum("bcsh,bcshn,bcshp->bchpn", w_state, Bc, xc)
+
+    # inter-chunk recurrence over Nc chunk states (the only sequential part)
+    T_c = jnp.exp(cum[:, :, -1, :])                    # (Bz,Nc,H) total decay
+
+    def step(carry, xs):  # carry (Bz,H,P,N) = state at chunk start
+        s_c, t_c = xs
+        out = carry
+        carry = carry * t_c[..., None, None] + s_c
+        return carry, out
+
+    xs = (jnp.moveaxis(states, 1, 0), jnp.moveaxis(T_c, 1, 0))
+    s0 = jnp.zeros((Bz, Hd, P, N), jnp.float32)
+    _, starts = jax.lax.scan(step, s0, xs)             # (Nc,Bz,H,P,N)
+    starts = jnp.moveaxis(starts, 0, 1)                # (Bz,Nc,H,P,N)
+
+    # inter-chunk: y_t += C_t · (exp(cum_t) · S_chunk_start)
+    y_inter = jnp.einsum(
+        "bcqhn,bchpn,bcqh->bcqhp", Cc, starts, jnp.exp(cum)
+    )
+    y = (y_intra + y_inter).reshape(Bz, T, Hd, P)[:, :S]
+    return y + x[:, :S] * D[None, None, :, None]
+
+
 def _mixer(h, lp, cfg: Mamba2Config, segment_ids=None):
     Bz, S, H = h.shape
     I, N, G, Hd = cfg.intermediate_size, cfg.state_size, cfg.n_groups, cfg.num_heads
@@ -239,7 +318,16 @@ def _mixer(h, lp, cfg: Mamba2Config, segment_ids=None):
     if segment_ids is not None:
         prev = jnp.pad(segment_ids, ((0, 0), (1, 0)), constant_values=-1)[:, :S]
         reset = segment_ids != prev
-    y = selective_scan(x, dt, A, B, C, lp["D"].astype(jnp.float32), reset)
+    use_chunked = cfg.ssd_impl == "chunked" or (
+        cfg.ssd_impl == "auto" and S > cfg.ssd_chunk
+    )
+    if use_chunked:
+        y = selective_scan_chunked(
+            x, dt, A, B, C, lp["D"].astype(jnp.float32), reset,
+            chunk=cfg.ssd_chunk,
+        )
+    else:
+        y = selective_scan(x, dt, A, B, C, lp["D"].astype(jnp.float32), reset)
     y = y.reshape(Bz, S, I)
     # HF MambaRMSNormGated: gate first, then normalize
     y = y * jax.nn.silu(gate.astype(jnp.float32))

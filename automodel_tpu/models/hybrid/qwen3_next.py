@@ -62,6 +62,10 @@ class Qwen3NextConfig:
     dtype: jnp.dtype = jnp.float32
     remat_policy: Optional[str] = "full"
     scan_unroll: int = 1
+    # gated-delta-net impl: "scan" (sequential oracle), "chunked" (WY block
+    # form), or "auto" (chunked once S outgrows one chunk)
+    gdn_impl: str = "auto"
+    gdn_chunk: int = 64
     mtp_num_layers: int = 0  # chassis compatibility
 
     def __post_init__(self):
@@ -328,6 +332,79 @@ def gated_delta_rule(q, k, v, g, beta):
     return jnp.moveaxis(outs, 0, 1)  # (B,S,Hv,dv)
 
 
+def gated_delta_rule_chunked(q, k, v, g, beta, chunk: int = 64):
+    """Chunked (block-parallel) gated delta rule — same contract as
+    `gated_delta_rule` (q pre-scaled, q/k pre-l2normed).
+
+    Algorithm oracle: HF transformers `torch_chunk_gated_delta_rule`
+    (modeling_qwen3_next.py) — the WY/UT-transform chunk decomposition of
+    the delta rule. TPU-native differences: the in-chunk unit-lower-
+    triangular inverse is a batched `solve_triangular` (one MXU-friendly
+    solve instead of HF's per-row Python loop), and the inter-chunk
+    recurrence is a `lax.scan` over S/chunk steps carrying the (dk, dv)
+    state.
+    """
+    B, S, Hv, dk = q.shape
+    dv = v.shape[-1]
+    pad = (-S) % chunk
+    if pad:
+        p2 = lambda a: jnp.pad(a, [(0, 0), (0, pad)] + [(0, 0)] * (a.ndim - 2))
+        q, k, v, g, beta = p2(q), p2(k), p2(v), p2(g), p2(beta)
+    T = S + pad
+    Nc, Q = T // chunk, chunk
+
+    def ch(a):  # (B,T,H,...) → (B,H,Nc,Q,...)
+        a = jnp.swapaxes(a, 1, 2)
+        return a.reshape((B, Hv, Nc, Q) + a.shape[3:])
+
+    qc, kc, vc = ch(q), ch(k), ch(v.astype(jnp.float32))
+    gc, bc = ch(g.astype(jnp.float32)), ch(beta.astype(jnp.float32))
+    v_beta = vc * bc[..., None]
+    k_beta = kc * bc[..., None]
+    gcum = jnp.cumsum(gc, axis=-1)                     # (B,H,Nc,Q)
+
+    ii = jnp.arange(Q)
+    tril = ii[:, None] >= ii[None, :]
+    tril_s = ii[:, None] > ii[None, :]
+    # mask BEFORE exp: upper-triangle diffs are sums of |g| over the interval
+    # and can exceed the fp32 exp range (~88.7) → inf, whose where-VJP would
+    # send 0·inf = NaN into the A_log/dt_bias gradients
+    dmask = jnp.exp(
+        jnp.where(tril, gcum[..., :, None] - gcum[..., None, :], -jnp.inf)
+    )                                                   # (B,H,Nc,Q,Q)
+    A = jnp.where(
+        tril_s, jnp.einsum("bhcik,bhcjk->bhcij", k_beta, kc) * dmask, 0.0
+    )
+    M = jnp.eye(Q, dtype=A.dtype) + A                  # unit lower triangular
+    u = jax.scipy.linalg.solve_triangular(M, v_beta, lower=True)
+    w = jax.scipy.linalg.solve_triangular(
+        M, k_beta * jnp.exp(gcum)[..., None], lower=True
+    )
+
+    def step(S_state, xs):  # S_state (B,H,dk,dv)
+        q_i, k_i, u_i, w_i, gc_i, dm_i = xs
+        v_prime = jnp.einsum("bhqk,bhkv->bhqv", w_i, S_state)
+        v_new = u_i - v_prime
+        attn_local = jnp.einsum("bhik,bhjk->bhij", q_i, k_i) * dm_i
+        out_i = (
+            jnp.einsum("bhqk,bhkv->bhqv", q_i * jnp.exp(gc_i)[..., None], S_state)
+            + jnp.einsum("bhij,bhjv->bhiv", attn_local, v_new)
+        )
+        g_last = gc_i[..., -1:]
+        S_state = S_state * jnp.exp(g_last)[..., None] + jnp.einsum(
+            "bhqk,bhqv->bhkv", k_i * jnp.exp(g_last - gc_i)[..., None], v_new
+        )
+        return S_state, out_i
+
+    xs = jax.tree.map(
+        lambda a: jnp.moveaxis(a, 2, 0), (qc, kc, u, w, gcum, dmask)
+    )
+    S0 = jnp.zeros((B, Hv, dk, dv), jnp.float32)
+    _, outs = jax.lax.scan(step, S0, xs)                # (Nc,B,H,Q,dv)
+    out = jnp.moveaxis(outs, 0, 2).reshape(B, Hv, T, dv)[:, :, :S]
+    return jnp.swapaxes(out, 1, 2)                     # (B,S,Hv,dv)
+
+
 def _gdn_block(x, lp, cfg: Qwen3NextConfig):
     """x (B,S,H) normed input → GDN output (B,S,H)."""
     B, S, H = x.shape
@@ -380,7 +457,15 @@ def _gdn_block(x, lp, cfg: Qwen3NextConfig):
         a.astype(jnp.float32) + lp["dt_bias"].astype(jnp.float32)
     )
 
-    core = gated_delta_rule(q, k, v.astype(jnp.float32), g, beta)  # (B,S,Hv,dv)
+    use_chunked = cfg.gdn_impl == "chunked" or (
+        cfg.gdn_impl == "auto" and S > cfg.gdn_chunk
+    )
+    if use_chunked:
+        core = gated_delta_rule_chunked(
+            q, k, v.astype(jnp.float32), g, beta, chunk=cfg.gdn_chunk
+        )
+    else:
+        core = gated_delta_rule(q, k, v.astype(jnp.float32), g, beta)
 
     # gated RMSNorm per value head: w·x̂·silu(z) (NOT zero-centered)
     core = rms_norm(core, lp["norm"]["scale"], cfg.rms_norm_eps)

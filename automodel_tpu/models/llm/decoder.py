@@ -162,6 +162,17 @@ def layer_windows(cfg: "TransformerConfig", num_layers: int | None = None) -> tu
     )
 
 
+def mixed_window_xs(windows: tuple, freq_for) -> tuple:
+    """Encode static per-layer windows as scan-able arrays: window ints with
+    a huge sentinel for None (global attention — the window mask becomes a
+    tautology), plus the per-layer rope freq table selected statically."""
+    win_arr = jnp.asarray(
+        [w if w is not None else (1 << 30) for w in windows], jnp.int32
+    )
+    freq_arr = jnp.stack([freq_for(w) for w in windows])
+    return win_arr, freq_arr
+
+
 def make_freq_for(cfg: "TransformerConfig", inv_freq):
     """Per-layer-window rope frequency selector.
 
@@ -361,8 +372,6 @@ def forward(
         from automodel_tpu.parallel.pp import pipeline_layers
 
         windows = layer_windows(cfg)
-        if len(set(windows)) != 1:
-            raise NotImplementedError("pp with per-layer window types")
         if return_aux_hidden is not None:
             raise NotImplementedError("aux-hidden capture inside the pp pipeline")
         if cfg.attention_type == "mla" and (
@@ -395,16 +404,37 @@ def forward(
         else:
             cfg_pl = cfg
 
-        def pl_layer(hh, lp, pos, sg):
-            return _decoder_layer(
-                hh, lp, cfg_pl, pos, sg, freq_for(windows[0]), lambda x, axes: x,
-                windows[0], mesh_ctx, manual=True,
-            )
+        layers_in = params["layers"]
+        lspecs = param_specs(cfg)["layers"]
+        if len(set(windows)) == 1:
+
+            def pl_layer(hh, lp, pos, sg):
+                return _decoder_layer(
+                    hh, lp, cfg_pl, pos, sg, freq_for(windows[0]),
+                    lambda x, axes: x, windows[0], mesh_ctx, manual=True,
+                )
+        else:
+            # mixed per-layer windows inside the pipeline: the window value
+            # and its rope freq table ride the scanned layer pytree (windows
+            # are static per layer; only the stage scan makes them traced —
+            # the flash kernel folds a traced window into its qwin aux array)
+            win_arr, freq_arr = mixed_window_xs(windows, freq_for)
+            layers_in = dict(layers_in, _window=win_arr, _freq=freq_arr)
+            lspecs = dict(lspecs, _window=("layers",), _freq=("layers", None))
+
+            def pl_layer(hh, lp, pos, sg):
+                lp = dict(lp)
+                w = lp.pop("_window")
+                fr = lp.pop("_freq")
+                return _decoder_layer(
+                    hh, lp, cfg_pl, pos, sg, fr, lambda x, axes: x, w,
+                    mesh_ctx, manual=True,
+                )
 
         h = pipeline_layers(
-            h, positions, seg, params["layers"], pl_layer, mesh_ctx,
+            h, positions, seg, layers_in, pl_layer, mesh_ctx,
             cfg.pipeline_microbatches, remat_policy=cfg.remat_policy,
-            param_logical_specs=param_specs(cfg)["layers"],
+            param_logical_specs=lspecs,
         )
     else:
 
@@ -416,27 +446,43 @@ def forward(
 
         if return_aux_hidden is not None:
             windows = layer_windows(cfg)
-            if len(set(windows)) != 1:
-                raise NotImplementedError("aux-hidden capture with mixed windows")
             from automodel_tpu.models.common.layers import maybe_remat
 
             aux_ids = tuple(return_aux_hidden)
+            mixed = len(set(windows)) != 1
+            if mixed:
+                # per-layer windows ride the scan as traced values (the flash
+                # kernel folds them into its qwin aux array); rope freqs are
+                # selected statically per layer and stacked
+                win_xs, freq_xs = mixed_window_xs(windows, freq_for)
 
             # carry an (A, B, S, H) buffer updated only at the selected
             # layers — never materializes all L per-layer outputs
             def body(carry, xs):
                 c, aux = carry
-                lp, i = xs
-                y = layer(c, lp, windows[0])
+                if mixed:
+                    lp, i, w, fr = xs
+                    y = _decoder_layer(
+                        c, lp, cfg, positions, segment_ids, fr, constrain, w,
+                        mesh_ctx,
+                    )
+                else:
+                    lp, i = xs
+                    y = layer(c, lp, windows[0])
                 for j, lid in enumerate(aux_ids):
                     aux = aux.at[j].set(jnp.where(i == lid, y, aux[j]))
                 return (y, aux), None
 
+            xs = (
+                (params["layers"], jnp.arange(cfg.num_layers), win_xs, freq_xs)
+                if mixed
+                else (params["layers"], jnp.arange(cfg.num_layers))
+            )
             aux0 = jnp.zeros((len(aux_ids),) + h.shape, h.dtype)
             (h, aux), _ = jax.lax.scan(
                 maybe_remat(body, cfg.remat_policy),
                 (h, aux0),
-                (params["layers"], jnp.arange(cfg.num_layers)),
+                xs,
                 unroll=cfg.scan_unroll,
             )
         else:
@@ -528,6 +574,7 @@ def attention_block(h, lp, cfg: TransformerConfig, positions, segment_ids, inv_f
     k = constrain(k, ("act_batch", "act_seq", "act_kv_heads", None))
     v = constrain(v, ("act_batch", "act_seq", "act_kv_heads", None))
 
+    sinks = lp.get("sinks") if cfg.attention_sinks else None
     if mesh_ctx is not None and mesh_ctx.sizes["cp"] > 1:
         if manual:
             from automodel_tpu.parallel.cp import ring_attention
@@ -538,6 +585,8 @@ def attention_block(h, lp, cfg: TransformerConfig, positions, segment_ids, inv_f
                 sliding_window=sliding_window,
                 logits_soft_cap=cfg.attn_soft_cap,
                 scale=cfg.attn_scale,
+                sinks=sinks,
+                attn_impl=cfg.attn_impl,
             )
         else:
             from automodel_tpu.parallel.cp import ring_dot_product_attention
@@ -548,6 +597,8 @@ def attention_block(h, lp, cfg: TransformerConfig, positions, segment_ids, inv_f
                 sliding_window=sliding_window,
                 logits_soft_cap=cfg.attn_soft_cap,
                 scale=cfg.attn_scale,
+                sinks=sinks,
+                attn_impl=cfg.attn_impl,
             )
     else:
         attn = dot_product_attention(
@@ -558,7 +609,7 @@ def attention_block(h, lp, cfg: TransformerConfig, positions, segment_ids, inv_f
             sliding_window=sliding_window,
             logits_soft_cap=cfg.attn_soft_cap,
             scale=cfg.attn_scale,
-            sinks=lp.get("sinks") if cfg.attention_sinks else None,
+            sinks=sinks,
             impl=cfg.attn_impl,
         )
     attn = attn.reshape(B, S, cfg.num_heads * D)

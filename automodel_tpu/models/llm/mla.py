@@ -209,8 +209,11 @@ def mla_attention_block(h, lp, cfg, positions, segment_ids, inv_freq, constrain,
             sliding_window=sliding_window,
             logits_soft_cap=cfg.attn_soft_cap,
             scale=scale,
+            attn_impl=cfg.attn_impl,
         )
     else:
+        # the flash kernel handles MLA's asymmetric qk (192) / v (128) head
+        # dims natively (qk padded to 256 lanes, v block carries its own dim)
         attn = dot_product_attention(
             q, k, v,
             causal=cfg.causal,
@@ -219,7 +222,7 @@ def mla_attention_block(h, lp, cfg, positions, segment_ids, inv_freq, constrain,
             sliding_window=sliding_window,
             logits_soft_cap=cfg.attn_soft_cap,
             scale=scale,
-            impl="xla",  # asymmetric qk/v dims — flash MLA kernel is future work
+            impl=cfg.attn_impl,
         )
     attn = attn.reshape(B, S, n * dv)
     h = h + _dense(attn, {"kernel": lp["o_proj"]["kernel"]}, cfg.linear_precision)

@@ -208,12 +208,28 @@ def hunyuan_moe_config(hf: Mapping[str, Any], **overrides) -> MoETransformerConf
     topk = hf.get("moe_topk", 1)
     if not isinstance(n_experts, int) or not isinstance(topk, int):
         raise NotImplementedError("hunyuan per-layer expert-count lists")
+    # Released HunYuan-A13B checkpoints carry moe_intermediate_size /
+    # num_shared_expert; fall back to the dense intermediate size (what the
+    # installed transformers modeling always uses) only when absent.
+    moe_inter = hf.get("moe_intermediate_size")
+    if moe_inter is None:
+        moe_inter = hf["intermediate_size"]
+    n_shared = hf.get("num_shared_expert")
+    if n_shared is None:
+        n_shared = 1
+    # released A13B checkpoints carry these as uniform per-layer lists
+    if isinstance(moe_inter, (list, tuple)) and len(set(moe_inter)) == 1:
+        moe_inter = moe_inter[0]
+    if isinstance(n_shared, (list, tuple)) and len(set(n_shared)) == 1:
+        n_shared = n_shared[0]
+    if not isinstance(moe_inter, int) or not isinstance(n_shared, int):
+        raise NotImplementedError("hunyuan per-layer moe size/shared lists")
     moe = MoEConfig(
         n_routed_experts=int(n_experts),
-        n_shared_experts=1,
+        n_shared_experts=int(n_shared),
         experts_per_token=int(topk),
-        moe_intermediate_size=int(hf["intermediate_size"]),
-        shared_expert_intermediate_size=int(hf["intermediate_size"]),
+        moe_intermediate_size=int(moe_inter),
+        # shared width n_shared·moe_inter comes from shared_intermediate's default
         score_func="softmax",
         norm_topk_prob=True,
         aux_loss_coeff=float(hf.get("router_aux_loss_coef", 0.0)),

@@ -65,7 +65,12 @@ _TEXT_ADAPTERS = {"llama": llama_config, "qwen2": qwen2_config}
 def omni_config(hf: Mapping[str, Any], **overrides) -> OmniConfig:
     """HF-style omni config: {text_config|llm_config, vision_config,
     audio_config|sound_config, image_token_id, audio_token_id}."""
-    text_hf = dict(hf.get("text_config") or hf.get("llm_config"))
+    text_section = hf.get("text_config") or hf.get("llm_config")
+    if text_section is None:
+        raise ValueError(
+            "omni config requires a 'text_config' (or 'llm_config') section"
+        )
+    text_hf = dict(text_section)
     arch = (text_hf.get("architectures") or ["LlamaForCausalLM"])[0]
     name = "qwen2" if "Qwen2" in arch else "llama"
     text_overrides = {
@@ -74,9 +79,12 @@ def omni_config(hf: Mapping[str, Any], **overrides) -> OmniConfig:
     text = _TEXT_ADAPTERS[name](text_hf, **text_overrides)
     common = dict(dtype=text.dtype, remat_policy=text_overrides.get("remat_policy", "full"))
     vision = vit.VisionConfig.from_hf(dict(hf["vision_config"]), **common)
-    audio = audio_encoder.AudioConfig.from_hf(
-        dict(hf.get("audio_config") or hf.get("sound_config")), **common
-    )
+    audio_section = hf.get("audio_config") or hf.get("sound_config")
+    if audio_section is None:
+        raise ValueError(
+            "omni config requires an 'audio_config' (or 'sound_config') section"
+        )
+    audio = audio_encoder.AudioConfig.from_hf(dict(audio_section), **common)
     return OmniConfig(
         vision=vision,
         audio=audio,

@@ -26,7 +26,6 @@ import jax
 import jax.numpy as jnp
 
 logger = logging.getLogger(__name__)
-_warned_sinks_fallback = False
 
 AttnImpl = Literal["auto", "xla", "flash"]
 
@@ -128,21 +127,6 @@ def dot_product_attention(
     resolved = impl
     if impl == "auto":
         resolved = "flash" if _on_tpu() else "xla"
-    if sinks is not None and resolved == "flash":
-        if impl == "flash":
-            raise NotImplementedError(
-                "attention sinks are not supported by the flash kernel yet; "
-                "use attn_impl='xla' (full S×T logits) or drop the sinks"
-            )
-        global _warned_sinks_fallback
-        if not _warned_sinks_fallback:
-            logger.warning(
-                "attention sinks force the XLA attention path (full S×T fp32 "
-                "logits) — expect higher memory until the flash kernel gains "
-                "sink slots"
-            )
-            _warned_sinks_fallback = True
-        resolved = "xla"
     if resolved == "flash":
         from automodel_tpu.ops.pallas.flash_attention import flash_attention
 
@@ -155,6 +139,7 @@ def dot_product_attention(
                 sliding_window=sliding_window,
                 logits_soft_cap=logits_soft_cap,
                 scale=scale,
+                sinks=sinks,
             )
         except NotImplementedError:
             resolved = "xla"

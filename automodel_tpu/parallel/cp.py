@@ -95,11 +95,12 @@ class ContextParallelSharder:
 # ---------------------------------------------------------------------------
 # ring attention (inside shard_map)
 # ---------------------------------------------------------------------------
-def _partial_attention(q, k, v, qpos, kpos, qseg, kseg, *, scale, soft_cap, window, causal):
-    """One ring step: masked scores of local q vs a visiting kv block.
+def _partial_attention_xla(q, k, v, qpos, kpos, qseg, kseg, *, scale, soft_cap, window, causal):
+    """One XLA ring step: normalized partial out + lse of local q vs a
+    visiting kv block.
 
-    Returns (m (B,Hq,S,1), l (B,Hq,S,1), o (B,S,Hq,D) un-normalized).
-    Shapes: q (B,S,Hq,D); k,v (B,T,Hkv,D).
+    Returns (o (B,S,Hq,Dv) normalized fp32, lse (B,Hq,S) fp32; NEG_INF for
+    rows with no unmasked kv). Shapes: q (B,S,Hq,D); k,v (B,T,Hkv,D).
     """
     B, S, Hq, D = q.shape
     T, Hkv = k.shape[1], k.shape[2]
@@ -119,8 +120,24 @@ def _partial_attention(q, k, v, qpos, kpos, qseg, kseg, *, scale, soft_cap, wind
     p = jnp.exp(s - m[..., None])
     p = jnp.where(mask[:, None, None, :, :], p, 0.0)
     l = jnp.sum(p, axis=-1)
-    o = jnp.einsum("bkgst,btkd->bskgd", p.astype(v.dtype), v)
-    return m, l, o.reshape(B, S, Hq, v.shape[-1])
+    o = jnp.einsum("bkgst,btkd->bskgd", p.astype(v.dtype), v).astype(jnp.float32)
+    o = o.reshape(B, S, Hq, v.shape[-1])
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(l_safe)).reshape(B, Hq, S)
+    o = o / jnp.moveaxis(l_safe.reshape(B, Hq, S), 1, 2)[..., None]
+    return o, lse
+
+
+def _flash_ring_ok(q, k) -> bool:
+    from automodel_tpu.ops.pallas.flash_attention import _pick_block
+
+    S, T = q.shape[1], k.shape[1]
+    return (
+        _pick_block(S, 512) > 0
+        and _pick_block(T, 512) > 0
+        and q.shape[2] % k.shape[2] == 0
+        and q.shape[-1] == k.shape[-1]
+    )
 
 
 def ring_attention(
@@ -132,51 +149,76 @@ def ring_attention(
     sliding_window: int | None = None,
     logits_soft_cap: float | None = None,
     scale: float | None = None,
+    sinks=None,
+    attn_impl: str = "auto",
 ):
     """Ring attention over `axis_name`; call INSIDE shard_map.
 
     All inputs are local shards: q/k/v (B, S_loc, H, D); positions and
     segment_ids (B, S_loc) in GLOBAL coordinates (survive any layout).
+
+    Each step computes local-q × visiting-kv attention — through the Pallas
+    flash kernel in position-causal mode when shapes allow (reference: TE ring
+    wiring, moe/parallelizer.py:749-800), else the XLA oracle — and merges
+    (out, lse) partials with a running logsumexp. The merge is plain JAX, so
+    the whole ring differentiates through the flash kernel's lse-aware VJP.
+    gpt-oss sinks join once at the end: out *= sigmoid(lse_final - sink).
     """
     B, S, Hq, D = q.shape
-    Hkv = k.shape[2]
-    G = Hq // Hkv
+    Dv = v.shape[-1]
     scale = scale if scale is not None else D ** -0.5
     cp = lax.axis_size(axis_name)
 
     if segment_ids is None:
         segment_ids = jnp.zeros((B, S), jnp.int32)
 
+    use_flash = attn_impl in ("auto", "flash") and _flash_ring_ok(q, k)
+    if use_flash:
+        from automodel_tpu.ops.pallas.flash_attention import flash_attention
+
+        def partial_step(k_blk, v_blk, kpos, kseg):
+            o, lse = flash_attention(
+                q, k_blk, v_blk,
+                causal=causal,
+                positions=positions, segment_ids=segment_ids,
+                kv_positions=kpos, kv_segment_ids=kseg,
+                sliding_window=sliding_window,
+                logits_soft_cap=logits_soft_cap,
+                scale=scale, return_lse=True,
+            )
+            return o.astype(jnp.float32), lse
+    else:
+        def partial_step(k_blk, v_blk, kpos, kseg):
+            return _partial_attention_xla(
+                q, k_blk, v_blk, positions, kpos, segment_ids, kseg,
+                scale=scale, soft_cap=logits_soft_cap,
+                window=sliding_window, causal=causal,
+            )
+
     def step(carry, _):
-        m_acc, l_acc, o_acc, kv = carry
+        o_acc, lse_acc, kv = carry
         k_blk, v_blk, kpos, kseg = kv
-        m_i, l_i, o_i = _partial_attention(
-            q, k_blk, v_blk, positions, kpos, segment_ids, kseg,
-            scale=scale, soft_cap=logits_soft_cap, window=sliding_window, causal=causal,
-        )
-        m_new = jnp.maximum(m_acc, m_i)
-        a_old = jnp.exp(m_acc - m_new)
-        a_new = jnp.exp(m_i - m_new)
-        l_acc = l_acc * a_old + l_i * a_new
-        # scale factors broadcast (B,Hkv,G,S) → (B,S,Hq,1)
-        def to_bshd(x):
-            return jnp.moveaxis(x, -1, 1).reshape(B, S, Hq)[..., None]
-        o_acc = o_acc * to_bshd(a_old) + o_i * to_bshd(a_new)
+        o_i, lse_i = partial_step(k_blk, v_blk, kpos, kseg)
+        lse_new = jnp.logaddexp(lse_acc, lse_i)
+        w_old = jnp.exp(lse_acc - lse_new)       # (B,Hq,S)
+        w_new = jnp.exp(lse_i - lse_new)
+        to_bshd = lambda x: jnp.moveaxis(x, 1, 2)[..., None]
+        o_acc = o_acc * to_bshd(w_old) + o_i * to_bshd(w_new)
         kv = lax.ppermute(
             kv, axis_name, [(i, (i + 1) % cp) for i in range(cp)]
         )
-        return (m_new, l_acc, o_acc, kv), None
+        return (o_acc, lse_new, kv), None
 
-    m0 = jnp.full((B, Hkv, G, S), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((B, Hkv, G, S), jnp.float32)
-    o0 = jnp.zeros((B, S, Hq, v.shape[-1]), jnp.float32)
+    o0 = jnp.zeros((B, S, Hq, Dv), jnp.float32)
+    lse0 = jnp.full((B, Hq, S), NEG_INF, jnp.float32)
     kv0 = (k, v, positions, segment_ids)
-    (m_f, l_f, o_f, _), _ = lax.scan(step, (m0, l0, o0, kv0), None, length=cp)
+    (o_f, lse_f, _), _ = lax.scan(step, (o0, lse0, kv0), None, length=cp)
 
-    l_bshd = jnp.moveaxis(l_f, -1, 1).reshape(B, S, Hq)[..., None]
-    l_safe = jnp.where(l_bshd == 0.0, 1.0, l_bshd)
-    out = jnp.where(l_bshd == 0.0, 0.0, o_f / l_safe)
-    return out.astype(q.dtype)
+    if sinks is not None:
+        # the sink joins the global softmax denominator exactly once
+        sig = jax.nn.sigmoid(lse_f - sinks.astype(jnp.float32).reshape(1, Hq, 1))
+        o_f = o_f * jnp.moveaxis(sig, 1, 2)[..., None]
+    return o_f.astype(q.dtype)
 
 
 def ring_dot_product_attention(
@@ -188,6 +230,8 @@ def ring_dot_product_attention(
     sliding_window: int | None = None,
     logits_soft_cap: float | None = None,
     scale: float | None = None,
+    sinks=None,
+    attn_impl: str = "auto",
 ):
     """shard_map wrapper: GSPMD everywhere else, explicit ring on `cp`."""
     batch = ("dp_replicate", "dp_shard", "ep")
@@ -204,11 +248,28 @@ def ring_dot_product_attention(
         sliding_window=sliding_window,
         logits_soft_cap=logits_soft_cap,
         scale=scale,
+        attn_impl=attn_impl,
     )
+    in_specs = [qkv_spec, qkv_spec, qkv_spec, tok_spec, tok_spec]
+    args = [q, k, v, positions, segment_ids]
+    if sinks is not None:
+        # sinks are per-q-head → sharded with the head (tp) axis
+        in_specs.append(P("tp"))
+        args.append(sinks)
+
+        def fn(q, k, v, positions, segment_ids, sinks):  # noqa: F811
+            return ring_attention(
+                q, k, v, positions, segment_ids,
+                axis_name="cp", causal=causal,
+                sliding_window=sliding_window,
+                logits_soft_cap=logits_soft_cap,
+                scale=scale, sinks=sinks, attn_impl=attn_impl,
+            )
+
     return jax.shard_map(
         fn,
         mesh=mesh_ctx.mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec, tok_spec, tok_spec),
+        in_specs=tuple(in_specs),
         out_specs=qkv_spec,
         check_vma=False,
-    )(q, k, v, positions, segment_ids)
+    )(*args)

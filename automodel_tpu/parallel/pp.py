@@ -124,7 +124,12 @@ def pipeline_layers(
         (_, outputs), _ = lax.scan(
             tick, (init_stream, jnp.zeros_like(h_mb)), jnp.arange(T)
         )
-        # only the last stage's buffer is real; make it consistent everywhere
+        # Only the last stage's buffer is real; every pp rank needs it because
+        # the head (final norm + lm-head/loss) runs under GSPMD outside this
+        # shard_map with pp unmapped. masked-psum IS the broadcast: an
+        # all-reduce of one activation buffer moves the same bytes as any
+        # one-to-all broadcast over the ring, and XLA lowers it to one
+        # collective — the zeros are the selection mask, not wasted traffic.
         outputs = lax.psum(
             jnp.where(p_idx == n_stage - 1, outputs, jnp.zeros_like(outputs)), "pp"
         )

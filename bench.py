@@ -23,15 +23,20 @@ H100_BASELINE_MFU_PCT = 40.6  # reference Llama3-8B single-GPU, BASELINE.md
 
 
 def _probe_accelerator(
-    timeout: float = 120.0, retries: int = 2
+    budget: float = 480.0, attempt_timeout: float = 75.0
 ) -> tuple[str | None, str]:
     """Check in a SUBPROCESS whether the ambient accelerator backend works.
 
     The axon TPU tunnel can fail two ways: a fast UNAVAILABLE error (round-1
-    BENCH rc=1) or an indefinite hang. Probing in-process can't recover from
-    the hang, so run `jax.devices()` + one tiny computation in a child with a
-    hard timeout, retrying once for transient outages. Returns
-    (device_kind, "") on success or (None, diagnostic) when unusable.
+    BENCH rc=1) or an indefinite hang (round-2 BENCH: 2x120s then give-up,
+    which scored the round zero even though the chip recovered later).
+    Probing in-process can't recover from the hang, so run `jax.devices()` +
+    one tiny computation in a child with a hard per-attempt timeout, and keep
+    trying — fresh subprocess each time, exponential backoff — until a total
+    wall-clock *budget* (~8 min) is exhausted. The hang is per-process, so a
+    fresh child after a backoff frequently succeeds where the first one hung.
+
+    Returns (device_kind, "") on success or (None, diagnostic) when unusable.
     """
     probe = (
         "import jax, jax.numpy as jnp;"
@@ -41,12 +46,16 @@ def _probe_accelerator(
         "jnp.ones((128, 128)).sum().block_until_ready();"
         "print('KIND:' + d[0].device_kind)"
     )
-    diag = ""
-    for attempt in range(retries):
+    deadline = time.monotonic() + budget
+    diag, attempt, backoff = "", 0, 5.0
+    while time.monotonic() < deadline:
+        attempt += 1
+        # never let one attempt run past the overall deadline + a little slack
+        t_attempt = min(attempt_timeout, deadline - time.monotonic() + 15.0)
         try:
             out = subprocess.run(
                 [sys.executable, "-c", probe],
-                capture_output=True, text=True, timeout=timeout,
+                capture_output=True, text=True, timeout=t_attempt,
             )
             for line in out.stdout.splitlines():
                 if line.startswith("KIND:"):
@@ -55,16 +64,50 @@ def _probe_accelerator(
                     return None, "no accelerator platform registered"
             diag = f"probe rc={out.returncode}: {out.stderr.strip()[-300:]}"
         except subprocess.TimeoutExpired:
-            diag = f"probe timed out after {timeout:.0f}s (backend hang)"
-        if attempt + 1 < retries:
-            time.sleep(10.0)
-    return None, diag
+            diag = f"probe timed out after {t_attempt:.0f}s (backend hang)"
+        print(
+            f"[bench] probe attempt {attempt} failed ({diag}); "
+            f"retrying in {backoff:.0f}s", file=sys.stderr,
+        )
+        if time.monotonic() + backoff >= deadline:
+            break
+        time.sleep(backoff)
+        backoff = min(backoff * 2.0, 60.0)
+    return None, f"{diag} [after {attempt} attempts over {budget:.0f}s budget]"
 
 
 def _force_cpu(n_devices: int = 1) -> None:
     from automodel_tpu.utils.hostplatform import force_cpu_devices
 
     force_cpu_devices(n_devices)
+
+
+def _append_perf_trail(result: dict) -> None:
+    """Append every successful on-accelerator run to PERF.jsonl (committed).
+
+    The driver only captures bench output at round end; if the TPU tunnel is
+    down at that exact moment the round records a CPU fallback even when the
+    chip ran fine an hour earlier. This file is the auditable trail of real
+    on-TPU numbers (timestamp + preset + metrics), committed as it grows.
+    """
+    import datetime
+    import os
+
+    kind = result.get("detail", {}).get("device_kind", "cpu")
+    if "cpu" in kind.lower() or result.get("value", 0.0) <= 0.0:
+        return
+    rec = {
+        "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+            timespec="seconds"
+        ),
+        **result,
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "PERF.jsonl")
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError as e:  # trail is best-effort; never break the bench line
+        print(f"[bench] PERF.jsonl append failed: {e}", file=sys.stderr)
 
 
 def build(preset: str):
@@ -125,20 +168,32 @@ def main() -> None:
             _force_cpu()
             args.preset = "tiny"
         else:
-            args.preset = args.preset or "small"
+            args.preset = args.preset or "medium"
 
     try:
         result = _run(args)
         if fallback:
             result["detail"]["fallback"] = fallback
     except Exception as e:  # noqa: BLE001 — one parseable line, no matter what
-        result = {
-            "metric": "llama_pretrain_mfu_pct",
-            "value": 0.0,
-            "unit": "% MFU",
-            "vs_baseline": 0.0,
-            "detail": {"error": repr(e)[:500], "fallback": fallback},
-        }
+        result = None
+        if args.preset == "medium":
+            # medium (~1.1B + fp32 adam states) can OOM a 16GB v5e; a smaller
+            # measured number beats a zero, so retry once at the small preset.
+            try:
+                args.preset = "small"
+                result = _run(args)
+                result["detail"]["fallback"] = f"medium failed ({repr(e)[:200]})"
+            except Exception as e2:  # noqa: BLE001
+                e = e2
+        if result is None:
+            result = {
+                "metric": "llama_pretrain_mfu_pct",
+                "value": 0.0,
+                "unit": "% MFU",
+                "vs_baseline": 0.0,
+                "detail": {"error": repr(e)[:500], "fallback": fallback},
+            }
+    _append_perf_trail(result)
     print(json.dumps(result))
 
 

@@ -110,6 +110,147 @@ def test_bwd_packed_segments():
 
 
 def test_unsupported_shapes_raise():
-    q = jnp.zeros((1, 100, 4, 64))  # seq not 128-divisible, head_dim 64
+    q = jnp.zeros((1, 100, 4, 64))  # seq not 128-divisible
     with pytest.raises(NotImplementedError):
         flash_attention(q, q, q)
+
+
+@pytest.mark.parametrize("D", [64, 96])
+def test_narrow_head_dim_padded(D):
+    """head_dim 64/96 (gpt-oss, qwen2-0.5B class) runs via lane padding."""
+    q, k, v = _rand_qkv(jax.random.key(4), S=256, D=D)
+    out = flash_attention(q, k, v, block_sizes=SMALL_BLOCKS)
+    ref = _oracle(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+    g1 = jax.grad(lambda *a: jnp.sum(flash_attention(*a, block_sizes=SMALL_BLOCKS) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda *a: jnp.sum(_oracle(*a) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b, n in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-3, err_msg=f"d{n}"
+        )
+
+
+def test_mla_shaped_heads():
+    """MLA: q/k head_dim (192) differs from v head_dim (128)."""
+    key = jax.random.key(5)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (1, 256, 4, 192))
+    k = jax.random.normal(kk, (1, 256, 4, 192))
+    v = jax.random.normal(kv, (1, 256, 4, 128))
+    out = flash_attention(q, k, v, block_sizes=SMALL_BLOCKS)
+    ref = _oracle(q, k, v)
+    assert out.shape == (1, 256, 4, 128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+    g1 = jax.grad(lambda *a: jnp.sum(flash_attention(*a, block_sizes=SMALL_BLOCKS) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda *a: jnp.sum(_oracle(*a) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b, n in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-3, err_msg=f"d{n}"
+        )
+
+
+def test_sinks_parity():
+    """gpt-oss attention sinks: fwd/bwd parity incl. the sink gradient."""
+    q, k, v = _rand_qkv(jax.random.key(6), S=256, Hq=4, Hkv=2)
+    sinks = jax.random.normal(jax.random.key(7), (4,))
+
+    def f_flash(q, k, v, s):
+        return jnp.sum(
+            flash_attention(q, k, v, sinks=s, block_sizes=SMALL_BLOCKS) ** 2
+        )
+
+    def f_ref(q, k, v, s):
+        mask = make_attention_mask(q.shape[1], k.shape[1], causal=True)
+        return jnp.sum(xla_attention(q, k, v, mask=mask, sinks=s) ** 2)
+
+    np.testing.assert_allclose(
+        np.asarray(flash_attention(q, k, v, sinks=sinks, block_sizes=SMALL_BLOCKS)),
+        np.asarray(xla_attention(
+            q, k, v,
+            mask=make_attention_mask(q.shape[1], k.shape[1], causal=True),
+            sinks=sinks,
+        )),
+        rtol=2e-4, atol=2e-4,
+    )
+    g1 = jax.grad(f_flash, argnums=(0, 1, 2, 3))(q, k, v, sinks)
+    g2 = jax.grad(f_ref, argnums=(0, 1, 2, 3))(q, k, v, sinks)
+    for a, b, n in zip(g1, g2, ("q", "k", "v", "sinks")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-3, err_msg=f"d{n}"
+        )
+
+
+def test_traced_sliding_window():
+    """A traced (scan-carried) window matches the static-window kernel."""
+    q, k, v = _rand_qkv(jax.random.key(8), S=256)
+    ref = flash_attention(q, k, v, sliding_window=100, block_sizes=SMALL_BLOCKS)
+
+    @jax.jit
+    def run(w):
+        return flash_attention(q, k, v, sliding_window=w, block_sizes=SMALL_BLOCKS)
+
+    out = run(jnp.int32(100))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_position_causal_asymmetric_kv():
+    """Ring-step mode: kv carries its own global positions/segments."""
+    B, S, H, D = 1, 128, 2, 128
+    kq, kk, kv = jax.random.split(jax.random.key(9), 3)
+    q = jax.random.normal(kq, (B, S, H, D))
+    k = jax.random.normal(kk, (B, S, H, D))
+    v = jax.random.normal(kv, (B, S, H, D))
+    # q holds global tokens [128..256), visiting kv block holds [0..128)
+    qpos = jnp.arange(S, dtype=jnp.int32)[None] + S
+    kpos = jnp.arange(S, dtype=jnp.int32)[None]
+    out, lse = flash_attention(
+        q, k, v, positions=qpos, kv_positions=kpos,
+        block_sizes=SMALL_BLOCKS, return_lse=True,
+    )
+    # every kv position precedes every q position → dense (non-causal) scores
+    ref = _oracle(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+    assert lse.shape == (B, H, S)
+
+    # reversed: q precedes all kv → fully masked, zero output, -inf-like lse
+    out2, lse2 = flash_attention(
+        q, k, v, positions=kpos, kv_positions=qpos + 1,
+        block_sizes=SMALL_BLOCKS, return_lse=True,
+    )
+    np.testing.assert_allclose(np.asarray(out2), 0.0, atol=1e-6)
+    assert bool(jnp.all(lse2 < -1e30))
+
+
+def test_return_lse_differentiable():
+    """lse cotangents fold into the kernel backward (ring merge needs this)."""
+    q, k, v = _rand_qkv(jax.random.key(10), S=128)
+
+    def f_flash(q, k, v):
+        out, lse = flash_attention(q, k, v, block_sizes=SMALL_BLOCKS, return_lse=True)
+        return jnp.sum(out ** 2) + jnp.sum(jnp.sin(lse))
+
+    def f_ref(q, k, v):
+        mask = make_attention_mask(q.shape[1], k.shape[1], causal=True)
+        B, S, Hq, D = q.shape
+        G = Hq // k.shape[2]
+        qg = q.reshape(B, S, k.shape[2], G, D)
+        s = jnp.einsum("bskgd,btkd->bkgst", qg, k) * (D ** -0.5)
+        s = jnp.where(mask[None, None, None], s, -jnp.inf)
+        lse = jax.scipy.special.logsumexp(s, axis=-1)  # (B,Hkv,G,S)
+        lse = lse.reshape(B, Hq, S)
+        out = xla_attention(q, k, v, mask=mask)
+        return jnp.sum(out ** 2) + jnp.sum(jnp.sin(lse))
+
+    np.testing.assert_allclose(
+        float(f_flash(q, k, v)), float(f_ref(q, k, v)), rtol=1e-4
+    )
+    g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, n in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-3, err_msg=f"d{n}"
+        )
