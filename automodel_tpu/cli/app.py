@@ -26,6 +26,8 @@ RECIPE_ALIASES = {
     "llm_benchmark": "automodel_tpu.recipes.llm.benchmark.BenchmarkRecipe",
     "llm_kd": "automodel_tpu.recipes.llm.kd.KDRecipeForNextTokenPrediction",
     "llm_train_eagle3": "automodel_tpu.recipes.llm.train_eagle3.TrainEagle3Recipe",
+    "llm_train_eagle1": "automodel_tpu.recipes.llm.train_eagle1.TrainEagle1Recipe",
+    "llm_train_eagle2": "automodel_tpu.recipes.llm.train_eagle1.TrainEagle2Recipe",
     "dllm_train_ft": "automodel_tpu.recipes.dllm.train_ft.DiffusionLMSFTRecipe",
     "diffusion_train": "automodel_tpu.recipes.diffusion.train.TrainDiffusionRecipe",
     "vlm_finetune": "automodel_tpu.recipes.vlm.finetune.FinetuneRecipeForVLM",

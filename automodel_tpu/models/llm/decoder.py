@@ -360,7 +360,12 @@ def forward(
     if inputs_embeds is not None:
         h = inputs_embeds.astype(cfg_dtype)
     else:
-        h = jnp.take(params["embed"]["embedding"], input_ids, axis=0).astype(cfg_dtype)
+        # FSDP-unshard the table's embed dim before the gather: a gather out
+        # of a (vocab×tp, embed×dp_shard) 2-D-sharded table otherwise yields
+        # an H-on-dp_shard output the partitioner can only move to the
+        # batch-sharded activation layout via involuntary full remat
+        tbl = constrain(params["embed"]["embedding"], ("vocab", None))
+        h = jnp.take(tbl, input_ids, axis=0).astype(cfg_dtype)
     if cfg.embed_scale != 1.0:
         h = h * jnp.asarray(cfg.embed_scale, cfg_dtype)
     h = constrain(h, ("act_batch", "act_seq", "act_embed"))
@@ -436,6 +441,10 @@ def forward(
             cfg.pipeline_microbatches, remat_policy=cfg.remat_policy,
             param_logical_specs=lspecs,
         )
+        # pin the exit layout: without this the partitioner may propagate the
+        # (pp-replicated) head's weight shardings backward into the pipeline
+        # boundary and fall into involuntary full remat on the transition
+        h = constrain(h, ("act_batch", "act_seq", "act_embed"))
     else:
 
         def layer(h, lp, window):

@@ -155,8 +155,14 @@ def forward(
     return_stats: bool = False,
     return_routing: bool = False,           # stats["routing"] (Lm, B*S, K)
     routing_override: jnp.ndarray | None = None,  # replay a captured routing
+    return_aux_hidden: tuple | None = None,  # EAGLE-3 target-side capture
 ) -> tuple:
     """Returns (logits-or-hidden, aux_loss[, stats]).
+
+    `return_aux_hidden=(lo, mid, hi)` additionally captures those layers'
+    outputs (global layer indices over dense+moe layers, pre-final-norm),
+    stacked (k, B, S, H) — the EAGLE-3 aux-hidden hook (same contract as the
+    dense decoder). The first return becomes (out, aux_hidden).
 
     stats["tokens_per_expert"] is (num_moe_layers, E) — feed it to
     `apply_gate_bias_update` after the optimizer step for DeepSeek aux-free
@@ -177,7 +183,9 @@ def forward(
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
     constrain = _make_constrain(mesh_ctx, rules)
 
-    h = jnp.take(params["embed"]["embedding"], input_ids, axis=0).astype(cfg.dtype)
+    # FSDP-unshard the table's embed dim before the gather (see llm/decoder)
+    tbl = constrain(params["embed"]["embedding"], ("vocab", None))
+    h = jnp.take(tbl, input_ids, axis=0).astype(cfg.dtype)
     if cfg.embed_scale != 1.0:
         h = h * jnp.asarray(cfg.embed_scale, cfg.dtype)
     h = constrain(h, ("act_batch", "act_seq", "act_embed"))
@@ -205,17 +213,27 @@ def forward(
         )
         return h, jnp.float32(0.0)
 
-    def dense_layer(carry, lp, window):
-        h, aux, *rest = carry
+    cap_ids = tuple(return_aux_hidden) if return_aux_hidden is not None else None
+
+    def _capture(auxbuf, gidx, y):
+        for j, lid in enumerate(cap_ids):
+            auxbuf = auxbuf.at[j].set(jnp.where(gidx == lid, y, auxbuf[j]))
+        return auxbuf
+
+    def dense_layer(carry, xs, window):
+        h, aux, stats, routing, auxbuf = carry
+        lp, gidx = xs
         h, idx_aux = _attn(h, lp, window)
         h = mlp_block(h, lp, cfg, constrain)
-        return (h, aux + idx_aux, *rest)
+        if cap_ids is not None:
+            auxbuf = _capture(auxbuf, gidx, h)
+        return (h, aux + idx_aux, stats, routing, auxbuf)
 
     K = cfg.moe.experts_per_token
     replay = routing_override is not None
 
     def moe_layer(carry, xs, window):
-        h, aux, stats, routing = carry
+        h, aux, stats, routing, auxbuf = carry
         lp, idx = xs
         h, idx_aux = _attn(h, lp, window)
         aux = aux + idx_aux
@@ -232,14 +250,23 @@ def forward(
         routing = jax.lax.dynamic_update_index_in_dim(
             routing, layer_stats["indices"], idx, 0
         )
-        return (h, aux + layer_aux, stats, routing)
+        if cap_ids is not None:
+            auxbuf = _capture(auxbuf, idx + cfg.first_k_dense, h)
+        return (h, aux + layer_aux, stats, routing, auxbuf)
 
     stats0 = jnp.zeros((Lm, E), jnp.float32)
     routing0 = jnp.zeros((Lm, B * S, K), jnp.int32)
-    carry = (h, jnp.float32(0.0), stats0, routing0)
+    auxbuf0 = (
+        jnp.zeros((len(cap_ids),) + h.shape, h.dtype)
+        if cap_ids is not None
+        else jnp.zeros((0,) + h.shape, h.dtype)
+    )
+    carry = (h, jnp.float32(0.0), stats0, routing0, auxbuf0)
     if cfg.first_k_dense > 0:
         carry = scan_layers_windowed(
-            dense_layer, carry, params["dense_layers"], windows[: cfg.first_k_dense],
+            dense_layer, carry,
+            (params["dense_layers"], jnp.arange(cfg.first_k_dense)),
+            windows[: cfg.first_k_dense],
             remat_policy=cfg.remat_policy, unroll=cfg.scan_unroll,
         )
     carry = scan_layers_windowed(
@@ -248,10 +275,12 @@ def forward(
         windows[cfg.first_k_dense :],
         remat_policy=cfg.remat_policy, unroll=cfg.scan_unroll,
     )
-    h, aux_loss, tokens_per_expert, routing = carry
+    h, aux_loss, tokens_per_expert, routing, aux_hidden = carry
 
     h = rms_norm(h, params["final_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
     out = h if return_hidden else unembed(params, cfg, h)
+    if cap_ids is not None:
+        out = (out, aux_hidden)
     if return_stats:
         stats_out = {"tokens_per_expert": tokens_per_expert}
         if return_routing:

@@ -21,6 +21,8 @@ both share the gate and expert weights.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -182,15 +184,67 @@ def experts_forward_dropless(
     return jnp.zeros((T, H), dtype).at[token_of].add(contrib)
 
 
-def _dropless_ep_local(params, cfg, x, weights, indices, *, axis_name, bucket):
+def _raw_ragged_a2a(x, out, in_off, send_sz, out_off, recv_sz, axis_name):
+    """Seam over `lax.ragged_all_to_all` — tests monkeypatch this with a
+    collective emulator because XLA:CPU has no ragged-all-to-all thunk."""
+    from jax import lax
+
+    return lax.ragged_all_to_all(
+        x, out, in_off, send_sz, out_off, recv_sz, axis_name=axis_name
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8))
+def _ragged_exchange(x, in_off, send_sz, out_off, recv_sz, recv_off,
+                     back_out_off, out_rows, axis_name):
+    """Differentiable ragged all-to-all (TPU): sends x's contiguous
+    per-peer row chunks, returns an (out_rows, …) buffer with untouched rows
+    zero. The VJP runs the REVERSE ragged exchange of the cotangents — the
+    combine direction's metadata is exactly the dispatch direction's swapped.
+    """
+    out = jnp.zeros((out_rows,) + x.shape[1:], x.dtype)
+    return _raw_ragged_a2a(x, out, in_off, send_sz, out_off, recv_sz, axis_name)
+
+
+def _ragged_exchange_fwd(x, in_off, send_sz, out_off, recv_sz, recv_off,
+                         back_out_off, out_rows, axis_name):
+    out = _ragged_exchange(
+        x, in_off, send_sz, out_off, recv_sz, recv_off, back_out_off,
+        out_rows, axis_name,
+    )
+    return out, (x.shape[0], in_off, send_sz, out_off, recv_sz, recv_off,
+                 back_out_off)
+
+
+def _ragged_exchange_bwd(out_rows, axis_name, res, dout):
+    n_in, in_off, send_sz, out_off, recv_sz, recv_off, back_out_off = res
+    dx = jnp.zeros((n_in,) + dout.shape[1:], dout.dtype)
+    dx = _raw_ragged_a2a(
+        dout, dx, recv_off, recv_sz, back_out_off, send_sz, axis_name
+    )
+    return dx, None, None, None, None, None, None
+
+
+_ragged_exchange.defvjp(_ragged_exchange_fwd, _ragged_exchange_bwd)
+
+
+def _dropless_ep_local(params, cfg, x, weights, indices, *, axis_name, bucket,
+                       ragged=False):
     """Per-shard body of the EP dropless dispatch; call INSIDE shard_map.
 
     The DeepEP-semantics analog (reference: moe/megatron/fused_a2a.py:139
     `fused_dispatch`, :238 `fused_combine`; token_dispatcher.py:504): tokens
     travel to the EP rank that owns their expert and come back, with NO
-    capacity drops. NVSHMEM ragged buffers are replaced by a static
-    (ep, bucket, H) all_to_all — bucket = T_loc*K is the dropless worst case
-    (XLA:CPU has no ragged-all-to-all; on TPU the same layout rides ICI).
+    capacity drops. Two exchange layouts:
+
+    - ragged=True (TPU): `lax.ragged_all_to_all` ships exactly the routed
+      rows — wire traffic proportional to actual tokens, DeepEP's defining
+      property. Offsets ride a tiny (P,P) counts all_gather. The receive
+      buffer stays worst-case sized (P·bucket — every token in the step
+      could route here), but bytes on ICI are the ragged sizes.
+    - ragged=False (CPU fallback / dryrun): a static (ep, bucket, H)
+      all_to_all padded to the dropless worst case (XLA:CPU has no
+      ragged-all-to-all).
 
     Layout invariant: rows sorted by global expert id are grouped by owner
     rank (experts are contiguous per rank), so one stable sort serves both
@@ -219,23 +273,50 @@ def _dropless_ep_local(params, cfg, x, weights, indices, *, axis_name, bucket):
         [jnp.zeros(1, jnp.int32), jnp.cumsum(counts_peer)[:-1]]
     )
 
-    dest = jnp.minimum(expert_sorted // E_loc, P)           # sentinel → P (drop)
-    slot = jnp.arange(TK, dtype=jnp.int32) - jnp.take(
-        offsets_peer, jnp.minimum(dest, P - 1)
-    )
-    valid_send = (dest < P) & (slot < bucket)
-    flat_pos = jnp.where(valid_send, dest * bucket + slot, P * bucket)
+    if ragged:
+        # C[j, i] = rows rank j sends to rank i (tiny (P,P) metadata gather)
+        C = lax.all_gather(counts_peer, axis_name)          # (P, P)
+        recv_sz = C[:, r]                                   # from each sender
+        recv_off = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(recv_sz)[:-1]]
+        )
+        # where MY chunk lands on receiver i: after all senders j < r
+        out_off = (jnp.cumsum(C, axis=0) - C)[r]            # (P,)
+        # where my RETURN chunk lands on source i: at i's offsets_peer[r]
+        OP = lax.all_gather(offsets_peer, axis_name)        # (P, P)
+        back_out_off = OP[:, r]
+        R = P * bucket
 
-    send_x = jnp.zeros((P * bucket, H), dtype).at[flat_pos].set(xs, mode="drop")
-    send_eid = jnp.full((P * bucket,), E, jnp.int32).at[flat_pos].set(
-        expert_sorted, mode="drop"
-    )
+        recv_x = _ragged_exchange(
+            xs, offsets_peer, counts_peer, out_off, recv_sz, recv_off,
+            back_out_off, R, axis_name,
+        )
+        eid_out = jnp.full((R,), E, jnp.int32)
+        recv_eid = _raw_ragged_a2a(
+            expert_sorted.astype(jnp.int32), eid_out, offsets_peer,
+            counts_peer, out_off, recv_sz, axis_name,
+        )
+        le = recv_eid - r * E_loc                           # local expert id
+        recv_valid = (le >= 0) & (le < E_loc)
+        valid_send = expert_sorted < E
+    else:
+        dest = jnp.minimum(expert_sorted // E_loc, P)       # sentinel → P (drop)
+        slot = jnp.arange(TK, dtype=jnp.int32) - jnp.take(
+            offsets_peer, jnp.minimum(dest, P - 1)
+        )
+        valid_send = (dest < P) & (slot < bucket)
+        flat_pos = jnp.where(valid_send, dest * bucket + slot, P * bucket)
 
-    recv_x = lax.all_to_all(send_x.reshape(P, bucket, H), axis_name, 0, 0)
-    recv_eid = lax.all_to_all(send_eid.reshape(P, bucket), axis_name, 0, 0)
-    recv_x = recv_x.reshape(P * bucket, H)
-    le = recv_eid.reshape(P * bucket) - r * E_loc           # local expert id
-    recv_valid = (le >= 0) & (le < E_loc)
+        send_x = jnp.zeros((P * bucket, H), dtype).at[flat_pos].set(xs, mode="drop")
+        send_eid = jnp.full((P * bucket,), E, jnp.int32).at[flat_pos].set(
+            expert_sorted, mode="drop"
+        )
+
+        recv_x = lax.all_to_all(send_x.reshape(P, bucket, H), axis_name, 0, 0)
+        recv_eid = lax.all_to_all(send_eid.reshape(P, bucket), axis_name, 0, 0)
+        recv_x = recv_x.reshape(P * bucket, H)
+        le = recv_eid.reshape(P * bucket) - r * E_loc       # local expert id
+        recv_valid = (le >= 0) & (le < E_loc)
 
     # regroup received rows by local expert (invalid rows sort last);
     # group sizes come from the received expert ids — no extra collective
@@ -262,10 +343,17 @@ def _dropless_ep_local(params, cfg, x, weights, indices, *, axis_name, bucket):
 
     # undo the regroup sort, return rows to their source rank
     y_recv = jnp.zeros_like(y2).at[sort2].set(y2)
-    y_back = lax.all_to_all(y_recv.reshape(P, bucket, H), axis_name, 0, 0)
-    y_back = y_back.reshape(P * bucket, H)
-
-    ys = jnp.take(y_back, jnp.minimum(flat_pos, P * bucket - 1), axis=0)
+    if ragged:
+        # combine = dispatch with the metadata roles swapped; rows land back
+        # at their original sorted offsets, unsent rows stay zero
+        ys = _ragged_exchange(
+            y_recv, recv_off, recv_sz, back_out_off, counts_peer,
+            offsets_peer, out_off, TK, axis_name,
+        )
+    else:
+        y_back = lax.all_to_all(y_recv.reshape(P, bucket, H), axis_name, 0, 0)
+        y_back = y_back.reshape(P * bucket, H)
+        ys = jnp.take(y_back, jnp.minimum(flat_pos, P * bucket - 1), axis=0)
     ys = jnp.where(valid_send[:, None], ys, 0.0)
     w_sorted = jnp.take(weights.reshape(TK), sort_idx).astype(dtype)
     return jnp.zeros((T, H), dtype).at[token_of].add(ys * w_sorted[:, None])
@@ -278,6 +366,7 @@ def experts_forward_dropless_ep(
     weights: jnp.ndarray,  # (T, K)
     indices: jnp.ndarray,  # (T, K)
     mesh_ctx,
+    ragged: bool | None = None,  # None = auto (TPU yes, CPU dense fallback)
 ) -> jnp.ndarray:
     """Dropless dispatch ACROSS an ep>1 mesh axis (DeepEP semantics).
 
@@ -311,8 +400,14 @@ def experts_forward_dropless_ep(
     t_loc = t_total // (mesh_ctx.axis_size("batch") * mesh_ctx.sizes["cp"])
     bucket = max(8, t_loc * cfg.experts_per_token)
 
+    # ragged A2A ships only the routed rows (DeepEP's bandwidth property);
+    # XLA:CPU has no ragged-all-to-all, so the virtual-device mesh (tests,
+    # driver dryrun) uses the dense worst-case bucket layout instead
+    if ragged is None:
+        ragged = jax.default_backend() == "tpu"
     fn = functools.partial(
-        _dropless_ep_local, axis_name="ep", bucket=bucket, cfg=cfg
+        _dropless_ep_local, axis_name="ep", bucket=bucket, cfg=cfg,
+        ragged=ragged,
     )
     return jax.shard_map(
         lambda p, xx, ww, ii: fn(p, x=xx, weights=ww, indices=ii),

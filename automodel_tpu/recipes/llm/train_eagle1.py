@@ -1,0 +1,195 @@
+"""EAGLE-1 / EAGLE-2 speculative draft training recipes.
+
+The analog of the reference trainers (reference: nemo_automodel/recipes/llm/
+train_eagle1.py `TrainEagle1Recipe`, train_eagle2.py): same target-building
+chassis as EAGLE-3 (shared via `TrainEagle3Recipe._build_target`), but the
+drafter is the feature-regression model of speculative/eagle1.py — no TTT
+unroll, no draft-vocab compression, logits through the frozen target head.
+EAGLE-2 is the same training objective (the variants differ only in the
+serving-time draft tree), so `TrainEagle2Recipe` is an alias with its own
+recipe name for config parity.
+
+YAML:
+
+    recipe: llm_train_eagle1
+    target_model: {hf_config: {...} | pretrained_path: ...}
+    speculative:
+      num_layers: 1
+      feature_noise: 0.1
+      hidden_loss_weight: 1.0
+      token_loss_weight: 0.1
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.parallel import logical_to_shardings
+from automodel_tpu.recipes.llm.train_eagle3 import TrainEagle3Recipe
+from automodel_tpu.recipes.llm.train_ft import _DTYPES
+from automodel_tpu.speculative.eagle1 import (
+    Eagle1Config,
+    drafter_param_specs,
+    eagle1_loss,
+    init_drafter,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def _target_head_kernel(target_params):
+    """(H, V) frozen head — lm_head kernel, or tied embedding transposed."""
+    if "lm_head" in target_params:
+        return target_params["lm_head"]["kernel"]
+    return target_params["embed"]["embedding"].T
+
+
+class TrainEagle1Recipe(TrainEagle3Recipe):
+    def _build_drafter(self) -> None:
+        cfg = self.cfg
+        scfg = cfg.get("speculative")
+        t = self.target_cfg
+        g = (lambda k, d: scfg.get(k, d)) if scfg else (lambda k, d: d)
+        self.eagle_cfg = Eagle1Config(
+            vocab_size=t.vocab_size,
+            hidden_size=int(g("hidden_size", 0)) or t.hidden_size,
+            intermediate_size=int(g("intermediate_size", 0)) or t.intermediate_size,
+            num_heads=int(g("num_heads", 0)) or t.num_heads,
+            num_kv_heads=int(g("num_kv_heads", 0)) or t.num_kv_heads,
+            num_layers=int(g("num_layers", 1)),
+            rope_theta=t.rope_theta,
+            feature_noise=float(g("feature_noise", 0.1)),
+            hidden_loss_weight=float(g("hidden_loss_weight", 1.0)),
+            token_loss_weight=float(g("token_loss_weight", 0.1)),
+            dtype=_DTYPES[g("dtype", "float32")],
+        )
+        params = init_drafter(self.eagle_cfg, jax.random.key(int(cfg.get("seed", 42))))
+        if self.eagle_cfg.hidden_size == t.hidden_size:
+            params["embed"]["embedding"] = jnp.array(
+                self.target_params["embed"]["embedding"], jnp.float32, copy=True
+            )
+        dshardings = logical_to_shardings(
+            drafter_param_specs(self.eagle_cfg), self.mesh_ctx,
+            shapes=jax.tree.map(lambda p: p.shape, params),
+        )
+        self._init_params = jax.device_put(params, dshardings)
+        self.model_cfg = self.target_cfg
+        self.model_spec = self.target_spec
+        self.peft_cfg = None
+        self.is_moe = False
+
+    def _make_loss_fn(self):
+        eagle_cfg = self.eagle_cfg
+        target_cfg = self.target_cfg
+        target_module = self.target_spec.module
+        target_is_moe = self.target_is_moe
+        mesh_ctx = self.mesh_ctx
+        accum = float(self.cfg.get("dataloader.grad_acc_steps", 1))
+
+        from automodel_tpu.speculative.eagle3 import _shift_left as shift_left
+
+        def loss_fn(params, batch, rng, target_params):
+            ids = batch["input_ids"]
+            loss_mask = batch["labels"] != -100
+            kw = {}
+            for k in ("positions", "segment_ids"):
+                if k in batch:
+                    kw[k] = batch[k]
+            if target_is_moe:
+                hidden, _ = target_module.forward(
+                    target_params, target_cfg, ids, mesh_ctx=mesh_ctx,
+                    return_hidden=True, token_mask=loss_mask, **kw,
+                )
+            else:
+                hidden = target_module.forward(
+                    target_params, target_cfg, ids, mesh_ctx=mesh_ctx,
+                    return_hidden=True, **kw,
+                )
+            head = _target_head_kernel(target_params)
+            logits = jnp.einsum(
+                "bth,hv->btv", hidden, head.astype(hidden.dtype),
+                preferred_element_type=jnp.float32,
+            )
+            hidden = jax.lax.stop_gradient(hidden)
+            logits = jax.lax.stop_gradient(logits)
+
+            loss, m = eagle1_loss(
+                params, eagle_cfg,
+                shift_left(ids), hidden, shift_left(hidden),
+                shift_left(logits), head, shift_left(loss_mask),
+                rng=rng,
+                positions=kw.get("positions"),
+                segment_ids=kw.get("segment_ids"),
+            )
+            return loss, {
+                "num_label_tokens": jnp.float32(1.0),
+                "supervised_tokens": m["valid_tokens"],
+                "draft_accuracy": m["accuracy"] / accum,
+                "hidden_loss": m["hidden_loss"] / accum,
+                "token_loss": m["token_loss"] / accum,
+            }
+
+        return loss_fn
+
+    def save_consolidated_hf(self, out_dir=None):
+        """Serve-layout export (reference: draft_llama_v12.py
+        `LlamaEagleDraftModel` — model.embed_tokens / model.fc /
+        model.layers.N.* / model.norm; logits come from the target's own
+        lm_head at serve time, so none is exported)."""
+        import os
+
+        import numpy as np
+
+        from automodel_tpu.checkpoint.hf_adapter import save_hf_checkpoint
+
+        out_dir = out_dir or os.path.join(
+            self.cfg.get("checkpoint.checkpoint_dir", "checkpoints"), "hf_draft"
+        )
+        p = jax.device_get(self.train_state.params)
+        c = self.eagle_cfg
+        sd = {
+            "model.embed_tokens.weight": np.asarray(p["embed"]["embedding"]),
+            "model.fc.weight": np.asarray(p["fc"]["kernel"]).T,
+            "model.norm.weight": np.asarray(p["final_norm"]["scale"]),
+        }
+        lnames = {
+            "input_norm": "input_layernorm.weight",
+            "post_attn_norm": "post_attention_layernorm.weight",
+        }
+        for i in range(c.num_layers):
+            base = f"model.layers.{i}."
+            for jk, hk in lnames.items():
+                sd[base + hk] = np.asarray(p["layers"][jk]["scale"][i])
+            for proj in ("q", "k", "v", "o"):
+                sd[base + f"self_attn.{proj}_proj.weight"] = np.asarray(
+                    p["layers"][f"{proj}_proj"]["kernel"][i]
+                ).T
+            for proj in ("gate", "up", "down"):
+                sd[base + f"mlp.{proj}_proj.weight"] = np.asarray(
+                    p["layers"][f"{proj}_proj"]["kernel"][i]
+                ).T
+        hf_cfg = {
+            "architectures": ["LlamaEagleDraftModel"],
+            "model_type": "llama",
+            "vocab_size": c.vocab_size,
+            "hidden_size": c.hidden_size,
+            "intermediate_size": c.intermediate_size,
+            "num_attention_heads": c.num_heads,
+            "num_key_value_heads": c.num_kv_heads,
+            "head_dim": c.resolved_head_dim,
+            "num_hidden_layers": c.num_layers,
+            "draft_num_hidden_layers": c.num_layers,
+            "rope_theta": c.rope_theta,
+            "rms_norm_eps": c.rms_norm_eps,
+        }
+        save_hf_checkpoint(sd.items(), out_dir, hf_config=hf_cfg)
+        logger.info("EAGLE-1/2 drafter written to %s", out_dir)
+        return out_dir
+
+
+class TrainEagle2Recipe(TrainEagle1Recipe):
+    """EAGLE-2 trains identically to EAGLE-1 (reference: train_eagle2.py);
+    the dynamic draft tree is a serving-time concern."""

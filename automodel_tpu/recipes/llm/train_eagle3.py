@@ -51,6 +51,10 @@ logger = logging.getLogger(__name__)
 
 class TrainEagle3Recipe(TrainFinetuneRecipeForNextTokenPrediction):
     def _build_model(self) -> None:
+        self._build_target()
+        self._build_drafter()
+
+    def _build_target(self) -> None:
         cfg = self.cfg
         tcfg = cfg.get("target_model") or cfg.get("model")
         if tcfg is None:
@@ -69,11 +73,13 @@ class TrainEagle3Recipe(TrainFinetuneRecipeForNextTokenPrediction):
                 else dict(hf_config)
             )
         self.target_spec = get_model_spec(hf_config)
-        if self.target_spec.adapter_name != "dense_decoder":
+        if self.target_spec.adapter_name not in ("dense_decoder", "moe_decoder"):
             raise NotImplementedError(
-                "EAGLE-3 targets are dense decoders for now (MoE targets need "
-                "aux-hidden capture in the MoE scan)"
+                "EAGLE-3 targets must be dense or MoE decoders; got "
+                f"{self.target_spec.adapter_name}"
             )
+        self.target_is_moe = self.target_spec.adapter_name == "moe_decoder"
+        self._target_hf_config = dict(hf_config)
         self.target_cfg = self.target_spec.config_from_hf(
             hf_config, dtype=dtype, remat_policy=tcfg.get("remat_policy", "none")
         )
@@ -96,7 +102,8 @@ class TrainEagle3Recipe(TrainFinetuneRecipeForNextTokenPrediction):
             self.target_params,
         )
 
-        # -- drafter -------------------------------------------------------
+    def _build_drafter(self) -> None:
+        cfg = self.cfg
         scfg = cfg.get("speculative")
         t = self.target_cfg
         L = t.num_layers
@@ -155,7 +162,7 @@ class TrainEagle3Recipe(TrainFinetuneRecipeForNextTokenPrediction):
         self.model_cfg = self.target_cfg
         self.model_spec = self.target_spec
         self.peft_cfg = None
-        self.is_moe = False
+        self.is_moe = False  # the TRAINED model (drafter) is dense
 
     def _make_loss_fn(self):
         eagle_cfg = self.eagle_cfg
@@ -168,6 +175,8 @@ class TrainEagle3Recipe(TrainFinetuneRecipeForNextTokenPrediction):
 
         from automodel_tpu.speculative.eagle3 import _shift_left as shift_left
 
+        target_is_moe = self.target_is_moe
+
         def loss_fn(params, batch, rng, target_params):
             ids = batch["input_ids"]
             loss_mask = batch["labels"] != -100
@@ -175,12 +184,23 @@ class TrainEagle3Recipe(TrainFinetuneRecipeForNextTokenPrediction):
             for k in ("positions", "segment_ids"):
                 if k in batch:
                     kw[k] = batch[k]
-            logits, aux_h = jax.lax.stop_gradient(
-                target_module.forward(
-                    target_params, target_cfg, ids,
-                    mesh_ctx=mesh_ctx, return_aux_hidden=aux_ids, **kw,
+            if target_is_moe:
+                # MoE target forward: ((logits, aux_h), moe_aux_loss) —
+                # the balance loss belongs to the frozen target, drop it
+                (logits, aux_h), _ = jax.lax.stop_gradient(
+                    target_module.forward(
+                        target_params, target_cfg, ids,
+                        mesh_ctx=mesh_ctx, return_aux_hidden=aux_ids,
+                        token_mask=loss_mask, **kw,
+                    )
                 )
-            )
+            else:
+                logits, aux_h = jax.lax.stop_gradient(
+                    target_module.forward(
+                        target_params, target_cfg, ids,
+                        mesh_ctx=mesh_ctx, return_aux_hidden=aux_ids, **kw,
+                    )
+                )
             # drafter frame: everything shifts one step ahead of the target
             # (reference: speculative/eagle/target.py:373-379)
             loss, m = eagle3_ttt_loss(
@@ -205,6 +225,27 @@ class TrainEagle3Recipe(TrainFinetuneRecipeForNextTokenPrediction):
         return (self.target_params,)
 
     def save_consolidated_hf(self, out_dir=None):
-        raise NotImplementedError(
-            "EAGLE-3 drafter export to HF/SGLang format not implemented yet"
+        """Serve-ready drafter export: SGLang/vLLM-canonical state dict
+        (model.layers.0.* single fused layer, un-fused q/k/v, d2t offset +
+        t2d mask buffers) + drafter config.json (reference:
+        train_eagle3.py:330 `_export_merged_lora_draft`, draft_llama.py
+        layout doc)."""
+        import os
+
+        from automodel_tpu.checkpoint.hf_adapter import save_hf_checkpoint
+        from automodel_tpu.speculative.eagle3 import (
+            drafter_hf_config,
+            drafter_to_hf,
         )
+
+        out_dir = out_dir or os.path.join(
+            self.cfg.get("checkpoint.checkpoint_dir", "checkpoints"), "hf_draft"
+        )
+        params = jax.device_get(self.train_state.params)
+        sd = drafter_to_hf(params, self.eagle_cfg, self.d2t, self.t2d_mask)
+        save_hf_checkpoint(
+            sd.items(), out_dir,
+            hf_config=drafter_hf_config(self.eagle_cfg, self._target_hf_config),
+        )
+        logger.info("drafter (SGLang layout) written to %s", out_dir)
+        return out_dir

@@ -1,0 +1,206 @@
+"""EAGLE-1 / EAGLE-2 speculative draft training, TPU-native.
+
+The reference trains EAGLE-1 and EAGLE-2 with the same objective
+(reference: nemo_automodel/components/speculative/eagle/core_v12.py:84
+`forward`, recipes/llm/train_eagle{1,2}.py) — the variants differ only at
+serving time (EAGLE-2's dynamic draft tree). One training stack covers both:
+
+- Drafter: fc(concat(embed(ids), target_hidden)) → N standard pre-norm
+  decoder layers → final norm. Predicts the TARGET's next-position hidden
+  state (feature regression), full target vocab via the FROZEN target
+  lm_head — no draft-vocab compression, no TTT unroll.
+- Loss (core_v12.py:133-142): hidden_w · SmoothL1(pred, target_hidden)
+  + token_w · softCE(target_lm_head(pred), softmax(target_logits)),
+  masked to supervised positions. Defaults hidden_w=1.0, token_w=0.1.
+- Feature-noise augmentation (EAGLE paper §data aug; core_v12.py:59-67):
+  U(-noise, +noise) added to the draft's INPUT features only.
+
+JAX-native differences: the drafter is a params pytree + pure functions,
+attention runs through the shared `dot_product_attention` (flash on TPU,
+incl. packed segment ids — the reference's block-causal seq_lens path), and
+the frozen target head enters the loss as a stop_gradient'd argument instead
+of a module reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.layers import dense_init
+from automodel_tpu.ops.attention import dot_product_attention
+from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.rope import apply_rope, rope_frequencies
+
+
+@dataclasses.dataclass
+class Eagle1Config:
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: Optional[int] = None
+    num_layers: int = 1
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    feature_noise: float = 0.1
+    hidden_loss_weight: float = 1.0
+    token_loss_weight: float = 0.1
+    dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "auto"
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+
+def init_drafter(cfg: Eagle1Config, rng: jax.Array) -> dict:
+    H, I, D = cfg.hidden_size, cfg.intermediate_size, cfg.resolved_head_dim
+    L = cfg.num_layers
+    ks = jax.random.split(rng, 9)
+
+    def stack(k, shape):
+        return jnp.stack([dense_init(kk, shape) for kk in jax.random.split(k, L)])
+
+    return {
+        "embed": {"embedding": 0.02 * jax.random.normal(ks[0], (cfg.vocab_size, H))},
+        "fc": {"kernel": dense_init(ks[1], (2 * H, H))},
+        "layers": {
+            "input_norm": {"scale": jnp.ones((L, H))},
+            "q_proj": {"kernel": stack(ks[2], (H, cfg.num_heads * D))},
+            "k_proj": {"kernel": stack(ks[3], (H, cfg.num_kv_heads * D))},
+            "v_proj": {"kernel": stack(ks[4], (H, cfg.num_kv_heads * D))},
+            "o_proj": {"kernel": stack(ks[5], (cfg.num_heads * D, H))},
+            "post_attn_norm": {"scale": jnp.ones((L, H))},
+            "gate_proj": {"kernel": stack(ks[6], (H, I))},
+            "up_proj": {"kernel": stack(ks[7], (H, I))},
+            "down_proj": {"kernel": stack(ks[8], (I, H))},
+        },
+        "final_norm": {"scale": jnp.ones((H,))},
+    }
+
+
+def drafter_param_specs(cfg: Eagle1Config) -> dict:
+    return {
+        "embed": {"embedding": ("vocab", "embed")},
+        "fc": {"kernel": ("embed", None)},
+        "layers": {
+            "input_norm": {"scale": ("layers", "norm")},
+            "q_proj": {"kernel": ("layers", "embed", "heads")},
+            "k_proj": {"kernel": ("layers", "embed", "kv_heads")},
+            "v_proj": {"kernel": ("layers", "embed", "kv_heads")},
+            "o_proj": {"kernel": ("layers", "heads", "embed")},
+            "post_attn_norm": {"scale": ("layers", "norm")},
+            "gate_proj": {"kernel": ("layers", "embed", "mlp")},
+            "up_proj": {"kernel": ("layers", "embed", "mlp")},
+            "down_proj": {"kernel": ("layers", "mlp", "embed")},
+        },
+        "final_norm": {"scale": ("norm",)},
+    }
+
+
+def drafter_forward(
+    params: dict,
+    cfg: Eagle1Config,
+    input_ids: jnp.ndarray,       # (B, T)
+    target_hidden: jnp.ndarray,   # (B, T, H) features fed to the draft
+    positions: jnp.ndarray | None = None,
+    segment_ids: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Predict the next-step target hidden state per position → (B, T, H)."""
+    dtype = cfg.dtype
+    B, T = input_ids.shape
+    D = cfg.resolved_head_dim
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+    inv_freq = rope_frequencies(D, cfg.rope_theta)
+
+    e = jnp.take(params["embed"]["embedding"], input_ids, axis=0).astype(dtype)
+    h = jnp.concatenate([e, target_hidden.astype(dtype)], axis=-1)
+    h = h @ params["fc"]["kernel"].astype(dtype)
+
+    def layer(h, lp):
+        x = rms_norm(h, lp["input_norm"]["scale"], cfg.rms_norm_eps)
+        q = (x @ lp["q_proj"]["kernel"].astype(dtype)).reshape(B, T, cfg.num_heads, D)
+        k = (x @ lp["k_proj"]["kernel"].astype(dtype)).reshape(B, T, cfg.num_kv_heads, D)
+        v = (x @ lp["v_proj"]["kernel"].astype(dtype)).reshape(B, T, cfg.num_kv_heads, D)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        attn = dot_product_attention(
+            q, k, v, causal=True, segment_ids=segment_ids,
+            positions=positions, impl=cfg.attn_impl,
+        ).reshape(B, T, cfg.num_heads * D)
+        h = h + attn @ lp["o_proj"]["kernel"].astype(dtype)
+        x = rms_norm(h, lp["post_attn_norm"]["scale"], cfg.rms_norm_eps)
+        mlp = jax.nn.silu(x @ lp["gate_proj"]["kernel"].astype(dtype)) * (
+            x @ lp["up_proj"]["kernel"].astype(dtype)
+        )
+        return h + mlp @ lp["down_proj"]["kernel"].astype(dtype), None
+
+    h, _ = jax.lax.scan(layer, h, params["layers"])
+    return rms_norm(h, params["final_norm"]["scale"], cfg.rms_norm_eps)
+
+
+def smooth_l1(pred, target):
+    """SmoothL1 (beta=1), elementwise: 0.5·x² for |x|<1 else |x|−0.5."""
+    d = jnp.abs(pred.astype(jnp.float32) - target.astype(jnp.float32))
+    return jnp.where(d < 1.0, 0.5 * d * d, d - 0.5)
+
+
+def eagle1_loss(
+    draft_params: dict,
+    cfg: Eagle1Config,
+    input_ids: jnp.ndarray,       # (B, T) draft-frame (left-shifted) ids
+    input_hidden: jnp.ndarray,    # (B, T, H) target features (unshifted)
+    target_hidden: jnp.ndarray,   # (B, T, H) regression target (shifted)
+    target_logits: jnp.ndarray,   # (B, T, V) frozen-target logits (shifted)
+    lm_head_kernel: jnp.ndarray,  # (H, V) FROZEN target head
+    loss_mask: jnp.ndarray,       # (B, T) bool, draft frame
+    rng: jax.Array | None = None,
+    positions: jnp.ndarray | None = None,
+    segment_ids: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, dict]:
+    """EAGLE-1/2 training objective. Returns (loss, metrics)."""
+    if rng is not None and cfg.feature_noise > 0:
+        noise = cfg.feature_noise * (
+            2.0 * jax.random.uniform(rng, input_hidden.shape, jnp.float32) - 1.0
+        )
+        input_hidden = input_hidden + noise.astype(input_hidden.dtype)
+
+    pred = drafter_forward(
+        draft_params, cfg, input_ids, input_hidden,
+        positions=positions, segment_ids=segment_ids,
+    )
+
+    m = loss_mask.astype(jnp.float32)
+    denom = jnp.maximum(jnp.sum(m), 1.0)
+    hidden_loss = jnp.sum(
+        smooth_l1(pred, jax.lax.stop_gradient(target_hidden)).mean(-1) * m
+    ) / denom
+
+    head = jax.lax.stop_gradient(lm_head_kernel)
+    pred_logits = jnp.einsum(
+        "bth,hv->btv", pred, head.astype(pred.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    tp = jax.nn.softmax(
+        jax.lax.stop_gradient(target_logits).astype(jnp.float32), axis=-1
+    )
+    ce = -jnp.sum(tp * jax.nn.log_softmax(pred_logits, axis=-1), axis=-1)
+    token_loss = jnp.sum(ce * m) / denom
+
+    loss = cfg.hidden_loss_weight * hidden_loss + cfg.token_loss_weight * token_loss
+    correct = (
+        (jnp.argmax(pred_logits, -1) == jnp.argmax(target_logits, -1))
+        & loss_mask.astype(bool)
+    )
+    return loss, {
+        "hidden_loss": hidden_loss,
+        "token_loss": token_loss,
+        "accuracy": jnp.sum(correct) / denom,
+        "valid_tokens": denom,
+    }

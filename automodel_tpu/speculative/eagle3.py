@@ -359,6 +359,109 @@ def eagle3_ttt_loss(
     return loss_sum / weight_sum, metrics
 
 
+# ---------------------------------------------------------------------------
+# HF / SGLang export
+# ---------------------------------------------------------------------------
+#: JAX param path → serve-layout key (reference: draft_llama.py:25-45 — the
+#: canonical on-disk format SGLang's LlamaForCausalLMEagle3.load_weights and
+#: vLLM's EAGLE-3 integration consume; q/k/v stay un-fused on disk)
+_EXPORT_MAP = {
+    ("embed", "embedding"): "model.embed_tokens.weight",
+    ("fc", "kernel"): "model.fc.weight",
+    ("layer", "input_norm", "scale"): "model.layers.0.input_layernorm.weight",
+    ("layer", "hidden_norm", "scale"): "model.layers.0.hidden_norm.weight",
+    ("layer", "post_attn_norm", "scale"):
+        "model.layers.0.post_attention_layernorm.weight",
+    ("layer", "q_proj", "kernel"): "model.layers.0.self_attn.q_proj.weight",
+    ("layer", "k_proj", "kernel"): "model.layers.0.self_attn.k_proj.weight",
+    ("layer", "v_proj", "kernel"): "model.layers.0.self_attn.v_proj.weight",
+    ("layer", "o_proj", "kernel"): "model.layers.0.self_attn.o_proj.weight",
+    ("layer", "gate_proj", "kernel"): "model.layers.0.mlp.gate_proj.weight",
+    ("layer", "up_proj", "kernel"): "model.layers.0.mlp.up_proj.weight",
+    ("layer", "down_proj", "kernel"): "model.layers.0.mlp.down_proj.weight",
+    ("final_norm", "scale"): "model.norm.weight",
+    ("lm_head", "kernel"): "lm_head.weight",
+}
+
+
+def drafter_to_hf(params: dict, cfg: Eagle3Config, d2t, t2d_mask) -> dict:
+    """Drafter params → serve-layout state dict (SGLang/vLLM-loadable).
+
+    Kernels transpose to torch Linear (out, in) order. The vocab-mapping
+    buffers ship in the offset/mask forms inference engines consume
+    (reference: draft_llama.py set_vocab_mapping — `d2t[i] =
+    target_id(i) - i` for vLLM, boolean `t2d` for SGLang); without them the
+    engines silently misalign the draft vocab and acceptance collapses.
+    """
+    import numpy as np
+
+    sd = {}
+    for path, key in _EXPORT_MAP.items():
+        leaf = params
+        for p in path:
+            leaf = leaf[p]
+        arr = np.asarray(jax.device_get(leaf))
+        if path[-1] == "kernel":
+            arr = arr.T
+        sd[key] = arr
+    if cfg.draft_vocab_size < cfg.vocab_size:
+        base = np.arange(cfg.draft_vocab_size, dtype=np.int64)
+        sd["d2t"] = np.asarray(jax.device_get(d2t), np.int64) - base
+        sd["t2d"] = np.asarray(jax.device_get(t2d_mask), bool)
+    return sd
+
+
+def drafter_from_hf(read_fn, cfg: Eagle3Config) -> tuple[dict, tuple]:
+    """Serve-layout state dict → drafter params (the round-trip inverse).
+
+    `read_fn(key)` returns the named array. Returns (params, (d2t, t2d_mask));
+    the mapping pair is (None, None) when the checkpoint has no compression
+    buffers.
+    """
+    import numpy as np
+
+    params: dict = {}
+    for path, key in _EXPORT_MAP.items():
+        arr = np.asarray(read_fn(key))
+        if path[-1] == "kernel":
+            arr = arr.T
+        node = params
+        for p in path[:-1]:
+            node = node.setdefault(p, {})
+        node[path[-1]] = jnp.asarray(arr)
+    d2t = t2d = None
+    if cfg.draft_vocab_size < cfg.vocab_size:
+        off = np.asarray(read_fn("d2t"), np.int64)
+        d2t = jnp.asarray(off + np.arange(cfg.draft_vocab_size), jnp.int32)
+        t2d = jnp.asarray(np.asarray(read_fn("t2d"), bool))
+    return params, (d2t, t2d)
+
+
+def drafter_hf_config(cfg: Eagle3Config, target_hf_config: dict | None = None) -> dict:
+    """config.json for the exported drafter (architectures string kept at the
+    value SGLang dispatches on; reference: train_eagle3.py:465)."""
+    t = target_hf_config or {}
+    return {
+        "architectures": ["LlamaEagle3DraftModel"],
+        "model_type": "llama",
+        "vocab_size": cfg.vocab_size,
+        "draft_vocab_size": cfg.draft_vocab_size,
+        "hidden_size": cfg.hidden_size,
+        "intermediate_size": cfg.intermediate_size,
+        "num_attention_heads": cfg.num_heads,
+        "num_key_value_heads": cfg.num_kv_heads,
+        "head_dim": cfg.resolved_head_dim,
+        "num_hidden_layers": 1,
+        "target_hidden_size": cfg.resolved_target_hidden,
+        "num_aux_hidden_states": cfg.num_aux_hidden_states,
+        "rope_theta": cfg.rope_theta,
+        "rms_norm_eps": cfg.rms_norm_eps,
+        "max_position_embeddings": int(t.get("max_position_embeddings", 131072)),
+        "bos_token_id": t.get("bos_token_id", 1),
+        "eos_token_id": t.get("eos_token_id", 2),
+    }
+
+
 def simulated_accept_length(step_prefix_hits, step_valid) -> jnp.ndarray:
     """Expected accepted tokens per round: 1 + Σ_k hits_k/valid_k
     (reference: core.py:218 `simulated_accept_length`)."""

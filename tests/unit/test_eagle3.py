@@ -206,3 +206,138 @@ def test_target_aux_hidden_capture_matches_prefix_runs():
         np.testing.assert_allclose(
             np.asarray(aux[j]), np.asarray(aux_sub[0]), rtol=1e-5, atol=1e-6
         )
+
+
+def test_drafter_export_roundtrip(tmp_path):
+    """SGLang-layout export → import reproduces params, d2t offsets, and the
+    forward logits exactly (reference: draft_llama.py layout doc +
+    set_vocab_mapping offset/mask conventions)."""
+    from automodel_tpu.speculative.eagle3 import (
+        drafter_from_hf,
+        drafter_hf_config,
+        drafter_to_hf,
+    )
+
+    params = init_drafter(CFG, jax.random.key(0))
+    counts = jnp.arange(CFG.vocab_size, 0, -1, dtype=jnp.float32)
+    d2t, t2d = build_vocab_mapping(counts, CFG.draft_vocab_size)
+
+    sd = drafter_to_hf(params, CFG, d2t, t2d)
+    assert sd["model.layers.0.self_attn.q_proj.weight"].shape == (
+        CFG.num_heads * CFG.resolved_head_dim, 2 * CFG.hidden_size,
+    )
+    # offset convention: target_id = draft_id + d2t[draft_id]
+    assert (np.asarray(sd["d2t"]) + np.arange(CFG.draft_vocab_size)).min() >= 0
+    assert np.asarray(sd["t2d"]).sum() == CFG.draft_vocab_size
+
+    # write + reread through the real safetensors writer
+    from automodel_tpu.checkpoint.hf_adapter import save_hf_checkpoint
+
+    out = str(tmp_path / "draft")
+    save_hf_checkpoint(sd.items(), out, hf_config=drafter_hf_config(CFG))
+    import json
+    import os
+
+    from safetensors.numpy import load_file
+
+    files = [f for f in os.listdir(out) if f.endswith(".safetensors")]
+    merged = {}
+    for f in files:
+        merged.update(load_file(os.path.join(out, f)))
+    cfg_json = json.load(open(os.path.join(out, "config.json")))
+    assert cfg_json["architectures"] == ["LlamaEagle3DraftModel"]
+
+    params2, (d2t2, t2d2) = drafter_from_hf(lambda k: merged[k], CFG)
+    np.testing.assert_array_equal(np.asarray(d2t2), np.asarray(d2t))
+    np.testing.assert_array_equal(np.asarray(t2d2), np.asarray(t2d))
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(params2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=0, atol=0)
+
+
+def test_moe_target_aux_hidden_capture():
+    """MoE decoder aux-hidden capture: the last captured layer must equal the
+    pre-final-norm hidden (final-norm of it == return_hidden output)."""
+    from automodel_tpu.models.moe_lm import decoder as moe_decoder
+    from automodel_tpu.models.moe_lm.decoder import MoETransformerConfig
+    from automodel_tpu.moe.config import MoEConfig
+    from automodel_tpu.ops.norms import rms_norm
+
+    cfg = MoETransformerConfig(
+        vocab_size=64, hidden_size=16, intermediate_size=32,
+        num_layers=3, num_heads=2, num_kv_heads=1, first_k_dense=1,
+        moe=MoEConfig(
+            n_routed_experts=4, n_shared_experts=1, experts_per_token=2,
+            moe_intermediate_size=8, shared_expert_intermediate_size=8,
+        ),
+        dtype=jnp.float32, remat_policy="none", attn_impl="xla",
+    )
+    params = moe_decoder.init(cfg, jax.random.key(0))
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(1, 64, (2, 8)), jnp.int32
+    )
+    (hidden, aux_h), _ = moe_decoder.forward(
+        params, cfg, ids, return_hidden=True, return_aux_hidden=(0, 2)
+    )
+    assert aux_h.shape == (2, 2, 8, 16)
+    renormed = rms_norm(
+        aux_h[1], params["final_norm"]["scale"], cfg.rms_norm_eps,
+        cfg.zero_centered_norm,
+    )
+    np.testing.assert_allclose(
+        np.asarray(renormed), np.asarray(hidden), rtol=1e-5, atol=1e-5
+    )
+    # the two captures differ (layers actually ran in between)
+    assert float(jnp.max(jnp.abs(aux_h[0] - aux_h[1]))) > 1e-3
+
+
+def test_eagle1_loss_and_grads():
+    """EAGLE-1/2: loss composition (hidden_w·SmoothL1 + token_w·softCE),
+    finite grads, and the frozen head receiving no gradient."""
+    from automodel_tpu.speculative.eagle1 import (
+        Eagle1Config,
+        drafter_param_specs as e1_specs,
+        eagle1_loss,
+        init_drafter as e1_init,
+    )
+
+    cfg = Eagle1Config(
+        vocab_size=64, hidden_size=16, intermediate_size=32,
+        num_heads=2, num_kv_heads=1, num_layers=2, feature_noise=0.1,
+    )
+    params = e1_init(cfg, jax.random.key(0))
+    # specs cover the params tree exactly
+    flat_p = jax.tree_util.tree_flatten_with_path(params)[0]
+    flat_s = jax.tree_util.tree_flatten_with_path(
+        e1_specs(cfg), is_leaf=lambda x: isinstance(x, tuple)
+    )[0]
+    assert {jax.tree_util.keystr(k) for k, _ in flat_p} == {
+        jax.tree_util.keystr(k) for k, _ in flat_s
+    }
+
+    rng = np.random.default_rng(1)
+    B, T, H, V = 2, 8, 16, 64
+    ids = jnp.asarray(rng.integers(1, V, (B, T)), jnp.int32)
+    hid = jnp.asarray(rng.normal(size=(B, T, H)), jnp.float32)
+    tgt_hid = jnp.asarray(rng.normal(size=(B, T, H)), jnp.float32)
+    logits = jnp.asarray(rng.normal(size=(B, T, V)), jnp.float32)
+    head = jnp.asarray(rng.normal(size=(H, V)), jnp.float32)
+    mask = jnp.ones((B, T), bool).at[:, -1].set(False)
+
+    def f(p, head):
+        loss, m = eagle1_loss(
+            p, cfg, ids, hid, tgt_hid, logits, head, mask,
+            rng=jax.random.key(0),
+        )
+        return loss, m
+
+    (loss, m), grads = jax.value_and_grad(f, has_aux=True, argnums=0)(params, head)
+    assert np.isfinite(float(loss))
+    expected = (
+        cfg.hidden_loss_weight * float(m["hidden_loss"])
+        + cfg.token_loss_weight * float(m["token_loss"])
+    )
+    np.testing.assert_allclose(float(loss), expected, rtol=1e-6)
+    assert all(np.isfinite(np.asarray(g)).all() for g in jax.tree.leaves(grads))
+    # frozen head: grad wrt head must be zero (stop_gradient inside)
+    g_head = jax.grad(lambda h: f(params, h)[0])(head)
+    np.testing.assert_allclose(np.asarray(g_head), 0.0, atol=0)

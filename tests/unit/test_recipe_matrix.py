@@ -192,3 +192,68 @@ def test_distill_bi_encoder_lora(tmp_path):
     cfg.set("step_scheduler.max_steps", 3)
     _run(cfg)
     _finite(_records(tmp_path))
+
+
+def _eagle_cfg(tmp_path, recipe, target_hf, spec=None):
+    cfg = ConfigNode({
+        "recipe": recipe,
+        "seed": 3,
+        "run_dir": str(tmp_path),
+        "target_model": {"hf_config": target_hf, "dtype": "float32"},
+        "speculative": spec or {},
+        "distributed": {"dp_shard": -1},
+        "dataset": {
+            "_target_": "automodel_tpu.datasets.mock.MockDatasetConfig",
+            "num_samples": 16, "seq_len": 16,
+            "vocab_size": target_hf["vocab_size"],
+        },
+        "dataloader": {"microbatch_size": 8, "grad_acc_steps": 1},
+        "optimizer": {"name": "adamw", "lr": 1e-3},
+        "lr_scheduler": {"warmup_steps": 1, "decay_steps": 10},
+        "step_scheduler": {"max_steps": 3},
+        "checkpoint": {
+            "enabled": False, "checkpoint_dir": str(tmp_path / "ckpt"),
+        },
+    })
+    return cfg
+
+
+def test_eagle3_moe_target_and_export(tmp_path):
+    """EAGLE-3 with a MoE (qwen3-moe) target: aux-hidden capture rides the
+    MoE layer scan; the trained drafter exports in the SGLang layout."""
+    cfg = _eagle_cfg(
+        tmp_path, "llm_train_eagle3", dict(MOE_HF),
+        spec={"draft_vocab_size": 64, "ttt_steps": 2, "aux_layer_ids": [0, 1]},
+    )
+    r = _run(cfg)
+    recs = _records(tmp_path)
+    _finite(recs)
+    assert "accept_length" in recs[-1]
+    out = r.save_consolidated_hf()
+    import os
+
+    files = os.listdir(out)
+    assert "config.json" in files
+    assert any(f.endswith(".safetensors") for f in files)
+
+
+def test_eagle1_dense_target_and_export(tmp_path):
+    """EAGLE-1 feature-regression drafter trains and exports."""
+    dense_hf = {
+        "architectures": ["LlamaForCausalLM"],
+        "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "num_key_value_heads": 2,
+    }
+    cfg = _eagle_cfg(
+        tmp_path, "llm_train_eagle1", dense_hf,
+        spec={"num_layers": 1, "feature_noise": 0.1},
+    )
+    r = _run(cfg)
+    recs = _records(tmp_path)
+    _finite(recs)
+    assert "hidden_loss" in recs[-1] and "token_loss" in recs[-1]
+    out = r.save_consolidated_hf()
+    import os
+
+    assert any(f.endswith(".safetensors") for f in os.listdir(out))
