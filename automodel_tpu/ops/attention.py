@@ -49,12 +49,16 @@ def make_attention_mask(
 ) -> jnp.ndarray | None:
     """Boolean mask (B?, q_len, kv_len); True = attend."""
     masks = []
-    if causal:
+    if causal or sliding_window is not None:
         qp = q_positions if q_positions is not None else jnp.arange(q_len)
         kp = kv_positions if kv_positions is not None else jnp.arange(kv_len)
+    if causal:
         masks.append(qp[..., :, None] >= kp[..., None, :])
-        if sliding_window is not None:
-            masks.append(qp[..., :, None] - kp[..., None, :] < sliding_window)
+    if sliding_window is not None:
+        masks.append(qp[..., :, None] - kp[..., None, :] < sliding_window)
+        if not causal:
+            # bidirectional local attention: the window is two-sided
+            masks.append(kp[..., None, :] - qp[..., :, None] < sliding_window)
     if q_segment_ids is not None and kv_segment_ids is not None:
         masks.append(q_segment_ids[..., :, None] == kv_segment_ids[..., None, :])
     if not masks:

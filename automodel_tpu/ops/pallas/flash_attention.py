@@ -104,6 +104,10 @@ def _block_mask(iq, ik, qpos_col, qwin_col, kpos_row, qseg_col, kseg_row,
     if has_window:
         # qwin = qpos - window + 1 (host-computed, so `window` may be traced)
         mask = jnp.logical_and(mask, kpos_row >= qwin_col)
+        if causal_mode is None:
+            # bidirectional local attention: two-sided window. The upper
+            # bound qpos + window - 1 == 2*qpos - qwin needs no extra aux.
+            mask = jnp.logical_and(mask, kpos_row <= 2 * qpos_col - qwin_col)
     return mask
 
 
@@ -117,9 +121,15 @@ def _run_predicate(iq, ik, *, causal_mode, skip_window, block_q, block_kv):
     run = jnp.bool_(True)
     if causal_mode == "index":
         run = jnp.logical_and(run, (iq + 1) * block_q - 1 >= ik * block_kv)
-        if skip_window is not None:
+    if skip_window is not None:
+        # skip_window is only ever set for monotonic positions (qpos == kpos
+        # == arange), so the bounds hold for non-causal windows too
+        run = jnp.logical_and(
+            run, (ik + 1) * block_kv - 1 >= iq * block_q - skip_window
+        )
+        if causal_mode is None:
             run = jnp.logical_and(
-                run, (ik + 1) * block_kv - 1 >= iq * block_q - skip_window
+                run, ik * block_kv <= (iq + 1) * block_q - 1 + skip_window
             )
     return run
 

@@ -114,6 +114,10 @@ def _partial_attention_xla(q, k, v, qpos, kpos, qseg, kseg, *, scale, soft_cap, 
         mask = jnp.logical_and(mask, qpos[:, :, None] >= kpos[:, None, :])
     if window is not None:
         mask = jnp.logical_and(mask, qpos[:, :, None] - kpos[:, None, :] < window)
+        if not causal:
+            # bidirectional local attention: two-sided window (matches the
+            # flash kernel and ops/attention.py oracle)
+            mask = jnp.logical_and(mask, kpos[:, None, :] - qpos[:, :, None] < window)
     mask = jnp.logical_and(mask, qseg[:, :, None] == kseg[:, None, :])
     s = jnp.where(mask[:, None, None, :, :], s, NEG_INF)
     m = jnp.max(s, axis=-1)                      # (B,Hkv,G,S)

@@ -53,9 +53,18 @@ class TrainEagle1Recipe(TrainEagle3Recipe):
         scfg = cfg.get("speculative")
         t = self.target_cfg
         g = (lambda k, d: scfg.get(k, d)) if scfg else (lambda k, d: d)
+        if int(g("hidden_size", 0)) not in (0, t.hidden_size):
+            # The drafter's features must live in the target's hidden space:
+            # fc consumes concat(embed, target_hidden), the regression target
+            # is the target's hidden state, and logits go through the frozen
+            # target lm_head. A different width breaks all three.
+            raise ValueError(
+                "speculative.hidden_size must equal the target's hidden_size "
+                f"({t.hidden_size}) for EAGLE-1/2; got {g('hidden_size', 0)}"
+            )
         self.eagle_cfg = Eagle1Config(
             vocab_size=t.vocab_size,
-            hidden_size=int(g("hidden_size", 0)) or t.hidden_size,
+            hidden_size=t.hidden_size,
             intermediate_size=int(g("intermediate_size", 0)) or t.intermediate_size,
             num_heads=int(g("num_heads", 0)) or t.num_heads,
             num_kv_heads=int(g("num_kv_heads", 0)) or t.num_kv_heads,
@@ -67,10 +76,9 @@ class TrainEagle1Recipe(TrainEagle3Recipe):
             dtype=_DTYPES[g("dtype", "float32")],
         )
         params = init_drafter(self.eagle_cfg, jax.random.key(int(cfg.get("seed", 42))))
-        if self.eagle_cfg.hidden_size == t.hidden_size:
-            params["embed"]["embedding"] = jnp.array(
-                self.target_params["embed"]["embedding"], jnp.float32, copy=True
-            )
+        params["embed"]["embedding"] = jnp.array(
+            self.target_params["embed"]["embedding"], jnp.float32, copy=True
+        )
         dshardings = logical_to_shardings(
             drafter_param_specs(self.eagle_cfg), self.mesh_ctx,
             shapes=jax.tree.map(lambda p: p.shape, params),

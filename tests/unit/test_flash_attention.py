@@ -43,6 +43,7 @@ CASES = {
     "gqa8": {"Hq": 8, "Hkv": 2},
     "mha": {"Hq": 2, "Hkv": 2},
     "window": {"sliding_window": 100},
+    "noncausal_window": {"causal": False, "sliding_window": 100},
     "softcap": {"logits_soft_cap": 20.0},
     "scale": {"scale": 0.05},
 }
@@ -71,7 +72,18 @@ def test_fwd_packed_segments():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
 
-@pytest.mark.parametrize("name", ["causal", "gqa8", "window", "softcap"])
+def test_fwd_noncausal_window_block_skip():
+    """S=512 with window 100 and 128-blocks: kv blocks fully outside the
+    two-sided window are skipped by _run_predicate; parity proves no valid
+    block is dropped."""
+    q, k, v = _rand_qkv(jax.random.key(7), S=512)
+    kw = {"causal": False, "sliding_window": 100}
+    out = flash_attention(q, k, v, block_sizes=SMALL_BLOCKS, **kw)
+    ref = _oracle(q, k, v, **kw)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("name", ["causal", "gqa8", "window", "noncausal_window", "softcap"])
 def test_bwd_parity(name):
     kw = dict(CASES[name])
     shape_kw = {k: kw.pop(k) for k in ("Hq", "Hkv") if k in kw}
