@@ -1,16 +1,33 @@
-"""Autoregressive generation with a static KV cache (dense decoder).
+"""Autoregressive generation with a static KV cache (dense + MoE decoders).
 
 The analog of the reference's generation surfaces (reference: examples
 vlm_generate / dllm_generate; speculative target servers). TPU-native
-design: a static-shape (L, B, max_len, Hkv, D) cache; prefill runs one
-batched pass over the prompt collecting per-layer K/V as scan outputs;
-decode is a `lax.scan` over new tokens with an inner layer scan — the whole
-generate call is one jit with no dynamic shapes.
+design: static-shape caches; prefill runs one batched pass over the prompt
+collecting per-layer cache entries as scan outputs; decode is a `lax.scan`
+over new tokens with an inner layer scan — the whole generate call is one
+jit with no dynamic shapes.
 
-Scope: the dense GQA decoder (models/llm/decoder), including sliding
-windows (global/alternating per-layer patterns — gemma2/gpt-oss style) and
-attention sinks. Greedy or temperature sampling. MoE/MLA decode and batched
-beam search are next-round work.
+Attention flavors:
+- GQA: (L, B, T, Hkv, D) K/V caches, sliding windows (global/alternating
+  per-layer patterns — gemma2/gpt-oss style) and attention sinks.
+- MLA (DeepSeek V2/V3/V4 family): the cache stores the COMPRESSED per-token
+  state — the kv latent (B, T, r) plus the single shared rotated key-rope
+  head (B, T, dr) — and attention runs ABSORBED (reference:
+  deepseek_v3/model.py MLA; the absorbed decode is the standard latent-cache
+  identity): q_nope is folded through the kv up-projection's key half so
+  scores are taken in latent space, and the value half is applied after the
+  softmax. Exactly equal to materializing full k/v, at r+dr cached floats
+  per token instead of n*(dn+dr+dv). DSA models (dsa_index_topk set) decode
+  with DENSE MLA over the cache — the indexer's top-k is an efficiency
+  device for long-context scoring, not a correctness requirement at the
+  cache sizes generate targets.
+
+MoE decoders (MoETransformerConfig) run their dense-mlp prefix stack then
+the MoE stack, routing each decoded token through the gate; dispatch is
+forced dropless at decode time (exact for any token population — the
+capacity bound would depend on B·S vs B and silently drop differently).
+
+Greedy or temperature sampling. Batched beam search is later-round work.
 """
 
 from __future__ import annotations
@@ -25,6 +42,7 @@ from automodel_tpu.models.common.layers import cast_params
 from automodel_tpu.models.llm.decoder import (
     TransformerConfig,
     _dense,
+    layer_windows,
     mlp_inner,
     project_qkv,
     unembed,
@@ -78,9 +96,9 @@ def _attend(q, keys, values, mask_len, cfg, *, q_positions, window=None, sinks=N
     return o.reshape(B, Sq, Hq, D)
 
 
-def _layer_with_cache(h, lp, cfg, positions, inv_freq, cache_k, cache_v, write_at, attend_len, window=None):
-    """Run one decoder layer, writing this chunk's K/V into the cache at
-    `write_at` and attending over cache[:attend_len]."""
+def _gqa_attn_with_cache(h, lp, cfg, positions, inv_freq, cache_k, cache_v,
+                         write_at, attend_len, window=None):
+    """GQA attention sub-block with cache write; returns post-residual h."""
     B, Sq, _ = h.shape
     x = rms_norm(h, lp["input_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
     q, k, v = project_qkv(x, lp, cfg, positions, inv_freq)
@@ -94,12 +112,84 @@ def _layer_with_cache(h, lp, cfg, positions, inv_freq, cache_k, cache_v, write_a
     attn_out = _dense(attn, lp["o_proj"])
     if cfg.use_post_norms:
         attn_out = rms_norm(attn_out, lp["post_attn_out_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
-    h = h + attn_out
+    return h + attn_out, cache_k, cache_v
+
+
+def _mla_attn_with_cache(h, lp, cfg, positions, inv_freq, cache_c, cache_kr,
+                         write_at, attend_len, window=None):
+    """MLA attention sub-block over the absorbed latent cache.
+
+    cache_c (B,T,r) holds the rms-normed kv latent; cache_kr (B,T,dr) the
+    rotated shared key-rope head. Scores/values are taken in latent space by
+    folding the kv up-projection halves into q and out respectively — the
+    exact-algebra absorbed form of models/llm/mla.py `_mla_qkv` + attention.
+    """
+    B, Sq, H = h.shape
+    n = cfg.num_heads
+    dn, dr, dv = cfg.mla_qk_nope_head_dim, cfg.mla_qk_rope_head_dim, cfg.mla_v_head_dim
+    r = cfg.mla_kv_lora_rank
+    prec = cfg.linear_precision
+
+    x = rms_norm(h, lp["input_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
+    if cfg.mla_q_lora_rank:
+        q_lat = rms_norm(_mm(x, lp["q_down_proj"]["kernel"], prec), lp["q_norm"]["scale"], cfg.rms_norm_eps)
+        q = _mm(q_lat, lp["q_up_proj"]["kernel"], prec)
+    else:
+        q = _mm(x, lp["q_proj"]["kernel"], prec)
+    q = q.reshape(B, Sq, n, dn + dr)
+    q_nope, q_rope = q[..., :dn], q[..., dn:]
+    q_rope = apply_rope(q_rope, positions, inv_freq)
+
+    kv = _mm(x, lp["kv_down_proj"]["kernel"], prec)
+    c_kv, k_rope = kv[..., :r], kv[..., r:]
+    c_kv = rms_norm(c_kv, lp["kv_norm"]["scale"], cfg.rms_norm_eps)
+    k_rope = apply_rope(k_rope[:, :, None, :], positions, inv_freq)[:, :, 0, :]
+    cache_c = jax.lax.dynamic_update_slice(cache_c, c_kv.astype(cache_c.dtype), (0, write_at, 0))
+    cache_kr = jax.lax.dynamic_update_slice(cache_kr, k_rope.astype(cache_kr.dtype), (0, write_at, 0))
+
+    W = lp["kv_up_proj"]["kernel"].reshape(r, n, dn + dv)
+    w_uk, w_uv = W[..., :dn], W[..., dn:]
+    # absorbed scores: (q_nope · W_uk) · c  +  q_rope · k_rope
+    q_abs = jnp.einsum("bsnd,rnd->bsnr", q_nope, w_uk)
+    s = jnp.einsum("bsnr,btr->bnst", q_abs, cache_c, preferred_element_type=jnp.float32)
+    s = s + jnp.einsum("bsnd,btd->bnst", q_rope, cache_kr, preferred_element_type=jnp.float32)
+    scale = cfg.attn_scale if cfg.attn_scale is not None else (dn + dr) ** -0.5
+    s = s * scale
+    T = cache_c.shape[1]
+    kv_idx = jnp.arange(T)
+    mask = kv_idx[None, :] <= positions[:, :, None]
+    mask = jnp.logical_and(mask, (kv_idx < attend_len)[None, None, :])
+    if window is not None:
+        # window==0 → global (same per-layer convention as the GQA path)
+        dist = positions[:, :, None] - kv_idx[None, None, :]
+        mask = jnp.logical_and(mask, (window == 0) | (dist < window))
+    s = jnp.where(mask[:, None, :, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out_lat = jnp.einsum("bnst,btr->bsnr", p.astype(cache_c.dtype), cache_c)
+    attn = jnp.einsum("bsnr,rnd->bsnd", out_lat, w_uv).reshape(B, Sq, n * dv)
+    h = h + _dense(attn, {"kernel": lp["o_proj"]["kernel"]}, prec)
+    return h, cache_c, cache_kr
+
+
+def _dense_mlp(h, lp, cfg):
     x = rms_norm(h, lp["post_attn_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
     mlp_out = _mm(mlp_inner(x, lp, cfg), lp["down_proj"]["kernel"], cfg.linear_precision)
     if cfg.use_post_norms:
         mlp_out = rms_norm(mlp_out, lp["post_mlp_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
-    return h + mlp_out, cache_k, cache_v
+    return h + mlp_out
+
+
+def _moe_mlp(h, lp, cfg):
+    from automodel_tpu.moe.layer import moe_forward
+
+    # force dropless dispatch: the capacity dispatcher's bound depends on the
+    # token population (B·S in a full forward vs B in one decode step), so a
+    # capacity-trained config would silently drop differently-routed tokens
+    # at decode time; dropless is exact for any population
+    moe_cfg = dataclasses.replace(cfg.moe, dispatcher="dropless")
+    x = rms_norm(h, lp["post_attn_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
+    moe_out, _aux, _stats = moe_forward(lp["moe"], moe_cfg, x, lambda a, ax: a)
+    return h + moe_out
 
 
 def _embed(params, cfg, ids):
@@ -107,6 +197,31 @@ def _embed(params, cfg, ids):
     if cfg.embed_scale != 1.0:
         h = h * jnp.asarray(cfg.embed_scale, cfg.dtype)
     return h
+
+
+def _cache_shapes(cfg, L, B, T):
+    """Per-stack cache arrays; a (kind, *arrays) tuple rides the scans."""
+    if cfg.attention_type == "mla":
+        return (
+            jnp.zeros((L, B, T, cfg.mla_kv_lora_rank), cfg.dtype),
+            jnp.zeros((L, B, T, cfg.mla_qk_rope_head_dim), cfg.dtype),
+        )
+    D = cfg.resolved_head_dim
+    return (
+        jnp.zeros((L, B, T, cfg.num_kv_heads, D), cfg.dtype),
+        jnp.zeros((L, B, T, cfg.num_kv_heads, D), cfg.dtype),
+    )
+
+
+def _attn_with_cache(h, lp, cfg, positions, inv_freq, c0, c1, write_at, attend_len, window):
+    if cfg.attention_type == "mla":
+        return _mla_attn_with_cache(
+            h, lp, cfg, positions, inv_freq, c0, c1, write_at, attend_len,
+            window=window,
+        )
+    return _gqa_attn_with_cache(
+        h, lp, cfg, positions, inv_freq, c0, c1, write_at, attend_len, window=window
+    )
 
 
 @partial(jax.jit, static_argnames=("cfg", "gen"))
@@ -118,12 +233,10 @@ def generate(
     gen: GenerateConfig = GenerateConfig(),
 ) -> jnp.ndarray:
     """Returns (B, S_prompt + max_new_tokens) token ids."""
-    if cfg.attention_type != "gqa":
-        raise NotImplementedError("generate: MLA decode cache lands with DSA (r3)")
     params = cast_params(params, cfg.dtype)
     B, S = input_ids.shape
     T = S + gen.max_new_tokens
-    D = cfg.resolved_head_dim
+    is_moe = getattr(cfg, "moe", None) is not None
     inv_freq = rope_frequencies(cfg.rope_dim, cfg.rope_theta, cfg.rope_scaling)
     if cfg.rope_local_theta is not None:
         # gemma3: sliding layers rotate with the unscaled local theta; the
@@ -132,36 +245,49 @@ def generate(
         freq_for_win = lambda win: jnp.where(win > 0, inv_freq_local, inv_freq)
     else:
         freq_for_win = lambda win: inv_freq
-    L = jax.tree.leaves(params["layers"])[0].shape[0]
 
-    from automodel_tpu.models.llm.decoder import layer_windows
+    # (stack_params, mlp_fn, L) per stack: dense decoder has one; MoE
+    # decoders run first_k_dense dense layers then the MoE stack
+    if is_moe:
+        stacks = []
+        if cfg.first_k_dense > 0:
+            stacks.append((params["dense_layers"], _dense_mlp, cfg.first_k_dense))
+        stacks.append((params["moe_layers"], _moe_mlp, cfg.num_moe_layers))
+    else:
+        L = jax.tree.leaves(params["layers"])[0].shape[0]
+        stacks = [(params["layers"], _dense_mlp, L)]
 
-    # per-layer sliding windows ride the layer scans as an (L,) array
-    # (0 = global) so alternating-window models (gemma2/gpt-oss) decode
-    # without per-layer python dispatch
-    windows = jnp.asarray(
-        [w or 0 for w in layer_windows(cfg, L)], jnp.int32
-    )
+    all_windows = [w or 0 for w in layer_windows(cfg, sum(s[2] for s in stacks))]
+    caches = []
+    stack_windows = []
+    off = 0
+    for _, _, L in stacks:
+        caches.append(_cache_shapes(cfg, L, B, T))
+        stack_windows.append(jnp.asarray(all_windows[off : off + L], jnp.int32))
+        off += L
 
-    cache_shape = (L, B, T, cfg.num_kv_heads, D)
-    cache_k = jnp.zeros(cache_shape, cfg.dtype)
-    cache_v = jnp.zeros(cache_shape, cfg.dtype)
+    def run_stacks(h, positions, caches, write_at, attend_len):
+        new_caches = []
+        for (sp, mlp_fn, _), (c0, c1), wins in zip(stacks, caches, stack_windows):
+
+            def one_layer(carry, xs, mlp_fn=mlp_fn):
+                (h,) = carry
+                lp, cc0, cc1, win = xs
+                h, cc0, cc1 = _attn_with_cache(
+                    h, lp, cfg, positions, freq_for_win(win), cc0, cc1,
+                    write_at, attend_len, win,
+                )
+                h = mlp_fn(h, lp, cfg)
+                return (h,), (cc0, cc1)
+
+            (h,), (c0, c1) = jax.lax.scan(one_layer, (h,), (sp, c0, c1, wins))
+            new_caches.append((c0, c1))
+        return h, new_caches
 
     # -- prefill: one batched pass over the prompt --------------------------
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
     h = _embed(params, cfg, input_ids)
-
-    def prefill_layer(carry, xs):
-        h, = carry
-        lp, ck, cv, win = xs
-        h, ck, cv = _layer_with_cache(
-            h, lp, cfg, positions, freq_for_win(win), ck, cv, 0, S, window=win
-        )
-        return (h,), (ck, cv)
-
-    (h,), (cache_k, cache_v) = jax.lax.scan(
-        prefill_layer, (h,), (params["layers"], cache_k, cache_v, windows)
-    )
+    h, caches = run_stacks(h, positions, caches, 0, S)
     h_last = rms_norm(h[:, -1:], params["final_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
     logits = unembed(params, cfg, h_last)[:, 0]
 
@@ -178,22 +304,11 @@ def generate(
 
     # -- decode loop ---------------------------------------------------------
     def decode_step(carry, step):
-        token, done, cache_k, cache_v, key = carry
+        token, done, caches, key = carry
         pos = S + step  # position of `token` in the sequence
         positions = jnp.broadcast_to(pos[None, None], (B, 1)).astype(jnp.int32)
         h = _embed(params, cfg, token[:, None])
-
-        def layer(carry, xs):
-            h, = carry
-            lp, ck, cv, win = xs
-            h, ck, cv = _layer_with_cache(
-                h, lp, cfg, positions, freq_for_win(win), ck, cv, pos, pos + 1, window=win
-            )
-            return (h,), (ck, cv)
-
-        (h,), (cache_k, cache_v) = jax.lax.scan(
-            layer, (h,), (params["layers"], cache_k, cache_v, windows)
-        )
+        h, caches = run_stacks(h, positions, caches, pos, pos + 1)
         h = rms_norm(h, params["final_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
         logits = unembed(params, cfg, h)[:, 0]
         key, sub = jax.random.split(key)
@@ -202,11 +317,11 @@ def generate(
             # static shapes: after EOS, keep emitting EOS (HF-style padding)
             next_token = jnp.where(done, eos, next_token)
             done = jnp.logical_or(done, next_token == eos)
-        return (next_token, done, cache_k, cache_v, key), token
+        return (next_token, done, caches, key), token
 
-    (last, _, _, _, _), tokens = jax.lax.scan(
+    (last, _, _, _), tokens = jax.lax.scan(
         decode_step,
-        (first, done0, cache_k, cache_v, rng),
+        (first, done0, caches, rng),
         jnp.arange(gen.max_new_tokens - 1) if gen.max_new_tokens > 1 else jnp.arange(0),
     )
     new_tokens = (

@@ -695,9 +695,88 @@ class Qwen3NextAdapter:
         return params
 
     def to_hf(self, params):
-        raise NotImplementedError(
-            "qwen3-next export to HF format not implemented yet (from_hf only)"
-        )
+        """Yield (hf_name, tensor) — the exact inverse of from_hf, so a
+        trained model round-trips back into Qwen3NextForCausalLM layout."""
+        import numpy as np
+
+        cfg = self.cfg
+
+        def _t(x):
+            return np.ascontiguousarray(np.asarray(x).T)
+
+        yield "model.embed_tokens.weight", np.asarray(params["embed"]["embedding"])
+        yield "model.norm.weight", np.asarray(params["final_norm"]["scale"])
+        if not cfg.tie_word_embeddings:
+            yield "lm_head.weight", _t(params["lm_head"]["kernel"])
+
+        L = cfg.num_layers
+        for i in range(L):
+            yield (
+                f"model.layers.{i}.input_layernorm.weight",
+                np.asarray(params["input_norms"]["scale"][i]),
+            )
+            yield (
+                f"model.layers.{i}.post_attention_layernorm.weight",
+                np.asarray(params["post_norms"]["scale"][i]),
+            )
+
+        lin_ids = [i for i, t in enumerate(cfg.layer_types) if t == "linear_attention"]
+        full_ids = [i for i, t in enumerate(cfg.layer_types) if t == "full_attention"]
+
+        gdn = params["gdn_layers"]
+        for j, i in enumerate(lin_ids):
+            g = f"model.layers.{i}.linear_attn."
+            yield g + "in_proj_qkvz.weight", _t(gdn["in_proj_qkvz"]["kernel"][j])
+            yield g + "in_proj_ba.weight", _t(gdn["in_proj_ba"]["kernel"][j])
+            # ours (K, C) depthwise → HF conv1d.weight (C, 1, K)
+            yield g + "conv1d.weight", np.ascontiguousarray(
+                np.asarray(gdn["conv"]["kernel"][j]).T[:, None, :]
+            )
+            yield g + "dt_bias", np.asarray(gdn["dt_bias"][j])
+            yield g + "A_log", np.asarray(gdn["A_log"][j])
+            yield g + "norm.weight", np.asarray(gdn["norm"]["scale"][j])
+            yield g + "out_proj.weight", _t(gdn["out_proj"]["kernel"][j])
+
+        attn = params["attn_layers"]
+        for j, i in enumerate(full_ids):
+            a = f"model.layers.{i}.self_attn."
+            for proj in ("q_proj", "k_proj", "v_proj", "o_proj"):
+                yield a + f"{proj}.weight", _t(attn[proj]["kernel"][j])
+            yield a + "q_norm.weight", np.asarray(attn["q_norm"]["scale"][j])
+            yield a + "k_norm.weight", np.asarray(attn["k_norm"]["scale"][j])
+
+        mlp = params["mlp_layers"]
+        if cfg.moe is not None:
+            moe = mlp["moe"]
+            E = cfg.moe.n_routed_experts
+            for i in range(L):
+                m = f"model.layers.{i}.mlp."
+                yield m + "gate.weight", _t(moe["gate"]["weight"][i])
+                for proj in ("gate_proj", "up_proj", "down_proj"):
+                    w = np.asarray(moe["experts"][proj]["kernel"][i])
+                    for e in range(E):
+                        yield (
+                            f"model.layers.{i}.mlp.experts.{e}.{proj}.weight",
+                            np.ascontiguousarray(w[e].T),
+                        )
+                if cfg.moe.n_shared_experts:
+                    for proj in ("gate_proj", "up_proj", "down_proj"):
+                        yield (
+                            m + f"shared_expert.{proj}.weight",
+                            _t(moe["shared"][proj]["kernel"][i]),
+                        )
+                    if cfg.moe.shared_expert_gated:
+                        yield (
+                            m + "shared_expert_gate.weight",
+                            _t(moe["shared"]["gate"]["kernel"][i]),
+                        )
+        else:
+            for i in range(L):
+                for proj in ("gate_proj", "up_proj", "down_proj"):
+                    yield (
+                        f"model.layers.{i}.mlp.{proj}.weight",
+                        _t(mlp[proj]["kernel"][i]),
+                    )
 
 
 def _register_adapter():

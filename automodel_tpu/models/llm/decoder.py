@@ -33,6 +33,12 @@ from automodel_tpu.ops.attention import dot_product_attention
 from automodel_tpu.ops.norms import rms_norm
 from automodel_tpu.ops.rope import RopeScalingConfig, apply_rope, rope_frequencies
 
+#: Attention here is position-causal everywhere (ring attention under cp,
+#: position/segment masks otherwise), so a permuted sequence layout — the
+#: CP load-balanced head/tail ordering — is numerically transparent. Order-
+#: sensitive modules (SSM/linear-attention hybrids) must NOT set this.
+CP_PERMUTATION_SAFE = True
+
 
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:
@@ -99,6 +105,12 @@ class TransformerConfig:
     scan_unroll: int = 1
     attn_impl: str = "auto"
     pipeline_microbatches: int = 2  # used when the mesh has pp > 1
+    # "gpipe": forward pipeline_layers + autodiff (stashes all M microbatch
+    # boundary activations). "1f1b": explicit fwd/bwd interleave with the
+    # 1F1B memory bound (≤ pp stashed microbatches per stage) — training
+    # only, routed via make_pp_1f1b_loss_and_grad (reference: distributed/
+    # pipelining/functional.py:777 schedule builder).
+    pipeline_schedule: str = "gpipe"
     linear_precision: Optional[str] = None  # None | "fp8" | "int8"
 
     @property
@@ -316,6 +328,176 @@ def param_specs(cfg: TransformerConfig) -> dict:
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
+def _pp_layer_setup(layers_params, cfg: TransformerConfig, mesh_ctx, freq_for):
+    """Shared setup for both pipeline schedules: the per-stage layer fn plus
+    the (possibly window-augmented) scanned layer pytree and its logical
+    specs. Returns (layers_in, lspecs, pl_layer, uniform_windows).
+
+    Inside the pipeline shard_map, tp is explicit: each tp rank holds a
+    head/mlp slice, so the layer cfg carries the LOCAL counts and the layer
+    fn psums partial o/down projections over tp (manual=True mode).
+    """
+    windows = layer_windows(cfg)
+    if cfg.attention_type == "mla" and (
+        mesh_ctx.sizes["tp"] > 1 or mesh_ctx.sizes["cp"] > 1
+    ):
+        raise NotImplementedError(
+            "pp×tp / pp×cp with MLA attention: the manual-collective "
+            "layer mode is implemented for standard GQA attention only"
+        )
+    tp = mesh_ctx.sizes["tp"]
+    if tp > 1:
+        if (cfg.num_heads % tp or cfg.num_kv_heads % tp
+                or cfg.intermediate_size % tp):
+            raise ValueError(
+                f"pp×tp needs num_heads={cfg.num_heads}, "
+                f"num_kv_heads={cfg.num_kv_heads}, "
+                f"intermediate_size={cfg.intermediate_size} divisible by tp={tp}"
+            )
+        cfg_pl = dataclasses.replace(
+            cfg,
+            num_heads=cfg.num_heads // tp,
+            num_kv_heads=cfg.num_kv_heads // tp,
+            intermediate_size=cfg.intermediate_size // tp,
+            head_dim=cfg.resolved_head_dim,  # pin before num_heads changes
+        )
+    else:
+        cfg_pl = cfg
+
+    layers_in = layers_params
+    lspecs = param_specs(cfg)["layers"]
+    if len(set(windows)) == 1:
+
+        def pl_layer(hh, lp, pos, sg):
+            return _decoder_layer(
+                hh, lp, cfg_pl, pos, sg, freq_for(windows[0]),
+                lambda x, axes: x, windows[0], mesh_ctx, manual=True,
+            )
+
+        return layers_in, lspecs, pl_layer, True
+
+    # mixed per-layer windows inside the pipeline: the window value and its
+    # rope freq table ride the scanned layer pytree (windows are static per
+    # layer; only the stage scan makes them traced — the flash kernel folds
+    # a traced window into its qwin aux array)
+    win_arr, freq_arr = mixed_window_xs(windows, freq_for)
+    layers_in = dict(layers_in, _window=win_arr, _freq=freq_arr)
+    lspecs = dict(lspecs, _window=("layers",), _freq=("layers", None))
+
+    def pl_layer(hh, lp, pos, sg):
+        lp = dict(lp)
+        w = lp.pop("_window")
+        fr = lp.pop("_freq")
+        return _decoder_layer(
+            hh, lp, cfg_pl, pos, sg, fr, lambda x, axes: x, w,
+            mesh_ctx, manual=True,
+        )
+
+    return layers_in, lspecs, pl_layer, False
+
+
+def make_pp_1f1b_loss_and_grad(cfg: TransformerConfig, mesh_ctx, chunk_size: int = 1024):
+    """Explicit 1F1B value-and-grad for the dense decoder — the training-path
+    analog of `forward` + autodiff under pp, with the 1F1B memory bound (at
+    most pp stashed microbatch inputs per stage instead of all M boundary
+    activations; reference schedule zoo: distributed/pipelining/
+    functional.py:777 — here the schedule is precomputed action tables inside
+    one lax.scan, parallel/pp.py:219).
+
+    Returns grad_fn(params, batch, rng) -> (grads, ce_sum, aux) pluggable
+    into training.make_train_step(grad_fn=...). The head (final norm +
+    lm-head/tied-embed + fused linear CE) runs fused into the last stage's
+    backward so logits are never materialized.
+    """
+    from automodel_tpu.loss import fused_linear_cross_entropy
+    from automodel_tpu.parallel.pp import pipeline_train_1f1b
+
+    tie = cfg.tie_word_embeddings
+
+    def grad_fn(params, batch, rng):
+        del rng  # no dropout in the decoder
+        ids = batch["input_ids"]
+        labels = batch["labels"]
+        B, S = ids.shape
+        positions = batch.get("positions")
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(S, dtype=jnp.int32)[None, :], (B, S)
+            )
+        seg = batch.get("segment_ids")
+        if seg is None:
+            seg = jnp.zeros_like(positions)
+
+        inv_freq = rope_frequencies(cfg.rope_dim, cfg.rope_theta, cfg.rope_scaling)
+        freq_for = make_freq_for(cfg, inv_freq)
+        from automodel_tpu.models.common.layers import cast_params
+
+        def cast_layer(fn):
+            def wrapped(hh, lp, pos, sg):
+                return fn(hh, cast_params(lp, cfg.dtype), pos, sg)
+
+            return wrapped
+
+        layers_in, lspecs, pl_layer, uniform = _pp_layer_setup(
+            params["layers"], cfg, mesh_ctx, freq_for
+        )
+        if not uniform:
+            raise NotImplementedError(
+                "pipeline_schedule=1f1b with mixed per-layer sliding windows "
+                "(the window aux arrays are non-differentiable scan inputs); "
+                "use gpipe for this model"
+            )
+        pl_layer = cast_layer(pl_layer)
+
+        def embed_fwd(embed_p):
+            tbl = embed_p["embedding"].astype(cfg.dtype)
+            h = jnp.take(tbl, ids, axis=0)
+            if cfg.embed_scale != 1.0:
+                h = h * jnp.asarray(cfg.embed_scale, cfg.dtype)
+            return h
+
+        h, embed_vjp = jax.vjp(embed_fwd, params["embed"])
+
+        head = {"final_norm": params["final_norm"]}
+        if tie:
+            head["embed"] = params["embed"]
+        else:
+            head["lm_head"] = params["lm_head"]
+
+        def head_loss(h_mb, head_p, labels_mb):
+            hh = rms_norm(
+                h_mb, head_p["final_norm"]["scale"], cfg.rms_norm_eps,
+                cfg.zero_centered_norm,
+            )
+            kernel = (
+                head_p["embed"]["embedding"].T
+                if tie
+                else head_p["lm_head"]["kernel"]
+            )
+            ce, _ = fused_linear_cross_entropy(
+                hh, kernel.astype(hh.dtype), labels_mb, chunk_size=chunk_size,
+                logits_soft_cap=cfg.logits_soft_cap,
+            )
+            return ce
+
+        loss, dh, gl, gh = pipeline_train_1f1b(
+            h, positions, seg, labels, layers_in, pl_layer, head, head_loss,
+            mesh_ctx, cfg.pipeline_microbatches, param_logical_specs=lspecs,
+        )
+        (d_embed,) = embed_vjp(dh.astype(h.dtype))
+        grads = {"layers": gl, "final_norm": gh["final_norm"]}
+        if tie:
+            grads["embed"] = jax.tree.map(jnp.add, d_embed, gh["embed"])
+        else:
+            grads["embed"] = d_embed
+            grads["lm_head"] = gh["lm_head"]
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        n = jnp.sum((labels != -100).astype(jnp.float32))
+        return grads, loss, {"num_label_tokens": n}
+
+    return grad_fn
+
+
 def _dense(x, p, precision=None):
     from automodel_tpu.ops.quant import matmul
 
@@ -376,65 +558,12 @@ def forward(
     if mesh_ctx is not None and mesh_ctx.sizes["pp"] > 1:
         from automodel_tpu.parallel.pp import pipeline_layers
 
-        windows = layer_windows(cfg)
         if return_aux_hidden is not None:
             raise NotImplementedError("aux-hidden capture inside the pp pipeline")
-        if cfg.attention_type == "mla" and (
-            mesh_ctx.sizes["tp"] > 1 or mesh_ctx.sizes["cp"] > 1
-        ):
-            raise NotImplementedError(
-                "pp×tp / pp×cp with MLA attention: the manual-collective "
-                "layer mode is implemented for standard GQA attention only"
-            )
         seg = segment_ids if segment_ids is not None else jnp.zeros_like(positions)
-
-        # inside the pipeline shard_map, tp is explicit: each tp rank holds a
-        # head/mlp slice, so the layer cfg carries the LOCAL counts
-        tp = mesh_ctx.sizes["tp"]
-        if tp > 1:
-            if (cfg.num_heads % tp or cfg.num_kv_heads % tp
-                    or cfg.intermediate_size % tp):
-                raise ValueError(
-                    f"pp×tp needs num_heads={cfg.num_heads}, "
-                    f"num_kv_heads={cfg.num_kv_heads}, "
-                    f"intermediate_size={cfg.intermediate_size} divisible by tp={tp}"
-                )
-            cfg_pl = dataclasses.replace(
-                cfg,
-                num_heads=cfg.num_heads // tp,
-                num_kv_heads=cfg.num_kv_heads // tp,
-                intermediate_size=cfg.intermediate_size // tp,
-                head_dim=cfg.resolved_head_dim,  # pin before num_heads changes
-            )
-        else:
-            cfg_pl = cfg
-
-        layers_in = params["layers"]
-        lspecs = param_specs(cfg)["layers"]
-        if len(set(windows)) == 1:
-
-            def pl_layer(hh, lp, pos, sg):
-                return _decoder_layer(
-                    hh, lp, cfg_pl, pos, sg, freq_for(windows[0]),
-                    lambda x, axes: x, windows[0], mesh_ctx, manual=True,
-                )
-        else:
-            # mixed per-layer windows inside the pipeline: the window value
-            # and its rope freq table ride the scanned layer pytree (windows
-            # are static per layer; only the stage scan makes them traced —
-            # the flash kernel folds a traced window into its qwin aux array)
-            win_arr, freq_arr = mixed_window_xs(windows, freq_for)
-            layers_in = dict(layers_in, _window=win_arr, _freq=freq_arr)
-            lspecs = dict(lspecs, _window=("layers",), _freq=("layers", None))
-
-            def pl_layer(hh, lp, pos, sg):
-                lp = dict(lp)
-                w = lp.pop("_window")
-                fr = lp.pop("_freq")
-                return _decoder_layer(
-                    hh, lp, cfg_pl, pos, sg, fr, lambda x, axes: x, w,
-                    mesh_ctx, manual=True,
-                )
+        layers_in, lspecs, pl_layer, _ = _pp_layer_setup(
+            params["layers"], cfg, mesh_ctx, freq_for
+        )
 
         h = pipeline_layers(
             h, positions, seg, layers_in, pl_layer, mesh_ctx,

@@ -41,6 +41,12 @@ from automodel_tpu.moe.layer import init_moe, moe_forward, moe_param_specs
 from automodel_tpu.ops.norms import rms_norm
 from automodel_tpu.ops.rope import rope_frequencies
 
+#: Attention (incl. MLA/DSA) masks by position/segment and MoE routing is
+#: per-token, so the CP load-balanced permuted layout is transparent —
+#: EXCEPT the MTP head, which shifts in layout order; the recipe gates the
+#: permutation on mtp_num_layers == 0.
+CP_PERMUTATION_SAFE = True
+
 
 @dataclasses.dataclass(frozen=True)
 class MoETransformerConfig(TransformerConfig):

@@ -40,6 +40,24 @@ from automodel_tpu.distributed.mesh import MeshContext
 logger = logging.getLogger(__name__)
 
 
+def _check_microbatch_split(B: int, M: int, mesh_ctx, batch_axes) -> None:
+    """The microbatch dim splits the GLOBAL batch, and each microbatch is
+    still sharded over the data axes — so B must divide by M·dp_total.
+    Validate eagerly with an actionable message (the raw shard_map
+    divisibility error names in_specs, not the config knobs)."""
+    if B % M != 0:
+        raise ValueError(f"batch {B} must divide into {M} pipeline microbatches")
+    dp_total = 1
+    for ax in batch_axes:
+        dp_total *= mesh_ctx.sizes.get(ax, 1)
+    if (B // M) % dp_total != 0:
+        raise ValueError(
+            f"per-microbatch batch {B}//{M}={B // M} must be divisible by the "
+            f"data-parallel extent {dp_total} ({'×'.join(batch_axes)}); raise "
+            "dataloader.microbatch_size or lower pipeline_microbatches"
+        )
+
+
 def pipeline_bubble_fraction(num_microbatches: int, num_stages: int) -> float:
     """Idle fraction of the schedule span — (P-1)/(M+P-1) for both GPipe
     and non-interleaved 1F1B (1F1B buys memory, not bubble)."""
@@ -71,7 +89,7 @@ def pipeline_layers(
     pp = mesh_ctx.sizes["pp"]
     B, S, H = h.shape
     M = num_microbatches
-    assert B % M == 0, f"batch {B} must divide into {M} microbatches"
+    _check_microbatch_split(B, M, mesh_ctx, batch_axes)
     L = jax.tree.leaves(stacked_params)[0].shape[0]
     assert L % pp == 0, f"{L} layers not divisible by pp={pp}"
     logger.info(
@@ -246,7 +264,7 @@ def pipeline_train_1f1b(
     pp = mesh_ctx.sizes["pp"]
     B, S, H = h.shape
     M = num_microbatches
-    assert B % M == 0, f"batch {B} must divide into {M} microbatches"
+    _check_microbatch_split(B, M, mesh_ctx, batch_axes)
     fwd_tab, bwd_tab = one_f_one_b_tables(M, pp)
     T = fwd_tab.shape[0]
     logger.info(

@@ -190,6 +190,15 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         )
         if mcfg.get("linear_precision", None):
             overrides["linear_precision"] = mcfg.get("linear_precision")
+        # pipeline knobs live in the distributed section (reference:
+        # PipelineConfig under DistributedSetup) but a model-level override
+        # wins; schedule: "gpipe" (default) | "1f1b"
+        dist_node = cfg.get("distributed")
+        for k, conv in (("pipeline_microbatches", int), ("pipeline_schedule", str)):
+            v = dist_node.get(k) if dist_node is not None and k in dist_node else None
+            v = mcfg.get(k, v)
+            if v is not None:
+                overrides[k] = conv(v)
 
         pretrained = mcfg.get("pretrained_path", None)
         if pretrained:
@@ -337,10 +346,12 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                 "sees LoRA params); use peft.quantize_base=int8 (QLoRA) for "
                 "a quantized base model instead"
             )
+        grad_fn = self._make_grad_fn()
         self._train_step = jax.jit(
             make_train_step(
                 loss_fn, self.tx, self.lr_schedule, step_cfg,
                 param_transform=qat_cfg.make_param_transform(),
+                grad_fn=grad_fn,
             ),
             donate_argnums=0,
         )
@@ -405,6 +416,34 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
 
         return loss_fn
 
+    def _make_grad_fn(self):
+        """Explicit-gradient path: `distributed.pipeline_schedule: 1f1b`
+        routes training through the 1F1B interleave (decoder.
+        make_pp_1f1b_loss_and_grad) instead of autodiff over the GPipe
+        forward. Returns None for every other configuration."""
+        if (
+            self.mesh_ctx.sizes["pp"] <= 1
+            or getattr(self.model_cfg, "pipeline_schedule", "gpipe") != "1f1b"
+        ):
+            return None
+        for blocker, why in (
+            (self.is_moe, "MoE decoders"),
+            (self.peft_cfg is not None, "PEFT/LoRA"),
+            (self.cfg.get("qat.enabled", False), "QAT"),
+        ):
+            if blocker:
+                raise NotImplementedError(
+                    f"pipeline_schedule=1f1b does not yet support {why}; "
+                    "use the default gpipe schedule"
+                )
+        from automodel_tpu.models.llm.decoder import make_pp_1f1b_loss_and_grad
+
+        logger.info("pipeline schedule: 1f1b (explicit fwd/bwd interleave)")
+        return make_pp_1f1b_loss_and_grad(
+            self.model_cfg, self.mesh_ctx,
+            chunk_size=int(self.cfg.get("loss.chunk_size", 1024)),
+        )
+
     # ------------------------------------------------------------------
     def _build_tokenizer(self):
         """Optional `tokenizer:` section → HF tokenizer with pad defaulting
@@ -439,12 +478,42 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         ss_cfg = _dataclass_from_cfg(StepSchedulerConfig, cfg.get("step_scheduler"))
         ss_cfg.grad_acc_steps = dl_cfg.grad_acc_steps
         self.step_scheduler = StepScheduler(ss_cfg, self.dataloader)
+        self._build_cp_sharder()
 
         val_node = cfg.get("validation_dataset")
         self.val_dataloader = None
         if val_node is not None:
             val_ds = val_node.instantiate().build()
             self.val_dataloader = dl_cfg.build(val_ds)
+
+    def _build_cp_sharder(self) -> None:
+        """Load-balanced CP layout (reference: context_parallel/sharder.py:116
+        round-robin head/tail chunks): with causal masking an unpermuted
+        sequence shard leaves cp rank 0 nearly idle while the last rank does
+        ~2× the work; the permuted layout equalizes it. Applied host-side to
+        every batch; positions ride the permutation, and attention is
+        position-causal (ring), so the loss is unchanged (test_cp.py parity).
+
+        Gated on the module's CP_PERMUTATION_SAFE flag — SSM/linear-attention
+        hybrids and the layout-order MTP head must see natural order."""
+        from automodel_tpu.parallel.cp import ContextParallelSharder
+
+        self.cp_sharder = None
+        cp = self.mesh_ctx.sizes["cp"]
+        if cp <= 1 or not bool(self.cfg.get("distributed.cp_load_balanced", True)):
+            return
+        safe = getattr(self.model_spec.module, "CP_PERMUTATION_SAFE", False)
+        if getattr(self.model_cfg, "mtp_num_layers", 0) > 0:
+            safe = False
+        if not safe:
+            logger.warning(
+                "cp=%d: load-balanced layout disabled — model %s is sequence-"
+                "order-sensitive (SSM/MTP); causal work stays imbalanced "
+                "across cp ranks", cp, self.model_spec.name,
+            )
+            return
+        self.cp_sharder = ContextParallelSharder(cp_size=cp)
+        logger.info("cp=%d: load-balanced head/tail sequence layout enabled", cp)
 
     # ------------------------------------------------------------------
     def _step_extra(self) -> tuple:
@@ -454,6 +523,8 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         return (None, "batch", "cp")  # (accum, batch, seq)
 
     def _make_global(self, batch_np: dict):
+        if getattr(self, "cp_sharder", None) is not None:
+            batch_np = self.cp_sharder.shard_batch(batch_np)
         return make_global_batch(
             batch_np, self.mesh_ctx, self.mesh_ctx.sharding(*self._batch_spec())
         )
@@ -464,6 +535,8 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         return int(batch_np["input_ids"].size)
 
     def _make_global_eval(self, batch_np: dict):
+        if getattr(self, "cp_sharder", None) is not None:
+            batch_np = self.cp_sharder.shard_batch(batch_np)
         return make_global_batch(
             batch_np, self.mesh_ctx, self.mesh_ctx.sharding("batch", "cp")
         )

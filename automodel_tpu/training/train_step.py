@@ -53,6 +53,7 @@ def make_train_step(
     lr_schedule: Callable | None = None,
     config: TrainStepConfig | None = None,
     param_transform: Callable | None = None,  # (params, step) -> params (QAT)
+    grad_fn: Callable | None = None,  # (params, mb, rng, *extra) -> (grads, loss_sum, aux)
 ) -> Callable:
     """Build `train_step(state, batch, rng) -> (state, metrics)`.
 
@@ -62,10 +63,23 @@ def make_train_step(
     any extra per-step arrays (e.g. MoE tokens_per_expert), which are summed
     across microbatches and surfaced in metrics. Normalization by total
     tokens happens here, once.
+
+    `grad_fn` replaces value_and_grad(loss_fn) for programs that compute
+    gradients explicitly (the 1F1B pipeline interleaves its own forward and
+    backward — decoder.make_pp_1f1b_loss_and_grad); everything downstream
+    (accumulation, normalization, clipping, update) is identical.
     """
     config = config or TrainStepConfig()
+    if grad_fn is not None and param_transform is not None:
+        raise ValueError("param_transform (QAT) does not compose with grad_fn")
 
     def grad_one(params, step, mb, rng, *extra):
+        if grad_fn is not None:
+            grads, ce, aux = grad_fn(params, mb, rng, *extra)
+            if not isinstance(aux, dict):
+                aux = {"num_label_tokens": aux}
+            return grads, ce, aux
+
         # QAT fake-quant runs INSIDE the differentiated function so the
         # straight-through estimator routes gradients to the master weights
         def fwd(p):

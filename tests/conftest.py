@@ -3,6 +3,12 @@
 The analog of the reference's FakeStore/fake-process-group trick
 (reference: tests/unit_tests/distributed/test_cp_sharder.py) — distributed
 semantics are exercised on a host-only mesh with no accelerators.
+
+NOTE: do NOT enable jax's persistent compilation cache here — deserializing
+a cached CPU executable that contains collectives (any shard_map/pp test)
+aborts the process in this jaxlib (reproduced: first run populates and
+passes, second run SIGABRTs loading the cache). Suite wall time is managed
+by test tiering (pytest markers) instead.
 """
 
 from automodel_tpu.utils.hostplatform import force_cpu_devices

@@ -51,16 +51,69 @@ def test_temperature_sampling_valid_and_varied():
     assert not np.array_equal(np.asarray(a), np.asarray(b))  # keys differ
 
 
-def test_generate_rejects_mla():
+def test_mla_matches_naive():
+    """MLA absorbed latent-cache decode == full re-forward (VERDICT r3 #9:
+    the MLA decode path previously raised NotImplementedError)."""
     import dataclasses
 
     cfg = dataclasses.replace(
-        CFG, attention_type="mla", mla_kv_lora_rank=16,
+        CFG, attention_type="mla", mla_kv_lora_rank=16, mla_q_lora_rank=12,
         mla_qk_nope_head_dim=8, mla_qk_rope_head_dim=8, mla_v_head_dim=8,
     )
     params = decoder.init(cfg, jax.random.key(0))
-    with pytest.raises(NotImplementedError):
-        generate(params, cfg, jnp.zeros((1, 4), jnp.int32), jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(12), (2, 7), 0, 64)
+    fast = generate(params, cfg, prompt, jax.random.key(2), GenerateConfig(max_new_tokens=6))
+    slow = _naive_greedy(params, cfg, prompt, 6)
+    np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
+
+
+def test_mla_sliding_window_matches_naive():
+    """MLA decode honors per-layer sliding windows (the training forward
+    does; decode must not silently widen to global)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        CFG, attention_type="mla", mla_kv_lora_rank=16, mla_q_lora_rank=12,
+        mla_qk_nope_head_dim=8, mla_qk_rope_head_dim=8, mla_v_head_dim=8,
+        sliding_window=4,
+    )
+    params = decoder.init(cfg, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(14), (2, 7), 0, 64)
+    fast = generate(params, cfg, prompt, jax.random.key(2), GenerateConfig(max_new_tokens=6))
+    slow = _naive_greedy(params, cfg, prompt, 6)
+    np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
+
+
+def test_moe_mla_matches_naive():
+    """DeepSeek-family shape: first_k_dense + MoE stack + MLA cache."""
+    from automodel_tpu.models.moe_lm import decoder as moe_decoder
+    from automodel_tpu.models.moe_lm.decoder import MoETransformerConfig
+    from automodel_tpu.moe.config import MoEConfig
+
+    cfg = MoETransformerConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=48, num_layers=3,
+        num_heads=4, num_kv_heads=4, first_k_dense=1, dtype=jnp.float32,
+        remat_policy="none",
+        attention_type="mla", mla_kv_lora_rank=16, mla_q_lora_rank=12,
+        mla_qk_nope_head_dim=8, mla_qk_rope_head_dim=8, mla_v_head_dim=8,
+        moe=MoEConfig(
+            n_routed_experts=4, n_shared_experts=1, experts_per_token=2,
+            moe_intermediate_size=16, shared_expert_intermediate_size=16,
+            aux_loss_coeff=0.0,
+            # decode forces dropless (exact for any token population); use it
+            # in the oracle too so near-tie argmaxes see identical fp noise
+            dispatcher="dropless",
+        ),
+    )
+    params = moe_decoder.init(cfg, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(13), (2, 7), 0, 64)
+    fast = generate(params, cfg, prompt, jax.random.key(2), GenerateConfig(max_new_tokens=5))
+    ids = prompt
+    for _ in range(5):
+        logits, _aux = moe_decoder.forward(params, cfg, ids)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(fast), np.asarray(ids))
 
 
 def test_sliding_window_matches_naive():

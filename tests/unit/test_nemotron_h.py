@@ -133,6 +133,41 @@ def test_nemotron_h_recipe_ep_mesh(tmp_path):
     assert "moe_load_imbalance" in recs[-1]
 
 
+def test_qwen3_next_adapter_roundtrip():
+    """to_hf is the exact inverse of from_hf (VERDICT r3 #9: export
+    previously raised)."""
+    from automodel_tpu.models.hybrid import qwen3_next as qn
+
+    hf = {
+        "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+        "num_hidden_layers": 4, "num_attention_heads": 4,
+        "num_key_value_heads": 2, "head_dim": 8,
+        "layer_types": [
+            "linear_attention", "full_attention",
+            "linear_attention", "full_attention",
+        ],
+        "linear_num_value_heads": 4, "linear_num_key_heads": 2,
+        "linear_key_head_dim": 8, "linear_value_head_dim": 8,
+        "num_experts": 4, "num_experts_per_tok": 2,
+        "moe_intermediate_size": 16, "shared_expert_intermediate_size": 16,
+        "norm_topk_prob": True, "rope_theta": 10000.0,
+    }
+    cfg = qn.from_hf_config(hf, remat_policy="none")
+    p = qn.init(cfg, jax.random.key(0))
+    ad = qn.Qwen3NextAdapter(cfg)
+    sd = dict(ad.to_hf(p))
+    assert "model.layers.0.linear_attn.conv1d.weight" in sd
+    assert sd["model.layers.0.linear_attn.conv1d.weight"].ndim == 3
+    assert "model.layers.1.self_attn.q_norm.weight" in sd
+    assert "model.layers.2.mlp.experts.3.down_proj.weight" in sd
+    assert "model.layers.3.mlp.shared_expert_gate.weight" in sd
+    p2 = ad.from_hf(lambda k: np.asarray(sd[k]))
+    ids = jax.random.randint(jax.random.key(1), (2, 16), 0, 128)
+    o1, _ = qn.forward(p, cfg, ids)
+    o2, _ = qn.forward(p2, cfg, ids)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), atol=1e-5)
+
+
 def test_chunked_ssd_matches_scan():
     """Chunked SSD block form == sequential scan oracle (incl. packed-doc
     resets and a non-chunk-divisible length)."""
