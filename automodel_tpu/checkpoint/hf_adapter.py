@@ -69,6 +69,26 @@ def _permute_k_rope(kernel: np.ndarray, kv_rank: int, dr: int, inverse: bool) ->
     return np.concatenate([kernel[..., :kv_rank], rope], axis=-1)
 
 
+def _stack_layers_zero_fill(one, names, transpose, tr, absent_ok):
+    """Stack per-layer tensors, zero-filling layers `absent_ok` declares
+    keyless (GLM IndexShare "shared" layers own no indexer weights). A key
+    missing on a layer that should have one raises KeyError — that is a
+    broken checkpoint (or the reference's compressed-indexer layout), and
+    the caller's skip-and-backfill path must handle it, not silent zeros."""
+    vals = []
+    for j, n in enumerate(names):
+        try:
+            vals.append(one(n, transpose, tr))
+        except KeyError:
+            if not absent_ok(j):
+                raise
+            vals.append(None)
+    ref = next((v for v in vals if v is not None), None)
+    if ref is None:
+        raise KeyError(names[0])
+    return np.stack([v if v is not None else np.zeros_like(ref) for v in vals])
+
+
 @dataclasses.dataclass
 class DenseDecoderAdapter:
     """llama/mistral/qwen2/qwen3/gemma2/glm4/ernie ↔ models/llm/decoder params.
@@ -87,10 +107,14 @@ class DenseDecoderAdapter:
         cfg = self.cfg
         if getattr(cfg, "attention_type", "gqa") == "mla":
             return self._mla_layer_entries()
-        e = [
-            ("self_attn.q_proj.weight", ("q_proj", "kernel"), True),
-            ("self_attn.k_proj.weight", ("k_proj", "kernel"), True),
-            ("self_attn.v_proj.weight", ("v_proj", "kernel"), True),
+        e = []
+        if self.style != "baichuan":  # baichuan fuses q/k/v into W_pack
+            e += [
+                ("self_attn.q_proj.weight", ("q_proj", "kernel"), True),
+                ("self_attn.k_proj.weight", ("k_proj", "kernel"), True),
+                ("self_attn.v_proj.weight", ("v_proj", "kernel"), True),
+            ]
+        e += [
             ("self_attn.o_proj.weight", ("o_proj", "kernel"), True),
             ("mlp.down_proj.weight", ("down_proj", "kernel"), True),
             ("input_layernorm.weight", ("input_norm", "scale"), False),
@@ -158,16 +182,29 @@ class DenseDecoderAdapter:
         else:
             e.append(("self_attn.q_proj.weight", ("q_proj", "kernel"), True, "q_rope"))
         if getattr(cfg, "dsa_index_topk", None) is not None:
-            # DSA lightning indexer — OUR uncompressed parameterization
-            # (reference DSv4 checkpoints carry the compressed
-            # wkv/wq_b/weights_proj form, which is not layout-compatible;
-            # those keys are absent here, the loaders treat indexer entries
-            # as optional, and the recipe backfills + warns)
-            e += [
-                ("self_attn.indexer.wq.weight", ("indexer", "wq", "kernel"), True),
-                ("self_attn.indexer.wk.weight", ("indexer", "wk", "kernel"), True),
-                ("self_attn.indexer.wgate.weight", ("indexer", "wgate", "kernel"), True),
-            ]
+            if getattr(cfg, "dsa_indexer_style", "deepseek") == "glm":
+                # GLM-5.x indexer: HF-layout-compatible (glm_moe_dsa/
+                # layers.py — wq_b from the q-lora residual, LayerNorm'd wk,
+                # weights_proj). IndexShare "shared" layers carry no indexer
+                # keys; the loaders zero-fill those stack rows (unused).
+                e += [
+                    ("self_attn.indexer.wq_b.weight", ("indexer", "wq", "kernel"), True),
+                    ("self_attn.indexer.wk.weight", ("indexer", "wk", "kernel"), True),
+                    ("self_attn.indexer.k_norm.weight", ("indexer", "k_norm", "scale"), False),
+                    ("self_attn.indexer.k_norm.bias", ("indexer", "k_norm", "bias"), False),
+                    ("self_attn.indexer.weights_proj.weight", ("indexer", "wgate", "kernel"), True),
+                ]
+            else:
+                # DSA lightning indexer — OUR uncompressed parameterization
+                # (reference DSv4 checkpoints carry the compressed
+                # wkv/wq_b/weights_proj form, which is not layout-compatible;
+                # those keys are absent here, the loaders treat indexer
+                # entries as optional, and the recipe backfills + warns)
+                e += [
+                    ("self_attn.indexer.wq.weight", ("indexer", "wq", "kernel"), True),
+                    ("self_attn.indexer.wk.weight", ("indexer", "wk", "kernel"), True),
+                    ("self_attn.indexer.wgate.weight", ("indexer", "wgate", "kernel"), True),
+                ]
         # note: MLA models pair with the MoE adapter; MLP entries come from
         # the dense path only for the first-k dense layers
         e += [
@@ -185,6 +222,12 @@ class DenseDecoderAdapter:
         if not self.cfg.tie_word_embeddings:
             e.append(("lm_head.weight", ("lm_head", "kernel"), True))
         return [(*entry, None) for entry in e]
+
+    def _indexer_absent(self, layer_idx: int) -> bool:
+        """GLM IndexShare "shared" layers own no indexer in HF checkpoints;
+        the zero-filled stack rows must not be exported as real keys."""
+        t = getattr(self.cfg, "dsa_indexer_types", None)
+        return t is not None and t[layer_idx] == "shared"
 
     def _transform(self, x: np.ndarray, tname: str | None, inverse: bool) -> np.ndarray:
         """Named weight transforms (rope layout permutations; see _rope_perm)."""
@@ -211,6 +254,8 @@ class DenseDecoderAdapter:
         layers = params["layers"]
         for i in range(self.cfg.num_layers):
             for suffix, path, transpose, tr in self._layer_entries():
+                if path[0] == "indexer" and self._indexer_absent(i):
+                    continue
                 x = np.asarray(_get(layers, path)[i])
                 x = self._transform(x, tr, inverse=True)
                 yield f"model.layers.{i}.{suffix}", (_t(x) if transpose else x)
@@ -221,6 +266,12 @@ class DenseDecoderAdapter:
                     f"model.layers.{i}.mlp.gate_up_proj.weight",
                     _t(np.concatenate([g, u], axis=1)),
                 )
+            if self.style == "baichuan":
+                qkv = np.concatenate(  # (H, 3H) → HF W_pack (3H, H)
+                    [np.asarray(layers[p]["kernel"][i]) for p in ("q_proj", "k_proj", "v_proj")],
+                    axis=1,
+                )
+                yield f"model.layers.{i}.self_attn.W_pack.weight", _t(qkv)
 
     # -- import --------------------------------------------------------------
     def from_hf(self, read: Reader, shardings: Any = None) -> dict:
@@ -259,13 +310,14 @@ class DenseDecoderAdapter:
                     continue
                 raise
         for suffix, path, transpose, tr in self._layer_entries():
+            names = [f"model.layers.{i}.{suffix}" for i in range(self.cfg.num_layers)]
             try:
-                stacked = np.stack(
-                    [
-                        one(f"model.layers.{i}.{suffix}", transpose, tr)
-                        for i in range(self.cfg.num_layers)
-                    ]
-                )
+                if path[0] == "indexer":
+                    stacked = _stack_layers_zero_fill(
+                        one, names, transpose, tr, self._indexer_absent
+                    )
+                else:
+                    stacked = np.stack([one(n, transpose, tr) for n in names])
             except KeyError:
                 if path[0] == "indexer":  # optional: see _mla_layer_entries
                     continue
@@ -281,6 +333,17 @@ class DenseDecoderAdapter:
             I = self.cfg.intermediate_size
             put(("layers", "gate_proj", "kernel"), fused[..., :I])
             put(("layers", "up_proj", "kernel"), fused[..., I:])
+        if self.style == "baichuan":
+            fused = np.stack(
+                [
+                    _t(read_any(f"model.layers.{i}.self_attn.W_pack.weight"))
+                    for i in range(self.cfg.num_layers)
+                ]
+            )  # (L, H, 3H); order [q; k; v] (baichuan W_pack)
+            H = self.cfg.hidden_size
+            put(("layers", "q_proj", "kernel"), fused[..., :H])
+            put(("layers", "k_proj", "kernel"), fused[..., H : 2 * H])
+            put(("layers", "v_proj", "kernel"), fused[..., 2 * H :])
         return out
 
 
@@ -352,6 +415,8 @@ class MoEDecoderAdapter:
         if fk:
             for i in range(fk):
                 for suffix, path, transpose, tr in dense._layer_entries():
+                    if path[0] == "indexer" and dense._indexer_absent(i):
+                        continue
                     x = dense._transform(
                         np.asarray(_get(params["dense_layers"], path)[i]), tr, inverse=True
                     )
@@ -360,6 +425,8 @@ class MoEDecoderAdapter:
         for li in range(cfg.num_moe_layers):
             i = fk + li
             for suffix, path, transpose, tr in self._attn_entries():
+                if path[0] == "indexer" and dense._indexer_absent(i):
+                    continue
                 x = dense._transform(
                     np.asarray(_get(moe_layers, path)[li]), tr, inverse=True
                 )
@@ -422,23 +489,32 @@ class MoEDecoderAdapter:
         fk = cfg.first_k_dense
         if fk:
             for suffix, path, transpose, tr in dense._layer_entries():
+                names = [f"model.layers.{i}.{suffix}" for i in range(fk)]
                 try:
-                    stacked = np.stack(
-                        [one(f"model.layers.{i}.{suffix}", transpose, tr) for i in range(fk)]
-                    )
+                    if path[0] == "indexer":
+                        stacked = _stack_layers_zero_fill(
+                            one, names, transpose, tr, dense._indexer_absent
+                        )
+                    else:
+                        stacked = np.stack([one(n, transpose, tr) for n in names])
                 except KeyError:
                     if path[0] == "indexer":  # optional: see _mla_layer_entries
                         continue
                     raise
                 put(("dense_layers",) + path, stacked)
         for suffix, path, transpose, tr in self._attn_entries():
+            names = [
+                f"model.layers.{fk + li}.{suffix}"
+                for li in range(cfg.num_moe_layers)
+            ]
             try:
-                stacked = np.stack(
-                    [
-                        one(f"model.layers.{fk + li}.{suffix}", transpose, tr)
-                        for li in range(cfg.num_moe_layers)
-                    ]
-                )
+                if path[0] == "indexer":
+                    stacked = _stack_layers_zero_fill(
+                        one, names, transpose, tr,
+                        lambda li: dense._indexer_absent(fk + li),
+                    )
+                else:
+                    stacked = np.stack([one(n, transpose, tr) for n in names])
             except KeyError:
                 if path[0] == "indexer":  # optional: see _mla_layer_entries
                     continue

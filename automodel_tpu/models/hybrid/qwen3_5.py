@@ -1,0 +1,230 @@
+"""Qwen3.5 / Qwen3.5-MoE — the Qwen3-Next hybrid engine with the Qwen3.5
+checkpoint layout.
+
+The reference rebuilds both on the Qwen3-Next Block (reference:
+nemo_automodel/components/models/qwen3_5/model.py:321 `Qwen3_5DenseBlock`,
+qwen3_5_moe/model.py:98 `Qwen3_5MoeBlock`); the architecture differences are
+checkpoint-layout only:
+
+- The gated-delta-net projections are SEPARATE linears (`in_proj_qkv` flat
+  [q|k|v], `in_proj_z`, `in_proj_b`, `in_proj_a`) instead of Qwen3-Next's
+  fused per-key-head-interleaved `in_proj_qkvz`/`in_proj_ba`
+  (qwen3_5_moe/cp_linear_attn.py:545-565 vs qwen3_next
+  `fix_query_key_value_ordering`).
+- MoE expert weights are STACKED (`experts.gate_up_proj` (E, 2I, H),
+  `experts.down_proj` (E, H, I)) instead of per-expert
+  (qwen3_5_moe/state_dict_adapter.py:19-25).
+- VL checkpoints prefix text weights `model.language_model.`.
+
+So: forward/init/param_specs come verbatim from models/hybrid/qwen3_next;
+this module contributes config adapters and a state-dict adapter that
+synthesizes the Qwen3-Next layout from the Qwen3.5 one (and the exact
+inverse for export). MTP sublayers (`mtp.*` keys) are a training-time
+auxiliary in the reference and are skipped at load here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from automodel_tpu.models.hybrid import qwen3_next as qn
+
+# module protocol re-exports: the engine is qwen3-next
+init = qn.init
+forward = qn.forward
+param_specs = qn.param_specs
+Qwen3_5Config = qn.Qwen3NextConfig
+
+
+def _text_config(hf: dict) -> dict:
+    """Unwrap `text_config` (VL composite configs) when present."""
+    sub = hf.get("text_config")
+    if isinstance(sub, dict):
+        merged = dict(sub)
+        merged.setdefault("tie_word_embeddings", hf.get("tie_word_embeddings", False))
+        return merged
+    return hf
+
+
+def qwen3_5_config(hf: dict, **overrides) -> qn.Qwen3NextConfig:
+    """Qwen3_5ForCausalLM (dense hybrid)."""
+    return qn.from_hf_config(_text_config(hf), **overrides)
+
+
+def qwen3_5_moe_config(hf: dict, **overrides) -> qn.Qwen3NextConfig:
+    """Qwen3_5MoeForConditionalGeneration (text decoder; the vision tower is
+    served by the VLM tier)."""
+    return qn.from_hf_config(_text_config(hf), **overrides)
+
+
+class Qwen3_5Adapter(qn.Qwen3NextAdapter):
+    """Qwen3.5 checkpoint layout ↔ the qwen3-next params pytree.
+
+    Wraps the parent's from_hf/to_hf with a key-translation layer: prefix
+    stripping, GDN projection fuse/split, and expert restacking.
+
+    `vl_prefix`: VL composite checkpoints (ForConditionalGeneration) nest the
+    text weights under `model.language_model.`; the dense ForCausalLM does
+    not. Import probes the actual layout; export follows this flag.
+    """
+
+    def __init__(self, cfg, vl_prefix: bool = True):
+        super().__init__(cfg)
+        self.vl_prefix = vl_prefix
+
+    # -- GDN projection fuse/split ------------------------------------------
+    def _dims(self):
+        c = self.cfg
+        Hk, dk = c.linear_num_key_heads, c.linear_key_head_dim
+        Hv, dv = c.linear_num_value_heads, c.linear_value_head_dim
+        return Hk, dk, Hv, dv, Hv // Hk, c.gdn_key_dim, c.gdn_value_dim
+
+    def _fuse_qkvz(self, qkv_w, z_w):
+        """HF (2Kd+Vd, H) + (Vd, H) → fused interleaved (2Kd+2Vd, H)."""
+        Hk, dk, Hv, dv, gv, Kd, Vd = self._dims()
+        H = qkv_w.shape[1]
+        qkvT = np.ascontiguousarray(qkv_w.T)  # (H, 2Kd+Vd) flat [q|k|v]
+        q = qkvT[:, :Kd].reshape(H, Hk, dk)
+        k = qkvT[:, Kd : 2 * Kd].reshape(H, Hk, dk)
+        v = qkvT[:, 2 * Kd :].reshape(H, Hk, gv * dv)
+        z = np.ascontiguousarray(z_w.T).reshape(H, Hk, gv * dv)
+        fusedT = np.concatenate([q, k, v, z], axis=-1).reshape(H, 2 * Kd + 2 * Vd)
+        return np.ascontiguousarray(fusedT.T)
+
+    def _split_qkvz(self, fused_w):
+        """Inverse of _fuse_qkvz: fused (2Kd+2Vd, H) → (qkv (2Kd+Vd,H), z (Vd,H))."""
+        Hk, dk, Hv, dv, gv, Kd, Vd = self._dims()
+        H = fused_w.shape[1]
+        fT = np.ascontiguousarray(fused_w.T).reshape(H, Hk, 2 * dk + 2 * gv * dv)
+        q = fT[..., :dk].reshape(H, Kd)
+        k = fT[..., dk : 2 * dk].reshape(H, Kd)
+        v = fT[..., 2 * dk : 2 * dk + gv * dv].reshape(H, Vd)
+        z = fT[..., 2 * dk + gv * dv :].reshape(H, Vd)
+        qkvT = np.concatenate([q, k, v], axis=-1)
+        return np.ascontiguousarray(qkvT.T), np.ascontiguousarray(z.T)
+
+    def _fuse_ba(self, b_w, a_w):
+        """HF (Hv, H) + (Hv, H) → fused interleaved (2Hv, H)."""
+        Hk, dk, Hv, dv, gv, Kd, Vd = self._dims()
+        H = b_w.shape[1]
+        b = np.ascontiguousarray(b_w.T).reshape(H, Hk, gv)
+        a = np.ascontiguousarray(a_w.T).reshape(H, Hk, gv)
+        fusedT = np.concatenate([b, a], axis=-1).reshape(H, 2 * Hv)
+        return np.ascontiguousarray(fusedT.T)
+
+    def _split_ba(self, fused_w):
+        Hk, dk, Hv, dv, gv, Kd, Vd = self._dims()
+        H = fused_w.shape[1]
+        fT = np.ascontiguousarray(fused_w.T).reshape(H, Hk, 2 * gv)
+        b = fT[..., :gv].reshape(H, Hv)
+        a = fT[..., gv:].reshape(H, Hv)
+        return np.ascontiguousarray(b.T), np.ascontiguousarray(a.T)
+
+    # -- import --------------------------------------------------------------
+    def from_hf(self, read, shardings=None) -> dict:
+        def probe(key):
+            try:
+                read(key)
+                return True
+            except KeyError:
+                return False
+
+        prefix = ""
+        if probe("model.language_model.embed_tokens.weight"):
+            prefix = "language_model."
+
+        def vread(name):
+            """Serve qwen3-next-layout names from the qwen3.5 checkpoint."""
+            if name == "lm_head.weight":
+                for cand in ("lm_head.weight", "model.lm_head.weight"):
+                    if probe(cand):
+                        return read(cand)
+                raise KeyError(name)
+            assert name.startswith("model."), name
+            rest = name[len("model."):]
+            if ".linear_attn.in_proj_qkvz." in rest:
+                base = rest.replace("in_proj_qkvz.weight", "")
+                return self._fuse_qkvz(
+                    read(f"model.{prefix}{base}in_proj_qkv.weight"),
+                    read(f"model.{prefix}{base}in_proj_z.weight"),
+                )
+            if ".linear_attn.in_proj_ba." in rest:
+                base = rest.replace("in_proj_ba.weight", "")
+                return self._fuse_ba(
+                    read(f"model.{prefix}{base}in_proj_b.weight"),
+                    read(f"model.{prefix}{base}in_proj_a.weight"),
+                )
+            if ".mlp.experts." in rest:
+                # "layers.{i}.mlp.experts.{e}.{proj}.weight" ← stacked tensors
+                head, _, tail = rest.partition(".mlp.experts.")
+                e_str, proj, _w = tail.split(".")
+                e = int(e_str)
+                I = self.cfg.moe.moe_intermediate_size
+                if proj == "down_proj":
+                    # stacked (E, H, I); per-expert HF linear is (H, I)
+                    return read(f"model.{prefix}{head}.mlp.experts.down_proj")[e]
+                gu = read(f"model.{prefix}{head}.mlp.experts.gate_up_proj")[e]  # (2I, H)
+                return gu[:I] if proj == "gate_proj" else gu[I:]
+            return read(f"model.{prefix}{rest}")
+
+        return super().from_hf(vread, shardings=shardings)
+
+    # -- export --------------------------------------------------------------
+    def to_hf(self, params):
+        prefix = "language_model." if self.vl_prefix else ""
+        I = self.cfg.moe.moe_intermediate_size if self.cfg.moe is not None else 0
+        E = self.cfg.moe.n_routed_experts if self.cfg.moe is not None else 0
+        # buffer per-expert slices back into the stacked tensors
+        gu_buf: dict[str, dict[str, np.ndarray]] = {}
+        down_buf: dict[str, dict[str, np.ndarray]] = {}
+        for name, tensor in super().to_hf(params):
+            if name == "lm_head.weight":
+                yield name, tensor
+                continue
+            rest = name[len("model."):]
+            if ".linear_attn.in_proj_qkvz." in rest:
+                base = rest.replace("in_proj_qkvz.weight", "")
+                qkv, z = self._split_qkvz(tensor)
+                yield f"model.{prefix}{base}in_proj_qkv.weight", qkv
+                yield f"model.{prefix}{base}in_proj_z.weight", z
+                continue
+            if ".linear_attn.in_proj_ba." in rest:
+                base = rest.replace("in_proj_ba.weight", "")
+                b, a = self._split_ba(tensor)
+                yield f"model.{prefix}{base}in_proj_b.weight", b
+                yield f"model.{prefix}{base}in_proj_a.weight", a
+                continue
+            if ".mlp.experts." in rest:
+                head, _, tail = rest.partition(".mlp.experts.")
+                e_str, proj, _w = tail.split(".")
+                e = int(e_str)
+                if proj == "down_proj":
+                    buf = down_buf.setdefault(head, {})
+                else:
+                    buf = gu_buf.setdefault(head + "|" + proj, {})
+                buf[e] = tensor
+                full = f"model.{prefix}{head}.mlp.experts."
+                if proj == "down_proj" and len(buf) == E:
+                    yield full + "down_proj", np.stack([buf[i] for i in range(E)])
+                elif proj != "down_proj":
+                    gk, uk = head + "|gate_proj", head + "|up_proj"
+                    if len(gu_buf.get(gk, {})) == E and len(gu_buf.get(uk, {})) == E:
+                        yield full + "gate_up_proj", np.stack(
+                            [
+                                np.concatenate([gu_buf[gk][i], gu_buf[uk][i]], axis=0)
+                                for i in range(E)
+                            ]
+                        )
+                continue
+            yield f"model.{prefix}{rest}", tensor
+
+
+def _register_adapter():
+    from automodel_tpu.checkpoint.hf_adapter import ADAPTERS
+
+    ADAPTERS["qwen3_5"] = Qwen3_5Adapter
+
+
+_register_adapter()

@@ -83,6 +83,8 @@ class TransformerConfig:
     # False → bidirectional attention (retrieval/embedding encoders,
     # reference: models/llama_bidirectional)
     causal: bool = True
+    # baichuan NormHead: L2-normalize lm_head rows on every forward
+    normalized_lm_head: bool = False
     # gpt-oss: learnable per-head sink logits in the softmax denominator
     attention_sinks: bool = False
     o_proj_bias: bool = False  # gpt-oss biases ALL four attention projections
@@ -99,6 +101,14 @@ class TransformerConfig:
     dsa_index_n_heads: int = 4
     dsa_index_head_dim: int = 64
     dsa_indexer_loss_coeff: float = 0.01
+    # "deepseek": lightning indexer on hidden states, full-head rope.
+    # "glm": GLM-5.x variant — queries from the MLA q-lora residual,
+    # LayerNorm'd keys, rope-first half-split slice, n_heads**-0.5 gate
+    # scaling (reference: glm_moe_dsa/layers.py GlmMoeDsaIndexer).
+    dsa_indexer_style: str = "deepseek"
+    # GLM IndexShare: per-layer "full" (runs its own indexer) | "shared"
+    # (reuses the previous full layer's top-k selection). None → all full.
+    dsa_indexer_types: Optional[tuple] = None
     # execution knobs
     dtype: Any = jnp.bfloat16
     remat_policy: str = "full"
@@ -469,11 +479,7 @@ def make_pp_1f1b_loss_and_grad(cfg: TransformerConfig, mesh_ctx, chunk_size: int
                 h_mb, head_p["final_norm"]["scale"], cfg.rms_norm_eps,
                 cfg.zero_centered_norm,
             )
-            kernel = (
-                head_p["embed"]["embedding"].T
-                if tie
-                else head_p["lm_head"]["kernel"]
-            )
+            kernel = head_kernel(head_p, cfg)
             ce, _ = fused_linear_cross_entropy(
                 hh, kernel.astype(hh.dtype), labels_mb, chunk_size=chunk_size,
                 logits_soft_cap=cfg.logits_soft_cap,
@@ -636,12 +642,24 @@ def forward(
     return out
 
 
-def unembed(params: dict, cfg: TransformerConfig, h: jnp.ndarray) -> jnp.ndarray:
-    """hidden → fp32 logits (with optional tied embeddings / soft cap)."""
+def head_kernel(params: dict, cfg: TransformerConfig) -> jnp.ndarray:
+    """(H, V) output-projection kernel: tied/untied, with baichuan NormHead
+    L2-normalization per vocab row when cfg.normalized_lm_head."""
     if cfg.tie_word_embeddings:
         kernel = params["embed"]["embedding"].T
     else:
         kernel = params["lm_head"]["kernel"]
+    if getattr(cfg, "normalized_lm_head", False):
+        # baichuan NormHead (reference: models/baichuan/model.py NormHead):
+        # F.normalize over the hidden dim, applied on every training forward
+        norm = jnp.sqrt(jnp.sum(kernel.astype(jnp.float32) ** 2, axis=0, keepdims=True))
+        kernel = (kernel.astype(jnp.float32) / jnp.maximum(norm, 1e-12)).astype(kernel.dtype)
+    return kernel
+
+
+def unembed(params: dict, cfg: TransformerConfig, h: jnp.ndarray) -> jnp.ndarray:
+    """hidden → fp32 logits (with optional tied embeddings / soft cap)."""
+    kernel = head_kernel(params, cfg)
     logits = jnp.einsum("bsh,hv->bsv", h, kernel.astype(h.dtype), preferred_element_type=jnp.float32)
     if cfg.logits_soft_cap is not None:
         logits = cfg.logits_soft_cap * jnp.tanh(logits / cfg.logits_soft_cap)

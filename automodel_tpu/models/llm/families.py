@@ -194,3 +194,15 @@ def gemma2_config(hf: Mapping[str, Any], **overrides) -> TransformerConfig:
     kw["tie_word_embeddings"] = bool(hf.get("tie_word_embeddings", True))
     kw.update(overrides)
     return TransformerConfig(**kw)
+
+
+def baichuan_config(hf: Mapping[str, Any], **overrides) -> TransformerConfig:
+    """BaichuanForCausalLM — Baichuan2 7B shape (reference: models/baichuan/
+    model.py): llama-like MHA with a fused W_pack qkv projection (handled by
+    the adapter's "baichuan" style) and an L2-normalized lm_head (NormHead).
+    The 13B ALiBi variant is not covered (rope only, like the reference)."""
+    kw = _base_kwargs(hf)
+    kw["num_kv_heads"] = kw["num_heads"]  # MHA
+    kw["normalized_lm_head"] = True
+    kw.update(overrides)
+    return TransformerConfig(**kw)

@@ -71,13 +71,22 @@ def init_mla_layers(cfg, rng: jax.Array, L: int) -> dict:
 def init_indexer(cfg, rng: jax.Array, L: int) -> dict:
     """Fresh lightning-indexer stack — also used to backfill checkpoints
     that predate DSA (reference: deepseek_v4 checkpoints carry indexer.*
-    keys; V3-style ones do not)."""
+    keys; V3-style ones do not). GLM style (dsa_indexer_style="glm")
+    projects queries from the q-lora residual and LayerNorms keys."""
     from automodel_tpu.models.llm.decoder import _stack
     from automodel_tpu.models.common.layers import dense_init
 
     H = cfg.hidden_size
     Hi, Di = cfg.dsa_index_n_heads, cfg.dsa_index_head_dim
     ki = jax.random.split(rng, 3)
+    if getattr(cfg, "dsa_indexer_style", "deepseek") == "glm":
+        rq = cfg.mla_q_lora_rank or H
+        return {
+            "wq": {"kernel": _stack(dense_init, ki[0], (rq, Hi * Di), L)},
+            "wk": {"kernel": _stack(dense_init, ki[1], (H, Di), L)},
+            "k_norm": {"scale": jnp.ones((L, Di)), "bias": jnp.zeros((L, Di))},
+            "wgate": {"kernel": _stack(dense_init, ki[2], (H, Hi), L)},
+        }
     return {
         "wq": {"kernel": _stack(dense_init, ki[0], (H, Hi * Di), L)},
         "wk": {"kernel": _stack(dense_init, ki[1], (H, Di), L)},
@@ -106,11 +115,18 @@ def mla_layer_specs(cfg) -> dict:
             "wk": {"kernel": ("layers", "embed", None)},
             "wgate": {"kernel": ("layers", "embed", None)},
         }
+        if getattr(cfg, "dsa_indexer_style", "deepseek") == "glm":
+            layers["indexer"]["wq"] = {"kernel": ("layers", None, "heads")}
+            layers["indexer"]["k_norm"] = {
+                "scale": ("layers", "norm"), "bias": ("layers", "norm"),
+            }
     return layers
 
 
 def _mla_qkv(x, lp, cfg, positions, constrain, inv_freq):
-    """Project normed input to MLA q/k/v (B,S,n,·) and the logit scale."""
+    """Project normed input to MLA q/k/v (B,S,n,·), the logit scale, and the
+    q-lora residual (post q_norm; None without q compression) — the GLM
+    indexer's query source."""
     from automodel_tpu.ops.quant import matmul as _mm
 
     B, S, H = x.shape
@@ -118,6 +134,7 @@ def _mla_qkv(x, lp, cfg, positions, constrain, inv_freq):
     dn, dr, dv = cfg.mla_qk_nope_head_dim, cfg.mla_qk_rope_head_dim, cfg.mla_v_head_dim
     prec = cfg.linear_precision
 
+    q_lat = None
     if cfg.mla_q_lora_rank:
         q_lat = rms_norm(_mm(x, lp["q_down_proj"]["kernel"], prec), lp["q_norm"]["scale"], cfg.rms_norm_eps)
         q = _mm(q_lat, lp["q_up_proj"]["kernel"], prec)
@@ -140,23 +157,37 @@ def _mla_qkv(x, lp, cfg, positions, constrain, inv_freq):
     k = constrain(k, ("act_batch", "act_seq", "act_heads", None))
     v = constrain(v, ("act_batch", "act_seq", "act_heads", None))
     scale = cfg.attn_scale if cfg.attn_scale is not None else (dn + dr) ** -0.5
-    return q, k, v, scale
+    return q, k, v, scale, q_lat
 
 
-def mla_sparse_attention_block(h, lp, cfg, positions, segment_ids, inv_freq, constrain, token_mask=None):
+def mla_sparse_attention_block(
+    h, lp, cfg, positions, segment_ids, inv_freq, constrain, token_mask=None,
+    prev_sel=None, indexer_flag=None,
+):
     """DSA: lightning-indexer top-k sparse MLA (reference:
-    deepseek_v4/layers.py; mask-based like its SDPA fallback path).
+    deepseek_v4/layers.py; mask-based like its SDPA fallback path;
+    glm_moe_dsa/layers.py for the GLM indexer + IndexShare variant).
 
-    Returns (h_out, indexer_kl_aux) — the aux rides the MoE decoder's loss
-    carry; it is the ONLY gradient path into the indexer (hard top-k).
-    `token_mask` (B,S) excludes pad queries from the indexer KL."""
+    Returns (h_out, indexer_kl_aux, sel) — the aux rides the MoE decoder's
+    loss carry; it is the ONLY gradient path into the indexer (hard top-k).
+    `token_mask` (B,S) excludes pad queries from the indexer KL.
+
+    IndexShare (GLM-5.x): `indexer_flag` is a traced 0/1 scalar riding the
+    layer scan — 1 runs this layer's indexer, 0 reuses `prev_sel` (the most
+    recent full layer's selection) and contributes no indexer KL. The
+    returned `sel` is the running selection for the next layer."""
     from automodel_tpu.ops.attention import NEG_INF, make_attention_mask
-    from automodel_tpu.ops.dsa import indexer_kl_loss, indexer_scores, topk_select_mask
+    from automodel_tpu.ops.dsa import (
+        indexer_kl_loss,
+        indexer_scores,
+        indexer_scores_glm,
+        topk_select_mask,
+    )
     from automodel_tpu.ops.rope import rope_frequencies
 
     B, S, H = h.shape
     x = rms_norm(h, lp["input_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
-    q, k, v, scale = _mla_qkv(x, lp, cfg, positions, constrain, inv_freq)
+    q, k, v, scale, q_lat = _mla_qkv(x, lp, cfg, positions, constrain, inv_freq)
 
     base_mask = make_attention_mask(
         S, S, causal=cfg.causal,
@@ -166,16 +197,30 @@ def mla_sparse_attention_block(h, lp, cfg, positions, segment_ids, inv_freq, con
     if base_mask is None:
         base_mask = jnp.ones((1, S, S), bool)
 
-    # same rope scaling as the main path — a yarn-scaled model's indexer
-    # must agree with its attention about long-context positions
-    inv_freq_idx = rope_frequencies(
-        cfg.dsa_index_head_dim, cfg.rope_theta, cfg.rope_scaling
-    )
-    scores = indexer_scores(
-        x, lp["indexer"], cfg.dsa_index_n_heads, cfg.dsa_index_head_dim,
-        positions, inv_freq_idx,
-    )
+    if getattr(cfg, "dsa_indexer_style", "deepseek") == "glm":
+        # rope applies to the FIRST qk_rope_head_dim channels only (GLM)
+        inv_freq_idx = rope_frequencies(
+            cfg.mla_qk_rope_head_dim, cfg.rope_theta, cfg.rope_scaling
+        )
+        scores = indexer_scores_glm(
+            x, q_lat if q_lat is not None else x, lp["indexer"],
+            cfg.dsa_index_n_heads, cfg.dsa_index_head_dim,
+            positions, inv_freq_idx,
+        )
+    else:
+        # same rope scaling as the main path — a yarn-scaled model's indexer
+        # must agree with its attention about long-context positions
+        inv_freq_idx = rope_frequencies(
+            cfg.dsa_index_head_dim, cfg.rope_theta, cfg.rope_scaling
+        )
+        scores = indexer_scores(
+            x, lp["indexer"], cfg.dsa_index_n_heads, cfg.dsa_index_head_dim,
+            positions, inv_freq_idx,
+        )
     sel = topk_select_mask(scores, base_mask, cfg.dsa_index_topk)
+    if indexer_flag is not None and prev_sel is not None:
+        run = indexer_flag.astype(bool)
+        sel = jnp.where(run, sel, prev_sel)
 
     logits = jnp.einsum("bsnd,btnd->bnst", q, k, preferred_element_type=jnp.float32) * scale
     logits = jnp.where(sel[:, None, :, :], logits, NEG_INF)
@@ -185,10 +230,12 @@ def mla_sparse_attention_block(h, lp, cfg, positions, segment_ids, inv_freq, con
     aux = cfg.dsa_indexer_loss_coeff * indexer_kl_loss(
         scores, jnp.mean(probs, axis=1), sel, token_mask=token_mask
     )
+    if indexer_flag is not None:
+        aux = jnp.where(indexer_flag.astype(bool), aux, 0.0)
 
     attn = out.reshape(B, S, cfg.num_heads * cfg.mla_v_head_dim)
     h = h + _dense(attn, {"kernel": lp["o_proj"]["kernel"]}, cfg.linear_precision)
-    return constrain(h, ("act_batch", "act_seq", "act_embed")), aux
+    return constrain(h, ("act_batch", "act_seq", "act_embed")), aux, sel
 
 
 def mla_attention_block(h, lp, cfg, positions, segment_ids, inv_freq, constrain, sliding_window, mesh_ctx=None):
@@ -198,7 +245,7 @@ def mla_attention_block(h, lp, cfg, positions, segment_ids, inv_freq, constrain,
     dv = cfg.mla_v_head_dim
 
     x = rms_norm(h, lp["input_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
-    q, k, v, scale = _mla_qkv(x, lp, cfg, positions, constrain, inv_freq)
+    q, k, v, scale, _ = _mla_qkv(x, lp, cfg, positions, constrain, inv_freq)
 
     if mesh_ctx is not None and mesh_ctx.sizes["cp"] > 1:
         from automodel_tpu.parallel.cp import ring_dot_product_attention

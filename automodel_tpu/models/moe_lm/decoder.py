@@ -202,22 +202,43 @@ def forward(
     Lm, E = cfg.num_moe_layers, cfg.moe.n_routed_experts
 
     # DSA: lightning-indexer sparse MLA returns an indexer-KL aux that rides
-    # the same loss carry as the MoE balance loss (reference: deepseek_v4)
+    # the same loss carry as the MoE balance loss (reference: deepseek_v4).
+    # GLM IndexShare (reference: glm_moe_dsa/model.py:50): per-layer
+    # indexer_types; "shared" layers reuse the running top-k selection, which
+    # rides the layer-scan carry, with a traced 0/1 flag riding the xs.
     use_dsa = cfg.attention_type == "mla" and cfg.dsa_index_topk is not None
+    idx_types = getattr(cfg, "dsa_indexer_types", None)
+    index_share = use_dsa and idx_types is not None
+    if index_share:
+        assert len(idx_types) == cfg.num_layers, (len(idx_types), cfg.num_layers)
+        assert idx_types[0] == "full", "IndexShare: layer 0 must run its indexer"
+        idx_flags = jnp.asarray(
+            [1 if t == "full" else 0 for t in idx_types], jnp.int32
+        )
+    else:
+        idx_flags = jnp.ones((cfg.num_layers,), jnp.int32)
+    # the (B,S,S) running selection rides the carry ONLY under IndexShare;
+    # plain DSA would drag a dead S² boolean through every layer boundary
+    sel0 = (
+        jnp.zeros((B, S, S), bool) if index_share else jnp.zeros((1, 1, 1), bool)
+    )
 
-    def _attn(h, lp, window):
+    def _attn(h, lp, window, sel, iflag):
         if use_dsa:
             from automodel_tpu.models.llm.mla import mla_sparse_attention_block
 
-            return mla_sparse_attention_block(
+            h, aux, sel_new = mla_sparse_attention_block(
                 h, lp, cfg, positions, segment_ids, inv_freq, constrain,
                 token_mask=token_mask,
+                prev_sel=sel if index_share else None,
+                indexer_flag=iflag if index_share else None,
             )
+            return h, aux, (sel_new if index_share else sel)
         h = attention_block(
             h, lp, cfg, positions, segment_ids, freq_for(window), constrain,
             window, mesh_ctx,
         )
-        return h, jnp.float32(0.0)
+        return h, jnp.float32(0.0), sel
 
     cap_ids = tuple(return_aux_hidden) if return_aux_hidden is not None else None
 
@@ -227,21 +248,21 @@ def forward(
         return auxbuf
 
     def dense_layer(carry, xs, window):
-        h, aux, stats, routing, auxbuf = carry
-        lp, gidx = xs
-        h, idx_aux = _attn(h, lp, window)
+        h, aux, stats, routing, auxbuf, sel = carry
+        lp, gidx, iflag = xs
+        h, idx_aux, sel = _attn(h, lp, window, sel, iflag)
         h = mlp_block(h, lp, cfg, constrain)
         if cap_ids is not None:
             auxbuf = _capture(auxbuf, gidx, h)
-        return (h, aux + idx_aux, stats, routing, auxbuf)
+        return (h, aux + idx_aux, stats, routing, auxbuf, sel)
 
     K = cfg.moe.experts_per_token
     replay = routing_override is not None
 
     def moe_layer(carry, xs, window):
-        h, aux, stats, routing, auxbuf = carry
-        lp, idx = xs
-        h, idx_aux = _attn(h, lp, window)
+        h, aux, stats, routing, auxbuf, sel = carry
+        lp, idx, iflag = xs
+        h, idx_aux, sel = _attn(h, lp, window, sel, iflag)
         aux = aux + idx_aux
         x = rms_norm(h, lp["post_attn_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
         forced = routing_override[idx] if replay else None
@@ -258,7 +279,7 @@ def forward(
         )
         if cap_ids is not None:
             auxbuf = _capture(auxbuf, idx + cfg.first_k_dense, h)
-        return (h, aux + layer_aux, stats, routing, auxbuf)
+        return (h, aux + layer_aux, stats, routing, auxbuf, sel)
 
     stats0 = jnp.zeros((Lm, E), jnp.float32)
     routing0 = jnp.zeros((Lm, B * S, K), jnp.int32)
@@ -267,21 +288,25 @@ def forward(
         if cap_ids is not None
         else jnp.zeros((0,) + h.shape, h.dtype)
     )
-    carry = (h, jnp.float32(0.0), stats0, routing0, auxbuf0)
+    carry = (h, jnp.float32(0.0), stats0, routing0, auxbuf0, sel0)
     if cfg.first_k_dense > 0:
         carry = scan_layers_windowed(
             dense_layer, carry,
-            (params["dense_layers"], jnp.arange(cfg.first_k_dense)),
+            (
+                params["dense_layers"],
+                jnp.arange(cfg.first_k_dense),
+                idx_flags[: cfg.first_k_dense],
+            ),
             windows[: cfg.first_k_dense],
             remat_policy=cfg.remat_policy, unroll=cfg.scan_unroll,
         )
     carry = scan_layers_windowed(
         moe_layer, carry,
-        (params["moe_layers"], jnp.arange(Lm)),
+        (params["moe_layers"], jnp.arange(Lm), idx_flags[cfg.first_k_dense :]),
         windows[cfg.first_k_dense :],
         remat_policy=cfg.remat_policy, unroll=cfg.scan_unroll,
     )
-    h, aux_loss, tokens_per_expert, routing, aux_hidden = carry
+    h, aux_loss, tokens_per_expert, routing, aux_hidden, _sel = carry
 
     h = rms_norm(h, params["final_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
     out = h if return_hidden else unembed(params, cfg, h)

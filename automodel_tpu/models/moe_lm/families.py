@@ -106,6 +106,26 @@ def deepseek_v4_config(hf: Mapping[str, Any], **overrides) -> MoETransformerConf
     return deepseek_v3_moe_config(hf, **dsa, **overrides)
 
 
+def glm_moe_dsa_config(hf: Mapping[str, Any], **overrides) -> MoETransformerConfig:
+    """GlmMoeDsaForCausalLM (GLM-5.x; reference: models/glm_moe_dsa, 3028
+    LoC): the DeepSeek-style MLA+MoE body (sigmoid grouped router with
+    correction bias, shared experts, first-k-dense) plus the GLM lightning
+    indexer — queries from the q-lora residual, LayerNorm'd keys, rope-first
+    slice — with IndexShare ("shared" layers reuse the previous full layer's
+    top-k selection, config `indexer_types`)."""
+    dsa = {}
+    if hf.get("index_topk"):
+        dsa = dict(
+            dsa_index_topk=int(hf["index_topk"]),
+            dsa_index_n_heads=int(hf.get("index_n_heads", 4)),
+            dsa_index_head_dim=int(hf.get("index_head_dim", 64)),
+            dsa_indexer_style="glm",
+        )
+        if hf.get("indexer_types"):
+            dsa["dsa_indexer_types"] = tuple(hf["indexer_types"])
+    return deepseek_v3_moe_config(hf, **dsa, **overrides)
+
+
 def glm4_moe_config(hf: Mapping[str, Any], **overrides) -> MoETransformerConfig:
     """Glm4MoeForCausalLM (GLM-4.5/4.6; reference: models/glm4_moe, 658 LoC):
     DeepSeek-style sigmoid grouped router with e_score correction bias +

@@ -1,0 +1,562 @@
+"""Gemma4-MoE (E2B/E4B/26B-A4B family) — parallel dense+MoE FFN decoder.
+
+The analog of the reference's gemma4_moe (reference: nemo_automodel/
+components/models/gemma4_moe/model.py, 3377 LoC). Architecture, per layer
+(model.py:355-440 `Gemma4MoEDecoderLayer.forward`):
+
+    x  = residual + post_attn_norm(attn(input_norm(x)))
+    d  = post_ffn_norm_1(dense_mlp(pre_ffn_norm(x)))
+    m  = post_ffn_norm_2(moe(pre_ffn_norm_2(x), gate_input = RAW x))
+    x  = (residual' + post_ffn_norm(d + m)) * layer_scalar
+
+- The router (model.py:200 `Gemma4Gate`) scores a no-scale RMSNorm of the
+  RAW residual, scaled by hidden**-0.5 and a learned per-channel scale, in
+  fp32: softmax → top-k → renormalize. No aux loss, no groups.
+- Attention is gemma3-style: per-head-dim zero-centered qk-norm,
+  query_pre_attn_scalar scaling, alternating sliding/global layers with a
+  separate local rope theta, zero-centered norms, scaled embeddings.
+- KV sharing (model.py:103 `_Gemma4KVShareHolder`): the trailing
+  `num_kv_shared_layers` layers compute no K/V; each reads the most recent
+  SAME-TYPE (sliding/global) full layer's K/V. Shared layers' k/v kernels
+  are zero-filled placeholders in the pytree (absent from HF checkpoints)
+  so the stacked layout stays uniform.
+
+TPU design: stacked params + a python loop over layers (the KV-share read
+pattern is layer-heterogeneous; same idiom as models/hybrid/qwen3_next).
+Experts run through the shared MoE machinery (moe/experts.py dropless or
+EP-distributed paths) with the Gemma4 gate computed locally.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.layers import dense_init, embed_init
+from automodel_tpu.models.llm.decoder import _make_constrain, _stack
+from automodel_tpu.moe.config import MoEConfig
+from automodel_tpu.moe.experts import (
+    expert_param_specs,
+    experts_forward_dropless,
+    experts_forward_dropless_ep,
+    init_experts,
+)
+from automodel_tpu.ops.attention import dot_product_attention
+from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.rope import RopeScalingConfig, apply_rope, rope_frequencies
+
+
+@dataclasses.dataclass(frozen=True)
+class Gemma4MoEConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    intermediate_size: int = 4096      # dense-branch MLP
+    num_layers: int = 4
+    num_heads: int = 8
+    num_kv_heads: int = 4
+    head_dim: int = 256
+    layer_types: tuple = ()            # "sliding" | "global" per layer
+    sliding_window: Optional[int] = 512
+    rope_theta: float = 1_000_000.0
+    rope_local_theta: float = 10_000.0
+    rope_scaling: RopeScalingConfig = dataclasses.field(default_factory=RopeScalingConfig)
+    attn_scale: Optional[float] = None  # query_pre_attn_scalar ** -0.5
+    num_kv_shared_layers: int = 0
+    rms_norm_eps: float = 1e-6
+    tie_word_embeddings: bool = True
+    moe: MoEConfig = dataclasses.field(default_factory=MoEConfig)
+    causal: bool = True
+    logits_soft_cap: Optional[float] = None
+    dtype: Any = jnp.bfloat16
+    remat_policy: str = "full"
+    attn_impl: str = "auto"
+    scan_unroll: int = 1
+    mtp_num_layers: int = 0  # chassis compatibility
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.head_dim
+
+    @property
+    def embed_scale(self) -> float:
+        return float(self.hidden_size) ** 0.5
+
+    def flops_per_token(self, seq_len: int) -> float:
+        H, D = self.hidden_size, self.head_dim
+        attn_p = H * D * (2 * self.num_heads + 2 * self.num_kv_heads)
+        mlp_p = 3 * H * self.intermediate_size
+        moe_p = 3 * H * self.moe.moe_intermediate_size * self.moe.experts_per_token
+        n = self.vocab_size * H + self.num_layers * (attn_p + mlp_p + moe_p)
+        return 6.0 * n + 6.0 * self.num_layers * self.num_heads * D * seq_len
+
+
+def init(cfg: Gemma4MoEConfig, rng: jax.Array) -> dict:
+    H, I, D = cfg.hidden_size, cfg.intermediate_size, cfg.head_dim
+    L = cfg.num_layers
+    ks = jax.random.split(rng, 12)
+    layers = {
+        "input_norm": {"scale": jnp.zeros((L, H))},
+        "post_attn_norm": {"scale": jnp.zeros((L, H))},
+        "q_proj": {"kernel": _stack(dense_init, ks[0], (H, cfg.num_heads * D), L)},
+        "k_proj": {"kernel": _stack(dense_init, ks[1], (H, cfg.num_kv_heads * D), L)},
+        "v_proj": {"kernel": _stack(dense_init, ks[2], (H, cfg.num_kv_heads * D), L)},
+        "o_proj": {"kernel": _stack(dense_init, ks[3], (cfg.num_heads * D, H), L)},
+        "q_norm": {"scale": jnp.zeros((L, D))},
+        "k_norm": {"scale": jnp.zeros((L, D))},
+        "pre_ffn_norm": {"scale": jnp.zeros((L, H))},
+        "post_ffn_norm_1": {"scale": jnp.zeros((L, H))},
+        "pre_ffn_norm_2": {"scale": jnp.zeros((L, H))},
+        "post_ffn_norm_2": {"scale": jnp.zeros((L, H))},
+        "post_ffn_norm": {"scale": jnp.zeros((L, H))},
+        "layer_scalar": jnp.ones((L, 1)),
+        "gate_proj": {"kernel": _stack(dense_init, ks[4], (H, I), L)},
+        "up_proj": {"kernel": _stack(dense_init, ks[5], (H, I), L)},
+        "down_proj": {"kernel": _stack(dense_init, ks[6], (I, H), L)},
+        "router": {
+            "proj": {"kernel": _stack(dense_init, ks[7], (H, cfg.moe.n_routed_experts), L)},
+            "scale": jnp.ones((L, H)),
+        },
+        "experts": jax.tree.map(
+            lambda *xs: jnp.stack(xs),
+            *[
+                init_experts(cfg.moe, H, k)
+                for k in jax.random.split(ks[8], L)
+            ],
+        ),
+    }
+    params = {
+        "embed": {"embedding": embed_init(ks[9], (cfg.vocab_size, H))},
+        "final_norm": {"scale": jnp.zeros((H,))},
+        "layers": layers,
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = {"kernel": dense_init(ks[10], (H, cfg.vocab_size))}
+    return params
+
+
+def param_specs(cfg: Gemma4MoEConfig) -> dict:
+    layers = {
+        "input_norm": {"scale": ("layers", "norm")},
+        "post_attn_norm": {"scale": ("layers", "norm")},
+        "q_proj": {"kernel": ("layers", "embed", "heads")},
+        "k_proj": {"kernel": ("layers", "embed", "kv_heads")},
+        "v_proj": {"kernel": ("layers", "embed", "kv_heads")},
+        "o_proj": {"kernel": ("layers", "heads", "embed")},
+        "q_norm": {"scale": ("layers", "norm")},
+        "k_norm": {"scale": ("layers", "norm")},
+        "pre_ffn_norm": {"scale": ("layers", "norm")},
+        "post_ffn_norm_1": {"scale": ("layers", "norm")},
+        "pre_ffn_norm_2": {"scale": ("layers", "norm")},
+        "post_ffn_norm_2": {"scale": ("layers", "norm")},
+        "post_ffn_norm": {"scale": ("layers", "norm")},
+        "layer_scalar": ("layers", None),
+        "gate_proj": {"kernel": ("layers", "embed", "mlp")},
+        "up_proj": {"kernel": ("layers", "embed", "mlp")},
+        "down_proj": {"kernel": ("layers", "mlp", "embed")},
+        "router": {
+            "proj": {"kernel": ("layers", "embed", None)},
+            "scale": ("layers", "norm"),
+        },
+        "experts": jax.tree.map(
+            lambda s: ("layers",) + s,
+            expert_param_specs(cfg.moe),
+            is_leaf=lambda x: isinstance(x, tuple),
+        ),
+    }
+    specs = {
+        "embed": {"embedding": ("vocab", "embed")},
+        "final_norm": {"scale": ("norm",)},
+        "layers": layers,
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = {"kernel": ("embed", "vocab")}
+    return specs
+
+
+def gemma4_gate(x_raw, lp, cfg: Gemma4MoEConfig):
+    """Router on the RAW residual: no-scale RMSNorm · H**-0.5 · scale →
+    fp32 linear → softmax → top-k → renormalize. Returns (weights (T,K),
+    indices (T,K))."""
+    x = x_raw.astype(jnp.float32)
+    x = x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + cfg.rms_norm_eps)
+    x = x * (float(cfg.hidden_size) ** -0.5)
+    x = x * lp["router"]["scale"].astype(jnp.float32)
+    logits = x @ lp["router"]["proj"]["kernel"].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    weights, indices = jax.lax.top_k(probs, cfg.moe.experts_per_token)
+    weights = weights / jnp.maximum(jnp.sum(weights, -1, keepdims=True), 1e-20)
+    return weights, indices
+
+
+def forward(
+    params: dict,
+    cfg: Gemma4MoEConfig,
+    input_ids: jnp.ndarray,
+    *,
+    positions: jnp.ndarray | None = None,
+    segment_ids: jnp.ndarray | None = None,
+    mesh_ctx=None,
+    rules=None,
+    return_hidden: bool = False,
+    token_mask: jnp.ndarray | None = None,
+    return_stats: bool = False,
+    **_ignored,
+) -> tuple:
+    """Returns (logits-or-hidden, aux_loss[, stats]) — the moe_lm protocol
+    (aux is always 0.0: the Gemma4 router carries no aux loss)."""
+    from automodel_tpu.models.common.layers import cast_params, maybe_remat
+
+    params = cast_params(params, cfg.dtype)
+    B, S = input_ids.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
+    constrain = _make_constrain(mesh_ctx, rules)
+
+    tbl = constrain(params["embed"]["embedding"], ("vocab", None))
+    h = jnp.take(tbl, input_ids, axis=0).astype(cfg.dtype)
+    h = h * jnp.asarray(cfg.embed_scale, cfg.dtype)
+    h = constrain(h, ("act_batch", "act_seq", "act_embed"))
+
+    inv_freq_g = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    inv_freq_l = rope_frequencies(cfg.head_dim, cfg.rope_local_theta, None)
+    D = cfg.head_dim
+    scale = cfg.attn_scale if cfg.attn_scale is not None else D ** -0.5
+    eps = cfg.rms_norm_eps
+    layer_types = cfg.layer_types or tuple(
+        "sliding" if (i + 1) % 6 else "global" for i in range(cfg.num_layers)
+    )
+    first_shared = cfg.num_layers - cfg.num_kv_shared_layers
+    ep = mesh_ctx is not None and mesh_ctx.sizes["ep"] > 1
+
+    stats_rows = []
+    last_kv: dict = {"sliding": None, "global": None}
+
+    def one_layer(h, lp, lt, kv_in):
+        """Returns (h_out, (k, v), tokens_per_expert)."""
+        inv_freq = inv_freq_l if lt == "sliding" else inv_freq_g
+        window = cfg.sliding_window if lt == "sliding" else None
+        resid = h
+        x = rms_norm(h, lp["input_norm"]["scale"], eps, zero_centered=True)
+        q = (x @ lp["q_proj"]["kernel"]).reshape(B, S, cfg.num_heads, D)
+        q = rms_norm(q, lp["q_norm"]["scale"], eps, zero_centered=True)
+        q = apply_rope(q, positions, inv_freq)
+        if kv_in is None:
+            k = (x @ lp["k_proj"]["kernel"]).reshape(B, S, cfg.num_kv_heads, D)
+            k = rms_norm(k, lp["k_norm"]["scale"], eps, zero_centered=True)
+            k = apply_rope(k, positions, inv_freq)
+            v = (x @ lp["v_proj"]["kernel"]).reshape(B, S, cfg.num_kv_heads, D)
+        else:
+            k, v = kv_in
+        q = constrain(q, ("act_batch", "act_seq", "act_heads", None))
+        attn = dot_product_attention(
+            q, k, v, causal=cfg.causal, segment_ids=segment_ids,
+            positions=positions, sliding_window=window, scale=scale,
+            impl=cfg.attn_impl,
+        ).reshape(B, S, cfg.num_heads * D)
+        attn_out = attn @ lp["o_proj"]["kernel"]
+        attn_out = rms_norm(attn_out, lp["post_attn_norm"]["scale"], eps, zero_centered=True)
+        h = resid + attn_out
+        h = constrain(h, ("act_batch", "act_seq", "act_embed"))
+
+        resid = h
+        xd = rms_norm(h, lp["pre_ffn_norm"]["scale"], eps, zero_centered=True)
+        d = jax.nn.gelu(xd @ lp["gate_proj"]["kernel"], approximate=True) * (
+            xd @ lp["up_proj"]["kernel"]
+        )
+        d = d @ lp["down_proj"]["kernel"]
+        d = rms_norm(d, lp["post_ffn_norm_1"]["scale"], eps, zero_centered=True)
+
+        xm = rms_norm(h, lp["pre_ffn_norm_2"]["scale"], eps, zero_centered=True)
+        flat = xm.reshape(B * S, cfg.hidden_size)
+        weights, indices = gemma4_gate(h.reshape(B * S, cfg.hidden_size), lp, cfg)
+        weights = weights.astype(flat.dtype)
+        if ep:
+            routed = experts_forward_dropless_ep(
+                lp["experts"], cfg.moe, flat, weights, indices, mesh_ctx
+            )
+        else:
+            routed = experts_forward_dropless(
+                lp["experts"], cfg.moe, flat, weights, indices
+            )
+        m = routed.reshape(B, S, cfg.hidden_size)
+        m = rms_norm(m, lp["post_ffn_norm_2"]["scale"], eps, zero_centered=True)
+
+        out = rms_norm(d + m, lp["post_ffn_norm"]["scale"], eps, zero_centered=True)
+        h = (resid + out) * lp["layer_scalar"][0]
+        h = constrain(h, ("act_batch", "act_seq", "act_embed"))
+
+        tpe = jnp.sum(
+            jax.nn.one_hot(indices, cfg.moe.n_routed_experts, dtype=jnp.float32),
+            axis=(0, 1),
+        )
+        return h, (k, v), tpe
+
+    remat = cfg.remat_policy not in (None, "none")
+    for i, lt in enumerate(layer_types):
+        lp = jax.tree.map(lambda p: p[i], params["layers"])
+        kv_in = last_kv[lt] if i >= first_shared else None
+
+        def body(h, lp=lp, lt=lt, kv_in=kv_in):
+            return one_layer(h, lp, lt, kv_in)
+
+        h, kv, tpe = (jax.checkpoint(body) if remat else body)(h)
+        if i < first_shared:
+            last_kv[lt] = kv
+        stats_rows.append(tpe)
+
+    h = rms_norm(h, params["final_norm"]["scale"], eps, zero_centered=True)
+    if return_hidden:
+        out = h
+    else:
+        kernel = (
+            params["embed"]["embedding"].T
+            if cfg.tie_word_embeddings
+            else params["lm_head"]["kernel"]
+        )
+        out = jnp.einsum(
+            "bsh,hv->bsv", h, kernel.astype(h.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        if cfg.logits_soft_cap is not None:
+            out = cfg.logits_soft_cap * jnp.tanh(out / cfg.logits_soft_cap)
+    aux = jnp.float32(0.0)
+    if return_stats:
+        return out, aux, {"tokens_per_expert": jnp.stack(stats_rows)}
+    return out, aux
+
+
+def gemma4_moe_config(hf: dict, **overrides) -> Gemma4MoEConfig:
+    """Gemma4ForConditionalGeneration → text-decoder config. VL composite
+    configs nest under text_config (vision tower: VLM tier)."""
+    text = hf.get("text_config") or hf
+    lt = text.get("layer_types")
+    if lt is not None:
+        layer_types = tuple(
+            "sliding" if t == "sliding_attention" else "global" for t in lt
+        )
+    else:
+        pattern = int(text.get("sliding_window_pattern", 6) or 6)
+        layer_types = tuple(
+            "global" if (i + 1) % pattern == 0 else "sliding"
+            for i in range(int(text["num_hidden_layers"]))
+        )
+    moe_inter = text.get("moe_intermediate_size") or text.get("expert_intermediate_size")
+    moe = MoEConfig(
+        n_routed_experts=int(text["num_experts"]),
+        experts_per_token=int(text["top_k_experts"]),
+        moe_intermediate_size=int(moe_inter),
+        score_func="softmax",
+        norm_topk_prob=True,
+        expert_activation="geglu",
+        aux_loss_coeff=0.0,
+        dispatcher="dropless",
+    )
+    heads = int(text["num_attention_heads"])
+    qpas = text.get("query_pre_attn_scalar")
+    kw = dict(
+        vocab_size=int(text["vocab_size"]),
+        hidden_size=int(text["hidden_size"]),
+        intermediate_size=int(text["intermediate_size"]),
+        num_layers=int(text["num_hidden_layers"]),
+        num_heads=heads,
+        num_kv_heads=int(text.get("num_key_value_heads", heads)),
+        head_dim=int(text.get("head_dim", 256)),
+        layer_types=layer_types,
+        sliding_window=int(text.get("sliding_window", 512) or 512),
+        rope_theta=float(text.get("rope_theta", 1_000_000.0)),
+        rope_local_theta=float(text.get("rope_local_base_freq", 10_000.0)),
+        rope_scaling=RopeScalingConfig.from_hf(text.get("rope_scaling")),
+        attn_scale=(float(qpas) ** -0.5) if qpas else None,
+        num_kv_shared_layers=int(text.get("num_kv_shared_layers", 0) or 0),
+        rms_norm_eps=float(text.get("rms_norm_eps", 1e-6)),
+        tie_word_embeddings=bool(hf.get("tie_word_embeddings", True)),
+        moe=moe,
+    )
+    moe_overrides = overrides.pop("moe", None)
+    for k in list(overrides):
+        if k not in {f.name for f in dataclasses.fields(Gemma4MoEConfig)}:
+            overrides.pop(k)
+    kw.update(overrides)
+    if moe_overrides is not None:
+        kw["moe"] = moe_overrides
+    return Gemma4MoEConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# HF state-dict adapter (reference: gemma4_moe/state_dict_adapter.py —
+# stacked moe.gate_up_proj/down_proj/per_expert_scale, router.* keys)
+# ---------------------------------------------------------------------------
+class Gemma4MoEAdapter:
+    """Gemma4ForConditionalGeneration text weights ↔ our params pytree.
+
+    HF stores experts stacked: `moe.gate_up_proj` (E, 2I, H) [gate; up],
+    `moe.down_proj` (E, H, I) and a `moe.per_expert_scale` (E) absorbed into
+    down_proj at load (exported back as ones — reference adapter does the
+    same). KV-shared trailing layers carry no k/v/k_norm keys: zero-filled
+    placeholders at load, omitted at save.
+    """
+
+    def __init__(self, cfg: Gemma4MoEConfig):
+        self.cfg = cfg
+
+    _NORMS = {
+        "input_layernorm": ("input_norm",),
+        "post_attention_layernorm": ("post_attn_norm",),
+        "pre_feedforward_layernorm": ("pre_ffn_norm",),
+        "post_feedforward_layernorm_1": ("post_ffn_norm_1",),
+        "pre_feedforward_layernorm_2": ("pre_ffn_norm_2",),
+        "post_feedforward_layernorm_2": ("post_ffn_norm_2",),
+        "post_feedforward_layernorm": ("post_ffn_norm",),
+    }
+
+    def _kv_absent(self, i: int) -> bool:
+        return i >= self.cfg.num_layers - self.cfg.num_kv_shared_layers
+
+    def from_hf(self, read, shardings=None) -> dict:
+        import numpy as np
+
+        from automodel_tpu.checkpoint.hf_adapter import _get, _set
+
+        cfg = self.cfg
+        L = cfg.num_layers
+        I = cfg.moe.moe_intermediate_size
+
+        def probe(k):
+            try:
+                read(k)
+                return True
+            except KeyError:
+                return False
+
+        prefix = "model.language_model." if probe(
+            "model.language_model.embed_tokens.weight"
+        ) else "model."
+
+        params: dict = {}
+
+        def put(path, value):
+            sh = _get(shardings, path) if shardings is not None else None
+            _set(params, path, jax.device_put(value, sh) if sh is not None else jnp.asarray(value))
+
+        put(("embed", "embedding"), read(prefix + "embed_tokens.weight"))
+        put(("final_norm", "scale"), read(prefix + "norm.weight"))
+        if not cfg.tie_word_embeddings and probe("lm_head.weight"):
+            put(("lm_head", "kernel"), np.ascontiguousarray(read("lm_head.weight").T))
+
+        def lay(i, suffix):
+            return read(f"{prefix}layers.{i}.{suffix}")
+
+        def stackT(suffix):
+            return np.stack(
+                [np.ascontiguousarray(lay(i, suffix).T) for i in range(L)]
+            )
+
+        def stack_(suffix):
+            return np.stack([lay(i, suffix) for i in range(L)])
+
+        for hf_name, path in self._NORMS.items():
+            put(("layers",) + path + ("scale",), stack_(hf_name + ".weight"))
+        put(("layers", "q_norm", "scale"), stack_("self_attn.q_norm.weight"))
+        put(("layers", "q_proj", "kernel"), stackT("self_attn.q_proj.weight"))
+        put(("layers", "o_proj", "kernel"), stackT("self_attn.o_proj.weight"))
+
+        def kv_stack(suffix, transpose):
+            rows, ref = [], None
+            for i in range(L):
+                if self._kv_absent(i):
+                    rows.append(None)
+                    continue
+                x = lay(i, suffix)
+                x = np.ascontiguousarray(x.T) if transpose else np.asarray(x)
+                rows.append(x)
+                ref = x
+            return np.stack([r if r is not None else np.zeros_like(ref) for r in rows])
+
+        put(("layers", "k_proj", "kernel"), kv_stack("self_attn.k_proj.weight", True))
+        put(("layers", "v_proj", "kernel"), kv_stack("self_attn.v_proj.weight", True))
+        put(("layers", "k_norm", "scale"), kv_stack("self_attn.k_norm.weight", False))
+
+        scalars = []
+        for i in range(L):
+            try:
+                scalars.append(np.asarray(lay(i, "layer_scalar")).reshape(1))
+            except KeyError:
+                scalars.append(np.ones((1,), np.float32))
+        put(("layers", "layer_scalar"), np.stack(scalars))
+
+        for proj in ("gate_proj", "up_proj", "down_proj"):
+            put(("layers", proj, "kernel"), stackT(f"mlp.{proj}.weight"))
+
+        put(("layers", "router", "proj", "kernel"), stackT("router.proj.weight"))
+        put(("layers", "router", "scale"), stack_("router.scale"))
+
+        gates, ups, downs = [], [], []
+        for i in range(L):
+            gu = np.asarray(lay(i, "moe.gate_up_proj"))        # (E, 2I, H)
+            dn = np.asarray(lay(i, "moe.down_proj"))           # (E, H, I)
+            try:
+                pes = np.asarray(lay(i, "moe.per_expert_scale"))
+            except KeyError:
+                pes = np.ones((gu.shape[0],), gu.dtype)
+            guT = np.swapaxes(gu, -1, -2)                      # (E, H, 2I)
+            gates.append(guT[..., :I])
+            ups.append(guT[..., I:])
+            downs.append(np.swapaxes(dn, -1, -2) * pes[:, None, None])
+        put(("layers", "experts", "gate_proj", "kernel"), np.stack(gates))
+        put(("layers", "experts", "up_proj", "kernel"), np.stack(ups))
+        put(("layers", "experts", "down_proj", "kernel"), np.stack(downs))
+        return params
+
+    def to_hf(self, params):
+        import numpy as np
+
+        cfg = self.cfg
+        L = cfg.num_layers
+        prefix = "model.language_model."
+
+        def _t(x):
+            return np.ascontiguousarray(np.asarray(x).T)
+
+        yield prefix + "embed_tokens.weight", np.asarray(params["embed"]["embedding"])
+        yield prefix + "norm.weight", np.asarray(params["final_norm"]["scale"])
+        if not cfg.tie_word_embeddings and "lm_head" in params:
+            yield "lm_head.weight", _t(params["lm_head"]["kernel"])
+        lay = params["layers"]
+        for i in range(L):
+            base = f"{prefix}layers.{i}."
+            for hf_name, path in self._NORMS.items():
+                node = lay
+                for p in path:
+                    node = node[p]
+                yield base + hf_name + ".weight", np.asarray(node["scale"][i])
+            yield base + "self_attn.q_norm.weight", np.asarray(lay["q_norm"]["scale"][i])
+            yield base + "self_attn.q_proj.weight", _t(lay["q_proj"]["kernel"][i])
+            yield base + "self_attn.o_proj.weight", _t(lay["o_proj"]["kernel"][i])
+            if not self._kv_absent(i):
+                yield base + "self_attn.k_proj.weight", _t(lay["k_proj"]["kernel"][i])
+                yield base + "self_attn.v_proj.weight", _t(lay["v_proj"]["kernel"][i])
+                yield base + "self_attn.k_norm.weight", np.asarray(lay["k_norm"]["scale"][i])
+            yield base + "layer_scalar", np.asarray(lay["layer_scalar"][i]).reshape(1)
+            for proj in ("gate_proj", "up_proj", "down_proj"):
+                yield base + f"mlp.{proj}.weight", _t(lay[proj]["kernel"][i])
+            yield base + "router.proj.weight", _t(lay["router"]["proj"]["kernel"][i])
+            yield base + "router.scale", np.asarray(lay["router"]["scale"][i])
+            g = np.asarray(lay["experts"]["gate_proj"]["kernel"][i])  # (E, H, I)
+            u = np.asarray(lay["experts"]["up_proj"]["kernel"][i])
+            d = np.asarray(lay["experts"]["down_proj"]["kernel"][i])  # (E, I, H)
+            gu = np.swapaxes(np.concatenate([g, u], axis=-1), -1, -2)  # (E, 2I, H)
+            yield base + "moe.gate_up_proj", np.ascontiguousarray(gu)
+            yield base + "moe.down_proj", np.ascontiguousarray(np.swapaxes(d, -1, -2))
+            yield base + "moe.per_expert_scale", np.ones((g.shape[0],), g.dtype)
+
+
+def _register_adapter():
+    from automodel_tpu.checkpoint.hf_adapter import ADAPTERS
+
+    ADAPTERS["gemma4_moe"] = Gemma4MoEAdapter
+
+
+_register_adapter()

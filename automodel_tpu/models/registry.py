@@ -13,10 +13,12 @@ from typing import Any, Callable, Mapping
 
 from automodel_tpu.models.hybrid import mamba2 as mamba2_module
 from automodel_tpu.models.hybrid import nemotron_h as nemotron_h_module
+from automodel_tpu.models.hybrid import qwen3_5 as qwen3_5_module
 from automodel_tpu.models.hybrid import qwen3_next as qwen3_next_module
 from automodel_tpu.models.llm import decoder, families
 from automodel_tpu.models.moe_lm import decoder as moe_decoder
 from automodel_tpu.models.moe_lm import families as moe_families
+from automodel_tpu.models.moe_lm import gemma4 as gemma4_module
 from automodel_tpu.models.omni import model as omni_module
 from automodel_tpu.models.vlm import llava as llava_module
 
@@ -70,6 +72,18 @@ MODEL_ARCH_MAPPING: dict[str, ModelSpec] = {
         "glm4_moe", moe_families.glm4_moe_config, moe_decoder,
         adapter_name="moe_decoder",
     ),
+    # Gemma4-MoE (VL composite; text decoder — reference: models/gemma4_moe,
+    # parallel dense+MoE FFN, KV sharing, Gemma4Gate router)
+    "Gemma4ForConditionalGeneration": ModelSpec(
+        "gemma4_moe", gemma4_module.gemma4_moe_config, gemma4_module,
+        adapter_name="gemma4_moe",
+    ),
+    # GLM-5.x: MLA+MoE body + GLM indexer with IndexShare (reference:
+    # models/glm_moe_dsa — deepseek-style checkpoint naming for MLA/MoE)
+    "GlmMoeDsaForCausalLM": ModelSpec(
+        "glm_moe_dsa", moe_families.glm_moe_dsa_config, moe_decoder,
+        adapter_name="moe_decoder", adapter_kwargs={"style": "deepseek"},
+    ),
     "Ernie4_5_MoeForCausalLM": ModelSpec(
         "ernie4_5_moe", moe_families.ernie4_5_moe_config, moe_decoder,
         adapter_name="moe_decoder", adapter_kwargs={"style": "ernie"},
@@ -94,6 +108,10 @@ MODEL_ARCH_MAPPING: dict[str, ModelSpec] = {
         "deepseek_v32", moe_families.deepseek_v4_config, moe_decoder,
         adapter_name="moe_decoder", adapter_kwargs={"style": "deepseek"},
     ),
+    "BaichuanForCausalLM": ModelSpec(
+        "baichuan", families.baichuan_config, decoder,
+        adapter_kwargs={"style": "baichuan"},
+    ),
     "LlamaBidirectionalModel": ModelSpec(
         "llama_bidirectional", families.llama_bidirectional_config, decoder
     ),
@@ -114,6 +132,17 @@ MODEL_ARCH_MAPPING: dict[str, ModelSpec] = {
     "Qwen3NextForCausalLM": ModelSpec(
         "qwen3_next", qwen3_next_module.from_hf_config, qwen3_next_module,
         adapter_name="qwen3_next",
+    ),
+    # Qwen3.5 dense / MoE (VL text decoder) — the qwen3-next engine with the
+    # Qwen3.5 checkpoint layout (reference: models/qwen3_5{,_moe}/model.py
+    # rebuild both on the Qwen3-Next Block)
+    "Qwen3_5ForCausalLM": ModelSpec(
+        "qwen3_5", qwen3_5_module.qwen3_5_config, qwen3_5_module,
+        adapter_name="qwen3_5", adapter_kwargs={"vl_prefix": False},
+    ),
+    "Qwen3_5MoeForConditionalGeneration": ModelSpec(
+        "qwen3_5_moe", qwen3_5_module.qwen3_5_moe_config, qwen3_5_module,
+        adapter_name="qwen3_5",
     ),
     # omni (text·image·audio; reference: components/models/nemotron_omni,
     # qwen2_5_omni) — towers + projectors around a dense decoder backbone

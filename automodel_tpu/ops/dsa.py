@@ -52,6 +52,46 @@ def indexer_scores(
     return jnp.einsum("bth,bhts->bts", w.astype(jnp.float32), dots)
 
 
+def indexer_scores_glm(
+    x: jnp.ndarray,          # (B, S, H) normed layer input
+    q_lat: jnp.ndarray,      # (B, S, r_q) MLA q-lora residual (post q_norm)
+    ip: dict,                # {"wq","wk","k_norm","wgate"}
+    n_heads: int,
+    head_dim: int,
+    positions: jnp.ndarray,  # (B, S)
+    inv_freq: jnp.ndarray,   # rope freqs for the ROPE SLICE (qk_rope_head_dim)
+) -> jnp.ndarray:
+    """GLM-5.x IndexShare indexer scores (B, S, S) fp32 (reference:
+    glm_moe_dsa/layers.py:215-360 `GlmMoeDsaIndexer.forward`).
+
+    Differences from the DeepSeek lightning indexer (`indexer_scores`):
+    queries project from the MLA q-lora residual, keys are LayerNorm'd, the
+    rope slice is laid FIRST in the head dim with half-split rotation (our
+    apply_rope with a short inv_freq does exactly that), and the per-head
+    gate weights carry an extra n_heads**-0.5 factor.
+    """
+    from automodel_tpu.ops.rope import apply_rope
+
+    B, S, H = x.shape
+    q = (q_lat @ ip["wq"]["kernel"].astype(x.dtype)).reshape(B, S, n_heads, head_dim)
+    k = x @ ip["wk"]["kernel"].astype(x.dtype)  # (B, S, head_dim)
+    # LayerNorm (with bias, eps 1e-6) over the key head dim
+    mu = jnp.mean(k.astype(jnp.float32), axis=-1, keepdims=True)
+    var = jnp.var(k.astype(jnp.float32), axis=-1, keepdims=True)
+    k = (k.astype(jnp.float32) - mu) * jax.lax.rsqrt(var + 1e-6)
+    k = k * ip["k_norm"]["scale"].astype(jnp.float32) + ip["k_norm"]["bias"].astype(jnp.float32)
+    k = k.astype(x.dtype)
+    q = apply_rope(q, positions, inv_freq)
+    k = apply_rope(k[:, :, None, :], positions, inv_freq)[:, :, 0, :]
+    w = (x @ ip["wgate"]["kernel"].astype(x.dtype)).astype(jnp.float32)
+    w = w * (n_heads ** -0.5)
+    dots = jnp.einsum(
+        "bthd,bsd->bhts", q, k, preferred_element_type=jnp.float32
+    )
+    dots = jax.nn.relu(dots * (head_dim ** -0.5))
+    return jnp.einsum("bth,bhts->bts", w, dots)
+
+
 def topk_select_mask(
     scores: jnp.ndarray,        # (B, S, S) fp32 indexer scores
     base_mask: jnp.ndarray,     # (B?, S, S) bool causal/segment mask

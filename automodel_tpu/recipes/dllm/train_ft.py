@@ -109,11 +109,9 @@ class DiffusionLMSFTRecipe(TrainFinetuneRecipeForNextTokenPrediction):
                 params, noisy,
                 base_params=base_params, token_mask=loss_mask, **kw,
             )
-            kernel = (
-                params["embed"]["embedding"].T
-                if model_cfg.tie_word_embeddings
-                else params["lm_head"]["kernel"]
-            )
+            from automodel_tpu.models.llm.decoder import head_kernel
+
+            kernel = head_kernel(params, model_cfg)
             ce_sum, n = mdlm_loss_from_hidden(
                 hidden, kernel, clean_ids, noise_mask, p_mask, loss_mask,
                 chunk_size=chunk, logits_soft_cap=model_cfg.logits_soft_cap,

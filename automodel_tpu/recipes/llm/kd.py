@@ -122,16 +122,10 @@ class KDRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPrediction):
                 teacher_params, batch["input_ids"], token_mask=token_mask, **kw
             )
             t_hidden = jax.lax.stop_gradient(t_hidden)
-            s_kernel = (
-                params["embed"]["embedding"].T
-                if student_cfg.tie_word_embeddings
-                else params["lm_head"]["kernel"]
-            )
-            t_kernel = (
-                teacher_params["embed"]["embedding"].T
-                if teacher_cfg.tie_word_embeddings
-                else teacher_params["lm_head"]["kernel"]
-            )
+            from automodel_tpu.models.llm.decoder import head_kernel
+
+            s_kernel = head_kernel(params, student_cfg)
+            t_kernel = head_kernel(teacher_params, teacher_cfg)
             total, n = fused_kd_cross_entropy(
                 s_hidden, s_kernel, t_hidden, t_kernel, batch["labels"],
                 kd_ratio=kd_ratio, temperature=temperature, chunk_size=chunk,

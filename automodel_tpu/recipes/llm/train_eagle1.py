@@ -40,11 +40,12 @@ from automodel_tpu.speculative.eagle1 import (
 logger = logging.getLogger(__name__)
 
 
-def _target_head_kernel(target_params):
-    """(H, V) frozen head — lm_head kernel, or tied embedding transposed."""
-    if "lm_head" in target_params:
-        return target_params["lm_head"]["kernel"]
-    return target_params["embed"]["embedding"].T
+def _target_head_kernel(target_params, target_cfg):
+    """(H, V) frozen head — lm_head kernel, or tied embedding transposed
+    (incl. NormHead normalization)."""
+    from automodel_tpu.models.llm.decoder import head_kernel
+
+    return head_kernel(target_params, target_cfg)
 
 
 class TrainEagle1Recipe(TrainEagle3Recipe):
@@ -116,7 +117,7 @@ class TrainEagle1Recipe(TrainEagle3Recipe):
                     target_params, target_cfg, ids, mesh_ctx=mesh_ctx,
                     return_hidden=True, **kw,
                 )
-            head = _target_head_kernel(target_params)
+            head = _target_head_kernel(target_params, target_cfg)
             logits = jnp.einsum(
                 "bth,hv->btv", hidden, head.astype(hidden.dtype),
                 preferred_element_type=jnp.float32,

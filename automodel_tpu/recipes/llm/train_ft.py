@@ -199,6 +199,14 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             v = mcfg.get(k, v)
             if v is not None:
                 overrides[k] = conv(v)
+        sched = str(overrides.get("pipeline_schedule", "gpipe")).strip().lower()
+        if sched not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"pipeline_schedule must be 'gpipe' or '1f1b', got "
+                f"{overrides['pipeline_schedule']!r}"
+            )
+        if "pipeline_schedule" in overrides:
+            overrides["pipeline_schedule"] = sched
 
         pretrained = mcfg.get("pretrained_path", None)
         if pretrained:
@@ -386,11 +394,9 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                 params, batch["input_ids"],
                 base_params=base_params, token_mask=token_mask, **kw,
             )
-            kernel = (
-                params["embed"]["embedding"].T
-                if model_cfg.tie_word_embeddings
-                else params["lm_head"]["kernel"]
-            )
+            from automodel_tpu.models.llm.decoder import head_kernel
+
+            kernel = head_kernel(params, model_cfg)
             ce_sum, n = fused_linear_cross_entropy(
                 hidden, kernel, batch["labels"], chunk_size=chunk,
                 logits_soft_cap=model_cfg.logits_soft_cap,

@@ -22,13 +22,11 @@ logger = logging.getLogger(__name__)
 
 
 def vlm_lm_kernel(params, text_cfg):
-    """The language model's unembedding kernel (tied or separate)."""
-    lm = params["language_model"]
-    return (
-        lm["embed"]["embedding"].T
-        if text_cfg.tie_word_embeddings
-        else lm["lm_head"]["kernel"]
-    )
+    """The language model's unembedding kernel (tied or separate, incl.
+    NormHead normalization via head_kernel)."""
+    from automodel_tpu.models.llm.decoder import head_kernel
+
+    return head_kernel(params["language_model"], text_cfg)
 
 
 class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):

@@ -76,7 +76,7 @@ def test_sparse_equals_dense_when_topk_covers_all():
     inv_freq = rope_frequencies(cfg.rope_dim, cfg.rope_theta)
     ident = lambda a, axes: a
 
-    sparse_out, aux = mla.mla_sparse_attention_block(
+    sparse_out, aux, _sel = mla.mla_sparse_attention_block(
         h, lp, cfg, pos, None, inv_freq, ident
     )
     dense_cfg = dataclasses.replace(cfg, dsa_index_topk=None)
@@ -104,7 +104,7 @@ def test_indexer_gets_gradient_only_via_kl():
     ident = lambda a, axes: a
 
     def loss_with_aux(lp):
-        out, aux = mla.mla_sparse_attention_block(h, lp, cfg, pos, None, inv_freq, ident)
+        out, aux, _ = mla.mla_sparse_attention_block(h, lp, cfg, pos, None, inv_freq, ident)
         return jnp.sum(out**2) * 0.0 + aux  # only the aux path
 
     g = jax.grad(loss_with_aux)(lp)
@@ -112,7 +112,7 @@ def test_indexer_gets_gradient_only_via_kl():
     assert float(gnorm) > 0.0  # indexer learns from the KL term
 
     def loss_no_aux(lp):
-        out, aux = mla.mla_sparse_attention_block(h, lp, cfg, pos, None, inv_freq, ident)
+        out, aux, _ = mla.mla_sparse_attention_block(h, lp, cfg, pos, None, inv_freq, ident)
         return jnp.sum(out.astype(jnp.float32) ** 2)
 
     g2 = jax.grad(loss_no_aux)(lp)
