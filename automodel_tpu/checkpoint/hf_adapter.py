@@ -69,6 +69,36 @@ def _permute_k_rope(kernel: np.ndarray, kv_rank: int, dr: int, inverse: bool) ->
     return np.concatenate([kernel[..., :kv_rank], rope], axis=-1)
 
 
+def reader_has_key(read, key: str) -> bool:
+    """O(1) key-existence probe when `read` exposes keys() (HFCheckpointReader
+    / dict); falls back to a try-read for plain callables (tests)."""
+    ks = getattr(read, "keys", None)
+    if callable(ks):
+        return key in ks()
+    try:
+        read(key)
+        return True
+    except KeyError:
+        return False
+
+
+def memo1_reader(read):
+    """Wrap `read` with a one-entry cache — per-expert adapter shims slice
+    the same stacked tensor E times in a row; this makes that one disk read
+    without holding more than one tensor."""
+    last: dict = {}
+
+    def cached(name):
+        if last.get("name") != name:
+            last["name"], last["val"] = name, read(name)
+        return last["val"]
+
+    ks = getattr(read, "keys", None)
+    if callable(ks):
+        cached.keys = ks  # preserve the O(1) existence probe
+    return cached
+
+
 def _stack_layers_zero_fill(one, names, transpose, tr, absent_ok):
     """Stack per-layer tensors, zero-filling layers `absent_ok` declares
     keyless (GLM IndexShare "shared" layers own no indexer weights). A key

@@ -26,10 +26,13 @@ class MockVLMDatasetConfig:
     num_channels: int = 3
     image_token_id: int = 500
     seed: int = 0
+    # spatial merge after the tower (kimi-vl/qwen-vl style): one image token
+    # per merge_factor×merge_factor patch block
+    merge_factor: int = 1
 
     @property
     def num_patches(self) -> int:
-        return (self.image_size // self.patch_size) ** 2
+        return (self.image_size // self.patch_size // self.merge_factor) ** 2
 
     def build(self) -> "MockVLMDataset":
         return MockVLMDataset(self)

@@ -124,12 +124,10 @@ class Qwen3_5Adapter(qn.Qwen3NextAdapter):
 
     # -- import --------------------------------------------------------------
     def from_hf(self, read, shardings=None) -> dict:
-        def probe(key):
-            try:
-                read(key)
-                return True
-            except KeyError:
-                return False
+        from automodel_tpu.checkpoint.hf_adapter import memo1_reader, reader_has_key
+
+        read = memo1_reader(read)  # per-expert slicing re-reads stacked tensors
+        probe = lambda key: reader_has_key(read, key)  # noqa: E731
 
         prefix = ""
         if probe("model.language_model.embed_tokens.weight"):

@@ -162,6 +162,9 @@ def forward(
     return_routing: bool = False,           # stats["routing"] (Lm, B*S, K)
     routing_override: jnp.ndarray | None = None,  # replay a captured routing
     return_aux_hidden: tuple | None = None,  # EAGLE-3 target-side capture
+    inputs_embeds: jnp.ndarray | None = None,  # (B,S,H) — VLM merged embeds
+    rope_angles: jnp.ndarray | None = None,    # (B,S,rope_dim/2) MRoPE angles
+    deepstack_embeds: jnp.ndarray | None = None,  # (K,B,S,H) injected after layer k<K
 ) -> tuple:
     """Returns (logits-or-hidden, aux_loss[, stats]).
 
@@ -189,17 +192,37 @@ def forward(
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
     constrain = _make_constrain(mesh_ctx, rules)
 
-    # FSDP-unshard the table's embed dim before the gather (see llm/decoder)
-    tbl = constrain(params["embed"]["embedding"], ("vocab", None))
-    h = jnp.take(tbl, input_ids, axis=0).astype(cfg.dtype)
-    if cfg.embed_scale != 1.0:
-        h = h * jnp.asarray(cfg.embed_scale, cfg.dtype)
+    if inputs_embeds is not None:
+        h = inputs_embeds.astype(cfg.dtype)
+    else:
+        # FSDP-unshard the table's embed dim before the gather (see llm/decoder)
+        tbl = constrain(params["embed"]["embedding"], ("vocab", None))
+        h = jnp.take(tbl, input_ids, axis=0).astype(cfg.dtype)
+        if cfg.embed_scale != 1.0:
+            h = h * jnp.asarray(cfg.embed_scale, cfg.dtype)
     h = constrain(h, ("act_batch", "act_seq", "act_embed"))
 
     inv_freq = rope_frequencies(cfg.rope_dim, cfg.rope_theta, cfg.rope_scaling)
     freq_for = make_freq_for(cfg, inv_freq)
+    if rope_angles is not None:
+        # qwen-vl MRoPE: per-token angles precomputed by the VL wrapper
+        # (apply_rope detects the ndim>=2 form); window-local thetas don't
+        # apply to mrope models
+        freq_for = lambda w: rope_angles  # noqa: E731
     windows = layer_windows(cfg)
     Lm, E = cfg.num_moe_layers, cfg.moe.n_routed_experts
+
+    def _deepstack(h, gidx):
+        """Add the gidx-th deepstack visual residual when gidx < K
+        (reference: qwen3_vl_moe/model.py:419 _deepstack_process — the
+        embeds arrive pre-scattered over the sequence, zeros off-image)."""
+        if deepstack_embeds is None:
+            return h
+        K = deepstack_embeds.shape[0]
+        inj = jax.lax.dynamic_index_in_dim(
+            deepstack_embeds, jnp.clip(gidx, 0, K - 1), 0, keepdims=False
+        )
+        return h + jnp.where(gidx < K, inj.astype(h.dtype), 0.0)
 
     # DSA: lightning-indexer sparse MLA returns an indexer-KL aux that rides
     # the same loss carry as the MoE balance loss (reference: deepseek_v4).
@@ -252,6 +275,7 @@ def forward(
         lp, gidx, iflag = xs
         h, idx_aux, sel = _attn(h, lp, window, sel, iflag)
         h = mlp_block(h, lp, cfg, constrain)
+        h = _deepstack(h, gidx)
         if cap_ids is not None:
             auxbuf = _capture(auxbuf, gidx, h)
         return (h, aux + idx_aux, stats, routing, auxbuf, sel)
@@ -271,6 +295,7 @@ def forward(
             mesh_ctx=mesh_ctx, forced_indices=forced,
         )
         h = constrain(h + moe_out, ("act_batch", "act_seq", "act_embed"))
+        h = _deepstack(h, idx + cfg.first_k_dense)
         stats = jax.lax.dynamic_update_index_in_dim(
             stats, layer_stats["tokens_per_expert"], idx, 0
         )

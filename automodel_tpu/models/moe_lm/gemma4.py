@@ -311,17 +311,9 @@ def forward(
     if return_hidden:
         out = h
     else:
-        kernel = (
-            params["embed"]["embedding"].T
-            if cfg.tie_word_embeddings
-            else params["lm_head"]["kernel"]
-        )
-        out = jnp.einsum(
-            "bsh,hv->bsv", h, kernel.astype(h.dtype),
-            preferred_element_type=jnp.float32,
-        )
-        if cfg.logits_soft_cap is not None:
-            out = cfg.logits_soft_cap * jnp.tanh(out / cfg.logits_soft_cap)
+        from automodel_tpu.models.llm.decoder import unembed
+
+        out = unembed(params, cfg, h)
     aux = jnp.float32(0.0)
     if return_stats:
         return out, aux, {"tokens_per_expert": jnp.stack(stats_rows)}
@@ -424,16 +416,14 @@ class Gemma4MoEAdapter:
         L = cfg.num_layers
         I = cfg.moe.moe_intermediate_size
 
-        def probe(k):
-            try:
-                read(k)
-                return True
-            except KeyError:
-                return False
+        from automodel_tpu.checkpoint.hf_adapter import reader_has_key
 
-        prefix = "model.language_model." if probe(
-            "model.language_model.embed_tokens.weight"
+        prefix = "model.language_model." if reader_has_key(
+            read, "model.language_model.embed_tokens.weight"
         ) else "model."
+
+        def probe(k):
+            return reader_has_key(read, k)
 
         params: dict = {}
 
@@ -464,16 +454,25 @@ class Gemma4MoEAdapter:
         put(("layers", "o_proj", "kernel"), stackT("self_attn.o_proj.weight"))
 
         def kv_stack(suffix, transpose):
-            rows, ref = [], None
-            for i in range(L):
+            from automodel_tpu.checkpoint.hf_adapter import _stack_layers_zero_fill
+
+            def one_kv(name, tr, _tr2):
+                if tr:
+                    return np.ascontiguousarray(np.asarray(read(name)).T)
+                return np.asarray(read(name))
+
+            # kv-absent layers raise KeyError from read; zero-filled there
+            def guarded(name, tr, _tr2):
+                i = int(name.split("layers.")[1].split(".")[0])
                 if self._kv_absent(i):
-                    rows.append(None)
-                    continue
-                x = lay(i, suffix)
-                x = np.ascontiguousarray(x.T) if transpose else np.asarray(x)
-                rows.append(x)
-                ref = x
-            return np.stack([r if r is not None else np.zeros_like(ref) for r in rows])
+                    raise KeyError(name)
+                return one_kv(name, tr, _tr2)
+
+            names = [f"{prefix}layers.{i}.{suffix}" for i in range(L)]
+            return _stack_layers_zero_fill(
+                guarded, names, transpose, None,
+                absent_ok=self._kv_absent,
+            )
 
         put(("layers", "k_proj", "kernel"), kv_stack("self_attn.k_proj.weight", True))
         put(("layers", "v_proj", "kernel"), kv_stack("self_attn.v_proj.weight", True))

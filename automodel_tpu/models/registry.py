@@ -20,7 +20,9 @@ from automodel_tpu.models.moe_lm import decoder as moe_decoder
 from automodel_tpu.models.moe_lm import families as moe_families
 from automodel_tpu.models.moe_lm import gemma4 as gemma4_module
 from automodel_tpu.models.omni import model as omni_module
+from automodel_tpu.models.vlm import kimi_vl as kimi_vl_module
 from automodel_tpu.models.vlm import llava as llava_module
+from automodel_tpu.models.vlm import qwen3_vl as qwen3_vl_module
 
 
 @dataclasses.dataclass(frozen=True)
@@ -148,6 +150,18 @@ MODEL_ARCH_MAPPING: dict[str, ModelSpec] = {
     # qwen2_5_omni) — towers + projectors around a dense decoder backbone
     "OmniForConditionalGeneration": ModelSpec(
         "omni", omni_module.omni_config, omni_module, adapter_name="omni"
+    ),
+    # Kimi-VL: MoonViT tower + 2×2-merge projector + DeepSeek-V3 MoE text
+    # (reference: models/kimivl, 908 LoC)
+    "KimiVLForConditionalGeneration": ModelSpec(
+        "kimi_vl", kimi_vl_module.kimi_vl_config, kimi_vl_module,
+        adapter_name="kimi_vl",
+    ),
+    # Qwen3-VL-MoE: deepstack ViT + interleaved-MRoPE qwen3-moe text
+    # (reference: models/qwen3_vl_moe, 707 LoC)
+    "Qwen3VLMoeForConditionalGeneration": ModelSpec(
+        "qwen3_vl_moe", qwen3_vl_module.qwen3_vl_moe_config, qwen3_vl_module,
+        adapter_name="qwen3_vl_moe",
     ),
     "LlavaForConditionalGeneration": ModelSpec(
         "llava", llava_module.llava_config, llava_module, adapter_name="llava"

@@ -1,0 +1,450 @@
+"""Kimi-VL: MoonViT vision tower → 2×2 merge + MLP projector → DeepSeek-V3
+MoE text model.
+
+The analog of the reference's kimivl (reference: nemo_automodel/components/
+models/kimivl/model.py, 908 LoC): MoonViT is a bias-ful ViT with a learnable
+interpolatable 2D position embedding, interleaved 2D rope over (x, y) patch
+coordinates (model.py:195 `Rope2DPosEmb`, :138 `_apply_rope_vision`),
+LayerNorm/GELU-tanh blocks, and a 2×2 patch merger feeding a
+pre-LN → linear → gelu → linear projector into the DeepSeek-V3 hidden space
+(model.py:387). The text model is our MoE decoder with the deepseek config
+(the reference wires HF DeepseekV3 modeling; kimi_k2 checkpoints share the
+layout).
+
+TPU design: one fixed patch grid per batch (static shapes under jit; the
+reference's per-image variable grids are a host-side collation concern —
+the collator resizes to the configured grid). Attention inside the tower is
+bidirectional full attention over the image's patches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.layers import dense_init
+from automodel_tpu.models.moe_lm import decoder as moe_decoder
+from automodel_tpu.models.moe_lm.families import deepseek_v3_moe_config
+from automodel_tpu.models.vlm.llava import merge_image_embeddings
+
+
+@dataclasses.dataclass(frozen=True)
+class MoonViTConfig:
+    patch_size: int = 14
+    pos_emb_height: int = 64
+    pos_emb_width: int = 64
+    num_heads: int = 16
+    num_layers: int = 27
+    hidden_size: int = 1152
+    intermediate_size: int = 4304
+    merge_kernel: tuple = (2, 2)
+    rope_theta: float = 10000.0
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+@dataclasses.dataclass(frozen=True)
+class KimiVLConfig:
+    vision: MoonViTConfig = dataclasses.field(default_factory=MoonViTConfig)
+    text: Any = None  # MoETransformerConfig (deepseek-v3 body)
+    image_token_id: int = 163605
+
+    @property
+    def dtype(self):
+        return self.text.dtype
+
+    @property
+    def moe(self):
+        return self.text.moe
+
+    @property
+    def mtp_num_layers(self) -> int:
+        return getattr(self.text, "mtp_num_layers", 0)
+
+    def flops_per_token(self, seq_len: int) -> float:
+        v = self.vision
+        vis_params = v.num_layers * (4 * v.hidden_size**2 + 2 * v.hidden_size * v.intermediate_size)
+        return self.text.flops_per_token(seq_len) + 6.0 * vis_params / max(seq_len, 1)
+
+
+def kimi_vl_config(hf: Mapping[str, Any], **overrides) -> KimiVLConfig:
+    """HF KimiVLConfig: {vision_config (moonvit), text_config (deepseek_v3),
+    media_placeholder_token_id}."""
+    v = dict(hf.get("vision_config") or {})
+    text_overrides = {
+        k: overrides[k]
+        for k in ("dtype", "remat_policy", "attn_impl", "linear_precision")
+        if k in overrides
+    }
+    text = deepseek_v3_moe_config(dict(hf["text_config"]), **text_overrides)
+    mk = v.get("merge_kernel_size", (2, 2))
+    vision = MoonViTConfig(
+        patch_size=int(v.get("patch_size", 14)),
+        pos_emb_height=int(v.get("init_pos_emb_height", 64)),
+        pos_emb_width=int(v.get("init_pos_emb_width", 64)),
+        num_heads=int(v.get("num_attention_heads", 16)),
+        num_layers=int(v.get("num_hidden_layers", 27)),
+        hidden_size=int(v.get("hidden_size", 1152)),
+        intermediate_size=int(v.get("intermediate_size", 4304)),
+        merge_kernel=tuple(mk),
+    )
+    return KimiVLConfig(
+        vision=vision,
+        text=text,
+        image_token_id=int(
+            hf.get("media_placeholder_token_id", hf.get("image_token_id", 163605))
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# MoonViT tower
+# ---------------------------------------------------------------------------
+def _ln_init(dim):
+    return {"scale": jnp.ones((dim,)), "bias": jnp.zeros((dim,))}
+
+
+def init_vision(cfg: MoonViTConfig, rng: jax.Array) -> dict:
+    D, I, P = cfg.hidden_size, cfg.intermediate_size, cfg.patch_size
+    L = cfg.num_layers
+    ks = jax.random.split(rng, 8)
+
+    def stack(k, shape):
+        return jnp.stack([dense_init(kk, shape) for kk in jax.random.split(k, L)])
+
+    return {
+        "patch_embed": {
+            # conv kernel stored (P, P, C, D) — HWIO
+            "proj": {
+                "kernel": 0.02 * jax.random.normal(ks[0], (P, P, 3, D)),
+                "bias": jnp.zeros((D,)),
+            },
+            "pos_emb": {
+                "weight": jax.random.normal(
+                    ks[1], (cfg.pos_emb_height, cfg.pos_emb_width, D)
+                )
+            },
+        },
+        "blocks": {
+            "norm0": {"scale": jnp.ones((L, D)), "bias": jnp.zeros((L, D))},
+            "norm1": {"scale": jnp.ones((L, D)), "bias": jnp.zeros((L, D))},
+            "wqkv": {"kernel": stack(ks[2], (D, 3 * D)), "bias": jnp.zeros((L, 3 * D))},
+            "wo": {"kernel": stack(ks[3], (D, D)), "bias": jnp.zeros((L, D))},
+            "fc0": {"kernel": stack(ks[4], (D, I)), "bias": jnp.zeros((L, I))},
+            "fc1": {"kernel": stack(ks[5], (I, D)), "bias": jnp.zeros((L, D))},
+        },
+        "final_norm": _ln_init(D),
+    }
+
+
+def vision_param_specs(cfg: MoonViTConfig) -> dict:
+    return {
+        "patch_embed": {
+            "proj": {"kernel": (None, None, None, "embed"), "bias": ("norm",)},
+            "pos_emb": {"weight": (None, None, "embed")},
+        },
+        "blocks": {
+            "norm0": {"scale": ("layers", "norm"), "bias": ("layers", "norm")},
+            "norm1": {"scale": ("layers", "norm"), "bias": ("layers", "norm")},
+            "wqkv": {"kernel": ("layers", "embed", "heads"), "bias": ("layers", "heads")},
+            "wo": {"kernel": ("layers", "heads", "embed"), "bias": ("layers", "norm")},
+            "fc0": {"kernel": ("layers", "embed", "mlp"), "bias": ("layers", "mlp")},
+            "fc1": {"kernel": ("layers", "mlp", "embed"), "bias": ("layers", "norm")},
+        },
+        "final_norm": {"scale": ("norm",), "bias": ("norm",)},
+    }
+
+
+def _layer_norm(x, p, eps=1e-5):
+    mu = jnp.mean(x.astype(jnp.float32), axis=-1, keepdims=True)
+    var = jnp.var(x.astype(jnp.float32), axis=-1, keepdims=True)
+    y = (x.astype(jnp.float32) - mu) * jax.lax.rsqrt(var + eps)
+    return (y * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)).astype(x.dtype)
+
+
+def _rope2d_angles(cfg: MoonViTConfig, gh: int, gw: int) -> jnp.ndarray:
+    """(gh*gw, head_dim/2) rotation angles, pairs alternating (x, y)
+    (reference Rope2DPosEmb: freqs over dim/4, x/y interleaved per pair)."""
+    d = cfg.head_dim
+    n4 = d // 4
+    freqs = 1.0 / (cfg.rope_theta ** (jnp.arange(0, d, 4)[:n4] / d))
+    ys, xs = jnp.meshgrid(jnp.arange(gh), jnp.arange(gw), indexing="ij")
+    x_ang = xs.reshape(-1, 1) * freqs[None, :]  # (N, d/4)
+    y_ang = ys.reshape(-1, 1) * freqs[None, :]
+    return jnp.stack([x_ang, y_ang], axis=-1).reshape(gh * gw, d // 2)
+
+
+def _apply_rope2d(x, angles):
+    """x (B, N, Hn, D); angles (N, D/2): rotate adjacent channel pairs."""
+    B, N, Hn, D = x.shape
+    xf = x.astype(jnp.float32).reshape(B, N, Hn, D // 2, 2)
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    a, b = xf[..., 0], xf[..., 1]
+    out = jnp.stack([a * cos - b * sin, a * sin + b * cos], axis=-1)
+    return out.reshape(B, N, Hn, D).astype(x.dtype)
+
+
+def vision_forward(params: dict, cfg: MoonViTConfig, pixel_values: jnp.ndarray) -> jnp.ndarray:
+    """pixel_values (B, H, W, 3) → merged patch features
+    (B, (gh/kh)*(gw/kw), kh*kw, D)."""
+    B, Himg, Wimg, C = pixel_values.shape
+    P = cfg.patch_size
+    gh, gw = Himg // P, Wimg // P
+    D = cfg.hidden_size
+    dtype = params["blocks"]["wqkv"]["kernel"].dtype
+
+    x = jax.lax.conv_general_dilated(
+        pixel_values.astype(dtype),
+        params["patch_embed"]["proj"]["kernel"].astype(dtype),
+        window_strides=(P, P), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    ) + params["patch_embed"]["proj"]["bias"].astype(dtype)
+    x = x.reshape(B, gh * gw, D)
+
+    pe = params["patch_embed"]["pos_emb"]["weight"]
+    if pe.shape[:2] != (gh, gw):
+        pe = jax.image.resize(pe, (gh, gw, D), method="bicubic")
+    x = x + pe.reshape(1, gh * gw, D).astype(dtype)
+
+    angles = _rope2d_angles(cfg, gh, gw)
+    Hn, hd = cfg.num_heads, cfg.head_dim
+
+    def block(x, lp):
+        y = _layer_norm(x, lp["norm0"])
+        qkv = y @ lp["wqkv"]["kernel"] + lp["wqkv"]["bias"]
+        qkv = qkv.reshape(B, gh * gw, 3, Hn, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q = _apply_rope2d(q, angles)
+        k = _apply_rope2d(k, angles)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+        p = jax.nn.softmax(s * (hd ** -0.5), axis=-1).astype(v.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, gh * gw, D)
+        x = x + attn @ lp["wo"]["kernel"] + lp["wo"]["bias"]
+        y = _layer_norm(x, lp["norm1"])
+        m = jax.nn.gelu(y @ lp["fc0"]["kernel"] + lp["fc0"]["bias"], approximate=True)
+        x = x + m @ lp["fc1"]["kernel"] + lp["fc1"]["bias"]
+        return x, None
+
+    x, _ = jax.lax.scan(block, x, params["blocks"])
+    x = _layer_norm(x, params["final_norm"])
+
+    kh, kw = cfg.merge_kernel
+    x = x.reshape(B, gh // kh, kh, gw // kw, kw, D)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(B, (gh // kh) * (gw // kw), kh * kw, D)
+
+
+# ---------------------------------------------------------------------------
+# full model
+# ---------------------------------------------------------------------------
+def init(cfg: KimiVLConfig, rng: jax.Array) -> dict:
+    kv, kt, kp = jax.random.split(rng, 3)
+    D = cfg.vision.hidden_size
+    kh, kw = cfg.vision.merge_kernel
+    merged = D * kh * kw
+    Ht = cfg.text.hidden_size
+    k1, k2 = jax.random.split(kp)
+    return {
+        "vision_tower": init_vision(cfg.vision, kv),
+        "projector": {
+            "pre_norm": _ln_init(D),
+            "linear_1": {"kernel": dense_init(k1, (merged, merged)), "bias": jnp.zeros((merged,))},
+            "linear_2": {"kernel": dense_init(k2, (merged, Ht)), "bias": jnp.zeros((Ht,))},
+        },
+        "language_model": moe_decoder.init(cfg.text, kt),
+    }
+
+
+def param_specs(cfg: KimiVLConfig) -> dict:
+    return {
+        "vision_tower": vision_param_specs(cfg.vision),
+        "projector": {
+            "pre_norm": {"scale": ("norm",), "bias": ("norm",)},
+            "linear_1": {"kernel": ("embed", "mlp"), "bias": ("norm",)},
+            "linear_2": {"kernel": ("mlp", "embed"), "bias": ("norm",)},
+        },
+        "language_model": moe_decoder.param_specs(cfg.text),
+    }
+
+
+def forward(
+    params: dict,
+    cfg: KimiVLConfig,
+    input_ids: jnp.ndarray,      # (B, S)
+    pixel_values: jnp.ndarray,   # (B, H, W, 3)
+    *,
+    positions=None,
+    segment_ids=None,
+    mesh_ctx=None,
+    rules=None,
+    return_hidden: bool = False,
+    token_mask=None,
+    return_stats: bool = False,
+):
+    """Returns (out, aux_loss[, stats]) — the MoE module protocol (the VLM
+    recipe folds aux into the loss)."""
+    feats = vision_forward(params["vision_tower"], cfg.vision, pixel_values)
+    pj = params["projector"]
+    dtype = cfg.dtype
+    x = _layer_norm(feats.astype(dtype), pj["pre_norm"])  # LN over D per patch
+    B, Nm, K4, D = x.shape
+    x = x.reshape(B, Nm, K4 * D)
+    x = jax.nn.gelu(
+        x @ pj["linear_1"]["kernel"].astype(dtype) + pj["linear_1"]["bias"].astype(dtype),
+        approximate=True,
+    )
+    image_embeds = x @ pj["linear_2"]["kernel"].astype(dtype) + pj["linear_2"]["bias"].astype(dtype)
+
+    lm = params["language_model"]
+    token_embeds = jnp.take(lm["embed"]["embedding"], input_ids, axis=0).astype(dtype)
+    merged = merge_image_embeddings(
+        token_embeds, image_embeds, input_ids == cfg.image_token_id
+    )
+    return moe_decoder.forward(
+        lm, cfg.text, input_ids,
+        positions=positions, segment_ids=segment_ids,
+        mesh_ctx=mesh_ctx, rules=rules,
+        return_hidden=return_hidden, inputs_embeds=merged,
+        token_mask=token_mask, return_stats=return_stats,
+    )
+
+
+# ---------------------------------------------------------------------------
+# HF state-dict adapter
+# ---------------------------------------------------------------------------
+class KimiVLAdapter:
+    """HF Kimi-VL layout: `vision_tower.*` / `multi_modal_projector.*` /
+    `language_model.model.*` + `language_model.lm_head.*` (deepseek naming
+    inside — delegated to MoEDecoderAdapter with a key-prefix shim)."""
+
+    def __init__(self, cfg: KimiVLConfig):
+        self.cfg = cfg
+
+    def _lm(self):
+        from automodel_tpu.checkpoint.hf_adapter import MoEDecoderAdapter
+
+        return MoEDecoderAdapter(self.cfg.text, style="deepseek")
+
+    _VIS = [
+        # (hf suffix, path, transpose)
+        ("patch_embed.pos_emb.weight", ("patch_embed", "pos_emb", "weight"), False),
+        ("encoder.final_layernorm.weight", ("final_norm", "scale"), False),
+        ("encoder.final_layernorm.bias", ("final_norm", "bias"), False),
+    ]
+    _BLK = [
+        ("norm0.weight", ("norm0", "scale"), False),
+        ("norm0.bias", ("norm0", "bias"), False),
+        ("norm1.weight", ("norm1", "scale"), False),
+        ("norm1.bias", ("norm1", "bias"), False),
+        ("wqkv.weight", ("wqkv", "kernel"), True),
+        ("wqkv.bias", ("wqkv", "bias"), False),
+        ("wo.weight", ("wo", "kernel"), True),
+        ("wo.bias", ("wo", "bias"), False),
+        ("mlp.fc0.weight", ("fc0", "kernel"), True),
+        ("mlp.fc0.bias", ("fc0", "bias"), False),
+        ("mlp.fc1.weight", ("fc1", "kernel"), True),
+        ("mlp.fc1.bias", ("fc1", "bias"), False),
+    ]
+    _PROJ = [
+        ("pre_norm.weight", ("pre_norm", "scale"), False),
+        ("pre_norm.bias", ("pre_norm", "bias"), False),
+        ("linear_1.weight", ("linear_1", "kernel"), True),
+        ("linear_1.bias", ("linear_1", "bias"), False),
+        ("linear_2.weight", ("linear_2", "kernel"), True),
+        ("linear_2.bias", ("linear_2", "bias"), False),
+    ]
+
+    def from_hf(self, read, shardings=None) -> dict:
+        import numpy as np
+
+        from automodel_tpu.checkpoint.hf_adapter import _get, _set
+
+        from automodel_tpu.checkpoint.hf_adapter import reader_has_key
+
+        params: dict = {}
+
+        def put(path, value):
+            sh = _get(shardings, path) if shardings is not None else None
+            _set(params, path, jax.device_put(value, sh) if sh is not None else jnp.asarray(value))
+
+        def one(name, transpose):
+            x = read(name)
+            return np.ascontiguousarray(np.asarray(x).T) if transpose else np.asarray(x)
+
+        for suf, path, tr in self._VIS:
+            put(("vision_tower",) + path, one("vision_tower." + suf, tr))
+        # conv2d: HF OIHW (D, 3, P, P) → HWIO (P, P, 3, D)
+        w = np.asarray(read("vision_tower.patch_embed.proj.weight"))
+        put(("vision_tower", "patch_embed", "proj", "kernel"),
+            np.ascontiguousarray(np.transpose(w, (2, 3, 1, 0))))
+        put(("vision_tower", "patch_embed", "proj", "bias"),
+            np.asarray(read("vision_tower.patch_embed.proj.bias")))
+        L = self.cfg.vision.num_layers
+        for suf, path, tr in self._BLK:
+            put(
+                ("vision_tower", "blocks") + path,
+                np.stack([
+                    one(f"vision_tower.encoder.blocks.{i}.{suf}", tr)
+                    for i in range(L)
+                ]),
+            )
+        for suf, path, tr in self._PROJ:
+            put(("projector",) + path, one("multi_modal_projector." + suf, tr))
+
+        def lm_read(name):
+            if name == "lm_head.weight":
+                return read("language_model.lm_head.weight")
+            assert name.startswith("model."), name
+            return read("language_model." + name)
+
+        lm_sh = _get(shardings, ("language_model",)) if shardings is not None else None
+        params["language_model"] = self._lm().from_hf(lm_read, shardings=lm_sh)
+        return params
+
+    def to_hf(self, params):
+        import numpy as np
+
+        def _t(x):
+            return np.ascontiguousarray(np.asarray(x).T)
+
+        vis = params["vision_tower"]
+        from automodel_tpu.checkpoint.hf_adapter import _get
+
+        for suf, path, tr in self._VIS:
+            x = np.asarray(_get(vis, path))
+            yield "vision_tower." + suf, (_t(x) if tr else x)
+        k = np.asarray(vis["patch_embed"]["proj"]["kernel"])  # (P,P,3,D)
+        yield "vision_tower.patch_embed.proj.weight", np.ascontiguousarray(
+            np.transpose(k, (3, 2, 0, 1))
+        )
+        yield "vision_tower.patch_embed.proj.bias", np.asarray(
+            vis["patch_embed"]["proj"]["bias"]
+        )
+        L = self.cfg.vision.num_layers
+        for i in range(L):
+            for suf, path, tr in self._BLK:
+                x = np.asarray(_get(vis["blocks"], path)[i])
+                yield f"vision_tower.encoder.blocks.{i}.{suf}", (_t(x) if tr else x)
+        for suf, path, tr in self._PROJ:
+            x = np.asarray(_get(params["projector"], path))
+            yield "multi_modal_projector." + suf, (_t(x) if tr else x)
+        for name, tensor in self._lm().to_hf(params["language_model"]):
+            if name == "lm_head.weight":
+                yield "language_model.lm_head.weight", tensor
+            else:
+                yield "language_model." + name, tensor
+
+
+def _register_adapter():
+    from automodel_tpu.checkpoint.hf_adapter import ADAPTERS
+
+    ADAPTERS["kimi_vl"] = KimiVLAdapter
+
+
+_register_adapter()

@@ -128,13 +128,21 @@ def apply_rope(
     2*len(inv_freq) < head_dim only the first 2*len(inv_freq) channels are
     rotated and the tail passes through unchanged.
     positions: (..., seq) int32.
+
+    `inv_freq` with ndim >= 2 is treated as PRECOMPUTED per-token angles
+    (..., S, D/2) — the multi-axis rope hook (qwen-vl MRoPE, where each
+    channel's angle comes from a different position axis; see
+    models/vlm/qwen3_vl.mrope_angles). `positions` is then ignored.
     """
     orig_dtype = x.dtype
     rot = 2 * inv_freq.shape[-1]
     x_pass = None
     if rot < x.shape[-1]:
         x, x_pass = x[..., :rot], x[..., rot:]
-    angles = positions[..., :, None].astype(jnp.float32) * inv_freq  # (..., S, D/2)
+    if inv_freq.ndim >= 2:
+        angles = inv_freq.astype(jnp.float32)  # (..., S, D/2) precomputed
+    else:
+        angles = positions[..., :, None].astype(jnp.float32) * inv_freq  # (..., S, D/2)
     cos = jnp.cos(angles)[..., :, None, :]  # (..., S, 1, D/2)
     sin = jnp.sin(angles)[..., :, None, :]
     x = x.astype(jnp.float32)

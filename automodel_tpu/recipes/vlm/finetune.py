@@ -56,6 +56,8 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
             k for k in self.MEDIA_KEYS if k not in ("pixel_values",)
         )
 
+        is_moe = self.is_moe
+
         def student_forward(params, batch, extra):
             if peft_cfg is not None:
                 from automodel_tpu.peft.lora import merge_lora
@@ -67,29 +69,55 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
                     params = {**params, key: jax.lax.stop_gradient(params[key])}
             kw = {k: batch[k] for k in ("positions", "segment_ids") if k in batch}
             kw.update({k: batch[k] for k in extra_media if k in batch})
+            if is_moe:
+                # MoE text backends (kimi-vl) return (hidden, aux[, stats])
+                hidden, aux, stats = module.forward(
+                    params, model_cfg, batch["input_ids"], batch["pixel_values"],
+                    return_hidden=True, mesh_ctx=mesh_ctx,
+                    token_mask=batch["labels"] != -100, return_stats=True, **kw,
+                )
+                return params, hidden, (aux, stats), extra, kw
             hidden = module.forward(
                 params, model_cfg, batch["input_ids"], batch["pixel_values"],
                 return_hidden=True, mesh_ctx=mesh_ctx, **kw,
             )
-            return params, hidden, extra, kw
+            return params, hidden, (None, None), extra, kw
 
         return student_forward
 
     def _make_loss_fn(self):
+        from automodel_tpu.loss.utils import combine_losses
+
         model_cfg = self.model_cfg
         chunk = int(self.cfg.get("loss.chunk_size", 1024))
         student_forward = self._make_student_forward()
 
         def loss_fn(params, batch, rng, *extra):
-            params, hidden, _, _ = student_forward(params, batch, extra)
+            params, hidden, (aux, stats), _, _ = student_forward(params, batch, extra)
             ce, n = fused_linear_cross_entropy(
                 hidden, vlm_lm_kernel(params, model_cfg.text),
                 batch["labels"], chunk_size=chunk,
                 logits_soft_cap=model_cfg.text.logits_soft_cap,
             )
-            return ce, {"num_label_tokens": n}
+            total, n = combine_losses(ce, n, aux)
+            out = {"num_label_tokens": n}
+            if stats is not None:
+                out["tokens_per_expert"] = stats["tokens_per_expert"]
+            return total, out
 
         return loss_fn
+
+    def _update_gate_bias(self, tokens_per_expert) -> None:
+        """DeepSeek aux-free balancing on the nested text backbone."""
+        from automodel_tpu.models.moe_lm.decoder import apply_gate_bias_update
+
+        lm = apply_gate_bias_update(
+            self.train_state.params["language_model"],
+            self.model_cfg.text,
+            tokens_per_expert,
+        )
+        params = {**self.train_state.params, "language_model": lm}
+        self.train_state = self.train_state._replace(params=params)
 
     # media tensors shard on the batch axis only (their inner dims are
     # patch/frame grids, not the cp-sharded token sequence)

@@ -55,15 +55,21 @@ class KDRecipeForVLM(FinetuneRecipeForVLM):
             inspect.signature(teacher_module.forward).parameters
         )
 
+        teacher_is_moe = getattr(teacher_cfg, "moe", None) is not None
+
         def loss_fn(params, batch, rng, *extra):
-            params, s_hidden, extra_rest, kw = student_forward(params, batch, extra)
+            params, s_hidden, (s_aux, s_stats), extra_rest, kw = student_forward(
+                params, batch, extra
+            )
             (teacher_params,) = extra_rest
             t_kw = {k: v for k, v in kw.items() if k in teacher_kws}
-            t_hidden = teacher_module.forward(
+            t_out = teacher_module.forward(
                 teacher_params, teacher_cfg, batch["input_ids"],
                 batch["pixel_values"], return_hidden=True, mesh_ctx=mesh_ctx,
                 **t_kw,
             )
+            # MoE teachers (kimi-vl, qwen3-vl-moe) return (hidden, aux)
+            t_hidden = t_out[0] if teacher_is_moe else t_out
             t_hidden = jax.lax.stop_gradient(t_hidden)
             total, n = fused_kd_cross_entropy(
                 s_hidden, vlm_lm_kernel(params, model_cfg.text),
@@ -73,7 +79,15 @@ class KDRecipeForVLM(FinetuneRecipeForVLM):
                 student_soft_cap=model_cfg.text.logits_soft_cap,
                 teacher_soft_cap=teacher_cfg.text.logits_soft_cap,
             )
-            return total, {"num_label_tokens": n}
+            if s_aux is not None:
+                from automodel_tpu.loss.utils import combine_losses
+
+                total, n = combine_losses(total, n, s_aux)
+            out = {"num_label_tokens": n}
+            if s_stats is not None:
+                # keeps the base loop's gate-bias update fed (train_ft.py)
+                out["tokens_per_expert"] = s_stats["tokens_per_expert"]
+            return total, out
 
         return loss_fn
 

@@ -1,0 +1,164 @@
+"""Qwen3-VL-MoE: deepstack ViT + interleaved-MRoPE qwen3-moe text."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu.models.registry import get_model_spec
+from automodel_tpu.models.vlm import qwen3_vl
+
+Q3VL_HF = {
+    "architectures": ["Qwen3VLMoeForConditionalGeneration"],
+    "model_type": "qwen3_vl_moe",
+    "image_token_id": 120,
+    "vision_config": {
+        "patch_size": 14, "temporal_patch_size": 2, "spatial_merge_size": 2,
+        "num_heads": 2, "depth": 3, "hidden_size": 32, "intermediate_size": 48,
+        "out_hidden_size": 32, "num_position_embeddings": 64,
+        "deepstack_visual_indexes": [0, 1],
+    },
+    "text_config": {
+        "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "num_key_value_heads": 2, "head_dim": 8,
+        "num_experts": 4, "num_experts_per_tok": 2,
+        "moe_intermediate_size": 16, "norm_topk_prob": True,
+        "rope_scaling": {"mrope_section": [2, 1, 1], "mrope_interleaved": True},
+    },
+}
+
+
+def _setup():
+    spec = get_model_spec(Q3VL_HF)
+    cfg = spec.config_from_hf(Q3VL_HF, dtype=jnp.float32, remat_policy="none")
+    params = qwen3_vl.init(cfg, jax.random.key(0))
+    return spec, cfg, params
+
+
+def _mock_batch(cfg, B=2, S=32, img=56):
+    n_img = (img // cfg.vision.patch_size // cfg.vision.spatial_merge_size) ** 2
+    rng = np.random.default_rng(0)
+    text = rng.integers(1, 100, (B, S - n_img), dtype=np.int32)
+    ids = np.concatenate(
+        [text[:, :4], np.full((B, n_img), cfg.image_token_id, np.int32), text[:, 4:]],
+        axis=1,
+    )
+    pixels = rng.normal(size=(B, img, img, 3)).astype(np.float32)
+    return jnp.asarray(ids), jnp.asarray(pixels)
+
+
+def test_qwen3_vl_forward_and_deepstack():
+    spec, cfg, params = _setup()
+    ids, pixels = _mock_batch(cfg)
+    hidden, aux, stats = qwen3_vl.forward(
+        params, cfg, ids, pixels, return_hidden=True, return_stats=True
+    )
+    assert hidden.shape == (2, 32, 32)
+    assert np.isfinite(np.asarray(hidden)).all()
+    assert stats["tokens_per_expert"].shape == (2, 4)
+
+    # deepstack is live: zeroing the deepstack mergers changes the output
+    z = jax.tree.map(lambda x: x, params)
+    z["visual"]["deepstack_mergers"] = jax.tree.map(
+        jnp.zeros_like, z["visual"]["deepstack_mergers"]
+    )
+    h2, _, _ = qwen3_vl.forward(z, cfg, ids, pixels, return_hidden=True, return_stats=True)
+    assert np.abs(np.asarray(hidden) - np.asarray(h2)).max() > 1e-5
+
+
+def test_qwen3_vl_text_only_matches_plain_decoder():
+    """With no image tokens, MRoPE collapses to standard rope (t=h=w=index)
+    and deepstack injects zeros — forward must equal the plain MoE decoder."""
+    from automodel_tpu.models.moe_lm import decoder as moe_decoder
+
+    spec, cfg, params = _setup()
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(rng.integers(1, 100, (2, 16), dtype=np.int32))
+    pixels = jnp.asarray(rng.normal(size=(2, 56, 56, 3)).astype(np.float32))
+    h_vl, _, _ = qwen3_vl.forward(
+        params, cfg, ids, pixels, return_hidden=True, return_stats=True
+    )
+    h_txt, _ = moe_decoder.forward(
+        params["language_model"], cfg.text, ids, return_hidden=True
+    )
+    np.testing.assert_allclose(np.asarray(h_vl), np.asarray(h_txt), atol=1e-5)
+
+
+def test_mrope_positions_match_hf_semantics():
+    """Pinned to transformers qwen2_5_vl get_rope_index: image block gets
+    (0, row, col) + image start; following text resumes at max+1."""
+    ids = jnp.asarray([[5, 9, 9, 9, 9, 7, 8]])  # 2x2 merged image at 1..4
+    mask = ids == 9
+    pos3 = np.asarray(qwen3_vl.get_mrope_positions(ids, mask, 2, 2))
+    # text token 0 → 0; image start=1: t=1, h=1+row, w=1+col
+    np.testing.assert_array_equal(pos3[:, 0, 0], [0, 0, 0])
+    np.testing.assert_array_equal(pos3[0, 0, 1:5], [1, 1, 1, 1])       # t
+    np.testing.assert_array_equal(pos3[1, 0, 1:5], [1, 1, 2, 2])       # h
+    np.testing.assert_array_equal(pos3[2, 0, 1:5], [1, 2, 1, 2])       # w
+    # text resumes at img_start + max(gh,gw) = 3 → positions 3, 4
+    np.testing.assert_array_equal(pos3[:, 0, 5], [3, 3, 3])
+    np.testing.assert_array_equal(pos3[:, 0, 6], [4, 4, 4])
+
+
+def test_mrope_axis_maps():
+    m = qwen3_vl.mrope_axis_map((2, 1, 1), interleaved=False, n_freq=4)
+    np.testing.assert_array_equal(np.asarray(m), [0, 0, 1, 2])
+    m = qwen3_vl.mrope_axis_map((2, 1, 1), interleaved=True, n_freq=4)
+    np.testing.assert_array_equal(np.asarray(m), [0, 1, 2, 0])
+
+
+def test_qwen3_vl_adapter_roundtrip():
+    from automodel_tpu.checkpoint.hf_adapter import get_adapter
+
+    spec, cfg, params = _setup()
+    ad = get_adapter(spec.adapter_name, cfg, **spec.adapter_kwargs)
+    sd = dict(ad.to_hf(params))
+    assert sd["model.visual.patch_embed.proj.weight"].shape == (32, 3, 2, 14, 14)
+    assert sd["model.visual.pos_embed.weight"].shape == (64, 32)
+    assert "model.visual.deepstack_merger_list.1.linear_fc2.weight" in sd
+    assert sd["model.language_model.layers.0.mlp.experts.gate_up_proj"].shape == (4, 32, 32)
+    assert sd["model.language_model.layers.0.mlp.experts.down_proj"].shape == (4, 16, 32)
+    p2 = ad.from_hf(lambda k: np.asarray(sd[k]))
+    ids, pixels = _mock_batch(cfg)
+    o1, _, _ = qwen3_vl.forward(params, cfg, ids, pixels, return_stats=True)
+    o2, _, _ = qwen3_vl.forward(
+        jax.tree.map(jnp.asarray, p2), cfg, ids, pixels, return_stats=True
+    )
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), atol=1e-5)
+
+
+@pytest.mark.recipe
+def test_qwen3_vl_recipe_trains(tmp_path):
+    from automodel_tpu.cli.app import resolve_recipe_class
+    from automodel_tpu.config import ConfigNode
+
+    cfg = ConfigNode({
+        "seed": 7,
+        "run_dir": str(tmp_path),
+        "auto_resume": False,
+        "recipe": "vlm_finetune",
+        "model": {"hf_config": Q3VL_HF, "dtype": "float32", "remat_policy": "none"},
+        "distributed": {"dp_shard": -1, "ep": 2},
+        "dataset": {
+            "_target_": "automodel_tpu.datasets.vlm.MockVLMDatasetConfig",
+            "num_samples": 32, "seq_len": 32, "vocab_size": 128,
+            "image_size": 56, "patch_size": 14, "merge_factor": 2,
+            "image_token_id": 120,
+        },
+        "dataloader": {"microbatch_size": 8, "grad_acc_steps": 1},
+        "optimizer": {"name": "adamw", "lr": 1e-3},
+        "lr_scheduler": {"style": "constant", "warmup_steps": 0},
+        "step_scheduler": {"max_steps": 3, "ckpt_every_steps": 100},
+        "checkpoint": {"enabled": False},
+        "loss": {"chunk_size": 64},
+    })
+    r = resolve_recipe_class(cfg)(cfg)
+    r.setup()
+    assert r.is_moe
+    r.run_train_validation_loop()
+    recs = [json.loads(l) for l in open(tmp_path / "training.jsonl") if l.strip()]
+    assert len(recs) == 3
+    assert all(np.isfinite(x["loss"]) for x in recs)
