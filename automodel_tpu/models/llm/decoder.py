@@ -109,6 +109,14 @@ class TransformerConfig:
     # GLM IndexShare: per-layer "full" (runs its own indexer) | "shared"
     # (reuses the previous full layer's top-k selection). None → all full.
     dsa_indexer_types: Optional[tuple] = None
+    # "oracle": dense (S,S) mask formulation (exact, test reference).
+    # "chunked": blockwise two-phase sparse path — per-query-block indexer
+    # scores + top-k, then gather-based absorbed MLA over the selected kv
+    # latents; peak memory O(S·block) instead of O(S²) (the 32k-context
+    # path; reference: deepseek_v4/kernels/tilelang_sparse_mla_fwd.py).
+    # "auto": chunked once S > dsa_query_block·4.
+    dsa_impl: str = "auto"
+    dsa_query_block: int = 256
     # execution knobs
     dtype: Any = jnp.bfloat16
     remat_policy: str = "full"

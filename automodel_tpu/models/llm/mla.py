@@ -160,6 +160,190 @@ def _mla_qkv(x, lp, cfg, positions, constrain, inv_freq):
     return q, k, v, scale, q_lat
 
 
+def resolve_dsa_impl(cfg, seq_len: int) -> str:
+    impl = getattr(cfg, "dsa_impl", "auto")
+    if impl == "auto":
+        return "chunked" if seq_len > 4 * getattr(cfg, "dsa_query_block", 256) else "oracle"
+    return impl
+
+
+def dsa_sel_init(cfg, B: int, S: int):
+    """Zero-initialized IndexShare carry for the configured implementation:
+    a dense (B,S,S) bool selection for the oracle, (B,S,K) top-k indices
+    for the chunked path."""
+    if resolve_dsa_impl(cfg, S) == "chunked":
+        return jnp.zeros((B, S, min(cfg.dsa_index_topk, S)), jnp.int32)
+    return jnp.zeros((B, S, S), bool)
+
+
+def _indexer_qkw(x, q_lat, lp, cfg, positions):
+    """Roped indexer queries (B,S,Hi,Di), keys (B,S,Di) and fp32 gate
+    weights (B,S,Hi), canonicalized so that for BOTH styles
+    score[t,s] = Σ_h w[t,h] · relu(q[t,h]·k[s]) · Di**-0.5."""
+    from automodel_tpu.ops.rope import rope_frequencies
+
+    B, S, H = x.shape
+    Hi, Di = cfg.dsa_index_n_heads, cfg.dsa_index_head_dim
+    ip = lp["indexer"]
+    if getattr(cfg, "dsa_indexer_style", "deepseek") == "glm":
+        inv_freq_idx = rope_frequencies(
+            cfg.mla_qk_rope_head_dim, cfg.rope_theta, cfg.rope_scaling
+        )
+        qsrc = q_lat if q_lat is not None else x
+        q = (qsrc @ ip["wq"]["kernel"].astype(x.dtype)).reshape(B, S, Hi, Di)
+        k = x @ ip["wk"]["kernel"].astype(x.dtype)
+        mu = jnp.mean(k.astype(jnp.float32), axis=-1, keepdims=True)
+        var = jnp.var(k.astype(jnp.float32), axis=-1, keepdims=True)
+        k = (k.astype(jnp.float32) - mu) * jax.lax.rsqrt(var + 1e-6)
+        k = (k * ip["k_norm"]["scale"].astype(jnp.float32)
+             + ip["k_norm"]["bias"].astype(jnp.float32)).astype(x.dtype)
+        w = (x @ ip["wgate"]["kernel"].astype(x.dtype)).astype(jnp.float32)
+        w = w * (Hi ** -0.5)
+    else:
+        inv_freq_idx = rope_frequencies(Di, cfg.rope_theta, cfg.rope_scaling)
+        q = (x @ ip["wq"]["kernel"].astype(x.dtype)).reshape(B, S, Hi, Di)
+        k = x @ ip["wk"]["kernel"].astype(x.dtype)
+        w = (x @ ip["wgate"]["kernel"].astype(x.dtype)).astype(jnp.float32)
+    q = apply_rope(q, positions, inv_freq_idx)
+    k = apply_rope(k[:, :, None, :], positions, inv_freq_idx)[:, :, 0, :]
+    return q, k, w
+
+
+def mla_sparse_attention_block_chunked(
+    h, lp, cfg, positions, segment_ids, inv_freq, constrain, token_mask=None,
+    prev_idx=None, indexer_flag=None,
+):
+    """Two-phase sparse MLA without (S,S) materialization (the 32k-context
+    DSA path; reference: deepseek_v4/kernels/tilelang_sparse_mla_fwd.py +
+    tilelang_indexer_topk — here a blockwise XLA program: `lax.map` over
+    query blocks keeps peak memory at O(S·block) while the MXU sees dense
+    (block, K) dots).
+
+    Per query block: indexer scores vs all keys → masked top-k indices →
+    gather the kv LATENTS (c_kv (K, r) + shared rope key (K, dr)) → absorbed
+    attention (scores and values in latent space via the kv up-projection
+    halves — the exact-algebra form also used by the decode cache,
+    inference/generate._mla_attn_with_cache). Returns (h_out, aux, idx) with
+    idx (B, S, K) — the IndexShare carry in index form.
+    """
+    from automodel_tpu.ops.attention import NEG_INF
+
+    B, S, H = h.shape
+    n = cfg.num_heads
+    dn, dr, dv = cfg.mla_qk_nope_head_dim, cfg.mla_qk_rope_head_dim, cfg.mla_v_head_dim
+    r = cfg.mla_kv_lora_rank
+    prec = cfg.linear_precision
+    from automodel_tpu.ops.quant import matmul as _mm
+
+    K = min(cfg.dsa_index_topk, S)
+    bq = getattr(cfg, "dsa_query_block", 256)
+    while S % bq != 0:
+        bq //= 2
+    nb = S // bq
+
+    x = rms_norm(h, lp["input_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
+
+    # full-sequence latents (O(S·(r+dr)) — the whole point of MLA)
+    q_lat = None
+    if cfg.mla_q_lora_rank:
+        q_lat = rms_norm(_mm(x, lp["q_down_proj"]["kernel"], prec), lp["q_norm"]["scale"], cfg.rms_norm_eps)
+        q = _mm(q_lat, lp["q_up_proj"]["kernel"], prec)
+    else:
+        q = _mm(x, lp["q_proj"]["kernel"], prec)
+    q = q.reshape(B, S, n, dn + dr)
+    q_nope, q_rope = q[..., :dn], apply_rope(q[..., dn:], positions, inv_freq)
+
+    kv = _mm(x, lp["kv_down_proj"]["kernel"], prec)
+    c_kv = rms_norm(kv[..., :r], lp["kv_norm"]["scale"], cfg.rms_norm_eps)
+    k_rope = apply_rope(kv[..., r:][:, :, None, :], positions, inv_freq)[:, :, 0, :]
+
+    qi, ki, wi = _indexer_qkw(x, q_lat, lp, cfg, positions)
+
+    W = lp["kv_up_proj"]["kernel"].astype(x.dtype).reshape(r, n, dn + dv)
+    w_uk, w_uv = W[..., :dn], W[..., dn:]
+    q_abs = jnp.einsum("bsnd,rnd->bsnr", q_nope, w_uk)
+    scale = cfg.attn_scale if cfg.attn_scale is not None else (dn + dr) ** -0.5
+    Di = cfg.dsa_index_head_dim
+
+    seg = segment_ids if segment_ids is not None else jnp.zeros_like(positions)
+    tmask = token_mask if token_mask is not None else jnp.ones((B, S), bool)
+
+    def blk(xs):
+        (qa_b, qr_b, qi_b, wi_b, qpos_b, qseg_b, tm_b, pidx_b, flag_or_none) = xs
+        # ---- phase 1: indexer scores vs all keys, masked top-k ----
+        # head loop (Hi is 2-8): peak stays at one (B, bq, S) buffer instead
+        # of the (B, Hi, bq, S) einsum intermediate — at 32k keys that is
+        # the difference between ~33MB and ~0.5GB per block
+        scores = jnp.zeros(qi_b.shape[:2] + (ki.shape[1],), jnp.float32)
+        for hh in range(qi_b.shape[2]):
+            d = jnp.einsum(
+                "bqd,bsd->bqs", qi_b[:, :, hh], ki,
+                preferred_element_type=jnp.float32,
+            )
+            scores = scores + wi_b[:, :, hh][..., None] * jax.nn.relu(d)
+        scores = scores * (Di ** -0.5)  # (B, bq, S) fp32
+        adm = jnp.logical_and(
+            qpos_b[:, :, None] >= positions[:, None, :],
+            qseg_b[:, :, None] == seg[:, None, :],
+        ) if cfg.causal else (qseg_b[:, :, None] == seg[:, None, :])
+        masked = jnp.where(adm, scores, -jnp.inf)
+        top_vals, idx = jax.lax.top_k(masked, K)  # (B, bq, K)
+        if flag_or_none is not None:
+            run = flag_or_none.astype(bool)
+            idx = jnp.where(run, idx, pidx_b)
+            # recompute validity/scores at the (possibly replayed) indices
+            top_vals = jnp.take_along_axis(masked, idx, axis=-1)
+        valid = jnp.isfinite(top_vals)
+
+        # ---- phase 2: gather latents, absorbed attention over K ----
+        flat = idx.reshape(B, -1)
+        c_sel = jnp.take_along_axis(c_kv, flat[..., None], axis=1).reshape(B, bq, K, r)
+        kr_sel = jnp.take_along_axis(k_rope, flat[..., None], axis=1).reshape(B, bq, K, dr)
+        s = jnp.einsum("bqnr,bqkr->bqnk", qa_b, c_sel, preferred_element_type=jnp.float32)
+        s = s + jnp.einsum("bqnd,bqkd->bqnk", qr_b, kr_sel, preferred_element_type=jnp.float32)
+        s = jnp.where(valid[:, :, None, :], s * scale, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        out_lat = jnp.einsum("bqnk,bqkr->bqnr", p.astype(c_sel.dtype), c_sel)
+        out = jnp.einsum("bqnr,rnd->bqnd", out_lat, w_uv)
+
+        # ---- indexer KL over the selected set ----
+        neg = jnp.float32(NEG_INF)
+        logq = jax.nn.log_softmax(jnp.where(valid, top_vals, neg), axis=-1)
+        pm = jax.lax.stop_gradient(jnp.mean(p, axis=2))  # (B, bq, K) head-avg
+        pm = jnp.where(valid, pm, 0.0)
+        pm = pm / jnp.maximum(jnp.sum(pm, -1, keepdims=True), 1e-9)
+        kl = jnp.sum(pm * (jnp.log(jnp.maximum(pm, 1e-9)) - logq), axis=-1)
+        m = tm_b.astype(jnp.float32)
+        return out, idx, jnp.sum(kl * m), jnp.sum(m)
+
+    def rs(a):  # (B, S, ...) → (nb, B, bq, ...)
+        return jnp.swapaxes(a.reshape(B, nb, bq, *a.shape[2:]), 0, 1)
+
+    xs = (
+        rs(q_abs), rs(q_rope), rs(qi), rs(wi), rs(positions), rs(seg), rs(tmask),
+        rs(prev_idx) if prev_idx is not None else rs(jnp.zeros((B, S, K), jnp.int32)),
+        (jnp.broadcast_to(indexer_flag, (nb,)) if indexer_flag is not None else None),
+    )
+    if xs[-1] is None:
+        xs = xs[:-1]
+
+        def blk_noflag(args):
+            return blk(args + (None,))
+
+        out_b, idx_b, kl_b, cnt_b = jax.lax.map(blk_noflag, xs)
+    else:
+        out_b, idx_b, kl_b, cnt_b = jax.lax.map(blk, xs)
+
+    attn = jnp.swapaxes(out_b, 0, 1).reshape(B, S, n * dv)
+    idx = jnp.swapaxes(idx_b, 0, 1).reshape(B, S, K)
+    aux = cfg.dsa_indexer_loss_coeff * jnp.sum(kl_b) / jnp.maximum(jnp.sum(cnt_b), 1.0)
+    if indexer_flag is not None:
+        aux = jnp.where(indexer_flag.astype(bool), aux, 0.0)
+
+    h = h + _dense(attn, {"kernel": lp["o_proj"]["kernel"]}, prec)
+    return constrain(h, ("act_batch", "act_seq", "act_embed")), aux, idx
+
+
 def mla_sparse_attention_block(
     h, lp, cfg, positions, segment_ids, inv_freq, constrain, token_mask=None,
     prev_sel=None, indexer_flag=None,
@@ -175,7 +359,16 @@ def mla_sparse_attention_block(
     IndexShare (GLM-5.x): `indexer_flag` is a traced 0/1 scalar riding the
     layer scan — 1 runs this layer's indexer, 0 reuses `prev_sel` (the most
     recent full layer's selection) and contributes no indexer KL. The
-    returned `sel` is the running selection for the next layer."""
+    returned `sel` is the running selection for the next layer.
+
+    Implementation dispatch (cfg.dsa_impl): this dense-mask oracle, or the
+    blockwise two-phase `mla_sparse_attention_block_chunked` for long
+    sequences (prev_sel is then (B,S,K) indices)."""
+    if resolve_dsa_impl(cfg, h.shape[1]) == "chunked":
+        return mla_sparse_attention_block_chunked(
+            h, lp, cfg, positions, segment_ids, inv_freq, constrain,
+            token_mask=token_mask, prev_idx=prev_sel, indexer_flag=indexer_flag,
+        )
     from automodel_tpu.ops.attention import NEG_INF, make_attention_mask
     from automodel_tpu.ops.dsa import (
         indexer_kl_loss,

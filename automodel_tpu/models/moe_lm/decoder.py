@@ -240,11 +240,15 @@ def forward(
         )
     else:
         idx_flags = jnp.ones((cfg.num_layers,), jnp.int32)
-    # the (B,S,S) running selection rides the carry ONLY under IndexShare;
-    # plain DSA would drag a dead S² boolean through every layer boundary
-    sel0 = (
-        jnp.zeros((B, S, S), bool) if index_share else jnp.zeros((1, 1, 1), bool)
-    )
+    # the running selection ((B,S,S) bool for the oracle, (B,S,K) indices
+    # for the chunked path) rides the carry ONLY under IndexShare; plain DSA
+    # would drag a dead S²-scale buffer through every layer boundary
+    if index_share:
+        from automodel_tpu.models.llm.mla import dsa_sel_init
+
+        sel0 = dsa_sel_init(cfg, B, S)
+    else:
+        sel0 = jnp.zeros((1, 1, 1), bool)
 
     def _attn(h, lp, window, sel, iflag):
         if use_dsa:

@@ -301,8 +301,13 @@ def forward(
     )
     image_embeds = x @ pj["linear_2"]["kernel"].astype(dtype) + pj["linear_2"]["bias"].astype(dtype)
 
+    from automodel_tpu.models.llm.decoder import _make_constrain
+
     lm = params["language_model"]
-    token_embeds = jnp.take(lm["embed"]["embedding"], input_ids, axis=0).astype(dtype)
+    # FSDP-unshard the table's embed dim before the gather (see moe decoder)
+    constrain = _make_constrain(mesh_ctx, rules)
+    tbl = constrain(lm["embed"]["embedding"], ("vocab", None))
+    token_embeds = jnp.take(tbl, input_ids, axis=0).astype(dtype)
     merged = merge_image_embeddings(
         token_embeds, image_embeds, input_ids == cfg.image_token_id
     )
@@ -364,8 +369,6 @@ class KimiVLAdapter:
         import numpy as np
 
         from automodel_tpu.checkpoint.hf_adapter import _get, _set
-
-        from automodel_tpu.checkpoint.hf_adapter import reader_has_key
 
         params: dict = {}
 

@@ -370,9 +370,14 @@ def forward(
     gw_m = pixel_values.shape[2] // P // m
     image_embeds, ds_embeds = vision_forward(params["visual"], v, pixel_values)
 
+    from automodel_tpu.models.llm.decoder import _make_constrain
+
     lm = params["language_model"]
     dtype = cfg.dtype
-    token_embeds = jnp.take(lm["embed"]["embedding"], input_ids, axis=0).astype(dtype)
+    # FSDP-unshard the table's embed dim before the gather (see moe decoder)
+    constrain = _make_constrain(mesh_ctx, rules)
+    tbl = constrain(lm["embed"]["embedding"], ("vocab", None))
+    token_embeds = jnp.take(tbl, input_ids, axis=0).astype(dtype)
     image_mask = input_ids == cfg.image_token_id
     merged = merge_image_embeddings(token_embeds, image_embeds, image_mask)
 
@@ -494,7 +499,6 @@ class Qwen3VLMoEAdapter:
                 ]),
             )
 
-        E = self.cfg.text.moe.n_routed_experts
         I = self.cfg.text.moe.moe_intermediate_size
 
         def lm_read(name):
@@ -579,6 +583,7 @@ class Qwen3VLMoEAdapter:
                         yield full + "down_proj", np.stack(
                             [np.ascontiguousarray(buf[i].T) for i in range(E)]
                         )
+                        del down_buf[head]  # bound host memory to one layer
                 else:
                     buf = gu_buf.setdefault(head + "|" + proj, {})
                     buf[e] = tensor  # HF per-expert (I, dim)
@@ -594,6 +599,7 @@ class Qwen3VLMoEAdapter:
                                 for i in range(E)
                             ]
                         )
+                        del gu_buf[gk], gu_buf[uk]
                 continue
             yield "model.language_model." + rest, tensor
 

@@ -97,19 +97,26 @@ def topk_select_mask(
     base_mask: jnp.ndarray,     # (B?, S, S) bool causal/segment mask
     k: int,
 ) -> jnp.ndarray:
-    """Boolean (B, S, S) selection: per query, the top-k admissible keys.
+    """Boolean (B, S, S) selection: per query, EXACTLY the top-k admissible
+    keys (lax.top_k tie-breaking — lowest index wins — matching the
+    reference's `scores.topk(k).indices` and the chunked sparse path, which
+    must agree with this oracle selection-for-selection; a >=-threshold
+    formulation over-selects on ties, which the GLM indexer's relu produces
+    en masse at exact zero).
 
     When fewer than k keys are admissible (early queries under causality)
     every admissible key is selected — matching the reference's clamping of
     indexer_topk to the valid prefix."""
     if base_mask.ndim == 2:
-        base_mask = base_mask[None]
+        base_mask = jnp.broadcast_to(base_mask[None], scores.shape)
     masked = jnp.where(base_mask, scores, -jnp.inf)
     S = scores.shape[-1]
     k = min(k, S)
-    # threshold = k-th largest admissible score per query
-    thresh = jax.lax.top_k(masked, k)[0][..., -1:]  # (B, S, 1)
-    sel = masked >= thresh
+    vals, idx = jax.lax.top_k(masked, k)
+    sel = jnp.put_along_axis(
+        jnp.zeros(masked.shape, bool), idx, jnp.isfinite(vals), axis=-1,
+        inplace=False,
+    )
     return jnp.logical_and(sel, base_mask)
 
 

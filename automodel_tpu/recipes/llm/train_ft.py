@@ -190,6 +190,12 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         )
         if mcfg.get("linear_precision", None):
             overrides["linear_precision"] = mcfg.get("linear_precision")
+        # DSA implementation knobs (oracle | chunked | auto; see
+        # TransformerConfig.dsa_impl) — model-level YAML keys
+        if mcfg.get("dsa_impl", None):
+            overrides["dsa_impl"] = str(mcfg.get("dsa_impl"))
+        if mcfg.get("dsa_query_block", None):
+            overrides["dsa_query_block"] = int(mcfg.get("dsa_query_block"))
         # pipeline knobs live in the distributed section (reference:
         # PipelineConfig under DistributedSetup) but a model-level override
         # wins; schedule: "gpipe" (default) | "1f1b"

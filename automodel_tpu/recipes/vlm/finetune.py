@@ -31,8 +31,9 @@ def vlm_lm_kernel(params, text_cfg):
 
 class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
     # stop_gradient-freezable encoder subtrees, keyed by `freeze_<name>`
-    # config flags; towers absent from the param tree are skipped
-    TOWER_KEYS = ("vision_tower", "audio_tower")
+    # config flags; towers absent from the param tree are skipped.
+    # "visual" is qwen3-vl's tower name; freeze_vision_tower covers it too.
+    TOWER_KEYS = ("vision_tower", "visual", "audio_tower")
 
     def _make_student_forward(self):
         """(params, batch, extra) -> (merged_params, hidden, extra, kw):
@@ -48,7 +49,9 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
         # frozen tower; optimizer-exclusion freeze lands with multi-group
         # param handling next round.
         frozen = tuple(
-            key for key in self.TOWER_KEYS if self.cfg.get(f"freeze_{key}", False)
+            key for key in self.TOWER_KEYS
+            if self.cfg.get(f"freeze_{key}", False)
+            or (key == "visual" and self.cfg.get("freeze_vision_tower", False))
         )
         peft_cfg = self.peft_cfg
 

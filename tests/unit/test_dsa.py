@@ -170,3 +170,122 @@ def test_dsv4_recipe_smoke(tmp_path):
     recs = [json.loads(l) for l in open(tmp_path / "training.jsonl") if l.strip()]
     assert len(recs) == 3
     assert all(np.isfinite(x["loss"]) for x in recs)
+
+
+def test_chunked_sparse_matches_oracle():
+    """The blockwise two-phase path == the dense-mask oracle (fwd + the
+    indexer-KL aux), including gradient routing (indexer only via KL)."""
+    import dataclasses as dc
+
+    from automodel_tpu.models.llm import mla
+    from automodel_tpu.models.llm.decoder import init_attention_layers
+    from automodel_tpu.ops.rope import rope_frequencies
+
+    S = 48
+    base = TransformerConfig(
+        **MLA_KW, dsa_index_topk=8, dsa_indexer_loss_coeff=0.1,
+        mla_q_lora_rank=8,
+    )
+    cfg_o = dc.replace(base, dsa_impl="oracle")
+    cfg_c = dc.replace(base, dsa_impl="chunked", dsa_query_block=16)
+    lp_stack = init_attention_layers(cfg_o, jax.random.key(0), 1)
+    lp = jax.tree.map(lambda p: p[0], lp_stack)
+    h = jax.random.normal(jax.random.key(1), (2, S, cfg_o.hidden_size), jnp.float32)
+    pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (2, S))
+    seg = jnp.concatenate(
+        [jnp.zeros((2, S // 2), jnp.int32), jnp.ones((2, S - S // 2), jnp.int32)], 1
+    )
+    inv_freq = rope_frequencies(cfg_o.rope_dim, cfg_o.rope_theta)
+    ident = lambda a, axes: a
+
+    o_out, o_aux, _ = mla.mla_sparse_attention_block(h, lp, cfg_o, pos, seg, inv_freq, ident)
+    c_out, c_aux, c_idx = mla.mla_sparse_attention_block(h, lp, cfg_c, pos, seg, inv_freq, ident)
+    np.testing.assert_allclose(np.asarray(o_out), np.asarray(c_out), rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(float(o_aux), float(c_aux), rtol=2e-3)
+    assert c_idx.shape == (2, S, 8)
+
+    # indexer learns only from the KL term in the chunked path too
+    def loss_no_aux(lp):
+        out, aux, _ = mla.mla_sparse_attention_block(h, lp, cfg_c, pos, seg, inv_freq, ident)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    g = jax.grad(loss_no_aux)(lp)
+    gnorm = jnp.sqrt(sum(jnp.sum(x**2) for x in jax.tree.leaves(g["indexer"])))
+    assert float(gnorm) == 0.0
+
+    def loss_aux(lp):
+        out, aux, _ = mla.mla_sparse_attention_block(h, lp, cfg_c, pos, seg, inv_freq, ident)
+        return aux
+
+    g2 = jax.grad(loss_aux)(lp)
+    gnorm2 = jnp.sqrt(sum(jnp.sum(x**2) for x in jax.tree.leaves(g2["indexer"])))
+    assert float(gnorm2) > 0.0
+
+
+def test_chunked_sparse_glm_index_share_parity():
+    """IndexShare carries indices in the chunked path; shared-layer reuse
+    matches the oracle's mask reuse."""
+    import dataclasses as dc
+
+    from automodel_tpu.models.llm import mla
+    from automodel_tpu.models.llm.decoder import init_attention_layers
+    from automodel_tpu.ops.rope import rope_frequencies
+
+    S = 32
+    base = TransformerConfig(
+        **MLA_KW, dsa_index_topk=6, mla_q_lora_rank=8,
+        dsa_indexer_style="glm", dsa_index_n_heads=2, dsa_index_head_dim=16,
+    )
+    cfg_o = dc.replace(base, dsa_impl="oracle")
+    cfg_c = dc.replace(base, dsa_impl="chunked", dsa_query_block=16)
+    lp_stack = init_attention_layers(cfg_o, jax.random.key(0), 1)
+    lp = jax.tree.map(lambda p: p[0], lp_stack)
+    h = jax.random.normal(jax.random.key(1), (1, S, cfg_o.hidden_size), jnp.float32)
+    pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (1, S))
+    inv_freq = rope_frequencies(cfg_o.rope_dim, cfg_o.rope_theta)
+    ident = lambda a, axes: a
+
+    o_out, _, _ = mla.mla_sparse_attention_block(h, lp, cfg_o, pos, None, inv_freq, ident)
+    c_out, _, idx = mla.mla_sparse_attention_block(h, lp, cfg_c, pos, None, inv_freq, ident)
+    np.testing.assert_allclose(np.asarray(o_out), np.asarray(c_out), rtol=2e-4, atol=2e-5)
+
+    # a "shared" call (flag 0) with prev idx must reproduce the full call
+    flag0 = jnp.zeros((), jnp.int32)
+    s_out, s_aux, s_idx = mla.mla_sparse_attention_block(
+        h, lp, cfg_c, pos, None, inv_freq, ident, prev_sel=idx, indexer_flag=flag0
+    )
+    np.testing.assert_allclose(np.asarray(s_out), np.asarray(c_out), rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(s_idx), np.asarray(idx))
+    assert float(s_aux) == 0.0
+
+
+def test_chunked_sparse_memory_scales_blockwise():
+    """Compiled peak temps: the chunked path must not materialize (S,S)
+    score tensors — compare XLA's memory analysis vs the oracle."""
+    import dataclasses as dc
+
+    from automodel_tpu.models.llm import mla
+    from automodel_tpu.models.llm.decoder import init_attention_layers
+    from automodel_tpu.ops.rope import rope_frequencies
+
+    S = 1024
+    base = TransformerConfig(**MLA_KW, dsa_index_topk=64, mla_q_lora_rank=8)
+    cfg_o = dc.replace(base, dsa_impl="oracle")
+    cfg_c = dc.replace(base, dsa_impl="chunked", dsa_query_block=64)
+    lp_stack = init_attention_layers(cfg_o, jax.random.key(0), 1)
+    lp = jax.tree.map(lambda p: p[0], lp_stack)
+    pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (1, S))
+    inv_freq = rope_frequencies(cfg_o.rope_dim, cfg_o.rope_theta)
+    ident = lambda a, axes: a
+    h_shape = jax.ShapeDtypeStruct((1, S, cfg_o.hidden_size), jnp.float32)
+
+    def temp_bytes(cfg):
+        f = jax.jit(
+            lambda h: mla.mla_sparse_attention_block(h, lp, cfg, pos, None, inv_freq, ident)[0]
+        )
+        mem = f.lower(h_shape).compile().memory_analysis()
+        return int(mem.temp_size_in_bytes)
+
+    t_o, t_c = temp_bytes(cfg_o), temp_bytes(cfg_c)
+    # oracle carries (B,Hi,S,S)+(B,S,S) fp32 temps; chunked O(S·block)
+    assert t_c < t_o / 4, (t_o, t_c)
