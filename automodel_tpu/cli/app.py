@@ -28,6 +28,7 @@ RECIPE_ALIASES = {
     "llm_train_eagle3": "automodel_tpu.recipes.llm.train_eagle3.TrainEagle3Recipe",
     "llm_train_eagle1": "automodel_tpu.recipes.llm.train_eagle1.TrainEagle1Recipe",
     "llm_train_eagle2": "automodel_tpu.recipes.llm.train_eagle1.TrainEagle2Recipe",
+    "llm_spec_bench": "automodel_tpu.recipes.llm.spec_bench.SpecAcceptanceBenchRecipe",
     "dllm_train_ft": "automodel_tpu.recipes.dllm.train_ft.DiffusionLMSFTRecipe",
     "diffusion_train": "automodel_tpu.recipes.diffusion.train.TrainDiffusionRecipe",
     "vlm_finetune": "automodel_tpu.recipes.vlm.finetune.FinetuneRecipeForVLM",

@@ -1,0 +1,185 @@
+"""Speculative acceptance-length benchmark recipe.
+
+The analog of the reference's acceptance benches (reference: components/
+speculative/bench_common.py:1-250, recipes bench_vllm/bench_sglang — those
+drive a serving engine; this one emulates the greedy target offline, which
+is exact for greedy speculative decoding: a drafted token is accepted iff
+it equals the target's greedy token).
+
+YAML:
+
+    recipe: llm_spec_bench
+    target_model: {hf_config: {...} | pretrained_path: ...}
+    speculative: {num_layers: 1, ...}          # drafter shape (Eagle1Config)
+    drafter_path: /path/to/hf_draft            # train_eagle1 export (optional)
+    bench:
+      gamma: 4                                  # draft chain length
+      path_source: generate | dataset           # greedy-generate vs corpus
+      max_new_tokens: 64
+    dataset: {...}                              # prompts / corpus
+
+Emits per-batch JSONL records (accept_length, per-step hit rates) to
+`acceptance.jsonl` plus one summary record — the accept-length trail the
+reference's bench_sweep collects from serving logs.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from automodel_tpu.config import ConfigNode, parse_args_and_load_config
+from automodel_tpu.recipes.llm.train_eagle1 import TrainEagle1Recipe, _target_head_kernel
+from automodel_tpu.speculative.acceptance import eagle1_acceptance
+from automodel_tpu.speculative.eagle1 import init_drafter
+
+logger = logging.getLogger(__name__)
+
+
+def load_drafter_hf(path: str, cfg) -> dict:
+    """Inverse of TrainEagle1Recipe.save_consolidated_hf's serve layout."""
+    from automodel_tpu.checkpoint.hf_adapter import HFCheckpointReader
+
+    read = HFCheckpointReader(path)
+
+    def T(name):
+        return jnp.asarray(np.ascontiguousarray(np.asarray(read(name)).T))
+
+    L = cfg.num_layers
+    params = {
+        "embed": {"embedding": jnp.asarray(read("model.embed_tokens.weight"))},
+        "fc": {"kernel": T("model.fc.weight")},
+        "final_norm": {"scale": jnp.asarray(read("model.norm.weight"))},
+        "layers": {
+            "input_norm": {"scale": jnp.stack([
+                jnp.asarray(read(f"model.layers.{i}.input_layernorm.weight"))
+                for i in range(L)
+            ])},
+            "post_attn_norm": {"scale": jnp.stack([
+                jnp.asarray(read(f"model.layers.{i}.post_attention_layernorm.weight"))
+                for i in range(L)
+            ])},
+        },
+        }
+    for proj in ("q", "k", "v", "o"):
+        params["layers"][f"{proj}_proj"] = {"kernel": jnp.stack([
+            T(f"model.layers.{i}.self_attn.{proj}_proj.weight") for i in range(L)
+        ])}
+    for proj in ("gate", "up", "down"):
+        params["layers"][f"{proj}_proj"] = {"kernel": jnp.stack([
+            T(f"model.layers.{i}.mlp.{proj}_proj.weight") for i in range(L)
+        ])}
+    return params
+
+
+class SpecAcceptanceBenchRecipe(TrainEagle1Recipe):
+    """Reuses the EAGLE-1/2 chassis (target build + drafter shape), replaces
+    the train loop with the offline acceptance sweep."""
+
+    def setup(self) -> None:
+        super().setup()
+        drafter_path = self.cfg.get("drafter_path", None)
+        if drafter_path:
+            params = load_drafter_hf(drafter_path, self.eagle_cfg)
+            self.train_state = self.train_state._replace(
+                params=jax.device_put(params, jax.tree.map(lambda x: x.sharding, self.train_state.params))
+            )
+            logger.info("loaded drafter from %s", drafter_path)
+
+    def run_train_validation_loop(self) -> None:
+        cfg = self.cfg
+        gamma = int(cfg.get("bench.gamma", 4))
+        source = str(cfg.get("bench.path_source", "dataset"))
+        max_new = int(cfg.get("bench.max_new_tokens", 64))
+        out_path = os.path.join(cfg.get("run_dir", "."), "acceptance.jsonl")
+        max_batches = int(cfg.get("bench.max_batches", 8))
+
+        target_module = self.target_spec.module
+        target_cfg = self.target_cfg
+        target_params = self.target_params
+        head = _target_head_kernel(target_params, target_cfg)
+        draft_params = self.train_state.params
+        is_moe = self.target_is_moe
+
+        @jax.jit
+        def measure(path_ids, loss_mask):
+            if is_moe:
+                hidden, _ = target_module.forward(
+                    target_params, target_cfg, path_ids, return_hidden=True,
+                    mesh_ctx=self.mesh_ctx, token_mask=loss_mask,
+                )
+            else:
+                hidden = target_module.forward(
+                    target_params, target_cfg, path_ids, return_hidden=True,
+                    mesh_ctx=self.mesh_ctx,
+                )
+            return eagle1_acceptance(
+                draft_params, self.eagle_cfg, path_ids, hidden, head,
+                loss_mask, gamma=gamma,
+            )
+
+        records = []
+        with open(out_path, "w") as f:
+            for bi, mb in enumerate(self.dataloader):
+                if bi >= max_batches:
+                    break
+                ids = jnp.asarray(np.asarray(mb["input_ids"]))
+                if source == "generate":
+                    from automodel_tpu.inference.generate import GenerateConfig, generate
+
+                    prompt = ids[:, : max(4, ids.shape[1] // 4)]
+                    ids = generate(
+                        target_params, target_cfg, prompt, jax.random.key(bi),
+                        GenerateConfig(max_new_tokens=max_new),
+                    )
+                    mask = jnp.ones(ids.shape, bool).at[:, : prompt.shape[1]].set(False)
+                else:
+                    mask = jnp.asarray(np.asarray(mb["labels"]) != -100)
+                m = jax.device_get(measure(ids, mask))
+                rec = {
+                    "batch": bi,
+                    "accept_length": float(m["accept_length"]),
+                    "step_hit_rates": [float(x) for x in m["step_hit_rates"]],
+                    "rounds": float(m["rounds"]),
+                }
+                records.append(rec)
+                f.write(json.dumps(rec) + "\n")
+                logger.info(
+                    "batch %d: accept_length=%.3f hits=%s",
+                    bi, rec["accept_length"],
+                    [round(x, 3) for x in rec["step_hit_rates"]],
+                )
+            total_rounds = sum(r["rounds"] for r in records) or 1.0
+            summary = {
+                "summary": True,
+                "gamma": gamma,
+                "mean_accept_length": sum(
+                    r["accept_length"] * r["rounds"] for r in records
+                ) / total_rounds,
+                "batches": len(records),
+            }
+            f.write(json.dumps(summary) + "\n")
+        logger.info(
+            "acceptance bench: mean_accept_length=%.3f over %d batches → %s",
+            summary["mean_accept_length"], len(records), out_path,
+        )
+        for t in self.trackers:
+            t.finish()
+        self.metric_logger.close()
+        self.val_logger.close()
+
+
+def main(argv=None) -> None:
+    cfg = parse_args_and_load_config(argv)
+    recipe = SpecAcceptanceBenchRecipe(cfg)
+    recipe.setup()
+    recipe.run_train_validation_loop()
+
+
+if __name__ == "__main__":
+    main()

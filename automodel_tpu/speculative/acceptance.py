@@ -1,0 +1,77 @@
+"""Offline acceptance-length estimation for EAGLE-1/2 drafters.
+
+The analog of the reference's acceptance benchmarking harness (reference:
+nemo_automodel/components/speculative/bench_common.py + bench_vllm/
+bench_sglang — there, a serving engine measures accepted tokens per round;
+here the target is emulated greedily offline, which is exact for greedy
+speculative decoding and needs no server).
+
+Estimator: teacher-forced multi-step draft over a target GREEDY PATH.
+Round starting at position t (the standard EAGLE chain draft):
+
+    step 1: drafter sees (token_{t+1}, H_t) → predicts token_{t+2}
+    step k: feeds its OWN predicted hidden/token from step k-1
+
+A step-k hit means the drafter's k-th token equals the path token; the
+expected accepted tokens per round is 1 + Σ_k (prefix-hit rate through k)
+(reference: eagle/core.py:218 `simulated_accept_length`; same estimator the
+EAGLE-3 trainer logs during training, applied post-hoc over a corpus).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.speculative.eagle1 import Eagle1Config, drafter_forward
+from automodel_tpu.speculative.eagle3 import _shift_left, simulated_accept_length
+
+
+def eagle1_acceptance(
+    draft_params: dict,
+    eagle_cfg: Eagle1Config,
+    path_ids: jnp.ndarray,       # (B, S) target greedy path (prompt + continuation)
+    target_hidden: jnp.ndarray,  # (B, S, H) target hiddens over the path
+    lm_head_kernel: jnp.ndarray, # (H, V) frozen target head
+    loss_mask: jnp.ndarray,      # (B, S) bool — supervised round-start positions
+    gamma: int = 4,
+) -> dict:
+    """Returns {"accept_length", "step_hit_rates" (gamma,), "rounds"}."""
+    head = lm_head_kernel.astype(jnp.float32)
+
+    def draft_logits(pred_hidden):
+        return jnp.einsum(
+            "bth,hv->btv", pred_hidden.astype(jnp.float32), head
+        )
+
+    ids_cur = _shift_left(path_ids)
+    h_cur = target_hidden
+    valid0 = loss_mask
+    hits, valids = [], []
+    prefix = jnp.ones_like(valid0, dtype=bool)
+    for k in range(gamma):
+        pred_h = drafter_forward(draft_params, eagle_cfg, ids_cur, h_cur)
+        pred_tok = jnp.argmax(draft_logits(pred_h), axis=-1).astype(path_ids.dtype)
+        # the drafted token at slot t (step k) claims path position t+2+k;
+        # compare against the path shifted (k+2) left
+        true_tok = path_ids
+        for _ in range(k + 2):
+            true_tok = _shift_left(true_tok)
+        # positions whose comparison runs off the sequence end are invalid
+        S = path_ids.shape[1]
+        in_range = jnp.arange(S)[None, :] < (S - (k + 2))
+        valid = jnp.logical_and(valid0, in_range)
+        hit = jnp.logical_and(pred_tok == true_tok, valid)
+        prefix = jnp.logical_and(prefix, jnp.logical_or(hit, ~valid))
+        hits.append(jnp.sum(jnp.logical_and(prefix, valid).astype(jnp.float32)))
+        valids.append(jnp.sum(valid.astype(jnp.float32)))
+        # feed the drafter its own prediction (chain draft)
+        ids_cur = pred_tok
+        h_cur = pred_h
+    step_hits = jnp.stack(hits)
+    step_valid = jnp.stack(valids)
+    return {
+        "accept_length": simulated_accept_length(step_hits, step_valid),
+        "step_hit_rates": step_hits / jnp.maximum(step_valid, 1.0),
+        "rounds": jnp.sum(valid0.astype(jnp.float32)),
+    }
