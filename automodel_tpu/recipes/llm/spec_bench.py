@@ -14,7 +14,11 @@ YAML:
     drafter_path: /path/to/hf_draft            # train_eagle1 export (optional)
     bench:
       gamma: 4                                  # draft chain length
-      path_source: generate | dataset           # greedy-generate vs corpus
+      # generate (default): measure on the target's greedy continuation —
+      # exact for greedy speculative decoding. dataset: measure against
+      # corpus tokens instead — a drafter-vs-corpus accuracy PROXY, useful
+      # when generation for the target family is unavailable.
+      path_source: generate | dataset
       max_new_tokens: 64
     dataset: {...}                              # prompts / corpus
 
@@ -94,7 +98,7 @@ class SpecAcceptanceBenchRecipe(TrainEagle1Recipe):
     def run_train_validation_loop(self) -> None:
         cfg = self.cfg
         gamma = int(cfg.get("bench.gamma", 4))
-        source = str(cfg.get("bench.path_source", "dataset"))
+        source = str(cfg.get("bench.path_source", "generate"))
         max_new = int(cfg.get("bench.max_new_tokens", 64))
         out_path = os.path.join(cfg.get("run_dir", "."), "acceptance.jsonl")
         max_batches = int(cfg.get("bench.max_batches", 8))

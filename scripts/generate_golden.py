@@ -1,12 +1,12 @@
-"""Regenerate the committed golden training metrics.
+"""Regenerate the committed golden training metrics (all five families).
 
 The analog of the reference's golden-value CI tier (reference:
 tests/ci_tests/golden_values/**/training.jsonl + scripts/
-assert_finite_train_metrics.py): a pinned tiny recipe runs to completion
-and its per-step JSONL is committed; CI replays the recipe and compares
+assert_finite_train_metrics.py): pinned tiny recipes run to completion and
+their per-step JSONLs are committed; CI replays each recipe and compares
 step-by-step. Regenerate ONLY when an intentional numeric change lands:
 
-    PYTHONPATH=. python scripts/generate_golden.py
+    PYTHONPATH=. python scripts/generate_golden.py [name ...]
 """
 
 import os
@@ -19,7 +19,7 @@ from automodel_tpu.utils.hostplatform import force_cpu_devices  # noqa: E402
 
 force_cpu_devices(8)
 
-from tests.golden_config import GOLDEN_DIR, golden_cfg  # noqa: E402
+from tests.golden_config import GOLDEN_RECIPES, golden_path  # noqa: E402
 
 
 def main():
@@ -27,17 +27,18 @@ def main():
 
     from automodel_tpu.cli.app import resolve_recipe_class
 
-    with tempfile.TemporaryDirectory() as tmp:
-        cfg = golden_cfg(tmp)
-        recipe = resolve_recipe_class(cfg)(cfg)
-        recipe.setup()
-        recipe.run_train_validation_loop()
-        os.makedirs(GOLDEN_DIR, exist_ok=True)
-        shutil.copy(
-            os.path.join(tmp, "training.jsonl"),
-            os.path.join(GOLDEN_DIR, "training.jsonl"),
-        )
-    print(f"golden values written to {GOLDEN_DIR}/training.jsonl")
+    names = sys.argv[1:] or list(GOLDEN_RECIPES)
+    for name in names:
+        factory = GOLDEN_RECIPES[name]
+        with tempfile.TemporaryDirectory() as tmp:
+            cfg = factory(tmp)
+            recipe = resolve_recipe_class(cfg)(cfg)
+            recipe.setup()
+            recipe.run_train_validation_loop()
+            dst = golden_path(name)
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            shutil.copy(os.path.join(tmp, "training.jsonl"), dst)
+        print(f"[{name}] golden values written to {dst}")
 
 
 if __name__ == "__main__":

@@ -1,4 +1,11 @@
-"""The pinned recipe behind the golden-value tier (see scripts/generate_golden.py)."""
+"""The pinned recipes behind the golden-value tier (see
+scripts/generate_golden.py).
+
+Five recipe families are under per-step golden regression (the reference
+commits such JSONLs per recipe family, reference: tests/ci_tests/
+golden_values/**): dense SFT, MoE (ep mesh), LoRA, VLM (llava) and dLLM
+(MDLM). Regenerate ONLY on intentional numeric changes.
+"""
 
 import os
 
@@ -6,19 +13,29 @@ from automodel_tpu.config import ConfigNode
 
 GOLDEN_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden_values")
 
+_DENSE_HF = {
+    "architectures": ["LlamaForCausalLM"],
+    "vocab_size": 256, "hidden_size": 64, "intermediate_size": 128,
+    "num_hidden_layers": 2, "num_attention_heads": 4,
+    "num_key_value_heads": 2,
+}
 
-def golden_cfg(run_dir: str) -> ConfigNode:
-    return ConfigNode({
+_MOE_HF = {
+    "architectures": ["Qwen3MoeForCausalLM"],
+    "vocab_size": 256, "hidden_size": 64, "intermediate_size": 128,
+    "num_hidden_layers": 2, "num_attention_heads": 4,
+    "num_key_value_heads": 2, "num_experts": 4, "num_experts_per_tok": 2,
+    "moe_intermediate_size": 32, "router_aux_loss_coef": 0.01,
+}
+
+
+def _base(run_dir: str, **over) -> ConfigNode:
+    cfg = ConfigNode({
         "seed": 1234,
         "auto_resume": False,
         "run_dir": run_dir,
         "model": {
-            "hf_config": {
-                "architectures": ["LlamaForCausalLM"],
-                "vocab_size": 256, "hidden_size": 64, "intermediate_size": 128,
-                "num_hidden_layers": 2, "num_attention_heads": 4,
-                "num_key_value_heads": 2,
-            },
+            "hf_config": dict(_DENSE_HF),
             "dtype": "float32",
             "remat_policy": "none",
         },
@@ -34,3 +51,70 @@ def golden_cfg(run_dir: str) -> ConfigNode:
         "checkpoint": {"enabled": False},
         "loss": {"chunk_size": 64},
     })
+    for k, v in over.items():
+        cfg.set(k, v)
+    return cfg
+
+
+def golden_cfg(run_dir: str) -> ConfigNode:
+    """The original dense pinned recipe (kept for compatibility)."""
+    return _base(run_dir)
+
+
+def _moe_cfg(run_dir: str) -> ConfigNode:
+    cfg = _base(run_dir)
+    cfg.set("model.hf_config", dict(_MOE_HF))
+    cfg.set("distributed", {"dp_shard": -1, "ep": 2})
+    return cfg
+
+
+def _lora_cfg(run_dir: str) -> ConfigNode:
+    cfg = _base(run_dir)
+    cfg.set("peft", {"r": 4, "alpha": 8.0, "target_modules": ["q_proj", "v_proj"]})
+    return cfg
+
+
+def _vlm_cfg(run_dir: str) -> ConfigNode:
+    cfg = _base(run_dir, recipe="vlm_finetune")
+    cfg.set("model.hf_config", {
+        "architectures": ["LlavaForConditionalGeneration"],
+        "model_type": "llava",
+        "image_token_index": 250,
+        "vision_config": {
+            "model_type": "clip_vision_model",
+            "hidden_size": 32, "intermediate_size": 64,
+            "num_hidden_layers": 2, "num_attention_heads": 2,
+            "image_size": 56, "patch_size": 14,
+        },
+        "text_config": dict(_DENSE_HF),
+    })
+    cfg.set("dataset", {
+        "_target_": "automodel_tpu.datasets.vlm.MockVLMDatasetConfig",
+        "num_samples": 64, "seq_len": 64, "vocab_size": 256,
+        "image_size": 56, "patch_size": 14, "image_token_id": 250, "seed": 7,
+    })
+    cfg.set("step_scheduler.max_steps", 6)
+    return cfg
+
+
+def _dllm_cfg(run_dir: str) -> ConfigNode:
+    cfg = _base(run_dir, recipe="dllm_train_ft")
+    cfg.set("dllm", {"mode": "mdlm", "mask_token_id": 255})
+    cfg.set("step_scheduler.max_steps", 6)
+    return cfg
+
+
+#: name → config factory; each family has a committed training.jsonl
+GOLDEN_RECIPES = {
+    "dense": golden_cfg,
+    "moe": _moe_cfg,
+    "lora": _lora_cfg,
+    "vlm": _vlm_cfg,
+    "dllm": _dllm_cfg,
+}
+
+
+def golden_path(name: str) -> str:
+    if name == "dense":  # original flat location, kept stable
+        return os.path.join(GOLDEN_DIR, "training.jsonl")
+    return os.path.join(GOLDEN_DIR, name, "training.jsonl")

@@ -89,6 +89,7 @@ def test_sparse_equals_dense_when_topk_covers_all():
     assert np.isfinite(float(aux))
 
 
+@pytest.mark.slow
 def test_indexer_gets_gradient_only_via_kl():
     from automodel_tpu.models.llm import mla
     from automodel_tpu.models.llm.decoder import init_attention_layers
@@ -145,6 +146,7 @@ def test_indexer_adapter_roundtrip_and_optional():
     assert "indexer" not in p3["layers"]
 
 
+@pytest.mark.slow
 def test_dsv4_recipe_smoke(tmp_path):
     from automodel_tpu.cli.app import resolve_recipe_class
     from tests.unit.test_recipe import _smoke_cfg
@@ -259,6 +261,7 @@ def test_chunked_sparse_glm_index_share_parity():
     assert float(s_aux) == 0.0
 
 
+@pytest.mark.slow
 def test_chunked_sparse_memory_scales_blockwise():
     """Compiled peak temps: the chunked path must not materialize (S,S)
     score tensors — compare XLA's memory analysis vs the oracle."""

@@ -83,6 +83,7 @@ def test_fwd_noncausal_window_block_skip():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("name", ["causal", "gqa8", "window", "noncausal_window", "softcap"])
 def test_bwd_parity(name):
     kw = dict(CASES[name])
@@ -103,6 +104,7 @@ def test_bwd_parity(name):
         )
 
 
+@pytest.mark.slow
 def test_bwd_packed_segments():
     q, k, v = _rand_qkv(jax.random.key(3), S=256)
     seg = jnp.concatenate(
@@ -237,6 +239,7 @@ def test_position_causal_asymmetric_kv():
     assert bool(jnp.all(lse2 < -1e30))
 
 
+@pytest.mark.slow
 def test_return_lse_differentiable():
     """lse cotangents fold into the kernel backward (ring merge needs this)."""
     q, k, v = _rand_qkv(jax.random.key(10), S=128)

@@ -25,6 +25,7 @@ def _naive_greedy(params, cfg, ids, n):
     return ids
 
 
+@pytest.mark.slow
 def test_greedy_matches_naive():
     params = decoder.init(CFG, jax.random.key(0))
     prompt = jax.random.randint(jax.random.key(1), (2, 7), 0, 64)
@@ -67,6 +68,7 @@ def test_mla_matches_naive():
     np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
 
 
+@pytest.mark.slow
 def test_mla_sliding_window_matches_naive():
     """MLA decode honors per-layer sliding windows (the training forward
     does; decode must not silently widen to global)."""
@@ -84,6 +86,7 @@ def test_mla_sliding_window_matches_naive():
     np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
 
 
+@pytest.mark.slow
 def test_moe_mla_matches_naive():
     """DeepSeek-family shape: first_k_dense + MoE stack + MLA cache."""
     from automodel_tpu.models.moe_lm import decoder as moe_decoder

@@ -1,6 +1,7 @@
-"""Golden-value regression: replay the pinned recipe, compare per-step metrics
-against the committed JSONL (reference: ci_tests golden values +
-assert_finite_train_metrics.py)."""
+"""Golden-value regression: replay each pinned recipe, compare per-step
+metrics against the committed JSONL (reference: ci_tests golden values +
+assert_finite_train_metrics.py). Five recipe families are covered: dense,
+MoE (ep mesh), LoRA, VLM, dLLM."""
 
 import json
 import os
@@ -11,27 +12,28 @@ import pytest
 pytestmark = pytest.mark.parity
 
 from automodel_tpu.cli.app import resolve_recipe_class
-from tests.golden_config import GOLDEN_DIR, golden_cfg
+from tests.golden_config import GOLDEN_RECIPES, golden_path
 
 
-@pytest.mark.skipif(
-    not os.path.exists(os.path.join(GOLDEN_DIR, "training.jsonl")),
-    reason="golden values not generated (scripts/generate_golden.py)",
-)
-def test_training_matches_golden(tmp_path):
-    cfg = golden_cfg(str(tmp_path))
+@pytest.mark.parametrize("name", sorted(GOLDEN_RECIPES))
+def test_training_matches_golden(name, tmp_path):
+    path = golden_path(name)
+    if not os.path.exists(path):
+        pytest.skip(f"golden values for '{name}' not generated "
+                    "(scripts/generate_golden.py)")
+    cfg = GOLDEN_RECIPES[name](str(tmp_path))
     recipe = resolve_recipe_class(cfg)(cfg)
     recipe.setup()
     recipe.run_train_validation_loop()
 
     got = [json.loads(l) for l in open(tmp_path / "training.jsonl")]
-    want = [json.loads(l) for l in open(os.path.join(GOLDEN_DIR, "training.jsonl"))]
+    want = [json.loads(l) for l in open(path)]
     assert [r["step"] for r in got] == [r["step"] for r in want]
     for g, w in zip(got, want):
         for key, tol in (("loss", 1e-4), ("grad_norm", 1e-3), ("lr", 1e-7),
                          ("num_label_tokens", 0.0)):
             np.testing.assert_allclose(
                 g[key], w[key], rtol=tol, atol=tol,
-                err_msg=f"step {g['step']} metric {key}",
+                err_msg=f"[{name}] step {g['step']} metric {key}",
             )
         assert np.isfinite(g["loss"]) and np.isfinite(g["grad_norm"])

@@ -52,6 +52,7 @@ def _mock_batch(cfg, B=2, S=32, img=56):
     return jnp.asarray(ids), jnp.asarray(pixels)
 
 
+@pytest.mark.slow
 def test_kimi_vl_forward_moe_protocol():
     spec, cfg, params = _setup()
     ids, pixels = _mock_batch(cfg)
@@ -69,6 +70,7 @@ def test_kimi_vl_forward_moe_protocol():
     assert np.abs(np.asarray(hidden) - np.asarray(h2)).max() > 1e-4
 
 
+@pytest.mark.slow
 def test_kimi_vl_adapter_roundtrip():
     from automodel_tpu.checkpoint.hf_adapter import get_adapter
 

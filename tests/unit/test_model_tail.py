@@ -10,6 +10,7 @@ GDN projections) the reference implements.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from automodel_tpu.models.llm import decoder
 from automodel_tpu.models.registry import get_model_spec
@@ -151,6 +152,7 @@ def _glm_dsa_setup():
     return spec, cfg, params, moe_decoder
 
 
+@pytest.mark.slow
 def test_glm_dsa_index_share_ignores_shared_layer_indexer():
     """IndexShare: a "shared" layer reuses the previous full layer's top-k,
     so zeroing its own indexer weights must not change the output (while
@@ -252,6 +254,7 @@ def test_gemma4_forward_and_kv_sharing():
     np.testing.assert_allclose(np.asarray(out), np.asarray(out2), atol=1e-6)
 
 
+@pytest.mark.slow
 def test_gemma4_adapter_roundtrip():
     from automodel_tpu.checkpoint.hf_adapter import get_adapter
 
@@ -276,6 +279,7 @@ def test_gemma4_adapter_roundtrip():
     np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_gemma4_recipe_trains(tmp_path):
     import json
 

@@ -229,6 +229,7 @@ def test_moe_layer_types_windows():
     assert not np.allclose(np.asarray(out_mix), np.asarray(out_all))
 
 
+@pytest.mark.slow
 def test_deepseek_v3_mla_end_to_end(tmp_path):
     """DSv3-style config: MLA + sigmoid grouped gate + shared experts +
     first-k dense; forward, grads, EP sharding, HF checkpoint roundtrip."""
@@ -458,6 +459,7 @@ def test_swigluoai_combine():
     np.testing.assert_allclose(out, expect, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_mtp_head_and_loss(tmp_path):
     """DSv3-style MTP: params exist, loss decreases, t+2 shift verified."""
     import dataclasses as dc
@@ -606,6 +608,7 @@ def test_dropless_ep_full_decoder_train_step():
         assert np.isfinite(np.asarray(leaf)).all()
 
 
+@pytest.mark.slow
 def test_router_replay_pins_selection():
     """R3 (reference: moe/router_replay.py): capture the routing on one
     forward, replay it on another — selection identical even after the

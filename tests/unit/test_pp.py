@@ -56,6 +56,7 @@ def test_pp_layer_stack_is_stage_sharded():
     assert k.addressable_shards[0].data.shape[0] == 1
 
 
+@pytest.mark.slow
 def test_pp_backward_matches_single_device():
     ctx, params, sharded = _setup(2, 2)
     ids = jax.random.randint(jax.random.key(2), (8, 17), 0, 64)
@@ -72,6 +73,7 @@ def test_pp_backward_matches_single_device():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize(
     "sizes",
     [

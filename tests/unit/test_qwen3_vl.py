@@ -50,6 +50,7 @@ def _mock_batch(cfg, B=2, S=32, img=56):
     return jnp.asarray(ids), jnp.asarray(pixels)
 
 
+@pytest.mark.slow
 def test_qwen3_vl_forward_and_deepstack():
     spec, cfg, params = _setup()
     ids, pixels = _mock_batch(cfg)
@@ -110,6 +111,7 @@ def test_mrope_axis_maps():
     np.testing.assert_array_equal(np.asarray(m), [0, 1, 2, 0])
 
 
+@pytest.mark.slow
 def test_qwen3_vl_adapter_roundtrip():
     from automodel_tpu.checkpoint.hf_adapter import get_adapter
 

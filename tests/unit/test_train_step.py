@@ -1,6 +1,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from automodel_tpu.distributed import MeshConfig
 from automodel_tpu.loss import fused_linear_cross_entropy
@@ -50,6 +51,7 @@ def test_train_loss_decreases_memorization():
     assert np.isfinite(losses).all()
 
 
+@pytest.mark.slow
 def test_grad_accum_invariance():
     """2 microbatches of 2 == 1 microbatch of 4 (same tokens)."""
     params = decoder.init(CFG, jax.random.key(0))
@@ -70,6 +72,7 @@ def test_grad_accum_invariance():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_sharded_train_step_runs_and_matches():
     """FSDP+TP sharded step == single-device step."""
     ctx = MeshConfig(dp_shard=4, tp=2).build()
