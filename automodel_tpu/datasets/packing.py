@@ -23,6 +23,12 @@ class PackedSequenceConfig:
     seq_len: int = 2048
     pad_id: int = 0
     drop_last_incomplete: bool = False
+    # "first_fit": streaming greedy (order-preserving, O(1) memory).
+    # "knapsack": NeAT-style greedy knapsack over the whole corpus — sort by
+    # length descending, place each into the fullest bin that still fits
+    # (min-heap); materializes all documents first but packs tighter
+    # (reference: datasets/llm/neat_packing.py `greedy_knapsack`).
+    strategy: str = "first_fit"
 
 
 def pack_documents(
@@ -60,6 +66,11 @@ def pack_documents(
         seg = 0
         return row
 
+    if config.strategy == "knapsack":
+        docs = _knapsack_order(docs, S)
+    elif config.strategy != "first_fit":
+        raise ValueError(f"unknown packing strategy {config.strategy!r}")
+
     for doc in docs:
         ids = np.asarray(doc["input_ids"], np.int32)[:S]
         labels = np.asarray(doc["labels"], np.int32)[: len(ids)]
@@ -78,3 +89,33 @@ def pack_documents(
             yield flush()
     if offset > 0 and not config.drop_last_incomplete:
         yield flush()
+
+
+def _knapsack_order(docs: Iterable[dict], seq_len: int) -> Iterator[dict]:
+    """NeAT-style greedy knapsack: documents longest-first, each placed into
+    the FULLEST bin that still fits (best-fit-decreasing); bins re-emitted
+    document-by-document so the streaming packer above reproduces the bin
+    layout exactly (each bin fits by construction).
+    """
+    items = list(docs)
+    lengths = [min(len(np.asarray(d["input_ids"])), seq_len) for d in items]
+    order = sorted(range(len(items)), key=lambda i: -lengths[i])
+    loads: list[int] = []
+    bins: list[list[int]] = []
+    for i in order:
+        n = lengths[i]
+        # fullest fitting bin (linear scan; lengths are descending so early
+        # bins fill first and the scan stays short in practice)
+        best, best_load = -1, -1
+        for b, used in enumerate(loads):
+            if used + n <= seq_len and used > best_load:
+                best, best_load = b, used
+        if best >= 0:
+            bins[best].append(i)
+            loads[best] += n
+        else:
+            bins.append([i])
+            loads.append(n)
+    for b in bins:
+        for i in b:
+            yield items[i]

@@ -1,0 +1,106 @@
+"""Validation-time sampling eval: generate continuations, score them.
+
+The analog of the reference's DP-sharded sampling eval (reference:
+nemo_automodel/components/eval/ — generation metrics computed per DP rank
+over that rank's shard, then reduced). Here each process evaluates the
+batches its dataloader shard yields (the loader is already DP-rank
+sharded); metrics reduce across processes with a host all-gather when
+multi-host.
+
+Metrics:
+- gen_token_accuracy: greedy continuation tokens matching the reference
+  continuation, over supervised positions.
+- gen_prefix_len: mean exact-match prefix length (the acceptance-length
+  analog for plain generation).
+- tool-call precision/recall/F1 when a tokenizer is given and references
+  carry `<tool_call>` blocks (eval/tool_call_evaluator).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+IGNORE_INDEX = -100
+
+
+def run_sampling_eval(
+    params,
+    model_cfg,
+    batches,                  # iterable of {"input_ids", "labels", ...} (np)
+    *,
+    prompt_len: int = 16,
+    max_new_tokens: int = 32,
+    max_batches: int = 4,
+    eos_token_id: int | None = None,
+    tokenizer=None,
+    seed: int = 0,
+) -> dict:
+    """Greedy-generate from each batch's prompt prefix and score against the
+    corpus continuation. Returns a flat dict of scalar metrics."""
+    from automodel_tpu.inference.generate import GenerateConfig, generate
+
+    gen = GenerateConfig(max_new_tokens=max_new_tokens, eos_token_id=eos_token_id)
+    tok_hits = tok_total = 0.0
+    prefix_sum = prefix_n = 0.0
+    preds_text: list[str] = []
+    refs_text: list[str] = []
+    for bi, mb in enumerate(batches):
+        if bi >= max_batches:
+            break
+        ids = jnp.asarray(np.asarray(mb["input_ids"]))
+        if ids.shape[1] <= prompt_len:
+            continue
+        prompts = ids[:, :prompt_len]
+        out = generate(params, model_cfg, prompts, jax.random.key(seed + bi), gen)
+        n_ref = min(max_new_tokens, ids.shape[1] - prompt_len)
+        cont = np.asarray(out[:, prompt_len : prompt_len + n_ref])
+        ref = np.asarray(ids[:, prompt_len : prompt_len + n_ref])
+        # labels are pre-shifted (labels[t] supervises ids[t+1]): the token
+        # at absolute position p carries supervision flag labels[p-1]
+        labels = np.asarray(mb["labels"])[:, prompt_len - 1 : prompt_len - 1 + n_ref]
+        valid = labels != IGNORE_INDEX
+        hit = (cont == ref) & valid
+        tok_hits += float(hit.sum())
+        tok_total += float(valid.sum())
+        # exact-match prefix length per sample (over valid positions)
+        miss = (~hit) & valid
+        first_miss = np.where(
+            miss.any(axis=1), miss.argmax(axis=1), valid.sum(axis=1)
+        )
+        prefix_sum += float(first_miss.sum())
+        prefix_n += float(len(first_miss))
+        if tokenizer is not None:
+            for row_pred, row_ref, row_valid in zip(cont, ref, valid):
+                preds_text.append(tokenizer.decode([int(t) for t, v in zip(row_pred, row_valid) if v]))
+                refs_text.append(tokenizer.decode([int(t) for t, v in zip(row_ref, row_valid) if v]))
+
+    totals = np.asarray([tok_hits, tok_total, prefix_sum, prefix_n])
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        totals = np.asarray(
+            multihost_utils.process_allgather(jnp.asarray(totals))
+        ).sum(axis=0)
+    tok_hits, tok_total, prefix_sum, prefix_n = [float(x) for x in totals]
+    metrics = {
+        "gen_token_accuracy": tok_hits / max(tok_total, 1.0),
+        "gen_prefix_len": prefix_sum / max(prefix_n, 1.0),
+        "gen_samples": prefix_n,
+    }
+    if tokenizer is not None and refs_text:
+        from automodel_tpu.eval.tool_call_evaluator import (
+            evaluate_tool_calls,
+            parse_tool_calls,
+        )
+
+        ref_calls = [parse_tool_calls(t) for t in refs_text]
+        if any(ref_calls):
+            tc = evaluate_tool_calls(preds_text, ref_calls)
+            metrics.update({f"tool_{k}": v for k, v in tc.items()})
+    return metrics

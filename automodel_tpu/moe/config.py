@@ -39,9 +39,12 @@ class MoEConfig:
     shared_expert_gated: bool = False  # qwen3-next: sigmoid(gate(x))·shared(x)
     shared_expert_activation: str = "silu"  # nemotron: relu2 (non-gated)
     capacity_factor: float = 1.25    # static-shape dispatch headroom
-    # "capacity": einsum dispatch with padding (EP-friendly; GSPMD A2A)
-    # "dropless": sort + ragged grouped GEMM (no drops; ep=1 meshes)
-    dispatcher: str = "capacity"
+    # "dropless" (default): sort + ragged grouped GEMM, ragged_all_to_all
+    # under EP — exact (HF never drops tokens) and avoids the (T,E,C)
+    # dispatch tensor that dominates memory at DSv3 scale (E=256).
+    # "capacity": einsum dispatch with padded capacity (kept for perf
+    # comparison and as the GSPMD-A2A fallback).
+    dispatcher: str = "dropless"
     router_dtype: str = "float32"
     fake_balanced_gate: bool = False  # perf benchmarking (reference layers.py:126)
 

@@ -473,6 +473,7 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
     def _build_data(self) -> None:
         cfg = self.cfg
         tokenizer = self._build_tokenizer()
+        self._tokenizer = tokenizer
         ds_cfg = cfg.get("dataset").instantiate()
         try:
             dataset = ds_cfg.build(tokenizer) if tokenizer is not None else ds_cfg.build()
@@ -653,7 +654,55 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             total += float(loss_sum)
             count += float(n)
         val_loss = total / max(count, 1.0)
-        self.val_logger.log({"step": step, "val_loss": val_loss})
+        rec = {"step": step, "val_loss": val_loss}
+        rec.update(self._run_sampling_eval())
+        self.val_logger.log(rec)
+
+    def _run_sampling_eval(self) -> dict:
+        """Optional generation metrics at validation time (reference:
+        components/eval DP-sharded sampling eval). Enable with
+
+            validation_generation: {prompt_len: 16, max_new_tokens: 32,
+                                    max_batches: 4}
+        """
+        node = self.cfg.get("validation_generation")
+        if node is None or self.val_dataloader is None:
+            return {}
+        from automodel_tpu.models.llm import decoder as dense_decoder
+        from automodel_tpu.models.moe_lm import decoder as moe_decoder_mod
+
+        if self.model_spec.module not in (dense_decoder, moe_decoder_mod):
+            logger.warning(
+                "validation_generation: no KV-cache decode path for %s; skipped",
+                self.model_spec.name,
+            )
+            return {}
+        params = self.train_state.params
+        if self.peft_cfg is not None:
+            from automodel_tpu.peft.lora import merge_lora
+
+            params = merge_lora(self.base_params, params, self.peft_cfg)
+        # the val dataloader is resumable (its batch_index survives a
+        # partial iteration); snapshot + restore so the sampling sweep
+        # cannot shift the next val-loss pass's data
+        dl_state = self.val_dataloader.state_dict()
+        try:
+            from automodel_tpu.eval.sampling import run_sampling_eval
+
+            return run_sampling_eval(
+                params, self.model_cfg, iter(self.val_dataloader),
+                prompt_len=int(node.get("prompt_len", 16)),
+                max_new_tokens=int(node.get("max_new_tokens", 32)),
+                max_batches=int(node.get("max_batches", 4)),
+                eos_token_id=node.get("eos_token_id"),
+                tokenizer=getattr(self, "_tokenizer", None),
+                seed=int(self.cfg.get("seed", 42)),
+            )
+        except NotImplementedError as e:
+            logger.warning("validation_generation skipped: %s", e)
+            return {}
+        finally:
+            self.val_dataloader.load_state_dict(dl_state)
 
     def save_consolidated_hf(self, out_dir: str | None = None) -> str:
         """Consolidated HF safetensors export (reference: checkpointing.py

@@ -343,7 +343,7 @@ def test_dropless_matches_capacity_with_ample_headroom():
     from automodel_tpu.moe.experts import experts_forward_dropless
     from automodel_tpu.moe.layer import moe_forward as _mf
 
-    cfg_cap = dc.replace(MOE, capacity_factor=4.0)
+    cfg_cap = dc.replace(MOE, dispatcher="capacity", capacity_factor=4.0)
     cfg_drop = dc.replace(MOE, dispatcher="dropless")
     params = init_moe(cfg_cap, 16, jax.random.key(0))
     x = jax.random.normal(jax.random.key(4), (2, 6, 16))
