@@ -138,14 +138,18 @@ class DenseDecoderAdapter:
         if getattr(cfg, "attention_type", "gqa") == "mla":
             return self._mla_layer_entries()
         e = []
-        if self.style != "baichuan":  # baichuan fuses q/k/v into W_pack
+        if self._fused_qkv_name() is None:
             e += [
                 ("self_attn.q_proj.weight", ("q_proj", "kernel"), True),
                 ("self_attn.k_proj.weight", ("k_proj", "kernel"), True),
                 ("self_attn.v_proj.weight", ("v_proj", "kernel"), True),
             ]
+        o_name = (
+            "attention.dense.weight" if self.style == "bailing"
+            else "self_attn.o_proj.weight"
+        )
         e += [
-            ("self_attn.o_proj.weight", ("o_proj", "kernel"), True),
+            (o_name, ("o_proj", "kernel"), True),
             ("mlp.down_proj.weight", ("down_proj", "kernel"), True),
             ("input_layernorm.weight", ("input_norm", "scale"), False),
         ]
@@ -181,6 +185,11 @@ class DenseDecoderAdapter:
                 e += [
                     ("self_attn.query_layernorm.weight", ("q_norm", "scale"), False),
                     ("self_attn.key_layernorm.weight", ("k_norm", "scale"), False),
+                ]
+            elif self.style == "bailing":
+                e += [
+                    ("attention.query_layernorm.weight", ("q_norm", "scale"), False),
+                    ("attention.key_layernorm.weight", ("k_norm", "scale"), False),
                 ]
             else:
                 e += [
@@ -244,9 +253,40 @@ class DenseDecoderAdapter:
         ]
         return [entry if len(entry) == 4 else (*entry, None) for entry in e]
 
+    def _fused_qkv_name(self) -> str | None:
+        """HF key suffix when the checkpoint stores q/k/v fused: baichuan
+        W_pack, bailing (Ling 2.0) query_key_value — row order [Q|K|V]."""
+        return {
+            "baichuan": "self_attn.W_pack.weight",
+            "bailing": "attention.query_key_value.weight",
+        }.get(self.style)
+
+    def _split_fused_qkv(self, w: np.ndarray) -> dict[str, np.ndarray]:
+        """HF fused (q+k+v, H) → our per-projection (H, ·) kernels."""
+        D = self.cfg.resolved_head_dim
+        qd, kd = self.cfg.num_heads * D, self.cfg.num_kv_heads * D
+        wT = np.ascontiguousarray(w.T)
+        return {
+            "q_proj": wT[:, :qd],
+            "k_proj": wT[:, qd : qd + kd],
+            "v_proj": wT[:, qd + kd : qd + 2 * kd],
+        }
+
+    def _fuse_qkv(self, layers, i: int) -> np.ndarray:
+        """Inverse of _split_fused_qkv for layer i → HF (q+k+v, H)."""
+        cat = np.concatenate(
+            [np.asarray(layers[p]["kernel"][i]) for p in ("q_proj", "k_proj", "v_proj")],
+            axis=1,
+        )
+        return _t(cat)
+
     def _top_entries(self) -> list[tuple[str, tuple, bool]]:
+        embed_name = (
+            "model.word_embeddings.weight" if self.style == "bailing"
+            else "model.embed_tokens.weight"
+        )
         e = [
-            ("model.embed_tokens.weight", ("embed", "embedding"), False),
+            (embed_name, ("embed", "embedding"), False),
             ("model.norm.weight", ("final_norm", "scale"), False),
         ]
         if not self.cfg.tie_word_embeddings:
@@ -296,12 +336,11 @@ class DenseDecoderAdapter:
                     f"model.layers.{i}.mlp.gate_up_proj.weight",
                     _t(np.concatenate([g, u], axis=1)),
                 )
-            if self.style == "baichuan":
-                qkv = np.concatenate(  # (H, 3H) → HF W_pack (3H, H)
-                    [np.asarray(layers[p]["kernel"][i]) for p in ("q_proj", "k_proj", "v_proj")],
-                    axis=1,
+            if self._fused_qkv_name() is not None:
+                yield (
+                    f"model.layers.{i}.{self._fused_qkv_name()}",
+                    self._fuse_qkv(layers, i),
                 )
-                yield f"model.layers.{i}.self_attn.W_pack.weight", _t(qkv)
 
     # -- import --------------------------------------------------------------
     def from_hf(self, read: Reader, shardings: Any = None) -> dict:
@@ -363,17 +402,15 @@ class DenseDecoderAdapter:
             I = self.cfg.intermediate_size
             put(("layers", "gate_proj", "kernel"), fused[..., :I])
             put(("layers", "up_proj", "kernel"), fused[..., I:])
-        if self.style == "baichuan":
-            fused = np.stack(
-                [
-                    _t(read_any(f"model.layers.{i}.self_attn.W_pack.weight"))
-                    for i in range(self.cfg.num_layers)
-                ]
-            )  # (L, H, 3H); order [q; k; v] (baichuan W_pack)
-            H = self.cfg.hidden_size
-            put(("layers", "q_proj", "kernel"), fused[..., :H])
-            put(("layers", "k_proj", "kernel"), fused[..., H : 2 * H])
-            put(("layers", "v_proj", "kernel"), fused[..., 2 * H :])
+        if self._fused_qkv_name() is not None:
+            splits = [
+                self._split_fused_qkv(
+                    np.asarray(read_any(f"model.layers.{i}.{self._fused_qkv_name()}"))
+                )
+                for i in range(self.cfg.num_layers)
+            ]
+            for p in ("q_proj", "k_proj", "v_proj"):
+                put(("layers", p, "kernel"), np.stack([s[p] for s in splits]))
         return out
 
 
@@ -420,11 +457,13 @@ class MoEDecoderAdapter:
             return f"model.layers.{i}.mlp.moe_statics.e_score_correction_bias"
         if self.style == "minimax":
             return f"model.layers.{i}.block_sparse_moe.e_score_correction_bias"
+        if self.style == "bailing":
+            return f"model.layers.{i}.mlp.gate.expert_bias"
         return f"model.layers.{i}.mlp.gate.e_score_correction_bias"
 
     def _dense(self) -> DenseDecoderAdapter:
         # styles the dense adapter understands (attention/norm naming)
-        style = self.style if self.style in ("glm4", "hunyuan") else "llama"
+        style = self.style if self.style in ("glm4", "hunyuan", "bailing") else "llama"
         return DenseDecoderAdapter(self.cfg, style=style)
 
     def _attn_entries(self):
@@ -442,6 +481,7 @@ class MoEDecoderAdapter:
             x = dense._transform(np.asarray(_get(params, path)), tr, inverse=True)
             yield name, (_t(x) if transpose else x)
         fk = cfg.first_k_dense
+        fused = dense._fused_qkv_name()
         if fk:
             for i in range(fk):
                 for suffix, path, transpose, tr in dense._layer_entries():
@@ -451,6 +491,11 @@ class MoEDecoderAdapter:
                         np.asarray(_get(params["dense_layers"], path)[i]), tr, inverse=True
                     )
                     yield f"model.layers.{i}.{suffix}", (_t(x) if transpose else x)
+                if fused is not None:
+                    yield (
+                        f"model.layers.{i}.{fused}",
+                        dense._fuse_qkv(params["dense_layers"], i),
+                    )
         moe_layers = params["moe_layers"]
         for li in range(cfg.num_moe_layers):
             i = fk + li
@@ -461,6 +506,8 @@ class MoEDecoderAdapter:
                     np.asarray(_get(moe_layers, path)[li]), tr, inverse=True
                 )
                 yield f"model.layers.{i}.{suffix}", (_t(x) if transpose else x)
+            if fused is not None:
+                yield f"model.layers.{i}.{fused}", dense._fuse_qkv(moe_layers, li)
             moe = moe_layers["moe"]
             yield self._gate_name(i), _t(np.asarray(moe["gate"]["weight"][li]))
             if "bias" in moe["gate"]:
@@ -550,6 +597,25 @@ class MoEDecoderAdapter:
                     continue
                 raise
             put(("moe_layers",) + path, stacked)
+        fused = dense._fused_qkv_name()
+        if fused is not None:
+            def _qkv_stacks(i0, n):
+                splits = [
+                    dense._split_fused_qkv(
+                        np.asarray(read(f"model.layers.{i0 + j}.{fused}"))
+                    )
+                    for j in range(n)
+                ]
+                return {
+                    p: np.stack([s_[p] for s_ in splits])
+                    for p in ("q_proj", "k_proj", "v_proj")
+                }
+
+            if fk:
+                for p_, v_ in _qkv_stacks(0, fk).items():
+                    put(("dense_layers", p_, "kernel"), v_)
+            for p_, v_ in _qkv_stacks(fk, cfg.num_moe_layers).items():
+                put(("moe_layers", p_, "kernel"), v_)
         put(
             ("moe_layers", "moe", "gate", "weight"),
             np.stack([_t(read(self._gate_name(fk + li))) for li in range(cfg.num_moe_layers)]),

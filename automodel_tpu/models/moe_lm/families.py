@@ -106,6 +106,42 @@ def deepseek_v4_config(hf: Mapping[str, Any], **overrides) -> MoETransformerConf
     return deepseek_v3_moe_config(hf, **dsa, **overrides)
 
 
+def bailing_moe_v2_config(hf: Mapping[str, Any], **overrides) -> MoETransformerConfig:
+    """BailingMoeV2ForCausalLM (Ling 2.0 mini/flash/1T; reference:
+    models/ling_v2, 1007 LoC): GQA with per-head qk-norm and partial rotary,
+    first-k-dense prefix, DeepSeek-style sigmoid grouped routing with the
+    aux-free expert bias, one shared expert. Checkpoints store fused
+    query_key_value / attention.dense / word_embeddings names — the
+    adapter's "bailing" style."""
+    if hf.get("use_qkv_bias"):
+        raise NotImplementedError("bailing fused qkv bias")
+    kw = _base_kwargs(hf)
+    kw["qk_norm"] = bool(hf.get("use_qk_norm", True))
+    kw["partial_rotary_factor"] = float(hf.get("partial_rotary_factor", 1.0))
+    enable_bias = bool(hf.get("moe_router_enable_expert_bias", True))
+    moe = MoEConfig(
+        n_routed_experts=int(hf["num_experts"]),
+        n_shared_experts=int(hf.get("num_shared_experts", 1)),
+        experts_per_token=int(hf["num_experts_per_tok"]),
+        n_groups=int(hf.get("n_group", 1)),
+        topk_groups=int(hf.get("topk_group", 1)),
+        moe_intermediate_size=int(hf["moe_intermediate_size"]),
+        score_func=(
+            "sigmoid" if hf.get("score_function", "sigmoid") == "sigmoid" else "softmax"
+        ),
+        norm_topk_prob=bool(hf.get("norm_topk_prob", True)),
+        route_scale=float(hf.get("routed_scaling_factor", 1.0)),
+        aux_loss_coeff=float(hf.get("router_aux_loss_coef", 0.0) or 0.0),
+        gate_bias_update_speed=(
+            float(hf.get("bias_update_speed", 0.001)) if enable_bias else 0.0
+        ),
+    )
+    first_k = int(hf.get("first_k_dense_replace", 1))
+    moe_overrides = overrides.pop("moe", None)
+    kw.update(overrides)
+    return MoETransformerConfig(moe=moe_overrides or moe, first_k_dense=first_k, **kw)
+
+
 def glm_moe_dsa_config(hf: Mapping[str, Any], **overrides) -> MoETransformerConfig:
     """GlmMoeDsaForCausalLM (GLM-5.x; reference: models/glm_moe_dsa, 3028
     LoC): the DeepSeek-style MLA+MoE body (sigmoid grouped router with

@@ -80,6 +80,12 @@ MODEL_ARCH_MAPPING: dict[str, ModelSpec] = {
         "gemma4_moe", gemma4_module.gemma4_moe_config, gemma4_module,
         adapter_name="gemma4_moe",
     ),
+    # Ling 2.0 (reference: models/ling_v2): deepseek-style routed MoE on
+    # qk-normed partial-rope GQA; fused query_key_value checkpoint layout
+    "BailingMoeV2ForCausalLM": ModelSpec(
+        "ling_v2", moe_families.bailing_moe_v2_config, moe_decoder,
+        adapter_name="moe_decoder", adapter_kwargs={"style": "bailing"},
+    ),
     # GLM-5.x: MLA+MoE body + GLM indexer with IndexShare (reference:
     # models/glm_moe_dsa — deepseek-style checkpoint naming for MLA/MoE)
     "GlmMoeDsaForCausalLM": ModelSpec(

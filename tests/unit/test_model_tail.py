@@ -298,3 +298,46 @@ def test_gemma4_recipe_trains(tmp_path):
     recs = [json.loads(l) for l in open(tmp_path / "training.jsonl") if l.strip()]
     assert len(recs) == 3
     assert all(np.isfinite(x["loss"]) for x in recs)
+
+
+LING_HF = {
+    "architectures": ["BailingMoeV2ForCausalLM"],
+    "model_type": "bailing_moe",
+    "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+    "num_hidden_layers": 3, "num_attention_heads": 4,
+    "num_key_value_heads": 2, "head_dim": 8,
+    "use_qk_norm": True, "partial_rotary_factor": 0.5,
+    "num_experts": 4, "num_shared_experts": 1, "num_experts_per_tok": 2,
+    "n_group": 2, "topk_group": 2, "moe_intermediate_size": 16,
+    "first_k_dense_replace": 1, "score_function": "sigmoid",
+    "routed_scaling_factor": 1.0, "norm_topk_prob": True,
+    "moe_router_enable_expert_bias": True,
+}
+
+
+def test_ling_v2_adapter_fused_qkv_roundtrip():
+    """Ling 2.0 (BailingMoeV2): fused query_key_value / attention.dense /
+    word_embeddings naming round-trips exactly."""
+    from automodel_tpu.checkpoint.hf_adapter import get_adapter
+    from automodel_tpu.models.moe_lm import decoder as moe_decoder
+
+    spec = get_model_spec(LING_HF)
+    cfg = spec.config_from_hf(LING_HF, dtype=jnp.float32, remat_policy="none")
+    assert cfg.qk_norm and cfg.partial_rotary_factor == 0.5
+    assert cfg.first_k_dense == 1
+    assert cfg.moe.gate_bias_update_speed > 0
+    params = moe_decoder.init(cfg, jax.random.key(0))
+    ad = get_adapter(spec.adapter_name, cfg, **spec.adapter_kwargs)
+    sd = dict(ad.to_hf(params))
+    assert "model.word_embeddings.weight" in sd
+    assert sd["model.layers.0.attention.query_key_value.weight"].shape == (4 * 8 + 2 * 2 * 8, 32)
+    assert "model.layers.0.attention.dense.weight" in sd
+    assert "model.layers.0.attention.query_layernorm.weight" in sd
+    assert "model.layers.1.mlp.gate.expert_bias" in sd
+    assert "model.layers.1.mlp.shared_experts.gate_proj.weight" in sd
+    assert not any("q_proj" in k for k in sd)
+    p2 = ad.from_hf(lambda k: np.asarray(sd[k]))
+    ids = jax.random.randint(jax.random.key(1), (2, 12), 0, 128)
+    o1, _ = moe_decoder.forward(params, cfg, ids)
+    o2, _ = moe_decoder.forward(jax.tree.map(jnp.asarray, p2), cfg, ids)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), atol=1e-5)
