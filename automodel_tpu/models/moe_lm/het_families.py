@@ -1,0 +1,424 @@
+"""Step-3.5 and MiMo-V2-Flash family adapters for the heterogeneous MoE
+decoder (models/moe_lm/het_moe.py).
+
+References: nemo_automodel/components/models/step3p5/ (model.py:235 MoE
+mapping, layers.py:183 attention, state_dict_adapter.py stacked-expert
+layout) and mimo_v2_flash/ (config.py hybrid_layer_pattern semantics,
+model.py:269 per-type sink biases, standard per-expert checkpoint layout).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from automodel_tpu.models.moe_lm.het_moe import AttnGeom, HetMoEConfig
+from automodel_tpu.moe.config import MoEConfig
+
+
+# ---------------------------------------------------------------------------
+# configs
+# ---------------------------------------------------------------------------
+def step3p5_config(hf: Mapping[str, Any], **overrides) -> HetMoEConfig:
+    """Step3p5ForCausalLM: sliding layers re-head via attention_other_setting,
+    per-layer rope theta / partial rotary / NoPE, head-wise sigmoid gate,
+    moe_layers_enum MoE placement with a separate shared expert."""
+    L = int(hf["num_hidden_layers"])
+    heads = int(hf["num_attention_heads"])
+    kv = int(hf.get("num_attention_groups", heads))
+    other = dict(hf.get("attention_other_setting") or {})
+    head_dim = int(hf.get("head_dim", hf["hidden_size"] // heads))
+    lt_raw = list(hf.get("layer_types") or ["full_attention"] * L)
+    layer_types = tuple(
+        "sliding" if t == "sliding_attention" else "global" for t in lt_raw
+    )
+    enum = hf.get("moe_layers_enum")
+    if enum is None:
+        moe_set = set(range(1, L))
+    elif isinstance(enum, str):
+        moe_set = {int(i) for i in enum.strip().split(",")}
+    elif isinstance(enum, int):
+        moe_set = {enum}
+    else:
+        moe_set = {int(i) for i in enum}
+    thetas = hf.get("rope_theta", 10000.0)
+    thetas = tuple(thetas) if isinstance(thetas, (list, tuple)) else (float(thetas),) * L
+    prf = hf.get("partial_rotary_factors")
+    prf = tuple(prf) if prf else (1.0,) * L
+    use_rope = hf.get("use_rope_layers")
+    use_rope = tuple(bool(b) for b in use_rope) if use_rope else (True,) * L
+    use_bias = bool(hf.get("use_moe_router_bias", False))
+    act = str(hf.get("moe_router_activation", "softmax"))
+    share_dim = hf.get("share_expert_dims") or hf.get("share_expert_dim") or 0
+    if isinstance(share_dim, (list, tuple)):
+        if len(set(share_dim)) != 1:
+            raise NotImplementedError("step3p5 per-layer share_expert_dims")
+        share_dim = share_dim[0]
+    limits = hf.get("swiglu_limits_shared") or hf.get("swiglu_limits")
+    limit = None
+    if limits:
+        nz = {float(x) for x in limits if x}
+        if len(nz) > 1:
+            raise NotImplementedError("step3p5 per-layer swiglu limits")
+        limit = nz.pop() if nz else None
+    moe = MoEConfig(
+        n_routed_experts=int(hf["moe_num_experts"]),
+        experts_per_token=int(hf.get("moe_top_k", 2)),
+        moe_intermediate_size=int(hf.get("moe_intermediate_size", hf["intermediate_size"])),
+        score_func="sigmoid" if act == "sigmoid" else "softmax",
+        norm_topk_prob=True,
+        route_scale=float(hf.get("moe_router_scaling_factor", 1.0)),
+        gate_bias_update_speed=0.001 if use_bias else 0.0,
+    )
+    kw = dict(
+        vocab_size=int(hf["vocab_size"]),
+        hidden_size=int(hf["hidden_size"]),
+        intermediate_size=int(hf["intermediate_size"]),
+        num_layers=L,
+        layer_types=layer_types,
+        global_attn=AttnGeom(num_heads=heads, num_kv_heads=kv, head_dim=head_dim),
+        sliding_attn=AttnGeom(
+            num_heads=int(other.get("num_attention_heads", heads)),
+            num_kv_heads=int(other.get("num_attention_groups", kv)),
+            head_dim=head_dim,
+            sliding_window=int(hf.get("sliding_window") or 0) or None,
+        ),
+        qk_norm=True,
+        head_gate=bool(hf.get("use_head_wise_attn_gate", False)),
+        attention_bias=bool(hf.get("attention_bias", False)),
+        rope_thetas=thetas,
+        partial_rotary=prf,
+        use_rope=use_rope,
+        mlp_kinds=tuple("moe" if i in moe_set else "dense" for i in range(L)),
+        moe=moe,
+        share_expert_dim=int(share_dim),
+        swiglu_limit=limit,
+        rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
+        tie_word_embeddings=bool(hf.get("tie_word_embeddings", False)),
+    )
+    moe_overrides = overrides.pop("moe", None)
+    kw.update(overrides)  # unknown keys raise loudly in HetMoEConfig
+    if moe_overrides is not None:
+        kw["moe"] = moe_overrides
+    return HetMoEConfig(**kw)
+
+
+def mimo_v2_flash_config(hf: Mapping[str, Any], **overrides) -> HetMoEConfig:
+    """MiMoV2FlashForCausalLM: hybrid_layer_pattern (1 = sliding) with
+    swa_* head settings, per-type attention-sink biases, DeepSeek-style
+    sigmoid routing on every moe_layer_freq layer."""
+    L = int(hf["num_hidden_layers"])
+    heads = int(hf["num_attention_heads"])
+    kv = int(hf.get("num_key_value_heads", heads))
+    pattern = hf.get("hybrid_layer_pattern")
+    if pattern is None:
+        block = hf.get("hybrid_block_size")
+        if block:
+            pattern = [0 if ((i + 1) % int(block) == 0) else 1 for i in range(L)]
+        else:
+            pattern = [0 if (i % 6 == 0 or i == L - 1) else 1 for i in range(L)]
+    layer_types = tuple("sliding" if p == 1 else "global" for p in pattern)
+    freq = hf.get("moe_layer_freq")
+    if freq is None:
+        freq = [1] * L
+    head_dim = int(hf.get("head_dim", hf["hidden_size"] // heads))
+    v_dim = int(hf.get("v_head_dim", head_dim) or head_dim)
+    prf = float(hf.get("partial_rotary_factor", 1.0))
+    moe = MoEConfig(
+        n_routed_experts=int(hf["n_routed_experts"]),
+        n_shared_experts=int(hf.get("n_shared_experts") or 0),
+        experts_per_token=int(hf.get("num_experts_per_tok", 8)),
+        n_groups=int(hf.get("n_group", 1)),
+        topk_groups=int(hf.get("topk_group", 1)),
+        moe_intermediate_size=int(hf["moe_intermediate_size"]),
+        score_func="sigmoid" if hf.get("scoring_func", "sigmoid") == "sigmoid" else "softmax",
+        norm_topk_prob=bool(hf.get("norm_topk_prob", True)),
+        route_scale=float(hf.get("routed_scaling_factor", 1.0) or 1.0),
+        gate_bias_update_speed=float(hf.get("bias_update_speed", 0.001)),
+    )
+    thetas = tuple(
+        float(hf.get("swa_rope_theta", 10000.0)) if lt == "sliding"
+        else float(hf.get("rope_theta", 5_000_000.0))
+        for lt in layer_types
+    )
+    kw = dict(
+        vocab_size=int(hf["vocab_size"]),
+        hidden_size=int(hf["hidden_size"]),
+        intermediate_size=int(hf["intermediate_size"]),
+        num_layers=L,
+        layer_types=layer_types,
+        global_attn=AttnGeom(
+            num_heads=heads, num_kv_heads=kv, head_dim=head_dim,
+            v_head_dim=v_dim,
+            sinks=bool(hf.get("add_full_attention_sink_bias", False)),
+        ),
+        sliding_attn=AttnGeom(
+            num_heads=int(hf.get("swa_num_attention_heads", heads)),
+            num_kv_heads=int(hf.get("swa_num_key_value_heads", kv)),
+            head_dim=int(hf.get("swa_head_dim", head_dim) or head_dim),
+            v_head_dim=int(hf.get("swa_v_head_dim", v_dim) or v_dim),
+            sliding_window=int(hf.get("sliding_window") or 128),
+            sinks=bool(hf.get("add_swa_attention_sink_bias", True)),
+        ),
+        qk_norm=False,
+        attention_bias=bool(hf.get("attention_bias", False)),
+        rope_thetas=thetas,
+        partial_rotary=(prf,) * L,
+        use_rope=(True,) * L,
+        mlp_kinds=tuple("moe" if f else "dense" for f in freq),
+        moe=moe,
+        share_expert_dim=0,  # shared experts live inside the MoE block
+        rms_norm_eps=float(hf.get("layernorm_epsilon", hf.get("rms_norm_eps", 1e-5))),
+        tie_word_embeddings=bool(hf.get("tie_word_embeddings", False)),
+    )
+    moe_overrides = overrides.pop("moe", None)
+    kw.update(overrides)  # unknown keys raise loudly in HetMoEConfig
+    if moe_overrides is not None:
+        kw["moe"] = moe_overrides
+    return HetMoEConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# state-dict adapter (shared; per-family naming via `style`)
+# ---------------------------------------------------------------------------
+class HetMoEAdapter:
+    """HF ↔ het_moe params.
+
+    style="step3p5": self_attn.{q,k}_norm + g_proj; STACKED expert tensors
+    moe.{gate,up,down}_proj.weight (E, I, H)/(E, H, I), router moe.gate.weight
+    (E, H) + moe.router_bias, shared expert under share_expert.*.
+    style="mimo": standard per-expert mlp.experts.{e}.{proj}.weight, router
+    mlp.gate.weight + mlp.gate.e_score_correction_bias, per-layer
+    self_attn.attention_sink_bias, shared under mlp.shared_experts.*.
+    """
+
+    def __init__(self, cfg: HetMoEConfig, style: str = "step3p5"):
+        self.cfg = cfg
+        self.style = style
+
+    # per-layer bookkeeping -------------------------------------------------
+    def _index_maps(self):
+        cfg = self.cfg
+        gi = si = di = mi = 0
+        rows = []
+        for li, lt in enumerate(cfg.layer_types):
+            a_key = "s_attn" if lt == "sliding" else "g_attn"
+            ai = si if lt == "sliding" else gi
+            is_moe = cfg.mlp_kinds[li] == "moe"
+            rows.append((li, lt, a_key, ai, is_moe, mi if is_moe else di))
+            if lt == "sliding":
+                si += 1
+            else:
+                gi += 1
+            if is_moe:
+                mi += 1
+            else:
+                di += 1
+        return rows
+
+    def _attn_entries(self, g: AttnGeom):
+        e = [
+            ("self_attn.q_proj.weight", ("q_proj", "kernel"), True),
+            ("self_attn.k_proj.weight", ("k_proj", "kernel"), True),
+            ("self_attn.v_proj.weight", ("v_proj", "kernel"), True),
+            ("self_attn.o_proj.weight", ("o_proj", "kernel"), True),
+        ]
+        if self.cfg.qk_norm:
+            e += [
+                ("self_attn.q_norm.weight", ("q_norm", "scale"), False),
+                ("self_attn.k_norm.weight", ("k_norm", "scale"), False),
+            ]
+        if self.cfg.head_gate:
+            e.append(("self_attn.g_proj.weight", ("g_proj", "kernel"), True))
+        if g.sinks:
+            e.append(("self_attn.attention_sink_bias", ("sinks",), False))
+        return e
+
+    def to_hf(self, params):
+        cfg = self.cfg
+        E = cfg.moe.n_routed_experts
+
+        def _t(x):
+            return np.ascontiguousarray(np.asarray(x).T)
+
+        yield "model.embed_tokens.weight", np.asarray(params["embed"]["embedding"])
+        yield "model.norm.weight", np.asarray(params["final_norm"]["scale"])
+        if not cfg.tie_word_embeddings:
+            yield "lm_head.weight", _t(params["lm_head"]["kernel"])
+        for li, lt, a_key, ai, is_moe, mi in self._index_maps():
+            base = f"model.layers.{li}."
+            yield base + "input_layernorm.weight", np.asarray(
+                params["input_norms"]["scale"][li]
+            )
+            yield base + "post_attention_layernorm.weight", np.asarray(
+                params["post_norms"]["scale"][li]
+            )
+            ap = params[a_key]
+            for suf, path, tr in self._attn_entries(cfg.geom(lt)):
+                node = ap
+                for pseg in path:
+                    node = node[pseg]
+                x = np.asarray(node[ai])
+                yield base + suf, (_t(x) if tr else x)
+            if not is_moe:
+                for proj in ("gate_proj", "up_proj", "down_proj"):
+                    yield base + f"mlp.{proj}.weight", _t(
+                        params["dense_mlp"][proj]["kernel"][mi]
+                    )
+                continue
+            moe = params["moe"]
+            if self.style == "step3p5":
+                yield base + "moe.gate.weight", _t(np.asarray(moe["gate"]["weight"][mi]))
+                if "e_score_bias" in moe["gate"]:
+                    yield base + "moe.router_bias", np.asarray(
+                        moe["gate"]["e_score_bias"][mi]
+                    )
+                # stacked (E, I, H)/(E, H, I): ours are (E, H, I)/(E, I, H)
+                for proj in ("gate_proj", "up_proj", "down_proj"):
+                    w = np.asarray(moe["experts"][proj]["kernel"][mi])
+                    yield base + f"moe.{proj}.weight", np.ascontiguousarray(
+                        np.swapaxes(w, -1, -2)
+                    )
+                if cfg.share_expert_dim:
+                    for proj in ("gate_proj", "up_proj", "down_proj"):
+                        yield base + f"share_expert.{proj}.weight", _t(
+                            params["shared_mlp"][proj]["kernel"][mi]
+                        )
+            else:  # mimo
+                yield base + "mlp.gate.weight", _t(np.asarray(moe["gate"]["weight"][mi]))
+                if "e_score_bias" in moe["gate"]:
+                    yield base + "mlp.gate.e_score_correction_bias", np.asarray(
+                        moe["gate"]["e_score_bias"][mi]
+                    )
+                for e in range(E):
+                    for proj in ("gate_proj", "up_proj", "down_proj"):
+                        yield base + f"mlp.experts.{e}.{proj}.weight", _t(
+                            np.asarray(moe["experts"][proj]["kernel"][mi, e])
+                        )
+                if cfg.moe.n_shared_experts:
+                    for proj in ("gate_proj", "up_proj", "down_proj"):
+                        yield base + f"mlp.shared_experts.{proj}.weight", _t(
+                            np.asarray(moe["shared"][proj]["kernel"][mi])
+                        )
+
+    def from_hf(self, read, shardings=None) -> dict:
+        from automodel_tpu.checkpoint.hf_adapter import _get, _set, memo1_reader
+
+        read = memo1_reader(read)
+        cfg = self.cfg
+        E = cfg.moe.n_routed_experts
+        rows = self._index_maps()
+        params: dict = {}
+
+        def put(path, value):
+            sh = _get(shardings, path) if shardings is not None else None
+            _set(params, path, jax.device_put(value, sh) if sh is not None else jnp.asarray(value))
+
+        def one(name, tr):
+            x = np.asarray(read(name))
+            return np.ascontiguousarray(x.T) if tr else x
+
+        put(("embed", "embedding"), one("model.embed_tokens.weight", False))
+        put(("final_norm", "scale"), one("model.norm.weight", False))
+        if not cfg.tie_word_embeddings:
+            put(("lm_head", "kernel"), one("lm_head.weight", True))
+        put(("input_norms", "scale"), np.stack([
+            one(f"model.layers.{li}.input_layernorm.weight", False)
+            for li in range(cfg.num_layers)
+        ]))
+        put(("post_norms", "scale"), np.stack([
+            one(f"model.layers.{li}.post_attention_layernorm.weight", False)
+            for li in range(cfg.num_layers)
+        ]))
+        for a_key, lt_name in (("g_attn", "global"), ("s_attn", "sliding")):
+            lis = [r for r in rows if r[1] == lt_name]
+            if not lis:
+                # dummy stack kept for pytree uniformity — placed onto its
+                # declared shardings so jitted in_shardings stay consistent
+                from automodel_tpu.models.moe_lm.het_moe import _init_attn_group
+
+                dummy = _init_attn_group(cfg, cfg.geom(lt_name), jax.random.key(0), 1)
+                sub = _get(shardings, (a_key,)) if shardings is not None else None
+                if sub is not None:
+                    params[a_key] = jax.tree.map(jax.device_put, dummy, sub)
+                else:
+                    params[a_key] = jax.tree.map(jnp.asarray, dummy)
+                continue
+            for suf, path, tr in self._attn_entries(cfg.geom(lt_name)):
+                put(
+                    (a_key,) + path,
+                    np.stack([
+                        one(f"model.layers.{li}.{suf}", tr)
+                        for (li, *_rest) in lis
+                    ]),
+                )
+        dense_rows = [r for r in rows if not r[4]]
+        if dense_rows:
+            for proj in ("gate_proj", "up_proj", "down_proj"):
+                put(("dense_mlp", proj, "kernel"), np.stack([
+                    one(f"model.layers.{li}.mlp.{proj}.weight", True)
+                    for (li, *_r) in dense_rows
+                ]))
+        moe_rows = [r for r in rows if r[4]]
+        if moe_rows:
+            if self.style == "step3p5":
+                put(("moe", "gate", "weight"), np.stack([
+                    one(f"model.layers.{li}.moe.gate.weight", True)
+                    for (li, *_r) in moe_rows
+                ]))
+                if cfg.moe.gate_bias_update_speed > 0:
+                    put(("moe", "gate", "e_score_bias"), np.stack([
+                        one(f"model.layers.{li}.moe.router_bias", False)
+                        for (li, *_r) in moe_rows
+                    ]))
+                for proj in ("gate_proj", "up_proj", "down_proj"):
+                    put(("moe", "experts", proj, "kernel"), np.stack([
+                        np.ascontiguousarray(np.swapaxes(
+                            np.asarray(read(f"model.layers.{li}.moe.{proj}.weight")),
+                            -1, -2,
+                        ))
+                        for (li, *_r) in moe_rows
+                    ]))
+                if cfg.share_expert_dim:
+                    for proj in ("gate_proj", "up_proj", "down_proj"):
+                        put(("shared_mlp", proj, "kernel"), np.stack([
+                            one(f"model.layers.{li}.share_expert.{proj}.weight", True)
+                            for (li, *_r) in moe_rows
+                        ]))
+            else:  # mimo
+                put(("moe", "gate", "weight"), np.stack([
+                    one(f"model.layers.{li}.mlp.gate.weight", True)
+                    for (li, *_r) in moe_rows
+                ]))
+                if cfg.moe.gate_bias_update_speed > 0:
+                    put(("moe", "gate", "e_score_bias"), np.stack([
+                        one(f"model.layers.{li}.mlp.gate.e_score_correction_bias", False)
+                        for (li, *_r) in moe_rows
+                    ]))
+                for proj in ("gate_proj", "up_proj", "down_proj"):
+                    put(("moe", "experts", proj, "kernel"), np.stack([
+                        np.stack([
+                            one(f"model.layers.{li}.mlp.experts.{e}.{proj}.weight", True)
+                            for e in range(E)
+                        ])
+                        for (li, *_r) in moe_rows
+                    ]))
+                if cfg.moe.n_shared_experts:
+                    for proj in ("gate_proj", "up_proj", "down_proj"):
+                        put(("moe", "shared", proj, "kernel"), np.stack([
+                            one(f"model.layers.{li}.mlp.shared_experts.{proj}.weight", True)
+                            for (li, *_r) in moe_rows
+                        ]))
+        return params
+
+
+def _register_adapter():
+    from automodel_tpu.checkpoint.hf_adapter import ADAPTERS
+
+    ADAPTERS["het_moe"] = HetMoEAdapter
+
+
+_register_adapter()

@@ -1,0 +1,388 @@
+"""Heterogeneous-attention MoE decoder — the Step-3.5 / MiMo-V2-Flash engine.
+
+The analog of the reference's step3p5 (reference: nemo_automodel/components/
+models/step3p5/, 2581 LoC) and mimo_v2_flash (mimo_v2_flash/, 1107 LoC)
+families. Both interleave TWO attention geometries by `layer_types` — global
+layers and sliding-window layers with their OWN head counts (and, for MiMo,
+their own qk/v head dims and attention-sink biases) — over a decoder whose
+MLPs are per-layer dense or routed-MoE (+ a per-layer shared expert):
+
+- step3p5 (layers.py:183 `Step3p5Attention`): per-head qk-RMSNorm, optional
+  head-wise sigmoid gate (g_proj), per-layer rope theta / partial rotary /
+  NoPE layers (`use_rope_layers`), clamped swiglu MLPs with per-layer
+  limits, arbitrary `moe_layers_enum` MoE placement, separate shared expert.
+- mimo_v2_flash (model.py): sliding layers carry swa_* head settings and a
+  learnable attention-sink bias; MoE with DeepSeek-style sigmoid routing.
+
+TPU design: stacked parameter groups per attention geometry and per MLP
+kind, a python loop over `layer_types` with running per-group indices (the
+models/hybrid/qwen3_next idiom — the heterogeneity is static config), all
+attention through ops/attention.dot_product_attention (flash on TPU,
+sinks/windows/MLA-ish asymmetric v dims native).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.layers import dense_init, embed_init
+from automodel_tpu.models.llm.decoder import _make_constrain, _stack
+from automodel_tpu.moe.config import MoEConfig
+from automodel_tpu.moe.layer import init_moe, moe_forward, moe_param_specs
+from automodel_tpu.ops.attention import dot_product_attention
+from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.rope import apply_rope, rope_frequencies
+
+
+@dataclasses.dataclass(frozen=True)
+class AttnGeom:
+    """One attention geometry (the global or the sliding group)."""
+
+    num_heads: int = 8
+    num_kv_heads: int = 4
+    head_dim: int = 64
+    v_head_dim: Optional[int] = None   # None → head_dim (MiMo swa differs)
+    sliding_window: Optional[int] = None
+    sinks: bool = False                # learnable per-head sink bias (MiMo)
+
+    @property
+    def vd(self) -> int:
+        return self.v_head_dim or self.head_dim
+
+
+@dataclasses.dataclass(frozen=True)
+class HetMoEConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    intermediate_size: int = 5632      # dense-layer MLP width
+    num_layers: int = 4
+    layer_types: tuple = ()            # "global" | "sliding" per layer
+    global_attn: AttnGeom = dataclasses.field(default_factory=AttnGeom)
+    sliding_attn: AttnGeom = dataclasses.field(default_factory=AttnGeom)
+    qk_norm: bool = True               # per-head-dim RMSNorm on q/k
+    head_gate: bool = False            # step3p5 g_proj sigmoid head gate
+    attention_bias: bool = False
+    # per-layer rope: theta / rotary fraction / enabled (NoPE layers)
+    rope_thetas: tuple = ()
+    partial_rotary: tuple = ()
+    use_rope: tuple = ()
+    mlp_kinds: tuple = ()              # "dense" | "moe" per layer
+    moe: MoEConfig = dataclasses.field(default_factory=MoEConfig)
+    share_expert_dim: int = 0          # per-moe-layer shared expert width
+    swiglu_limit: Optional[float] = None  # clamp for dense/shared MLPs
+    rms_norm_eps: float = 1e-5
+    tie_word_embeddings: bool = False
+    logits_soft_cap: Optional[float] = None
+    causal: bool = True
+    dtype: Any = jnp.bfloat16
+    remat_policy: str = "full"
+    attn_impl: str = "auto"
+    scan_unroll: int = 1
+    mtp_num_layers: int = 0  # chassis compatibility
+
+    def __post_init__(self):
+        assert len(self.layer_types) == self.num_layers
+        assert len(self.mlp_kinds) == self.num_layers
+
+    def geom(self, lt: str) -> AttnGeom:
+        return self.sliding_attn if lt == "sliding" else self.global_attn
+
+    @property
+    def num_moe_layers(self) -> int:
+        return sum(1 for k in self.mlp_kinds if k == "moe")
+
+    def flops_per_token(self, seq_len: int) -> float:
+        H = self.hidden_size
+        total = self.vocab_size * H * (1 if self.tie_word_embeddings else 2)
+        for i, lt in enumerate(self.layer_types):
+            g = self.geom(lt)
+            total += H * g.head_dim * (g.num_heads + 2 * g.num_kv_heads)
+            total += g.num_heads * g.vd * H
+            if self.mlp_kinds[i] == "moe":
+                total += 3 * H * self.moe.moe_intermediate_size * self.moe.experts_per_token
+                total += 3 * H * self.share_expert_dim
+                if self.moe.n_shared_experts:
+                    total += 3 * H * self.moe.shared_intermediate
+                total += H * self.moe.n_routed_experts  # router
+            else:
+                total += 3 * H * self.intermediate_size
+        attn_flops = sum(
+            6.0 * self.geom(lt).num_heads * self.geom(lt).head_dim * seq_len
+            for lt in self.layer_types
+        )
+        return 6.0 * total + attn_flops
+
+
+def _init_attn_group(cfg: HetMoEConfig, g: AttnGeom, rng, n: int) -> dict:
+    H = cfg.hidden_size
+    ks = jax.random.split(rng, 6)
+    p = {
+        "q_proj": {"kernel": _stack(dense_init, ks[0], (H, g.num_heads * g.head_dim), n)},
+        "k_proj": {"kernel": _stack(dense_init, ks[1], (H, g.num_kv_heads * g.head_dim), n)},
+        "v_proj": {"kernel": _stack(dense_init, ks[2], (H, g.num_kv_heads * g.vd), n)},
+        "o_proj": {"kernel": _stack(dense_init, ks[3], (g.num_heads * g.vd, H), n)},
+    }
+    if cfg.attention_bias:
+        for name, width in (
+            ("q_proj", g.num_heads * g.head_dim),
+            ("k_proj", g.num_kv_heads * g.head_dim),
+            ("v_proj", g.num_kv_heads * g.vd),
+            ("o_proj", H),
+        ):
+            p[name]["bias"] = jnp.zeros((n, width))
+    if cfg.qk_norm:
+        p["q_norm"] = {"scale": jnp.ones((n, g.head_dim))}
+        p["k_norm"] = {"scale": jnp.ones((n, g.head_dim))}
+    if cfg.head_gate:
+        p["g_proj"] = {"kernel": _stack(dense_init, ks[4], (H, g.num_heads), n)}
+    if g.sinks:
+        p["sinks"] = jnp.zeros((n, g.num_heads))
+    return p
+
+
+def _attn_group_specs(cfg: HetMoEConfig, g: AttnGeom) -> dict:
+    p = {
+        "q_proj": {"kernel": ("layers", "embed", "heads")},
+        "k_proj": {"kernel": ("layers", "embed", "kv_heads")},
+        "v_proj": {"kernel": ("layers", "embed", "kv_heads")},
+        "o_proj": {"kernel": ("layers", "heads", "embed")},
+    }
+    if cfg.attention_bias:
+        for name in ("q_proj", "k_proj", "v_proj"):
+            p[name]["bias"] = ("layers", "heads")
+        p["o_proj"]["bias"] = ("layers", "norm")
+    if cfg.qk_norm:
+        p["q_norm"] = {"scale": ("layers", "norm")}
+        p["k_norm"] = {"scale": ("layers", "norm")}
+    if cfg.head_gate:
+        p["g_proj"] = {"kernel": ("layers", "embed", None)}
+    if g.sinks:
+        p["sinks"] = ("layers", "heads")
+    return p
+
+
+def _mlp_stack(cfg: HetMoEConfig, rng, n: int, width: int) -> dict:
+    H = cfg.hidden_size
+    ks = jax.random.split(rng, 3)
+    return {
+        "gate_proj": {"kernel": _stack(dense_init, ks[0], (H, width), n)},
+        "up_proj": {"kernel": _stack(dense_init, ks[1], (H, width), n)},
+        "down_proj": {"kernel": _stack(dense_init, ks[2], (width, H), n)},
+    }
+
+
+_MLP_SPECS = {
+    "gate_proj": {"kernel": ("layers", "embed", "mlp")},
+    "up_proj": {"kernel": ("layers", "embed", "mlp")},
+    "down_proj": {"kernel": ("layers", "mlp", "embed")},
+}
+
+
+def init(cfg: HetMoEConfig, rng: jax.Array) -> dict:
+    H = cfg.hidden_size
+    L = cfg.num_layers
+    n_g = sum(1 for t in cfg.layer_types if t == "global")
+    n_s = L - n_g
+    n_d = sum(1 for k in cfg.mlp_kinds if k == "dense")
+    n_m = L - n_d
+    ks = jax.random.split(rng, 9)
+    params: dict = {
+        "embed": {"embedding": embed_init(ks[0], (cfg.vocab_size, H))},
+        "final_norm": {"scale": jnp.ones((H,))},
+        "input_norms": {"scale": jnp.ones((L, H))},
+        "post_norms": {"scale": jnp.ones((L, H))},
+        "g_attn": _init_attn_group(cfg, cfg.global_attn, ks[1], max(n_g, 1)),
+        "s_attn": _init_attn_group(cfg, cfg.sliding_attn, ks[2], max(n_s, 1)),
+    }
+    if n_d:
+        params["dense_mlp"] = _mlp_stack(cfg, ks[3], n_d, cfg.intermediate_size)
+    if n_m:
+        params["moe"] = jax.tree.map(
+            lambda *xs: jnp.stack(xs),
+            *[init_moe(cfg.moe, H, k) for k in jax.random.split(ks[4], n_m)],
+        )
+        if cfg.share_expert_dim:
+            params["shared_mlp"] = _mlp_stack(cfg, ks[5], n_m, cfg.share_expert_dim)
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = {"kernel": dense_init(ks[6], (H, cfg.vocab_size))}
+    return params
+
+
+def param_specs(cfg: HetMoEConfig) -> dict:
+    specs: dict = {
+        "embed": {"embedding": ("vocab", "embed")},
+        "final_norm": {"scale": ("norm",)},
+        "input_norms": {"scale": ("layers", "norm")},
+        "post_norms": {"scale": ("layers", "norm")},
+        "g_attn": _attn_group_specs(cfg, cfg.global_attn),
+        "s_attn": _attn_group_specs(cfg, cfg.sliding_attn),
+    }
+    if any(k == "dense" for k in cfg.mlp_kinds):
+        specs["dense_mlp"] = _MLP_SPECS
+    if cfg.num_moe_layers:
+        specs["moe"] = jax.tree.map(
+            lambda s: ("layers",) + s,
+            moe_param_specs(cfg.moe),
+            is_leaf=lambda x: isinstance(x, tuple),
+        )
+        if cfg.share_expert_dim:
+            specs["shared_mlp"] = _MLP_SPECS
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = {"kernel": ("embed", "vocab")}
+    return specs
+
+
+def _clamped_swiglu(x, lp, i, limit):
+    g = x @ lp["gate_proj"]["kernel"][i]
+    u = x @ lp["up_proj"]["kernel"][i]
+    if limit is not None:
+        g = jnp.clip(g, -limit, limit)
+        u = jnp.clip(u, -limit, limit)
+    return (jax.nn.silu(g) * u) @ lp["down_proj"]["kernel"][i]
+
+
+def forward(
+    params: dict,
+    cfg: HetMoEConfig,
+    input_ids: jnp.ndarray,
+    *,
+    positions: jnp.ndarray | None = None,
+    segment_ids: jnp.ndarray | None = None,
+    mesh_ctx=None,
+    rules=None,
+    return_hidden: bool = False,
+    token_mask: jnp.ndarray | None = None,
+    return_stats: bool = False,
+    **_ignored,
+) -> tuple:
+    """Returns (logits-or-hidden, aux_loss[, stats]) — the moe_lm protocol."""
+    from automodel_tpu.models.common.layers import cast_params
+
+    params = cast_params(params, cfg.dtype)
+    B, S = input_ids.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
+    constrain = _make_constrain(mesh_ctx, rules)
+
+    tbl = constrain(params["embed"]["embedding"], ("vocab", None))
+    h = jnp.take(tbl, input_ids, axis=0).astype(cfg.dtype)
+    h = constrain(h, ("act_batch", "act_seq", "act_embed"))
+
+    eps = cfg.rms_norm_eps
+    remat = cfg.remat_policy not in (None, "none")
+    aux_total = jnp.float32(0.0)
+    stats_rows = []
+    idx = {"g": 0, "s": 0, "d": 0, "m": 0}
+
+    for li, lt in enumerate(cfg.layer_types):
+        g = cfg.geom(lt)
+        gk = "s_attn" if lt == "sliding" else "g_attn"
+        ai = idx["s" if lt == "sliding" else "g"]
+        theta = cfg.rope_thetas[li] if cfg.rope_thetas else 10000.0
+        frac = cfg.partial_rotary[li] if cfg.partial_rotary else 1.0
+        roped = cfg.use_rope[li] if cfg.use_rope else True
+        rot = int(g.head_dim * frac) // 2 * 2
+        inv_freq = rope_frequencies(rot, theta) if roped and rot else None
+        is_moe = cfg.mlp_kinds[li] == "moe"
+        mi = idx["m"] if is_moe else idx["d"]
+
+        def layer(h, li=li, gk=gk, ai=ai, g=g, inv_freq=inv_freq, is_moe=is_moe, mi=mi):
+            lp = params[gk]
+            x = rms_norm(h, params["input_norms"]["scale"][li], eps)
+            q = (x @ lp["q_proj"]["kernel"][ai]).reshape(B, S, g.num_heads, g.head_dim)
+            k = (x @ lp["k_proj"]["kernel"][ai]).reshape(B, S, g.num_kv_heads, g.head_dim)
+            v = (x @ lp["v_proj"]["kernel"][ai]).reshape(B, S, g.num_kv_heads, g.vd)
+            if cfg.attention_bias:
+                q = q + lp["q_proj"]["bias"][ai].reshape(1, 1, g.num_heads, g.head_dim)
+                k = k + lp["k_proj"]["bias"][ai].reshape(1, 1, g.num_kv_heads, g.head_dim)
+                v = v + lp["v_proj"]["bias"][ai].reshape(1, 1, g.num_kv_heads, g.vd)
+            if cfg.qk_norm:
+                q = rms_norm(q, lp["q_norm"]["scale"][ai], eps)
+                k = rms_norm(k, lp["k_norm"]["scale"][ai], eps)
+            if inv_freq is not None:
+                q = apply_rope(q, positions, inv_freq)
+                k = apply_rope(k, positions, inv_freq)
+            q = constrain(q, ("act_batch", "act_seq", "act_heads", None))
+            sinks = lp["sinks"][ai] if g.sinks else None
+            attn = dot_product_attention(
+                q, k, v, causal=cfg.causal, segment_ids=segment_ids,
+                positions=positions, sliding_window=g.sliding_window,
+                sinks=sinks, impl=cfg.attn_impl,
+            )
+            if cfg.head_gate:
+                gate = jax.nn.sigmoid(x @ lp["g_proj"]["kernel"][ai])
+                attn = attn * gate[..., :, None].astype(attn.dtype)
+            attn = attn.reshape(B, S, g.num_heads * g.vd)
+            out = attn @ lp["o_proj"]["kernel"][ai]
+            if cfg.attention_bias and "bias" in lp["o_proj"]:
+                out = out + lp["o_proj"]["bias"][ai]
+            h = constrain(h + out, ("act_batch", "act_seq", "act_embed"))
+
+            x = rms_norm(h, params["post_norms"]["scale"][li], eps)
+            if is_moe:
+                mp = jax.tree.map(lambda p: p[mi], params["moe"])
+                moe_out, aux, st = moe_forward(
+                    mp, cfg.moe, x, constrain, token_mask=token_mask,
+                    mesh_ctx=mesh_ctx,
+                )
+                if cfg.share_expert_dim:
+                    moe_out = moe_out + _clamped_swiglu(
+                        x, params["shared_mlp"], mi, cfg.swiglu_limit
+                    )
+                h = h + moe_out
+                extra = (aux, st["tokens_per_expert"])
+            else:
+                h = h + _clamped_swiglu(x, params["dense_mlp"], mi, cfg.swiglu_limit)
+                extra = (jnp.float32(0.0), None)
+            return constrain(h, ("act_batch", "act_seq", "act_embed")), extra
+
+        h, (aux, tpe) = (jax.checkpoint(layer) if remat else layer)(h)
+        aux_total = aux_total + aux
+        if is_moe:
+            stats_rows.append(tpe)
+            idx["m"] += 1
+        else:
+            idx["d"] += 1
+        idx["s" if lt == "sliding" else "g"] += 1
+
+    h = rms_norm(h, params["final_norm"]["scale"], eps)
+    if return_hidden:
+        out = h
+    else:
+        kernel = (
+            params["embed"]["embedding"].T
+            if cfg.tie_word_embeddings
+            else params["lm_head"]["kernel"]
+        )
+        out = jnp.einsum(
+            "bsh,hv->bsv", h, kernel.astype(h.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        if cfg.logits_soft_cap is not None:
+            out = cfg.logits_soft_cap * jnp.tanh(out / cfg.logits_soft_cap)
+    if return_stats:
+        stats = {
+            "tokens_per_expert": (
+                jnp.stack(stats_rows) if stats_rows
+                else jnp.zeros((0, cfg.moe.n_routed_experts), jnp.float32)
+            )
+        }
+        return out, aux_total, stats
+    return out, aux_total
+
+
+def apply_gate_bias_update(params: dict, cfg: HetMoEConfig, tokens_per_expert) -> dict:
+    """DeepSeek aux-free balancing over the het layout's stacked MoE gates
+    (same math as moe_lm/decoder.apply_gate_bias_update; tokens_per_expert
+    is (num_moe_layers, E))."""
+    gate = params["moe"]["gate"]
+    if "e_score_bias" not in gate:
+        return params
+    err = tokens_per_expert.mean(-1, keepdims=True) - tokens_per_expert
+    new_bias = gate["e_score_bias"] + cfg.moe.gate_bias_update_speed * jnp.sign(err)
+    new_gate = {**gate, "e_score_bias": new_bias}
+    return {**params, "moe": {**params["moe"], "gate": new_gate}}

@@ -19,6 +19,8 @@ from automodel_tpu.models.llm import decoder, families
 from automodel_tpu.models.moe_lm import decoder as moe_decoder
 from automodel_tpu.models.moe_lm import families as moe_families
 from automodel_tpu.models.moe_lm import gemma4 as gemma4_module
+from automodel_tpu.models.moe_lm import het_families
+from automodel_tpu.models.moe_lm import het_moe as het_moe_module
 from automodel_tpu.models.omni import model as omni_module
 from automodel_tpu.models.vlm import kimi_vl as kimi_vl_module
 from automodel_tpu.models.vlm import llava as llava_module
@@ -79,6 +81,17 @@ MODEL_ARCH_MAPPING: dict[str, ModelSpec] = {
     "Gemma4ForConditionalGeneration": ModelSpec(
         "gemma4_moe", gemma4_module.gemma4_moe_config, gemma4_module,
         adapter_name="gemma4_moe",
+    ),
+    # Step-3.5 / MiMo-V2-Flash: heterogeneous sliding/global attention
+    # geometries over per-layer dense/MoE MLPs (reference: models/step3p5,
+    # models/mimo_v2_flash) — the het_moe engine
+    "Step3p5ForCausalLM": ModelSpec(
+        "step3p5", het_families.step3p5_config, het_moe_module,
+        adapter_name="het_moe", adapter_kwargs={"style": "step3p5"},
+    ),
+    "MiMoV2FlashForCausalLM": ModelSpec(
+        "mimo_v2_flash", het_families.mimo_v2_flash_config, het_moe_module,
+        adapter_name="het_moe", adapter_kwargs={"style": "mimo"},
     ),
     # Ling 2.0 (reference: models/ling_v2): deepseek-style routed MoE on
     # qk-normed partial-rope GQA; fused query_key_value checkpoint layout

@@ -636,10 +636,13 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
     def _update_gate_bias(self, tokens_per_expert) -> None:
         """DeepSeek aux-free balancing after the optimizer step
         (reference: train_ft.py:1164 update_moe_gate_bias). Stats come out
-        of the train step's aux, so this costs one elementwise update."""
+        of the train step's aux, so this costs one elementwise update.
+        Modules with their own parameter layout (het_moe) export their own
+        apply_gate_bias_update; the moe_lm decoder's is the default."""
         from automodel_tpu.models.moe_lm.decoder import apply_gate_bias_update
 
-        new_params = apply_gate_bias_update(
+        fn = getattr(self.model_spec.module, "apply_gate_bias_update", None) or apply_gate_bias_update
+        new_params = fn(
             self.train_state.params, self.model_cfg, tokens_per_expert
         )
         self.train_state = self.train_state._replace(params=new_params)

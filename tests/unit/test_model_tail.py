@@ -341,3 +341,136 @@ def test_ling_v2_adapter_fused_qkv_roundtrip():
     o1, _ = moe_decoder.forward(params, cfg, ids)
     o2, _ = moe_decoder.forward(jax.tree.map(jnp.asarray, p2), cfg, ids)
     np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), atol=1e-5)
+
+
+STEP35_HF = {
+    "architectures": ["Step3p5ForCausalLM"],
+    "model_type": "step3p5",
+    "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+    "num_hidden_layers": 4, "num_attention_heads": 4,
+    "num_attention_groups": 2, "head_dim": 8,
+    "attention_other_setting": {"num_attention_heads": 2, "num_attention_groups": 1},
+    "layer_types": [
+        "full_attention", "sliding_attention",
+        "sliding_attention", "full_attention",
+    ],
+    "sliding_window": 8,
+    "rope_theta": [10000.0, 5000.0, 5000.0, 10000.0],
+    "partial_rotary_factors": [1.0, 0.5, 0.5, 1.0],
+    "use_rope_layers": [True, True, False, True],
+    "use_head_wise_attn_gate": True,
+    "moe_layers_enum": [1, 3],
+    "moe_num_experts": 4, "moe_top_k": 2, "moe_intermediate_size": 16,
+    "moe_router_activation": "sigmoid", "use_moe_router_bias": True,
+    "share_expert_dims": [16, 16, 16, 16],
+    "rms_norm_eps": 1e-5,
+}
+
+MIMO_HF = {
+    "architectures": ["MiMoV2FlashForCausalLM"],
+    "model_type": "mimo_v2_flash",
+    "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+    "num_hidden_layers": 4, "num_attention_heads": 4,
+    "num_key_value_heads": 2, "head_dim": 8, "v_head_dim": 8,
+    "swa_num_attention_heads": 2, "swa_num_key_value_heads": 1,
+    "swa_head_dim": 16, "swa_v_head_dim": 8,
+    "hybrid_layer_pattern": [0, 1, 1, 0],
+    "sliding_window": 8,
+    "rope_theta": 5000000.0, "swa_rope_theta": 10000.0,
+    "partial_rotary_factor": 0.5,
+    "add_full_attention_sink_bias": False,
+    "add_swa_attention_sink_bias": True,
+    "n_routed_experts": 4, "num_experts_per_tok": 2,
+    "moe_intermediate_size": 16, "scoring_func": "sigmoid",
+    "n_group": 2, "topk_group": 2, "norm_topk_prob": True,
+    "moe_layer_freq": [0, 1, 1, 1], "n_shared_experts": 1,
+}
+
+
+@pytest.mark.slow
+def test_step3p5_forward_and_roundtrip():
+    from automodel_tpu.checkpoint.hf_adapter import get_adapter
+    from automodel_tpu.models.moe_lm import het_moe
+
+    spec = get_model_spec(STEP35_HF)
+    cfg = spec.config_from_hf(STEP35_HF, dtype=jnp.float32, remat_policy="none")
+    assert cfg.layer_types == ("global", "sliding", "sliding", "global")
+    assert cfg.mlp_kinds == ("dense", "moe", "dense", "moe")
+    assert cfg.sliding_attn.num_heads == 2 and cfg.global_attn.num_heads == 4
+    assert cfg.use_rope == (True, True, False, True)  # NoPE layer
+    assert cfg.head_gate
+    params = het_moe.init(cfg, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (2, 16), 0, 128)
+    out, aux, stats = het_moe.forward(params, cfg, ids, return_stats=True)
+    assert np.isfinite(np.asarray(out)).all()
+    assert stats["tokens_per_expert"].shape == (2, 4)
+
+    ad = get_adapter(spec.adapter_name, cfg, **spec.adapter_kwargs)
+    sd = dict(ad.to_hf(params))
+    assert sd["model.layers.1.moe.gate_proj.weight"].shape == (4, 16, 32)
+    assert "model.layers.1.moe.router_bias" in sd
+    assert "model.layers.1.share_expert.up_proj.weight" in sd
+    assert "model.layers.0.self_attn.g_proj.weight" in sd
+    assert sd["model.layers.1.self_attn.q_proj.weight"].shape == (2 * 8, 32)
+    p2 = ad.from_hf(lambda k: np.asarray(sd[k]))
+    o2, _, _ = het_moe.forward(
+        jax.tree.map(jnp.asarray, p2), cfg, ids, return_stats=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(o2), atol=1e-5)
+
+
+@pytest.mark.slow
+def test_mimo_v2_flash_forward_and_roundtrip():
+    from automodel_tpu.checkpoint.hf_adapter import get_adapter
+    from automodel_tpu.models.moe_lm import het_moe
+
+    spec = get_model_spec(MIMO_HF)
+    cfg = spec.config_from_hf(MIMO_HF, dtype=jnp.float32, remat_policy="none")
+    assert cfg.layer_types == ("global", "sliding", "sliding", "global")
+    assert cfg.mlp_kinds == ("dense", "moe", "moe", "moe")
+    assert cfg.sliding_attn.head_dim == 16 and cfg.sliding_attn.vd == 8
+    assert cfg.sliding_attn.sinks and not cfg.global_attn.sinks
+    params = het_moe.init(cfg, jax.random.key(0))
+    # non-zero sinks so the path is exercised
+    params["s_attn"]["sinks"] = 0.3 + 0.1 * jax.random.normal(
+        jax.random.key(5), params["s_attn"]["sinks"].shape
+    )
+    ids = jax.random.randint(jax.random.key(1), (2, 16), 0, 128)
+    out, aux, stats = het_moe.forward(params, cfg, ids, return_stats=True)
+    assert np.isfinite(np.asarray(out)).all()
+    assert stats["tokens_per_expert"].shape == (3, 4)
+
+    ad = get_adapter(spec.adapter_name, cfg, **spec.adapter_kwargs)
+    sd = dict(ad.to_hf(params))
+    assert "model.layers.1.self_attn.attention_sink_bias" in sd
+    assert "model.layers.0.self_attn.attention_sink_bias" not in sd
+    assert "model.layers.1.mlp.gate.e_score_correction_bias" in sd
+    assert "model.layers.1.mlp.shared_experts.down_proj.weight" in sd
+    assert "model.layers.0.mlp.gate_proj.weight" in sd  # dense layer
+    assert sd["model.layers.1.self_attn.k_proj.weight"].shape == (1 * 16, 32)
+    p2 = ad.from_hf(lambda k: np.asarray(sd[k]))
+    o2, _, _ = het_moe.forward(
+        jax.tree.map(jnp.asarray, p2), cfg, ids, return_stats=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(o2), atol=1e-5)
+
+
+@pytest.mark.recipe
+def test_step3p5_recipe_trains(tmp_path):
+    import json
+
+    from automodel_tpu.cli.app import resolve_recipe_class
+    from tests.unit.test_recipe import _smoke_cfg
+
+    cfg = _smoke_cfg(tmp_path)
+    cfg.set("model.hf_config", STEP35_HF)
+    cfg.set("distributed", {"dp_shard": -1, "ep": 2})
+    cfg.set("checkpoint.enabled", False)
+    cfg.set("step_scheduler.max_steps", 3)
+    r = resolve_recipe_class(cfg)(cfg)
+    r.setup()
+    assert r.is_moe
+    r.run_train_validation_loop()
+    recs = [json.loads(l) for l in open(tmp_path / "training.jsonl") if l.strip()]
+    assert len(recs) == 3
+    assert all(np.isfinite(x["loss"]) for x in recs)
