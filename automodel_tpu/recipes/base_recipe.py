@@ -23,6 +23,12 @@ class BaseRecipe:
     def __init__(self, cfg: ConfigNode):
         object.__setattr__(self, "_state_tracked", {})
         self.cfg = cfg
+        # typed facade over raw sections (the RecipeConfig analog,
+        # reference: recipes/_typed_config.py:130) — recipes read
+        # self.typed.<section> for validated dataclass configs
+        from automodel_tpu.recipes.typed_config import RecipeConfig
+
+        self.typed = RecipeConfig(cfg)
         self.checkpointer: Optional[Checkpointer] = None
         self.train_state = None  # TrainState pytree (sharded)
 

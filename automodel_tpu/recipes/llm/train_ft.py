@@ -41,19 +41,17 @@ import jax.numpy as jnp
 import numpy as np
 
 from automodel_tpu.checkpoint import (
-    CheckpointingConfig,
     HFCheckpointReader,
     get_adapter,
     save_hf_checkpoint,
 )
 from automodel_tpu.config import ConfigNode, parse_args_and_load_config
-from automodel_tpu.datasets.loader import DataloaderConfig, make_global_batch, stack_microbatches
-from automodel_tpu.distributed import MeshConfig, initialize_distributed
+from automodel_tpu.datasets.loader import make_global_batch, stack_microbatches
+from automodel_tpu.distributed import initialize_distributed
 from automodel_tpu.loggers.metric_logger import MetricLogger, setup_logging
 from automodel_tpu.loss import fused_linear_cross_entropy
 from automodel_tpu.loss.utils import combine_losses
 from automodel_tpu.models.registry import get_model_spec
-from automodel_tpu.optim import LRSchedulerConfig, OptimizerConfig
 from automodel_tpu.parallel import logical_to_shardings
 from automodel_tpu.recipes.base_recipe import BaseRecipe
 from automodel_tpu.training import (
@@ -62,7 +60,7 @@ from automodel_tpu.training import (
     make_train_step,
 )
 from automodel_tpu.training.rng import StatefulRNG
-from automodel_tpu.training.step_scheduler import StepScheduler, StepSchedulerConfig
+from automodel_tpu.training.step_scheduler import StepScheduler
 from automodel_tpu.utils.flops import MFUCalculator
 
 logger = logging.getLogger(__name__)
@@ -110,12 +108,11 @@ def make_hidden_forward(module, model_cfg, mesh_ctx, peft_cfg=None):
 
 
 def _dataclass_from_cfg(cls, node, **extra):
-    kwargs = dict(extra)
-    if node is not None:
-        for f in dataclasses.fields(cls):
-            if f.name in node:
-                kwargs[f.name] = node.get(f.name)
-    return cls(**kwargs)
+    """Legacy non-strict coercion (kept for recipes not yet on the typed
+    facade); new code should use recipes.typed_config / self.typed."""
+    from automodel_tpu.recipes.typed_config import dataclass_from_node
+
+    return dataclass_from_node(cls, node, strict=False, **extra)
 
 
 class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
@@ -130,15 +127,17 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         initialize_distributed()
 
         self.rng = StatefulRNG(seed=int(cfg.get("seed", 42)), ranked=False)
-        self.mesh_ctx = MeshConfig.from_config(cfg.get("distributed")).build()
+        self.mesh_ctx = self.typed.mesh.build()
         logger.info("mesh: %s", self.mesh_ctx.sizes)
 
         self._build_model()
         self._build_optimizer()
         self._build_data()
 
-        ckpt_cfg = _dataclass_from_cfg(CheckpointingConfig, cfg.get("checkpoint"))
-        ckpt_cfg.save_every_steps = self.step_scheduler.config.ckpt_every_steps
+        ckpt_cfg = dataclasses.replace(
+            self.typed.checkpoint,
+            save_every_steps=self.step_scheduler.config.ckpt_every_steps,
+        )
         self.checkpointer = ckpt_cfg.build() if ckpt_cfg.enabled else None
 
         run_dir = cfg.get("run_dir", ".")
@@ -153,7 +152,7 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
 
         from automodel_tpu.utils.profiling import ProfilingConfig
 
-        self.profiler = _dataclass_from_cfg(ProfilingConfig, cfg.get("profiling")).build()
+        self.profiler = self.typed.profiling.build()
 
         seq_len = int(cfg.get("dataset.seq_len", 512))
         self.mfu = MFUCalculator(
@@ -294,17 +293,9 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         self.peft_cfg = None
         self.base_params = None
         if peft_node is not None:
-            from automodel_tpu.peft.lora import (
-                LoRAConfig,
-                init_lora,
-                lora_param_shardings,
-            )
+            from automodel_tpu.peft.lora import init_lora, lora_param_shardings
 
-            self.peft_cfg = _dataclass_from_cfg(LoRAConfig, peft_node)
-            if "target_modules" in peft_node:
-                self.peft_cfg = dataclasses.replace(
-                    self.peft_cfg, target_modules=tuple(peft_node.get("target_modules"))
-                )
+            self.peft_cfg = self.typed.peft
             lora = init_lora(params, self.peft_cfg, self.rng.next_key())
             if self.peft_cfg.quantize_base:
                 from automodel_tpu.peft.lora import quantize_base
@@ -321,8 +312,8 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
     # ------------------------------------------------------------------
     def _build_optimizer(self) -> None:
         cfg = self.cfg
-        opt_cfg = _dataclass_from_cfg(OptimizerConfig, cfg.get("optimizer"))
-        sched_cfg = _dataclass_from_cfg(LRSchedulerConfig, cfg.get("lr_scheduler"))
+        opt_cfg = self.typed.optimizer
+        sched_cfg = self.typed.lr_scheduler
         self.lr_schedule = sched_cfg.build(opt_cfg.lr)
         self.tx = opt_cfg.build(self.lr_schedule)
         state = init_train_state(self._init_params, self.tx)
@@ -350,7 +341,7 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         # (reference: quantization/qat.py + train_ft.py:861 delayed enable)
         from automodel_tpu.ops.quant import QATConfig
 
-        qat_cfg = _dataclass_from_cfg(QATConfig, self.cfg.get("qat"))
+        qat_cfg = self.typed.qat
         if qat_cfg.enabled and self.cfg.get("peft") is not None:
             # the trainable tree is the LoRA pytree (leaves a/b/m, no
             # 'kernel'); the transform would silently fake-quant nothing.
@@ -479,7 +470,7 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             dataset = ds_cfg.build(tokenizer) if tokenizer is not None else ds_cfg.build()
         except TypeError:
             dataset = ds_cfg.build()
-        dl_cfg = _dataclass_from_cfg(DataloaderConfig, cfg.get("dataloader"))
+        dl_cfg = self.typed.dataloader
         div = self.mesh_ctx.batch_size_divisor
         if dl_cfg.microbatch_size % div != 0:
             raise ValueError(
@@ -488,8 +479,9 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                 "sharding axes of the mesh)"
             )
         self.dataloader = dl_cfg.build(dataset)
-        ss_cfg = _dataclass_from_cfg(StepSchedulerConfig, cfg.get("step_scheduler"))
-        ss_cfg.grad_acc_steps = dl_cfg.grad_acc_steps
+        ss_cfg = dataclasses.replace(
+            self.typed.step_scheduler, grad_acc_steps=dl_cfg.grad_acc_steps
+        )
         self.step_scheduler = StepScheduler(ss_cfg, self.dataloader)
         self._build_cp_sharder()
 

@@ -1,0 +1,135 @@
+"""Typed recipe-config facade: lazy coercion of raw ConfigNode sections into
+the framework's typed dataclass configs.
+
+The analog of the reference's `RecipeConfig` (reference: nemo_automodel/
+recipes/_typed_config.py:130-652): recipes read `self.typed.<section>` and
+get a validated dataclass (cached per access path), instead of hand-rolling
+per-section `_dataclass_from_cfg` calls. Unknown keys inside a section are
+rejected loudly — a typo'd field name otherwise trains with a default the
+user didn't ask for.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from automodel_tpu.config import ConfigNode
+
+
+def dataclass_from_node(cls, node, *, strict: bool = True, allow: tuple = (), **extra):
+    """ConfigNode/dict section → dataclass instance. With `strict`, keys the
+    dataclass does not declare raise instead of being dropped (`allow` lists
+    section keys the RECIPE reads directly rather than the dataclass)."""
+    kwargs = dict(extra)
+    names = {f.name for f in dataclasses.fields(cls)}
+    if node is not None:
+        keys = list(node.keys() if hasattr(node, "keys") else [])
+        unknown = [k for k in keys if k not in names and k not in allow]
+        if strict and unknown:
+            raise ValueError(
+                f"unknown key(s) {unknown} for {cls.__name__} "
+                f"(valid: {sorted(names)})"
+            )
+        for f in dataclasses.fields(cls):
+            if f.name in node:
+                kwargs[f.name] = node.get(f.name)
+    return cls(**kwargs)
+
+
+class RecipeConfig:
+    """Lazy typed view over a recipe's raw ConfigNode."""
+
+    def __init__(self, raw: ConfigNode):
+        self.raw = raw
+        self._cache: dict = {}
+
+    def _section(self, name: str, cls, required: bool = False, allow: tuple = (), **extra):
+        key = (name, cls.__name__)
+        if key not in self._cache:
+            node = self.raw.get(name)
+            if node is None and required:
+                raise ValueError(f"config section '{name}' is required")
+            self._cache[key] = dataclass_from_node(cls, node, allow=allow, **extra)
+        return self._cache[key]
+
+    # -- sections ------------------------------------------------------------
+    @property
+    def mesh(self):
+        from automodel_tpu.distributed import MeshConfig
+
+        key = ("distributed", "MeshConfig")
+        if key not in self._cache:
+            self._cache[key] = MeshConfig.from_config(self.raw.get("distributed"))
+        return self._cache[key]
+
+    @property
+    def checkpoint(self):
+        from automodel_tpu.checkpoint import CheckpointingConfig
+
+        return self._section(
+            "checkpoint", CheckpointingConfig,
+            allow=("restore_from", "restore_step"),
+        )
+
+    @property
+    def optimizer(self):
+        from automodel_tpu.optim import OptimizerConfig
+
+        return self._section("optimizer", OptimizerConfig)
+
+    @property
+    def lr_scheduler(self):
+        from automodel_tpu.optim import LRSchedulerConfig
+
+        return self._section("lr_scheduler", LRSchedulerConfig)
+
+    @property
+    def dataloader(self):
+        from automodel_tpu.datasets.loader import DataloaderConfig
+
+        return self._section("dataloader", DataloaderConfig)
+
+    @property
+    def step_scheduler(self):
+        from automodel_tpu.training.step_scheduler import StepSchedulerConfig
+
+        return self._section("step_scheduler", StepSchedulerConfig)
+
+    @property
+    def qat(self):
+        from automodel_tpu.ops.quant import QATConfig
+
+        return self._section("qat", QATConfig)
+
+    @property
+    def profiling(self):
+        from automodel_tpu.utils.profiling import ProfilingConfig
+
+        return self._section("profiling", ProfilingConfig)
+
+    @property
+    def peft(self) -> Optional[Any]:
+        node = self.raw.get("peft")
+        if node is None:
+            return None
+        from automodel_tpu.peft.lora import LoRAConfig
+
+        key = ("peft", "LoRAConfig")
+        if key not in self._cache:
+            cfg = dataclass_from_node(LoRAConfig, node)
+            if "target_modules" in node:
+                cfg = dataclasses.replace(
+                    cfg, target_modules=tuple(node.get("target_modules"))
+                )
+            self._cache[key] = cfg
+        return self._cache[key]
+
+    @property
+    def packing(self) -> Optional[Any]:
+        node = self.raw.get("packing")
+        if node is None:
+            return None
+        from automodel_tpu.datasets.packing import PackedSequenceConfig
+
+        return self._section("packing", PackedSequenceConfig)

@@ -80,3 +80,33 @@ def test_load_yaml(tmp_path):
     cfg = load_yaml(str(p))
     assert cfg.model.n_layers == 4
     assert cfg.optim.lr == pytest.approx(1e-3)
+
+
+def test_typed_recipe_config_facade_and_strictness():
+    """RecipeConfig coerces sections lazily and rejects typo'd keys
+    (previously silently dropped)."""
+    import pytest as _pytest
+
+    from automodel_tpu.config import ConfigNode
+    from automodel_tpu.recipes.typed_config import RecipeConfig
+
+    cfg = ConfigNode({
+        "distributed": {"dp_shard": -1, "tp": 1},
+        "optimizer": {"name": "adamw", "lr": 1e-3},
+        "dataloader": {"microbatch_size": 4, "grad_acc_steps": 2},
+        "checkpoint": {"enabled": False, "restore_from": "/x"},  # allowed extra
+        "step_scheduler": {"max_steps": 5},
+        "peft": {"r": 4, "alpha": 8.0, "target_modules": ["q_proj"]},
+    })
+    t = RecipeConfig(cfg)
+    assert t.optimizer.lr == 1e-3
+    assert t.optimizer is t.optimizer  # cached
+    assert t.dataloader.grad_acc_steps == 2
+    assert t.checkpoint.enabled is False  # restore_from tolerated
+    assert t.step_scheduler.max_steps == 5
+    assert t.peft.target_modules == ("q_proj",)
+    assert t.qat.enabled is False  # absent section → defaults
+
+    bad = ConfigNode({"optimizer": {"name": "adamw", "lr2": 1e-3}})
+    with _pytest.raises(ValueError, match="lr2"):
+        RecipeConfig(bad).optimizer

@@ -151,7 +151,7 @@ def test_qat_with_peft_raises():
         "dataloader": {"microbatch_size": 2, "grad_acc_steps": 1},
         "step_scheduler": {"max_steps": 1},
         "checkpoint": {"enabled": False},
-        "peft": {"rank": 2},
+        "peft": {"r": 2},
         "qat": {"enabled": True},
     })
     r = TrainFinetuneRecipeForNextTokenPrediction(cfg)
