@@ -125,10 +125,12 @@ class TransformerConfig:
     pipeline_microbatches: int = 2  # used when the mesh has pp > 1
     # "gpipe": forward pipeline_layers + autodiff (stashes all M microbatch
     # boundary activations). "1f1b": explicit fwd/bwd interleave with the
-    # 1F1B memory bound (≤ pp stashed microbatches per stage) — training
-    # only, routed via make_pp_1f1b_loss_and_grad (reference: distributed/
-    # pipelining/functional.py:777 schedule builder).
+    # 1F1B memory bound (≤ pp stashed microbatches per stage). "interleaved":
+    # virtual-stage 1F1B over pp·pipeline_virtual_stages stages mapped
+    # cyclically onto the ring — ~V× smaller bubble (reference: distributed/
+    # pipelining/functional.py:182 virtual stages, :777 schedule builder).
     pipeline_schedule: str = "gpipe"
+    pipeline_virtual_stages: int = 2  # used when pipeline_schedule=interleaved
     linear_precision: Optional[str] = None  # None | "fp8" | "int8"
 
     @property
@@ -428,7 +430,10 @@ def make_pp_1f1b_loss_and_grad(cfg: TransformerConfig, mesh_ctx, chunk_size: int
     backward so logits are never materialized.
     """
     from automodel_tpu.loss import fused_linear_cross_entropy
-    from automodel_tpu.parallel.pp import pipeline_train_1f1b
+    from automodel_tpu.parallel.pp import (
+        pipeline_train_1f1b,
+        pipeline_train_interleaved,
+    )
 
     tie = cfg.tie_word_embeddings
 
@@ -461,9 +466,9 @@ def make_pp_1f1b_loss_and_grad(cfg: TransformerConfig, mesh_ctx, chunk_size: int
         )
         if not uniform:
             raise NotImplementedError(
-                "pipeline_schedule=1f1b with mixed per-layer sliding windows "
-                "(the window aux arrays are non-differentiable scan inputs); "
-                "use gpipe for this model"
+                f"pipeline_schedule={cfg.pipeline_schedule} with mixed "
+                "per-layer sliding windows (the window aux arrays are "
+                "non-differentiable scan inputs); use gpipe for this model"
             )
         pl_layer = cast_layer(pl_layer)
 
@@ -494,10 +499,18 @@ def make_pp_1f1b_loss_and_grad(cfg: TransformerConfig, mesh_ctx, chunk_size: int
             )
             return ce
 
-        loss, dh, gl, gh = pipeline_train_1f1b(
-            h, positions, seg, labels, layers_in, pl_layer, head, head_loss,
-            mesh_ctx, cfg.pipeline_microbatches, param_logical_specs=lspecs,
-        )
+        if cfg.pipeline_schedule == "interleaved":
+            loss, dh, gl, gh = pipeline_train_interleaved(
+                h, positions, seg, labels, layers_in, pl_layer, head,
+                head_loss, mesh_ctx, cfg.pipeline_microbatches,
+                cfg.pipeline_virtual_stages, param_logical_specs=lspecs,
+            )
+        else:
+            loss, dh, gl, gh = pipeline_train_1f1b(
+                h, positions, seg, labels, layers_in, pl_layer, head,
+                head_loss, mesh_ctx, cfg.pipeline_microbatches,
+                param_logical_specs=lspecs,
+            )
         (d_embed,) = embed_vjp(dh.astype(h.dtype))
         grads = {"layers": gl, "final_norm": gh["final_norm"]}
         if tie:

@@ -169,6 +169,85 @@ def pipeline_layers(
 
 
 # ---------------------------------------------------------------------------
+# interleaved (virtual-stage) 1F1B schedule tables
+# ---------------------------------------------------------------------------
+def interleaved_1f1b_tables(num_microbatches: int, num_devices: int, virtual: int):
+    """Greedy simulation of interleaved 1F1B over S = P·V virtual stages,
+    stage s living on device s % P (the Megatron cyclic mapping; reference:
+    distributed/pipelining/functional.py:182 virtual stages + :777
+    ScheduleInterleaved1F1B).
+
+    Returns (fwd_tab, bwd_tab): int32 arrays (T, P) encoding the action per
+    half-tick as `v * M + m` (virtual-stage-major) or -1 for idle. One fwd
+    and one bwd slot per device per tick; every dependency is satisfied with
+    ≥ 1 tick of latency so the +1/-1 ppermute streams deliver in time.
+
+    Policy: depth-first over microbatch GROUPS of size P per virtual stage
+    (Megatron's ordering), bwd-first once a stage's backward is ready —
+    giving the interleaved bubble ≈ (P-1)/(V·M) instead of (P-1)/(M+P-1).
+    """
+    M, P, V = num_microbatches, num_devices, virtual
+    S = P * V
+    not_done = 10 ** 9
+    fwd_done = [[not_done] * M for _ in range(S)]
+    bwd_done = [[not_done] * M for _ in range(S)]
+    fwd_next = [0] * S
+    bwd_next = [0] * S
+
+    def stage_key(s: int, m: int, fwd: bool) -> tuple:
+        # depth-first group ordering: finish group g of vstage v before
+        # starting group g of vstage v+1's successors; backward prefers the
+        # LAST vstage first (it becomes ready first)
+        g = m // P
+        v = s // P
+        return (g, v if fwd else (V - 1 - v), m % P)
+
+    fwd_rows, bwd_rows = [], []
+    t = 0
+    while any(bwd_next[s] < M for s in range(S)) and t < 8 * V * (M + P):
+        frow, brow = [-1] * P, [-1] * P
+        for p in range(P):
+            # candidate forward actions on this device, best schedule-key first
+            f_cands = []
+            b_cands = []
+            for v in range(V):
+                s = v * P + p
+                f = fwd_next[s]
+                if f < M and (s == 0 or fwd_done[s - 1][f] < t):
+                    # in-flight bound per stage chain: keep ≤ S - s microbatches
+                    # between this stage's fwd and its bwd (generalizes the
+                    # non-interleaved P - p bound; also keys the stash mod)
+                    if (f - bwd_next[s]) < (S - s):
+                        f_cands.append((stage_key(s, f, True), s, f))
+                b = bwd_next[s]
+                if b < M and fwd_done[s][b] < t and (
+                    s == S - 1 or bwd_done[s + 1][b] < t
+                ):
+                    b_cands.append((stage_key(s, b, False), s, b))
+            if b_cands:
+                _, s, b = min(b_cands)
+                brow[p] = (s // P) * M + b
+                bwd_done[s][b] = t
+                bwd_next[s] += 1
+            if f_cands:
+                # bwd-first steady state: allow the fwd too (separate slot)
+                _, s, f = min(f_cands)
+                frow[p] = (s // P) * M + f
+                fwd_done[s][f] = t
+                fwd_next[s] += 1
+        fwd_rows.append(frow)
+        bwd_rows.append(brow)
+        t += 1
+    assert all(bwd_next[s] == M and fwd_next[s] == M for s in range(S)), (
+        f"interleaved schedule incomplete for M={M} P={P} V={V}: "
+        f"fwd={fwd_next} bwd={bwd_next}"
+    )
+    import numpy as np
+
+    return np.asarray(fwd_rows, np.int32), np.asarray(bwd_rows, np.int32)
+
+
+# ---------------------------------------------------------------------------
 # 1F1B schedule (memory-capped training pipeline)
 # ---------------------------------------------------------------------------
 def one_f_one_b_tables(num_microbatches: int, num_stages: int):
@@ -431,6 +510,277 @@ def pipeline_train_1f1b(
         check_vma=False,
     )(h_mb, pos_mb, seg_mb, lab_mb, stacked_params, head_params)
     return loss, dh.reshape(B, S, H), gl, gh
+
+
+def interleave_layer_order(num_layers: int, num_devices: int, virtual: int):
+    """Row permutation putting stage s = ℓ // chunk on device s % P under
+    contiguous pp sharding of dim 0: device p's rows become its V stage
+    chunks in v order. Returns (perm, inv_perm) index arrays."""
+    import numpy as np
+
+    S = num_devices * virtual
+    assert num_layers % S == 0, (num_layers, S)
+    chunk = num_layers // S
+    order = []
+    for p in range(num_devices):
+        for v in range(virtual):
+            s = v * num_devices + p
+            order.extend(range(s * chunk, (s + 1) * chunk))
+    perm = np.asarray(order, np.int64)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(num_layers)
+    return perm, inv
+
+
+def pipeline_train_interleaved(
+    h: jnp.ndarray,            # (B, S, H) embedded activations (global)
+    positions: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    labels: jnp.ndarray,
+    stacked_params: Any,       # leaves (L, ...), L % (pp·virtual) == 0
+    layer_fn: Callable,
+    head_params: Any,
+    head_loss_fn: Callable,
+    mesh_ctx: MeshContext,
+    num_microbatches: int,
+    virtual: int,
+    batch_axes: tuple = ("dp_replicate", "dp_shard", "ep"),
+    param_logical_specs: Any = None,
+) -> tuple:
+    """Interleaved (virtual-stage) 1F1B: S = pp·virtual stages mapped
+    cyclically onto the pp ring (stage s on device s % pp) — the Megatron
+    interleaved schedule (reference: pipelining/functional.py:777
+    ScheduleInterleaved1F1B). Same contract as `pipeline_train_1f1b`; the
+    bubble shrinks ≈ V× because each pipeline hop carries 1/V of the layer
+    work. Layer stacks are row-permuted so contiguous pp sharding gives each
+    device its V stage chunks (`interleave_layer_order`); returned layer
+    grads are un-permuted back to natural order.
+
+    KNOWN COST: the permute/unpermute pair reshards the layer stack across
+    pp every step (two all-to-alls). Storing params in permuted order for
+    the whole run (one-time setup permutation) removes it; so would folding
+    the non-interleaved 1F1B into this implementation as the V=1 case —
+    both are staged follow-ups.
+    """
+    pp = mesh_ctx.sizes["pp"]
+    B, Sq, H = h.shape
+    M = num_microbatches
+    V = virtual
+    Svirt = pp * V
+    _check_microbatch_split(B, M, mesh_ctx, batch_axes)
+    L = jax.tree.leaves(stacked_params)[0].shape[0]
+    assert L % Svirt == 0, f"{L} layers not divisible by pp*virtual={Svirt}"
+    chunk = L // Svirt
+    fwd_tab, bwd_tab = interleaved_1f1b_tables(M, pp, V)
+    T = fwd_tab.shape[0]
+    logger.info(
+        "pipeline(interleaved-1f1b): pp=%d V=%d M=%d ticks=%d",
+        pp, V, M, T,
+    )
+
+    perm, inv = interleave_layer_order(L, pp, V)
+    params_perm = jax.tree.map(lambda x: x[perm], stacked_params)
+
+    h_mb = h.reshape(M, B // M, Sq, H)
+    pos_mb = positions.reshape(M, B // M, Sq)
+    seg_mb = segment_ids.reshape(M, B // M, Sq)
+    lab_mb = labels.reshape(M, B // M, Sq)
+    K = min(Svirt, M)  # stash depth: in-flight per stage ≤ Svirt, consecutive
+
+    def run(h_mb, pos_mb, seg_mb, lab_mb, params_local, head_local):
+        p_idx = lax.axis_index("pp")
+        P = lax.axis_size("pp")
+        ftab = jnp.asarray(fwd_tab)
+        btab = jnp.asarray(bwd_tab)
+
+        def chunk_params(v):
+            return jax.tree.map(
+                lambda x: lax.dynamic_slice_in_dim(x, v * chunk, chunk, 0),
+                params_local,
+            )
+
+        def stage(x, v, pos, seg):
+            def body(c, lp):
+                return layer_fn(c, lp, pos, seg), None
+
+            y, _ = lax.scan(body, x, chunk_params(v))
+            return y
+
+        def full_bwd(x, v, head, pos, seg, lab, dy, is_last):
+            def fwd_last(xx, pp_, hh_):
+                def body(c, lp):
+                    return layer_fn(c, lp, pos, seg), None
+
+                y, _ = lax.scan(body, xx, pp_)
+                return head_loss_fn(y, hh_, lab).astype(jnp.float32)
+
+            def fwd_mid(xx, pp_, hh_):
+                del hh_
+
+                def body(c, lp):
+                    return layer_fn(c, lp, pos, seg), None
+
+                y, _ = lax.scan(body, xx, pp_)
+                return jnp.vdot(y.astype(jnp.float32), dy.astype(jnp.float32))
+
+            loss, vjp = jax.vjp(
+                lambda xx, pp_, hh_: lax.cond(
+                    is_last, fwd_last, fwd_mid, xx, pp_, hh_
+                ),
+                x, chunk_params(v), head,
+            )
+            dx, dparams, dhead = vjp(jnp.ones((), loss.dtype))
+            return jnp.where(is_last, loss, 0.0), dx, dparams, dhead
+
+        zeros_g = jax.tree.map(jnp.zeros_like, params_local)
+        zeros_h = jax.tree.map(jnp.zeros_like, head_local)
+        stash0 = jnp.zeros((V, K) + h_mb.shape[1:], h_mb.dtype)
+
+        def decode(a):
+            return a // M, a % M  # (vstage, microbatch); a < 0 → idle
+
+        def tick(carry, t):
+            (fstream, bstream, fstash, bstash, stash,
+             gacc, hacc, dh_acc, loss_acc) = carry
+            fa = jnp.take(ftab[t], p_idx)
+            ba = jnp.take(btab[t], p_idx)
+
+            # ---- bank arrivals (sent at t-1 by ring neighbors) ----
+            prev_t = jnp.maximum(t - 1, 0)
+            fa_prev = jnp.take(ftab[prev_t], (p_idx - 1) % P)
+            v_prev, m_prev = decode(jnp.maximum(fa_prev, 0))
+            v_recv = v_prev + jnp.where(p_idx == 0, 1, 0)
+            f_ok = jnp.logical_and(t > 0, fa_prev >= 0)
+            # stage Svirt-1's fwd output has no consumer; stage index of the
+            # sender is v_prev*P + (p_idx-1)%P — drop when it was the last
+            s_prev = v_prev * P + (p_idx - 1) % P
+            f_ok = jnp.logical_and(f_ok, s_prev < Svirt - 1)
+            f_ok = jnp.logical_and(f_ok, v_recv < V)
+            fstash = jnp.where(
+                f_ok,
+                lax.dynamic_update_index_in_dim(
+                    fstash,
+                    lax.dynamic_update_index_in_dim(
+                        jnp.take(fstash, jnp.clip(v_recv, 0, V - 1), axis=0),
+                        fstream, m_prev % K, 0,
+                    ),
+                    jnp.clip(v_recv, 0, V - 1), 0,
+                ),
+                fstash,
+            )
+            ba_prev = jnp.take(btab[prev_t], (p_idx + 1) % P)
+            vb_prev, mb_prev = decode(jnp.maximum(ba_prev, 0))
+            vb_recv = vb_prev - jnp.where(p_idx == P - 1, 1, 0)
+            s_bprev = vb_prev * P + (p_idx + 1) % P
+            b_ok = jnp.logical_and(t > 0, ba_prev >= 0)
+            b_ok = jnp.logical_and(b_ok, s_bprev > 0)
+            b_ok = jnp.logical_and(b_ok, vb_recv >= 0)
+            bstash = jnp.where(
+                b_ok,
+                lax.dynamic_update_index_in_dim(
+                    bstash,
+                    lax.dynamic_update_index_in_dim(
+                        jnp.take(bstash, jnp.clip(vb_recv, 0, V - 1), axis=0),
+                        bstream, mb_prev % K, 0,
+                    ),
+                    jnp.clip(vb_recv, 0, V - 1), 0,
+                ),
+                bstash,
+            )
+
+            # ---- forward slot ----
+            vf, mf = decode(jnp.maximum(fa, 0))
+            first_stage = jnp.logical_and(vf == 0, p_idx == 0)
+            x_in = jnp.where(
+                first_stage, h_mb[mf],
+                jnp.take(fstash, vf, axis=0)[mf % K],
+            )
+            stash = jnp.where(
+                fa >= 0,
+                lax.dynamic_update_index_in_dim(
+                    stash,
+                    lax.dynamic_update_index_in_dim(
+                        jnp.take(stash, vf, axis=0), x_in, mf % K, 0
+                    ),
+                    vf, 0,
+                ),
+                stash,
+            )
+            y = stage(x_in, vf, pos_mb[mf], seg_mb[mf])
+            fout = jnp.where(fa >= 0, y, jnp.zeros_like(y))
+
+            # ---- backward slot ----
+            vb, mb = decode(jnp.maximum(ba, 0))
+            x_b = jnp.take(stash, vb, axis=0)[mb % K]
+            is_last = jnp.logical_and(vb == V - 1, p_idx == P - 1)
+            loss_i, dx, dparams, dhead = full_bwd(
+                x_b, vb, head_local, pos_mb[mb], seg_mb[mb], lab_mb[mb],
+                jnp.take(bstash, vb, axis=0)[mb % K], is_last,
+            )
+            do_b = ba >= 0
+            gacc = jax.tree.map(
+                lambda a, g: jnp.where(
+                    do_b,
+                    lax.dynamic_update_slice_in_dim(
+                        a,
+                        lax.dynamic_slice_in_dim(a, vb * chunk, chunk, 0) + g,
+                        vb * chunk, 0,
+                    ),
+                    a,
+                ),
+                gacc, dparams,
+            )
+            hacc = jax.tree.map(
+                lambda a, g: a + jnp.where(do_b, g, jnp.zeros_like(g)), hacc, dhead
+            )
+            dh_acc = jnp.where(
+                jnp.logical_and(do_b, jnp.logical_and(vb == 0, p_idx == 0)),
+                lax.dynamic_update_index_in_dim(dh_acc, dx, mb, 0),
+                dh_acc,
+            )
+            loss_acc = loss_acc + jnp.where(do_b, loss_i, 0.0)
+
+            fwd_perm = [(i, (i + 1) % P) for i in range(P)]
+            bwd_perm = [((i + 1) % P, i) for i in range(P)]
+            fstream = lax.ppermute(fout, "pp", fwd_perm)
+            bout = jnp.where(do_b, dx, jnp.zeros_like(dx))
+            bstream = lax.ppermute(bout, "pp", bwd_perm)
+            return (
+                fstream, bstream, fstash, bstash, stash,
+                gacc, hacc, dh_acc, loss_acc,
+            ), None
+
+        carry0 = (
+            jnp.zeros_like(h_mb[0]),
+            jnp.zeros_like(h_mb[0]),
+            stash0, stash0, stash0,
+            zeros_g, zeros_h,
+            jnp.zeros_like(h_mb),
+            jnp.zeros((), jnp.float32),
+        )
+        (_, _, _, _, _, gacc, hacc, dh_acc, loss_acc), _ = lax.scan(
+            tick, carry0, jnp.arange(T)
+        )
+        data_axes = tuple(batch_axes) + ("cp",)
+        gacc = jax.tree.map(lambda g: lax.psum(g, data_axes), gacc)
+        hacc = jax.tree.map(lambda g: lax.psum(g, data_axes + ("pp",)), hacc)
+        dh_acc = lax.psum(dh_acc, "pp")
+        loss_acc = lax.psum(loss_acc, data_axes + ("pp",))
+        return loss_acc, dh_acc, gacc, hacc
+
+    act_spec = P(None, batch_axes, "cp", None)
+    tok_spec = P(None, batch_axes, "cp")
+    pspecs = _param_specs_pp(params_perm, param_logical_specs)
+    hspec = jax.tree.map(lambda x: P(*([None] * x.ndim)), head_params)
+    loss, dh, gl, gh = jax.shard_map(
+        run,
+        mesh=mesh_ctx.mesh,
+        in_specs=(act_spec, tok_spec, tok_spec, tok_spec, pspecs, hspec),
+        out_specs=(P(), act_spec, pspecs, hspec),
+        check_vma=False,
+    )(h_mb, pos_mb, seg_mb, lab_mb, params_perm, head_params)
+    gl = jax.tree.map(lambda x: x[inv], gl)  # back to natural layer order
+    return loss, dh.reshape(B, Sq, H), gl, gh
 
 
 #: logical param axes that stay sharded inside the pipeline shard_map;

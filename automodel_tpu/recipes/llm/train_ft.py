@@ -199,17 +199,29 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         # PipelineConfig under DistributedSetup) but a model-level override
         # wins; schedule: "gpipe" (default) | "1f1b"
         dist_node = cfg.get("distributed")
-        for k, conv in (("pipeline_microbatches", int), ("pipeline_schedule", str)):
+        for k, conv in (
+            ("pipeline_microbatches", int),
+            ("pipeline_schedule", str),
+            ("pipeline_virtual_stages", int),
+        ):
             v = dist_node.get(k) if dist_node is not None and k in dist_node else None
             v = mcfg.get(k, v)
             if v is not None:
                 overrides[k] = conv(v)
         sched = str(overrides.get("pipeline_schedule", "gpipe")).strip().lower()
-        if sched not in ("gpipe", "1f1b"):
+        if sched not in ("gpipe", "1f1b", "interleaved"):
             raise ValueError(
-                f"pipeline_schedule must be 'gpipe' or '1f1b', got "
-                f"{overrides['pipeline_schedule']!r}"
+                f"pipeline_schedule must be 'gpipe', '1f1b' or 'interleaved', "
+                f"got {overrides['pipeline_schedule']!r}"
             )
+        v = int(overrides.get("pipeline_virtual_stages", 1) or 1)
+        if sched == "interleaved" and v < 2:
+            raise ValueError(
+                "pipeline_schedule=interleaved needs pipeline_virtual_stages "
+                f">= 2 (got {v}); use 1f1b for a single stage per device"
+            )
+        if v < 1:
+            raise ValueError(f"pipeline_virtual_stages must be >= 1, got {v}")
         if "pipeline_schedule" in overrides:
             overrides["pipeline_schedule"] = sched
 
@@ -426,7 +438,8 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         forward. Returns None for every other configuration."""
         if (
             self.mesh_ctx.sizes["pp"] <= 1
-            or getattr(self.model_cfg, "pipeline_schedule", "gpipe") != "1f1b"
+            or getattr(self.model_cfg, "pipeline_schedule", "gpipe")
+            not in ("1f1b", "interleaved")
         ):
             return None
         for blocker, why in (
@@ -441,7 +454,10 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                 )
         from automodel_tpu.models.llm.decoder import make_pp_1f1b_loss_and_grad
 
-        logger.info("pipeline schedule: 1f1b (explicit fwd/bwd interleave)")
+        logger.info(
+            "pipeline schedule: %s (explicit fwd/bwd interleave)",
+            self.model_cfg.pipeline_schedule,
+        )
         return make_pp_1f1b_loss_and_grad(
             self.model_cfg, self.mesh_ctx,
             chunk_size=int(self.cfg.get("loss.chunk_size", 1024)),

@@ -287,3 +287,28 @@ def test_profiling_trace_capture(tmp_path):
     assert glob.glob(str(tmp_path / "trace" / "**" / "*.pb"), recursive=True) or glob.glob(
         str(tmp_path / "trace" / "**" / "*.json.gz"), recursive=True
     ), "no trace files written"
+
+
+def test_recipe_pipeline_interleaved_from_config(tmp_path):
+    """`distributed.pipeline_schedule: interleaved` (virtual-stage 1F1B)
+    matches gpipe losses step for step."""
+    losses = {}
+    for sched in ("gpipe", "interleaved"):
+        cfg = _smoke_cfg(
+            tmp_path / sched,
+            **{
+                "step_scheduler.max_steps": 3,
+                "checkpoint.enabled": False,
+                "auto_resume": False,
+            },
+        )
+        cfg.set("model.hf_config.num_hidden_layers", 4)
+        cfg.set("distributed", {
+            "pp": 2, "dp_shard": 4,
+            "pipeline_schedule": sched, "pipeline_microbatches": 2,
+            "pipeline_virtual_stages": 2,
+        })
+        _, losses[sched] = _run_and_read_losses(cfg)
+    np.testing.assert_allclose(
+        losses["interleaved"], losses["gpipe"], rtol=1e-4, atol=1e-5
+    )
