@@ -231,8 +231,13 @@ def generate(
     input_ids: jnp.ndarray,  # (B, S_prompt) — right-aligned, no padding
     rng: jax.Array,
     gen: GenerateConfig = GenerateConfig(),
+    prompt_embeds: jnp.ndarray | None = None,  # (B, S_prompt, H) — VLM merge
 ) -> jnp.ndarray:
-    """Returns (B, S_prompt + max_new_tokens) token ids."""
+    """Returns (B, S_prompt + max_new_tokens) token ids.
+
+    `prompt_embeds` replaces the prompt's token embeddings (the VLM path:
+    image features already merged at the placeholder positions —
+    vlm_generate below builds them); decode steps embed tokens normally."""
     params = cast_params(params, cfg.dtype)
     B, S = input_ids.shape
     T = S + gen.max_new_tokens
@@ -286,7 +291,13 @@ def generate(
 
     # -- prefill: one batched pass over the prompt --------------------------
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
-    h = _embed(params, cfg, input_ids)
+    if prompt_embeds is not None:
+        h = prompt_embeds.astype(cfg.dtype)
+        if cfg.embed_scale != 1.0:
+            # match decoder.forward's inputs_embeds handling AND _embed below
+            h = h * jnp.asarray(cfg.embed_scale, cfg.dtype)
+    else:
+        h = _embed(params, cfg, input_ids)
     h, caches = run_stacks(h, positions, caches, 0, S)
     h_last = rms_norm(h[:, -1:], params["final_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
     logits = unembed(params, cfg, h_last)[:, 0]
@@ -330,3 +341,50 @@ def generate(
         else first[:, None]
     )
     return jnp.concatenate([input_ids, new_tokens], axis=1)
+
+
+@partial(jax.jit, static_argnames=("module", "cfg"))
+def _encode_and_merge(module, params, cfg, input_ids, pixel_values):
+    from automodel_tpu.models.vlm.llava import merge_image_embeddings
+
+    image_embeds = module.encode_images(params, cfg, pixel_values)
+    token_embeds = jnp.take(
+        params["language_model"]["embed"]["embedding"], input_ids, axis=0
+    ).astype(cfg.dtype)
+    return merge_image_embeddings(
+        token_embeds, image_embeds, input_ids == cfg.image_token_id
+    )
+
+
+def vlm_generate(
+    module,
+    params: dict,
+    cfg,                       # VLM config (llava / kimi-vl)
+    input_ids: jnp.ndarray,    # (B, S_prompt) incl. image placeholder tokens
+    pixel_values: jnp.ndarray,
+    rng: jax.Array,
+    gen: GenerateConfig = GenerateConfig(),
+) -> jnp.ndarray:
+    """Image-conditioned generation (the reference's vlm_generate examples):
+    run the model's own `encode_images` (tower + projector, jitted with the
+    merge), scatter the features into the prompt's token embeddings, and
+    decode with the text model's KV cache. Exactly matches the teacher-
+    forced module.forward argmax loop for the supported families
+    (tests/unit/test_vlm.py, test_kimi_vl.py).
+
+    Families whose TEXT-side prompt encoding needs more than merged
+    embeddings (qwen3-vl-moe: MRoPE position geometry + deepstack residual
+    taps) are rejected — a merged-embeds-only prefill would silently
+    diverge from training.
+    """
+    if not hasattr(module, "encode_images"):
+        raise NotImplementedError(
+            f"vlm_generate: {getattr(module, '__name__', module)} exposes no "
+            "encode_images() — qwen3-vl-moe needs MRoPE + deepstack in the "
+            "decode cache (not implemented); llava and kimi-vl are supported"
+        )
+    merged = _encode_and_merge(module, params, cfg, input_ids, pixel_values)
+    return generate(
+        params["language_model"], cfg.text, input_ids, rng, gen,
+        prompt_embeds=merged,
+    )

@@ -273,6 +273,22 @@ def param_specs(cfg: KimiVLConfig) -> dict:
     }
 
 
+def encode_images(params: dict, cfg: KimiVLConfig, pixel_values: jnp.ndarray) -> jnp.ndarray:
+    """MoonViT tower + merge + projector → image embeddings (B, Nm, H_text).
+    Shared by forward and vlm_generate."""
+    feats = vision_forward(params["vision_tower"], cfg.vision, pixel_values)
+    pj = params["projector"]
+    dtype = cfg.dtype
+    x = _layer_norm(feats.astype(dtype), pj["pre_norm"])  # LN over D per patch
+    B, Nm, K4, D = x.shape
+    x = x.reshape(B, Nm, K4 * D)
+    x = jax.nn.gelu(
+        x @ pj["linear_1"]["kernel"].astype(dtype) + pj["linear_1"]["bias"].astype(dtype),
+        approximate=True,
+    )
+    return x @ pj["linear_2"]["kernel"].astype(dtype) + pj["linear_2"]["bias"].astype(dtype)
+
+
 def forward(
     params: dict,
     cfg: KimiVLConfig,
@@ -289,17 +305,7 @@ def forward(
 ):
     """Returns (out, aux_loss[, stats]) — the MoE module protocol (the VLM
     recipe folds aux into the loss)."""
-    feats = vision_forward(params["vision_tower"], cfg.vision, pixel_values)
-    pj = params["projector"]
-    dtype = cfg.dtype
-    x = _layer_norm(feats.astype(dtype), pj["pre_norm"])  # LN over D per patch
-    B, Nm, K4, D = x.shape
-    x = x.reshape(B, Nm, K4 * D)
-    x = jax.nn.gelu(
-        x @ pj["linear_1"]["kernel"].astype(dtype) + pj["linear_1"]["bias"].astype(dtype),
-        approximate=True,
-    )
-    image_embeds = x @ pj["linear_2"]["kernel"].astype(dtype) + pj["linear_2"]["bias"].astype(dtype)
+    image_embeds = encode_images(params, cfg, pixel_values)
 
     from automodel_tpu.models.llm.decoder import _make_constrain
 
@@ -307,7 +313,7 @@ def forward(
     # FSDP-unshard the table's embed dim before the gather (see moe decoder)
     constrain = _make_constrain(mesh_ctx, rules)
     tbl = constrain(lm["embed"]["embedding"], ("vocab", None))
-    token_embeds = jnp.take(tbl, input_ids, axis=0).astype(dtype)
+    token_embeds = jnp.take(tbl, input_ids, axis=0).astype(cfg.dtype)
     merged = merge_image_embeddings(
         token_embeds, image_embeds, input_ids == cfg.image_token_id
     )

@@ -120,6 +120,21 @@ def merge_image_embeddings(
     return jnp.where(image_mask[..., None], gathered.astype(token_embeds.dtype), token_embeds)
 
 
+def encode_images(params: dict, cfg: LlavaConfig, pixel_values: jnp.ndarray) -> jnp.ndarray:
+    """Vision tower + projector → per-image patch embeddings in the text
+    embedding space (B, N, H_text). Shared by forward and vlm_generate."""
+    feats = vit.forward(params["vision_tower"], cfg.vision, pixel_values)
+    if cfg.vision.use_cls_token:
+        feats = feats[:, 1:]  # llava "default" select: drop the CLS feature
+    pj = params["projector"]
+    x = jax.nn.gelu(
+        feats.astype(cfg.dtype) @ pj["fc1"]["kernel"].astype(cfg.dtype)
+        + pj["fc1"]["bias"].astype(cfg.dtype),
+        approximate=True,
+    )
+    return x @ pj["fc2"]["kernel"].astype(cfg.dtype) + pj["fc2"]["bias"].astype(cfg.dtype)
+
+
 def forward(
     params: dict,
     cfg: LlavaConfig,
@@ -132,16 +147,7 @@ def forward(
     rules=None,
     return_hidden: bool = False,
 ):
-    feats = vit.forward(params["vision_tower"], cfg.vision, pixel_values)
-    if cfg.vision.use_cls_token:
-        feats = feats[:, 1:]  # llava "default" select: drop the CLS feature
-    pj = params["projector"]
-    x = jax.nn.gelu(
-        feats.astype(cfg.dtype) @ pj["fc1"]["kernel"].astype(cfg.dtype)
-        + pj["fc1"]["bias"].astype(cfg.dtype),
-        approximate=True,
-    )
-    image_embeds = x @ pj["fc2"]["kernel"].astype(cfg.dtype) + pj["fc2"]["bias"].astype(cfg.dtype)
+    image_embeds = encode_images(params, cfg, pixel_values)
 
     lm = params["language_model"]
     token_embeds = jnp.take(lm["embed"]["embedding"], input_ids, axis=0).astype(cfg.dtype)

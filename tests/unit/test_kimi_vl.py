@@ -162,3 +162,23 @@ def test_kimi_vl_kd_moe_student_and_teacher(tmp_path):
     recs = [json.loads(l) for l in open(tmp_path / "training.jsonl") if l.strip()]
     assert len(recs) == 2
     assert all(np.isfinite(x["loss"]) for x in recs)
+
+
+@pytest.mark.slow
+def test_kimi_vl_generate_conditions_on_image():
+    """vlm_generate: image-conditioned decode runs and the image changes
+    the continuation (greedy, tiny model)."""
+    from automodel_tpu.inference.generate import GenerateConfig, vlm_generate
+
+    spec, cfg, params = _setup()
+    ids, pixels = _mock_batch(cfg, B=1, S=16, img=56)
+    out1 = vlm_generate(
+        kimi_vl, params, cfg, ids, pixels, jax.random.key(0),
+        GenerateConfig(max_new_tokens=6),
+    )
+    assert out1.shape == (1, 22)
+    out2 = vlm_generate(
+        kimi_vl, params, cfg, ids, pixels * 3.0, jax.random.key(0),
+        GenerateConfig(max_new_tokens=6),
+    )
+    assert not np.array_equal(np.asarray(out1), np.asarray(out2))

@@ -204,3 +204,49 @@ def test_vlm_flops_include_tower():
     cfg = _cfg()
     text_only = cfg.text.flops_per_token(64)
     assert cfg.flops_per_token(64) > text_only
+
+
+@pytest.mark.slow
+def test_llava_vlm_generate_matches_naive():
+    """vlm_generate greedy == teacher-forced llava.forward argmax loop."""
+    import numpy as np
+
+    from automodel_tpu.inference.generate import GenerateConfig, vlm_generate
+    from automodel_tpu.models.registry import get_model_spec
+    from automodel_tpu.models.vlm import llava
+
+    hf = {
+        "architectures": ["LlavaForConditionalGeneration"],
+        "model_type": "llava",
+        "image_token_index": 120,
+        "vision_config": {
+            "model_type": "clip_vision_model", "hidden_size": 32,
+            "intermediate_size": 64, "num_hidden_layers": 2,
+            "num_attention_heads": 2, "image_size": 56, "patch_size": 14,
+        },
+        "text_config": {
+            "architectures": ["LlamaForCausalLM"], "vocab_size": 128,
+            "hidden_size": 32, "intermediate_size": 64,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "num_key_value_heads": 2,
+        },
+    }
+    spec = get_model_spec(hf)
+    cfg = spec.config_from_hf(hf, dtype=jnp.float32, remat_policy="none")
+    params = llava.init(cfg, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    ids = np.concatenate(
+        [np.full((1, 16), 120, np.int32), rng.integers(1, 100, (1, 8), dtype=np.int32)],
+        axis=1,
+    )
+    pix = rng.normal(size=(1, 56, 56, 3)).astype(np.float32)
+    out = vlm_generate(
+        llava, params, cfg, jnp.asarray(ids), jnp.asarray(pix),
+        jax.random.key(1), GenerateConfig(max_new_tokens=4),
+    )
+    cur = jnp.asarray(ids)
+    for _ in range(4):
+        logits = llava.forward(params, cfg, cur, jnp.asarray(pix))
+        nxt = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+        cur = jnp.concatenate([cur, nxt[:, None]], 1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))
