@@ -81,10 +81,16 @@ def print_capabilities() -> None:
         "devices": len(jax.devices()),
         "architectures": sorted(MODEL_ARCH_MAPPING),
         "recipes": sorted(RECIPE_ALIASES),
-        "parallelism": ["dp_replicate", "dp_shard(fsdp)", "tp", "cp(ring)", "ep", "pp(gpipe)"],
+        "parallelism": [
+            "dp_replicate", "dp_shard(fsdp)", "tp", "cp(ring, load-balanced)",
+            "ep(dropless ragged-a2a)", "pp(gpipe|1f1b|interleaved)",
+        ],
         "features": [
             "lora_peft", "knowledge_distillation", "mtp", "fp8_int8_matmul",
             "dropless_moe", "attention_sinks", "kv_cache_generation",
+            "mla_latent_cache_decode", "vlm_generation", "chunked_sparse_dsa",
+            "speculative_eagle123", "acceptance_length_bench",
+            "sampling_eval", "agent_tool_call_sft", "neat_packing",
             "orbax_checkpointing", "hf_safetensors_io", "golden_value_ci",
             "profiler_traces", "wandb_mlflow_trackers",
         ],
