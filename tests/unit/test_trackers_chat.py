@@ -77,7 +77,7 @@ def test_capabilities_cli(capsys):
     out = json.loads(capsys.readouterr().out)
     assert "LlamaForCausalLM" in out["architectures"]
     assert "llm_kd" in out["recipes"]
-    assert "pp(gpipe)" in out["parallelism"]
+    assert any(p.startswith("pp(") for p in out["parallelism"])
 
 
 class TemplatedStubTokenizer(StubTokenizer):
