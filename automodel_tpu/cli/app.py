@@ -33,6 +33,7 @@ RECIPE_ALIASES = {
     "diffusion_train": "automodel_tpu.recipes.diffusion.train.TrainDiffusionRecipe",
     "vlm_finetune": "automodel_tpu.recipes.vlm.finetune.FinetuneRecipeForVLM",
     "vlm_kd": "automodel_tpu.recipes.vlm.kd.KDRecipeForVLM",
+    "vlm_generate": "automodel_tpu.recipes.vlm.generate.GenerateRecipeForVLM",
     "multimodal_finetune": "automodel_tpu.recipes.multimodal.finetune.FinetuneRecipeForOmni",
     "llm_seq_cls": "automodel_tpu.recipes.llm.train_seq_cls.TrainSeqClsRecipe",
     "retrieval_bi_encoder": "automodel_tpu.recipes.retrieval.train_bi_encoder.TrainBiEncoderRecipe",

@@ -1,0 +1,93 @@
+"""VLM generation recipe: image-conditioned decoding to JSONL.
+
+The analog of the reference's vlm_generate examples family (reference:
+examples/vlm_generate/): load a VLM checkpoint (or init from config), run
+`inference.vlm_generate` over an image+prompt dataset, write one JSON
+record per sample (prompt ids, generated ids, decoded text when a
+tokenizer is configured).
+
+YAML:
+
+    recipe: vlm_generate
+    model: {hf_config: {...} | pretrained_path: ...}
+    dataset: {...}                    # yields input_ids + pixel_values
+    generation: {max_new_tokens: 64, temperature: 0.0, eos_token_id: null}
+    max_batches: 8
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from automodel_tpu.config import parse_args_and_load_config
+from automodel_tpu.recipes.vlm.finetune import FinetuneRecipeForVLM
+
+logger = logging.getLogger(__name__)
+
+
+class GenerateRecipeForVLM(FinetuneRecipeForVLM):
+    """Reuses the VLM chassis (model build + checkpoint load + dataloader);
+    replaces the train loop with a generation sweep."""
+
+    def run_train_validation_loop(self) -> None:
+        from automodel_tpu.inference.generate import GenerateConfig, vlm_generate
+
+        cfg = self.cfg
+        node = cfg.get("generation")
+        gen = GenerateConfig(
+            max_new_tokens=int(node.get("max_new_tokens", 64)) if node else 64,
+            temperature=float(node.get("temperature", 0.0)) if node else 0.0,
+            eos_token_id=(node.get("eos_token_id") if node else None),
+        )
+        max_batches = int(cfg.get("max_batches", 8))
+        out_path = os.path.join(cfg.get("run_dir", "."), "generations.jsonl")
+        params = self.train_state.params
+        if self.peft_cfg is not None:
+            from automodel_tpu.peft.lora import merge_lora
+
+            params = merge_lora(self.base_params, params, self.peft_cfg)
+        tokenizer = getattr(self, "_tokenizer", None)
+
+        n = 0
+        with open(out_path, "w") as f:
+            for bi, mb in enumerate(self.dataloader):
+                if bi >= max_batches:
+                    break
+                ids = jnp.asarray(np.asarray(mb["input_ids"]))
+                pix = jnp.asarray(np.asarray(mb["pixel_values"]))
+                out = vlm_generate(
+                    self.model_spec.module, params, self.model_cfg,
+                    ids, pix, jax.random.key(bi), gen,
+                )
+                S = ids.shape[1]
+                for row_in, row_out in zip(np.asarray(ids), np.asarray(out)):
+                    rec = {
+                        "prompt_ids": [int(t) for t in row_in],
+                        "generated_ids": [int(t) for t in row_out[S:]],
+                    }
+                    if tokenizer is not None:
+                        rec["text"] = tokenizer.decode(rec["generated_ids"])
+                    f.write(json.dumps(rec) + "\n")
+                    n += 1
+        logger.info("wrote %d generations to %s", n, out_path)
+        for t in self.trackers:
+            t.finish()
+        self.metric_logger.close()
+        self.val_logger.close()
+
+
+def main(argv=None) -> None:
+    cfg = parse_args_and_load_config(argv)
+    recipe = GenerateRecipeForVLM(cfg)
+    recipe.setup()
+    recipe.run_train_validation_loop()
+
+
+if __name__ == "__main__":
+    main()

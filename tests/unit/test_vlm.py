@@ -250,3 +250,57 @@ def test_llava_vlm_generate_matches_naive():
         nxt = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
         cur = jnp.concatenate([cur, nxt[:, None]], 1)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))
+
+
+@pytest.mark.recipe
+def test_vlm_generate_recipe(tmp_path):
+    """vlm_generate recipe: checkpoint-chassis reuse + generations.jsonl."""
+    import json as _json
+
+    from automodel_tpu.cli.app import resolve_recipe_class
+    from automodel_tpu.config import ConfigNode
+
+    cfg = ConfigNode({
+        "recipe": "vlm_generate",
+        "seed": 7,
+        "run_dir": str(tmp_path),
+        "auto_resume": False,
+        "model": {
+            "hf_config": {
+                "architectures": ["LlavaForConditionalGeneration"],
+                "model_type": "llava",
+                "image_token_index": 500,
+                "vision_config": {
+                    "model_type": "clip_vision_model", "hidden_size": 32,
+                    "intermediate_size": 64, "num_hidden_layers": 2,
+                    "num_attention_heads": 2, "image_size": 56, "patch_size": 14,
+                },
+                "text_config": {
+                    "architectures": ["LlamaForCausalLM"], "vocab_size": 512,
+                    "hidden_size": 32, "intermediate_size": 64,
+                    "num_hidden_layers": 2, "num_attention_heads": 4,
+                    "num_key_value_heads": 2,
+                },
+            },
+            "dtype": "float32", "remat_policy": "none",
+        },
+        "distributed": {"dp_shard": -1},
+        "dataset": {
+            "_target_": "automodel_tpu.datasets.vlm.MockVLMDatasetConfig",
+            "num_samples": 8, "seq_len": 32, "vocab_size": 512,
+            "image_size": 56, "patch_size": 14, "image_token_id": 500,
+        },
+        "dataloader": {"microbatch_size": 8, "grad_acc_steps": 1},
+        "optimizer": {"lr": 1e-4},
+        "lr_scheduler": {"style": "constant", "warmup_steps": 0},
+        "step_scheduler": {"max_steps": 1},
+        "checkpoint": {"enabled": False},
+        "generation": {"max_new_tokens": 4},
+        "max_batches": 1,
+    })
+    r = resolve_recipe_class(cfg)(cfg)
+    r.setup()
+    r.run_train_validation_loop()
+    recs = [_json.loads(l) for l in open(tmp_path / "generations.jsonl") if l.strip()]
+    assert len(recs) == 8
+    assert all(len(x["generated_ids"]) == 4 for x in recs)
