@@ -1,0 +1,92 @@
+"""Block-diffusion training mask (the diffusion_gemma / BD3LM geometry).
+
+The analog of the reference's highest-correctness-risk dLLM piece
+(reference: nemo_automodel/components/models/diffusion_gemma/
+attention_mask.py `build_block_diffusion_training_mask`): the model runs a
+shared stack twice — a causal "encoder" pass over the CLEAN sequence and a
+bidirectional "canvas" pass over the NOISED response — and each canvas
+layer attends over `[encoder_KV ; canvas_KV]`. For training, all response
+blocks are supervised jointly, and the mask splits column-wise:
+
+* encoder columns → M_OBC (offset-block-causal): a canvas query in block i
+  sees a clean response column only if that column's block is STRICTLY
+  before i; prompt columns are always visible.
+* canvas columns → M_BD (block-diagonal): bidirectional within the query's
+  own block only.
+
+THE leakage invariant: M_OBC uses strict `block_q > block_kv`. With `>=`
+the canvas sees the clean answer for exactly the tokens it is being
+trained to denoise and the loss collapses (reference docstring; pinned by
+tests/unit/test_block_diffusion.py).
+
+The sliding variant anchors the encoder window to the BLOCK boundary (the
+inference-time cache end `prefix + i·block_size`), not the query position —
+a per-query band would starve late-in-block queries of previous-block
+context the inference geometry provides (train/inference parity).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def block_ids(num_positions: int, block_size: int) -> jnp.ndarray:
+    return jnp.arange(num_positions) // block_size
+
+
+def build_block_diffusion_training_mask(
+    prefix_lengths,               # int | (B,) int array — prompt lengths
+    response_length: int,
+    enc_len: int,
+    block_size: int,
+    *,
+    sliding_window: int | None = None,
+    batch_size: int | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (mask_full, mask_sliding): bool keep-masks of shape
+    (B, response_length, enc_len + response_length); True = attend.
+    mask_sliding additionally applies the block-anchored encoder window for
+    sliding-attention layers (equal to mask_full when sliding_window is
+    None)."""
+    if isinstance(prefix_lengths, int):
+        if batch_size is None:
+            raise ValueError("batch_size required when prefix_lengths is an int")
+        prefix = jnp.full((batch_size,), prefix_lengths, jnp.int32)
+    else:
+        prefix = jnp.asarray(prefix_lengths, jnp.int32)
+        if prefix.ndim != 1:
+            raise ValueError(f"prefix_lengths must be 1-D, got {prefix.shape}")
+        batch_size = prefix.shape[0]
+
+    canvas_len = response_length
+    q_block = block_ids(canvas_len, block_size)              # (Lq,)
+
+    # -- encoder columns: M_OBC --------------------------------------------
+    enc_pos = jnp.arange(enc_len)
+    enc_rel = enc_pos[None, :] - prefix[:, None]             # (B, enc_len)
+    enc_block = jnp.where(enc_rel >= 0, enc_rel // block_size, -1)
+    enc_is_valid = enc_rel < response_length                 # pad tail never attends
+    # strict >: the leakage invariant
+    m_obc = (q_block[None, :, None] > enc_block[:, None, :]) & enc_is_valid[:, None, :]
+
+    # -- canvas columns: M_BD ----------------------------------------------
+    kv_block = block_ids(canvas_len, block_size)
+    m_bd = jnp.broadcast_to(
+        q_block[:, None] == kv_block[None, :], (batch_size, canvas_len, canvas_len)
+    )
+
+    keep = jnp.concatenate([m_obc, m_bd], axis=2)            # (B, Lq, key_len)
+
+    if sliding_window is None:
+        return keep, keep
+
+    # block-anchored encoder window: keep the last `sliding_window` cache
+    # columns ending at the block's inference-time cache boundary
+    block_start = q_block * block_size                       # (Lq,)
+    valid_cache = prefix[:, None] + block_start[None, :]     # (B, Lq)
+    enc_within = enc_pos[None, None, :] >= (
+        valid_cache[:, :, None] - sliding_window + 1
+    )                                                        # (B, Lq, enc_len)
+    canvas_within = jnp.ones((batch_size, canvas_len, canvas_len), bool)
+    within = jnp.concatenate([enc_within, canvas_within], axis=2)
+    return keep, keep & within

@@ -1,0 +1,79 @@
+"""Block-diffusion training mask: the leakage invariant and geometry
+(reference: diffusion_gemma/attention_mask.py docstring — the strict
+block_q > block_kv comparison IS the correctness property)."""
+
+import numpy as np
+
+from automodel_tpu.dllm.block_diffusion import build_block_diffusion_training_mask
+
+
+def test_leakage_invariant_strict_block_causal():
+    """A canvas query must NEVER see the clean encoder column of its own
+    block (nor later blocks) — only strictly-earlier response blocks."""
+    prefix, resp, block = 4, 8, 4
+    enc_len = prefix + resp
+    full, _ = build_block_diffusion_training_mask(
+        prefix, resp, enc_len, block, batch_size=1
+    )
+    m = np.asarray(full[0])  # (resp, enc_len + resp)
+    for q in range(resp):
+        qb = q // block
+        for k in range(enc_len):
+            rel = k - prefix
+            if rel < 0:
+                assert m[q, k], "prompt columns always visible"
+            elif rel // block < qb:
+                assert m[q, k], f"earlier clean block hidden (q={q}, k={k})"
+            else:
+                # own block's clean column and later: MUST be masked
+                assert not m[q, k], f"LEAKAGE at q={q}, k={k}"
+
+
+def test_canvas_block_diagonal():
+    prefix, resp, block = 2, 8, 4
+    enc_len = prefix + resp
+    full, _ = build_block_diffusion_training_mask(
+        prefix, resp, enc_len, block, batch_size=1
+    )
+    m = np.asarray(full[0])[:, enc_len:]  # canvas columns
+    for q in range(resp):
+        for k in range(resp):
+            assert m[q, k] == (q // block == k // block)
+
+
+def test_per_example_prefix_and_pad_tail():
+    resp, block = 4, 2
+    enc_len = 10  # includes tail padding beyond prefix+resp for example 0
+    full, _ = build_block_diffusion_training_mask(
+        np.asarray([3, 6]), resp, enc_len, block
+    )
+    m = np.asarray(full)
+    # pad tail (enc positions >= prefix+resp) never attendable
+    assert not m[0, :, 3 + resp:enc_len].any()
+    assert not m[1, :, 6 + resp:enc_len].any()
+    # example-specific prompts fully visible
+    assert m[0, :, :3].all() and m[1, :, :6].all()
+
+
+def test_sliding_window_block_anchored():
+    """The encoder window anchors to the block's cache boundary, constant
+    for every query in the block (not a per-query band)."""
+    prefix, resp, block, sw = 6, 8, 4, 4
+    enc_len = prefix + resp
+    full, sliding = build_block_diffusion_training_mask(
+        prefix, resp, enc_len, block, sliding_window=sw, batch_size=1
+    )
+    f = np.asarray(full[0])
+    s = np.asarray(sliding[0])
+    # canvas columns unaffected by the window
+    np.testing.assert_array_equal(s[:, enc_len:], f[:, enc_len:])
+    for q in range(resp):
+        qb = q // block
+        cache_end = prefix + qb * block  # exclusive upper from M_OBC
+        lo = cache_end - sw + 1
+        for k in range(enc_len):
+            expect = f[q, k] and (k >= lo)
+            assert s[q, k] == expect, (q, k)
+        # every query in the same block sees the SAME encoder window
+        if q % block:
+            np.testing.assert_array_equal(s[q, :enc_len], s[q - 1, :enc_len])
