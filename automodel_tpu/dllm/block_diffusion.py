@@ -87,6 +87,6 @@ def build_block_diffusion_training_mask(
     enc_within = enc_pos[None, None, :] >= (
         valid_cache[:, :, None] - sliding_window + 1
     )                                                        # (B, Lq, enc_len)
-    canvas_within = jnp.ones((batch_size, canvas_len, canvas_len), bool)
-    within = jnp.concatenate([enc_within, canvas_within], axis=2)
-    return keep, keep & within
+    # canvas columns are never windowed (M_BD already confines them); only
+    # the encoder half needs the AND
+    return keep, jnp.concatenate([m_obc & enc_within, m_bd], axis=2)
