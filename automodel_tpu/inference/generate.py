@@ -57,7 +57,31 @@ from automodel_tpu.ops.rope import apply_rope, rope_frequencies
 class GenerateConfig:
     max_new_tokens: int = 64
     temperature: float = 0.0  # 0 → greedy
+    top_k: int | None = None      # sample from the k highest-prob tokens
+    top_p: float | None = None    # nucleus sampling (smallest mass ≥ p)
     eos_token_id: int | None = None
+
+
+def _filter_logits(logits: jnp.ndarray, gen: "GenerateConfig") -> jnp.ndarray:
+    """Static top-k / top-p filtering (HF sampling semantics: top-k first,
+    then nucleus over the surviving distribution; k=0/None and p>=1/None
+    mean "off", p<=0 keeps the single best token — min_tokens_to_keep=1)."""
+    if gen.top_k is not None and gen.top_k > 0:
+        kth = jax.lax.top_k(logits, min(gen.top_k, logits.shape[-1]))[0][..., -1:]
+        logits = jnp.where(logits < kth, NEG_INF, logits)
+    if gen.top_p is not None and gen.top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens whose PRECEDING cumulative mass is < top_p (so the
+        # token that crosses the threshold is included — HF convention)
+        keep_sorted = (cum - probs) < gen.top_p
+        # threshold logit = smallest kept sorted logit; always keep >= 1
+        # token (HF min_tokens_to_keep) — also guards top_p <= 0
+        n_keep = jnp.maximum(jnp.sum(keep_sorted, axis=-1, keepdims=True), 1)
+        thresh = jnp.take_along_axis(sorted_logits, n_keep - 1, axis=-1)
+        logits = jnp.where(logits < thresh, NEG_INF, logits)
+    return logits
 
 
 def _attend(q, keys, values, mask_len, cfg, *, q_positions, window=None, sinks=None):
@@ -305,7 +329,8 @@ def generate(
     def sample(logits, key):
         if gen.temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(key, logits / gen.temperature, axis=-1).astype(jnp.int32)
+        logits = _filter_logits(logits / gen.temperature, gen)
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
     first = sample(logits, rng)
     eos = gen.eos_token_id

@@ -165,3 +165,42 @@ def test_eos_early_stop_pads_with_eos():
     assert len(hits) > 0
     first = hits[0]
     assert (gen_tokens[first:] == eos).all()
+
+
+def test_top_k_top_p_sampling():
+    """top-k restricts samples to the k best tokens; top-p to the nucleus."""
+    from automodel_tpu.inference.generate import _filter_logits
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.1, 0.06, 0.04]]))
+    f = np.asarray(_filter_logits(logits, GenerateConfig(top_k=2)))
+    assert np.isfinite(f[0, :2]).all() and (f[0, 2:] < -1e30).all()
+    # top_p=0.75: cumulative 0.5, 0.8 — the crossing token (0.3) is kept
+    f = np.asarray(_filter_logits(logits, GenerateConfig(top_p=0.75)))
+    assert np.isfinite(f[0, :2]).all() and (f[0, 2:] < -1e30).all()
+
+    # end to end: every sampled token comes from the top-k set
+    params = decoder.init(CFG, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(5), (1, 4), 0, 64)
+    out = generate(
+        params, CFG, prompt, jax.random.key(6),
+        GenerateConfig(max_new_tokens=8, temperature=1.0, top_k=1),
+    )
+    greedy = generate(
+        params, CFG, prompt, jax.random.key(7),
+        GenerateConfig(max_new_tokens=8),
+    )
+    # top_k=1 sampling == greedy regardless of temperature/key
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(greedy))
+
+
+def test_sampling_degenerate_params():
+    """top_k=0 / top_p>=1 mean off; top_p<=0 keeps exactly the best token."""
+    from automodel_tpu.inference.generate import _filter_logits
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.1, 0.06, 0.04]]))
+    off1 = np.asarray(_filter_logits(logits, GenerateConfig(top_k=0)))
+    off2 = np.asarray(_filter_logits(logits, GenerateConfig(top_p=1.0)))
+    np.testing.assert_array_equal(off1, np.asarray(logits))
+    np.testing.assert_array_equal(off2, np.asarray(logits))
+    only_best = np.asarray(_filter_logits(logits, GenerateConfig(top_p=0.0)))
+    assert np.isfinite(only_best[0, 0]) and (only_best[0, 1:] < -1e30).all()
