@@ -90,7 +90,7 @@ def print_capabilities() -> None:
             "lora_peft", "knowledge_distillation", "mtp", "fp8_int8_matmul",
             "dropless_moe", "attention_sinks", "kv_cache_generation",
             "mla_latent_cache_decode", "vlm_generation", "chunked_sparse_dsa",
-            "speculative_eagle123", "acceptance_length_bench",
+            "speculative_eagle1_eagle3", "acceptance_length_bench",
             "sampling_eval", "agent_tool_call_sft", "neat_packing",
             "orbax_checkpointing", "hf_safetensors_io", "golden_value_ci",
             "profiler_traces", "wandb_mlflow_trackers",

@@ -256,12 +256,27 @@ def generate(
     rng: jax.Array,
     gen: GenerateConfig = GenerateConfig(),
     prompt_embeds: jnp.ndarray | None = None,  # (B, S_prompt, H) — VLM merge
+    rope_angles: jnp.ndarray | None = None,    # (B, S_prompt, D/2) MRoPE prefill
+    decode_rope_pos0: jnp.ndarray | None = None,  # (B,) rope pos of 1st new token
+    deepstack_embeds: jnp.ndarray | None = None,  # (K, B, S_prompt, H)
 ) -> jnp.ndarray:
     """Returns (B, S_prompt + max_new_tokens) token ids.
 
     `prompt_embeds` replaces the prompt's token embeddings (the VLM path:
     image features already merged at the placeholder positions —
-    vlm_generate below builds them); decode steps embed tokens normally."""
+    vlm_generate below builds them); decode steps embed tokens normally.
+
+    MRoPE models (qwen3-vl-moe) pass `rope_angles` — precomputed per-token
+    multi-axis angles for the prompt (apply_rope's ndim>=2 form) — plus
+    `decode_rope_pos0`, the per-sample rope position of the first generated
+    token (text resumes at max(pos3)+1, which is ≤ the cache slot index
+    because the image block advances positions by max(gh,gw) not by its
+    token count). Decode steps rotate with angles = (pos0+step)·inv_freq —
+    on all three mrope axes a text token has the same position, so the
+    multi-axis rope collapses to standard rope there. `deepstack_embeds`
+    (zeros off-image, pre-scattered) are added after global layer k<K
+    during prefill only — decode tokens are text and take no visual
+    residual (reference: qwen3_vl_moe/model.py:419 _deepstack_process)."""
     params = cast_params(params, cfg.dtype)
     B, S = input_ids.shape
     T = S + gen.max_new_tokens
@@ -295,21 +310,33 @@ def generate(
         stack_windows.append(jnp.asarray(all_windows[off : off + L], jnp.int32))
         off += L
 
-    def run_stacks(h, positions, caches, write_at, attend_len):
+    def run_stacks(h, positions, caches, write_at, attend_len,
+                   freq_override=None, deepstack=None):
+        """`freq_override` (per-token angles) replaces the layer-window freq
+        table (MRoPE); `deepstack` (K,B,S,H) is injected after global layer
+        gidx<K (prefill only)."""
         new_caches = []
-        for (sp, mlp_fn, _), (c0, c1), wins in zip(stacks, caches, stack_windows):
+        off = 0
+        for (sp, mlp_fn, L), (c0, c1), wins in zip(stacks, caches, stack_windows):
+            gidxs = jnp.arange(L, dtype=jnp.int32) + off
+            off += L
 
             def one_layer(carry, xs, mlp_fn=mlp_fn):
                 (h,) = carry
-                lp, cc0, cc1, win = xs
+                lp, cc0, cc1, win, gidx = xs
+                freq = freq_override if freq_override is not None else freq_for_win(win)
                 h, cc0, cc1 = _attn_with_cache(
-                    h, lp, cfg, positions, freq_for_win(win), cc0, cc1,
+                    h, lp, cfg, positions, freq, cc0, cc1,
                     write_at, attend_len, win,
                 )
                 h = mlp_fn(h, lp, cfg)
+                if deepstack is not None:
+                    from automodel_tpu.models.moe_lm.decoder import deepstack_inject
+
+                    h = deepstack_inject(h, gidx, deepstack)
                 return (h,), (cc0, cc1)
 
-            (h,), (c0, c1) = jax.lax.scan(one_layer, (h,), (sp, c0, c1, wins))
+            (h,), (c0, c1) = jax.lax.scan(one_layer, (h,), (sp, c0, c1, wins, gidxs))
             new_caches.append((c0, c1))
         return h, new_caches
 
@@ -322,7 +349,10 @@ def generate(
             h = h * jnp.asarray(cfg.embed_scale, cfg.dtype)
     else:
         h = _embed(params, cfg, input_ids)
-    h, caches = run_stacks(h, positions, caches, 0, S)
+    h, caches = run_stacks(
+        h, positions, caches, 0, S,
+        freq_override=rope_angles, deepstack=deepstack_embeds,
+    )
     h_last = rms_norm(h[:, -1:], params["final_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
     logits = unembed(params, cfg, h_last)[:, 0]
 
@@ -341,10 +371,17 @@ def generate(
     # -- decode loop ---------------------------------------------------------
     def decode_step(carry, step):
         token, done, caches, key = carry
-        pos = S + step  # position of `token` in the sequence
+        pos = S + step  # cache slot of `token` in the sequence
         positions = jnp.broadcast_to(pos[None, None], (B, 1)).astype(jnp.int32)
+        if decode_rope_pos0 is not None:
+            # MRoPE: rope position ≠ cache slot; all axes equal for text
+            rpos = (decode_rope_pos0 + step).astype(jnp.float32)
+            freq = rpos[:, None, None] * inv_freq[None, None, :]  # (B,1,D/2)
+        else:
+            freq = None
         h = _embed(params, cfg, token[:, None])
-        h, caches = run_stacks(h, positions, caches, pos, pos + 1)
+        h, caches = run_stacks(h, positions, caches, pos, pos + 1,
+                               freq_override=freq)
         h = rms_norm(h, params["final_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
         logits = unembed(params, cfg, h)[:, 0]
         key, sub = jax.random.split(key)
@@ -395,18 +432,22 @@ def vlm_generate(
     merge), scatter the features into the prompt's token embeddings, and
     decode with the text model's KV cache. Exactly matches the teacher-
     forced module.forward argmax loop for the supported families
-    (tests/unit/test_vlm.py, test_kimi_vl.py).
+    (tests/unit/test_vlm.py, test_kimi_vl.py, test_qwen3_vl.py).
 
     Families whose TEXT-side prompt encoding needs more than merged
-    embeddings (qwen3-vl-moe: MRoPE position geometry + deepstack residual
-    taps) are rejected — a merged-embeds-only prefill would silently
-    diverge from training.
+    embeddings expose `prepare_generation(params, cfg, ids, pixels)`
+    returning extra generate() kwargs — qwen3-vl-moe builds MRoPE prefill
+    angles, the decode rope-position origin, and deepstack residuals there.
     """
+    if hasattr(module, "prepare_generation"):
+        prep = module.prepare_generation(params, cfg, input_ids, pixel_values)
+        return generate(
+            params["language_model"], cfg.text, input_ids, rng, gen, **prep
+        )
     if not hasattr(module, "encode_images"):
         raise NotImplementedError(
-            f"vlm_generate: {getattr(module, '__name__', module)} exposes no "
-            "encode_images() — qwen3-vl-moe needs MRoPE + deepstack in the "
-            "decode cache (not implemented); llava and kimi-vl are supported"
+            f"vlm_generate: {getattr(module, '__name__', module)} exposes "
+            "neither prepare_generation() nor encode_images()"
         )
     merged = _encode_and_merge(module, params, cfg, input_ids, pixel_values)
     return generate(

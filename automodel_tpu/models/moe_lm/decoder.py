@@ -41,6 +41,21 @@ from automodel_tpu.moe.layer import init_moe, moe_forward, moe_param_specs
 from automodel_tpu.ops.norms import rms_norm
 from automodel_tpu.ops.rope import rope_frequencies
 
+def deepstack_inject(h, gidx, deepstack_embeds):
+    """Add the gidx-th deepstack visual residual when gidx < K (reference:
+    qwen3_vl_moe/model.py:419 _deepstack_process — the embeds arrive
+    pre-scattered over the sequence, zeros off-image). Shared by the
+    training forward and the KV-cache generate prefill, which must inject
+    identically for decode to match teacher forcing."""
+    if deepstack_embeds is None:
+        return h
+    K = deepstack_embeds.shape[0]
+    inj = jax.lax.dynamic_index_in_dim(
+        deepstack_embeds, jnp.clip(gidx, 0, K - 1), 0, keepdims=False
+    )
+    return h + jnp.where(gidx < K, inj.astype(h.dtype), 0.0)
+
+
 #: Attention (incl. MLA/DSA) masks by position/segment and MoE routing is
 #: per-token, so the CP load-balanced permuted layout is transparent —
 #: EXCEPT the MTP head, which shifts in layout order; the recipe gates the
@@ -213,16 +228,7 @@ def forward(
     Lm, E = cfg.num_moe_layers, cfg.moe.n_routed_experts
 
     def _deepstack(h, gidx):
-        """Add the gidx-th deepstack visual residual when gidx < K
-        (reference: qwen3_vl_moe/model.py:419 _deepstack_process — the
-        embeds arrive pre-scattered over the sequence, zeros off-image)."""
-        if deepstack_embeds is None:
-            return h
-        K = deepstack_embeds.shape[0]
-        inj = jax.lax.dynamic_index_in_dim(
-            deepstack_embeds, jnp.clip(gidx, 0, K - 1), 0, keepdims=False
-        )
-        return h + jnp.where(gidx < K, inj.astype(h.dtype), 0.0)
+        return deepstack_inject(h, gidx, deepstack_embeds)
 
     # DSA: lightning-indexer sparse MLA returns an indexer-KL aux that rides
     # the same loss carry as the MoE balance loss (reference: deepseek_v4).

@@ -27,6 +27,7 @@ both sides are native):
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import Any, Mapping
 
 import jax
@@ -349,35 +350,19 @@ def param_specs(cfg: Qwen3VLMoEConfig) -> dict:
     }
 
 
-def forward(
-    params: dict,
-    cfg: Qwen3VLMoEConfig,
-    input_ids: jnp.ndarray,
-    pixel_values: jnp.ndarray,
-    *,
-    positions=None,
-    segment_ids=None,
-    mesh_ctx=None,
-    rules=None,
-    return_hidden: bool = False,
-    token_mask=None,
-    return_stats: bool = False,
-):
-    """Returns (out, aux_loss[, stats]) — the MoE module protocol."""
+def _prepare_mm(params, cfg: Qwen3VLMoEConfig, input_ids, pixel_values, constrain):
+    """Shared multimodal prep for forward + generation: merged prompt
+    embeddings, pre-scattered deepstack residuals, MRoPE angles, pos3."""
     v = cfg.vision
     P, m = v.patch_size, v.spatial_merge_size
     gh_m = pixel_values.shape[1] // P // m
     gw_m = pixel_values.shape[2] // P // m
     image_embeds, ds_embeds = vision_forward(params["visual"], v, pixel_values)
 
-    from automodel_tpu.models.llm.decoder import _make_constrain
-
     lm = params["language_model"]
-    dtype = cfg.dtype
     # FSDP-unshard the table's embed dim before the gather (see moe decoder)
-    constrain = _make_constrain(mesh_ctx, rules)
     tbl = constrain(lm["embed"]["embedding"], ("vocab", None))
-    token_embeds = jnp.take(tbl, input_ids, axis=0).astype(dtype)
+    token_embeds = jnp.take(tbl, input_ids, axis=0).astype(cfg.dtype)
     image_mask = input_ids == cfg.image_token_id
     merged = merge_image_embeddings(token_embeds, image_embeds, image_mask)
 
@@ -396,6 +381,56 @@ def forward(
     )
     axis_map = mrope_axis_map(cfg.mrope_section, cfg.mrope_interleaved, inv_freq.shape[-1])
     angles = mrope_angles(pos3, inv_freq, axis_map)
+    return merged, ds_full, angles, pos3
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _prepare_generation_jit(params, cfg, input_ids, pixel_values):
+    merged, ds_full, angles, pos3 = _prepare_mm(
+        params, cfg, input_ids, pixel_values, lambda a, ax: a
+    )
+    return merged, ds_full, angles, jnp.max(pos3, axis=(0, 2)).astype(jnp.int32) + 1
+
+
+def prepare_generation(params, cfg: Qwen3VLMoEConfig, input_ids, pixel_values):
+    """Build the KV-cache generate inputs (inference.generate kwargs):
+    merged prompt embeds + prefill MRoPE angles + the rope position of the
+    first decoded token (text resumes at max(pos3)+1) + deepstack residuals
+    for the prefill layers. Jitted — the ViT's per-layer python loop would
+    otherwise dispatch op-by-op on every generation batch."""
+    merged, ds_full, angles, pos0 = _prepare_generation_jit(
+        params, cfg, input_ids, pixel_values
+    )
+    return {
+        "prompt_embeds": merged,
+        "rope_angles": angles,
+        "decode_rope_pos0": pos0,
+        "deepstack_embeds": ds_full,
+    }
+
+
+def forward(
+    params: dict,
+    cfg: Qwen3VLMoEConfig,
+    input_ids: jnp.ndarray,
+    pixel_values: jnp.ndarray,
+    *,
+    positions=None,
+    segment_ids=None,
+    mesh_ctx=None,
+    rules=None,
+    return_hidden: bool = False,
+    token_mask=None,
+    return_stats: bool = False,
+):
+    """Returns (out, aux_loss[, stats]) — the MoE module protocol."""
+    from automodel_tpu.models.llm.decoder import _make_constrain
+
+    constrain = _make_constrain(mesh_ctx, rules)
+    merged, ds_full, angles, _pos3 = _prepare_mm(
+        params, cfg, input_ids, pixel_values, constrain
+    )
+    lm = params["language_model"]
 
     return moe_decoder.forward(
         lm, cfg.text, input_ids,

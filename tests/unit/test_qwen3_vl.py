@@ -112,6 +112,45 @@ def test_mrope_axis_maps():
 
 
 @pytest.mark.slow
+def test_qwen3_vl_generate_matches_naive():
+    """vlm_generate greedy == teacher-forced qwen3_vl.forward argmax loop —
+    proves the KV-cache decode path carries MRoPE geometry (rope position ≠
+    cache slot after an image block) and deepstack residuals correctly."""
+    from automodel_tpu.inference.generate import GenerateConfig, vlm_generate
+
+    spec, cfg, params = _setup()
+    ids, pixels = _mock_batch(cfg, B=2, S=16, img=56)
+    out = vlm_generate(
+        qwen3_vl, params, cfg, ids, pixels,
+        jax.random.key(1), GenerateConfig(max_new_tokens=4),
+    )
+    cur = ids
+    for _ in range(4):
+        logits, _aux = qwen3_vl.forward(params, cfg, cur, pixels)
+        nxt = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+        cur = jnp.concatenate([cur, nxt[:, None]], 1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))
+
+
+def test_qwen3_vl_decode_rope_origin():
+    """prepare_generation: the first decoded token's rope position resumes
+    at max(pos3)+1 — NOT at the prompt length (the image block compresses
+    positions by its token count minus max(gh,gw))."""
+    spec, cfg, params = _setup()
+    ids, pixels = _mock_batch(cfg, B=1, S=16, img=56)
+    prep = qwen3_vl.prepare_generation(params, cfg, ids, pixels)
+    image_mask = np.asarray(ids) == cfg.image_token_id
+    pos3 = np.asarray(qwen3_vl.get_mrope_positions(ids, jnp.asarray(image_mask), 2, 2))
+    np.testing.assert_array_equal(np.asarray(prep["decode_rope_pos0"]), pos3.max((0, 2)) + 1)
+    n_img = int(image_mask.sum())
+    # image block advances positions by max(gh,gw)=2, not by its n_img tokens
+    assert prep["decode_rope_pos0"][0] == ids.shape[1] - n_img + 2
+    assert prep["decode_rope_pos0"][0] < ids.shape[1]  # compressed vs slots
+    assert prep["rope_angles"].shape[:2] == ids.shape
+    assert prep["deepstack_embeds"].shape[0] == len(cfg.vision.deepstack_visual_indexes)
+
+
+@pytest.mark.slow
 def test_qwen3_vl_adapter_roundtrip():
     from automodel_tpu.checkpoint.hf_adapter import get_adapter
 
