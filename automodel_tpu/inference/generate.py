@@ -277,6 +277,17 @@ def generate(
     (zeros off-image, pre-scattered) are added after global layer k<K
     during prefill only — decode tokens are text and take no visual
     residual (reference: qwen3_vl_moe/model.py:419 _deepstack_process)."""
+    from automodel_tpu.models.moe_lm.het_moe import HetMoEConfig
+
+    if isinstance(cfg, HetMoEConfig):
+        # heterogeneous engine (step3p5/mimo/minimax-m3): per-layer python-
+        # loop decode with its own cache layout (incl. sparse index caches)
+        assert rope_angles is None and deepstack_embeds is None
+        from automodel_tpu.inference.het_generate import het_generate
+
+        return het_generate(
+            params, cfg, input_ids, rng, gen, prompt_embeds=prompt_embeds
+        )
     params = cast_params(params, cfg.dtype)
     B, S = input_ids.shape
     T = S + gen.max_new_tokens

@@ -181,6 +181,94 @@ def mimo_v2_flash_config(hf: Mapping[str, Any], **overrides) -> HetMoEConfig:
     return HetMoEConfig(**kw)
 
 
+def minimax_m3_text_config(hf: Mapping[str, Any], **overrides) -> HetMoEConfig:
+    """MiniMaxM3SparseForCausalLM (reference: models/minimax_m3_vl/config.py
+    MiniMaxM3VLTextConfig + layers.py): single attention geometry with
+    per-head GEMMA qk-norm, partial rope (rotary_dim of head_dim), per-layer
+    dense-vs-MoE from moe_layer_freq (0 = dense), SwiGLU-OAI dense/shared
+    MLPs, sigmoid routing with correction bias + routed scaling, and
+    block-level DSA sparse attention on layers selected by
+    sparse_attention_config.sparse_attention_freq.
+
+    num_mtp_modules is accepted and DROPPED (the reference VL adapter's
+    stage-1 behavior, state_dict_adapter.py:30; MTP for M3 is future work —
+    training uses the main CE path only)."""
+    L = int(hf["num_hidden_layers"])
+    heads = int(hf["num_attention_heads"])
+    kv = int(hf.get("num_key_value_heads", heads))
+    head_dim = int(hf.get("head_dim", hf["hidden_size"] // heads))
+    rotary_dim = int(hf.get("rotary_dim") or round(
+        head_dim * float(hf.get("partial_rotary_factor", 1.0))
+    ))
+    freq = hf.get("moe_layer_freq")
+    mlp_kinds = tuple(
+        "dense" if (freq is not None and not freq[i]) else "moe" for i in range(L)
+    )
+    sp_cfg = dict(hf.get("sparse_attention_config") or {})
+    if sp_cfg and sp_cfg.get("use_sparse_attention", True):
+        sp_freq = sp_cfg.get("sparse_attention_freq")
+        sparse = tuple(
+            bool(sp_freq[i]) if sp_freq is not None else True for i in range(L)
+        )
+    else:
+        sparse = ()
+    n_shared = int(hf.get("n_shared_experts") or 0)
+    moe = MoEConfig(
+        n_routed_experts=int(hf.get("num_local_experts", hf.get("num_experts", 8))),
+        n_shared_experts=0,  # shared expert is the swigluoai share_expert_dim path
+        experts_per_token=int(hf.get("num_experts_per_tok", 4)),
+        moe_intermediate_size=int(hf["intermediate_size"]),
+        score_func=(
+            "softmax" if str(hf.get("scoring_func", "sigmoid")).lower() == "softmax"
+            else "sigmoid"
+        ),
+        norm_topk_prob=True,
+        route_scale=float(hf.get("routed_scaling_factor", 1.0) or 1.0),
+        gate_bias_update_speed=0.001 if bool(hf.get("use_routing_bias", True)) else 0.0,
+        expert_activation="swigluoai",
+        swiglu_limit=float(hf.get("swiglu_limit", 7.0)),
+    )
+    kw = dict(
+        vocab_size=int(hf["vocab_size"]),
+        hidden_size=int(hf["hidden_size"]),
+        intermediate_size=int(hf.get("dense_intermediate_size", hf["intermediate_size"])),
+        num_layers=L,
+        layer_types=("global",) * L,
+        global_attn=AttnGeom(num_heads=heads, num_kv_heads=kv, head_dim=head_dim),
+        sliding_attn=AttnGeom(num_heads=heads, num_kv_heads=kv, head_dim=head_dim),
+        qk_norm=bool(hf.get("use_qk_norm", True)),
+        rope_thetas=(float(hf.get("rope_theta", 5_000_000.0)),) * L,
+        partial_rotary=(rotary_dim / head_dim,) * L,
+        use_rope=(True,) * L,
+        mlp_kinds=mlp_kinds,
+        moe=moe,
+        share_expert_dim=int(hf.get("shared_intermediate_size", hf["intermediate_size"])) * n_shared,
+        swiglu_limit=float(hf.get("swiglu_limit", 7.0)),
+        dense_activation="swigluoai",
+        zero_centered_norm=bool(hf.get("use_gemma_norm", True)),
+        sparse_attn=sparse,
+        sparse_index_heads=int(sp_cfg.get("sparse_num_index_heads", 1) or 1),
+        sparse_index_dim=int(sp_cfg.get("sparse_index_dim", 64) or 64),
+        sparse_block_size=int(sp_cfg.get("sparse_block_size", 32) or 32),
+        sparse_topk_blocks=int(sp_cfg.get("sparse_topk_blocks", 8) or 8),
+        sparse_init_blocks=int(sp_cfg.get("sparse_init_block", 0) or 0),
+        sparse_local_blocks=int(sp_cfg.get("sparse_local_block", 1) or 1),
+        sparse_score_type=str(sp_cfg.get("sparse_score_type", "max")),
+        rms_norm_eps=float(hf.get("rms_norm_eps", 1e-6)),
+        tie_word_embeddings=bool(hf.get("tie_word_embeddings", False)),
+    )
+    if bool(hf.get("attention_output_gate", False)):
+        raise NotImplementedError(
+            "minimax_m3 attention_output_gate (the reference rejects it too: "
+            "minimax_m3_vl/layers.py:411)"
+        )
+    moe_overrides = overrides.pop("moe", None)
+    kw.update(overrides)  # unknown keys raise loudly in HetMoEConfig
+    if moe_overrides is not None:
+        kw["moe"] = moe_overrides
+    return HetMoEConfig(**kw)
+
+
 # ---------------------------------------------------------------------------
 # state-dict adapter (shared; per-family naming via `style`)
 # ---------------------------------------------------------------------------
@@ -193,7 +281,15 @@ class HetMoEAdapter:
     style="mimo": standard per-expert mlp.experts.{e}.{proj}.weight, router
     mlp.gate.weight + mlp.gate.e_score_correction_bias, per-layer
     self_attn.attention_sink_bias, shared under mlp.shared_experts.*.
+    style="minimax_m3": per-expert block_sparse_moe.experts.{e}.w1/w3/w2
+    (gate/up/down), router block_sparse_moe.gate.weight +
+    block_sparse_moe.e_score_correction_bias, shared experts under
+    block_sparse_moe.shared_experts.* (→ the share_expert_dim shared_mlp),
+    indexer self_attn.index_{q,k}_{proj,norm} on sparse layers (reference:
+    minimax_m3_vl/state_dict_adapter.py key maps).
     """
+
+    _M3_PROJ = {"gate_proj": "w1", "up_proj": "w3", "down_proj": "w2"}
 
     def __init__(self, cfg: HetMoEConfig, style: str = "step3p5"):
         self.cfg = cfg
@@ -201,23 +297,16 @@ class HetMoEAdapter:
 
     # per-layer bookkeeping -------------------------------------------------
     def _index_maps(self):
-        cfg = self.cfg
-        gi = si = di = mi = 0
-        rows = []
-        for li, lt in enumerate(cfg.layer_types):
-            a_key = "s_attn" if lt == "sliding" else "g_attn"
-            ai = si if lt == "sliding" else gi
-            is_moe = cfg.mlp_kinds[li] == "moe"
-            rows.append((li, lt, a_key, ai, is_moe, mi if is_moe else di))
-            if lt == "sliding":
-                si += 1
-            else:
-                gi += 1
-            if is_moe:
-                mi += 1
-            else:
-                di += 1
-        return rows
+        from automodel_tpu.models.moe_lm.het_moe import layer_rows
+
+        return layer_rows(self.cfg)
+
+    _IDX_ENTRIES = [
+        ("self_attn.index_q_proj.weight", ("index_q_proj", "kernel"), True),
+        ("self_attn.index_k_proj.weight", ("index_k_proj", "kernel"), True),
+        ("self_attn.index_q_norm.weight", ("index_q_norm", "scale"), False),
+        ("self_attn.index_k_norm.weight", ("index_k_norm", "scale"), False),
+    ]
 
     def _attn_entries(self, g: AttnGeom):
         e = [
@@ -248,7 +337,7 @@ class HetMoEAdapter:
         yield "model.norm.weight", np.asarray(params["final_norm"]["scale"])
         if not cfg.tie_word_embeddings:
             yield "lm_head.weight", _t(params["lm_head"]["kernel"])
-        for li, lt, a_key, ai, is_moe, mi in self._index_maps():
+        for li, lt, a_key, ai, is_moe, mi, is_sparse, spi in self._index_maps():
             base = f"model.layers.{li}."
             yield base + "input_layernorm.weight", np.asarray(
                 params["input_norms"]["scale"][li]
@@ -263,6 +352,13 @@ class HetMoEAdapter:
                     node = node[pseg]
                 x = np.asarray(node[ai])
                 yield base + suf, (_t(x) if tr else x)
+            if is_sparse:
+                for suf, path, tr in self._IDX_ENTRIES:
+                    node = params["indexer"]
+                    for pseg in path:
+                        node = node[pseg]
+                    x = np.asarray(node[spi])
+                    yield base + suf, (_t(x) if tr else x)
             if not is_moe:
                 for proj in ("gate_proj", "up_proj", "down_proj"):
                     yield base + f"mlp.{proj}.weight", _t(
@@ -270,7 +366,25 @@ class HetMoEAdapter:
                     )
                 continue
             moe = params["moe"]
-            if self.style == "step3p5":
+            if self.style == "minimax_m3":
+                yield base + "block_sparse_moe.gate.weight", _t(
+                    np.asarray(moe["gate"]["weight"][mi])
+                )
+                if "e_score_bias" in moe["gate"]:
+                    yield base + "block_sparse_moe.e_score_correction_bias", (
+                        np.asarray(moe["gate"]["e_score_bias"][mi])
+                    )
+                for e in range(E):
+                    for proj, w in self._M3_PROJ.items():
+                        yield base + f"block_sparse_moe.experts.{e}.{w}.weight", _t(
+                            np.asarray(moe["experts"][proj]["kernel"][mi, e])
+                        )
+                if cfg.share_expert_dim:
+                    for proj in ("gate_proj", "up_proj", "down_proj"):
+                        yield base + f"block_sparse_moe.shared_experts.{proj}.weight", _t(
+                            params["shared_mlp"][proj]["kernel"][mi]
+                        )
+            elif self.style == "step3p5":
                 yield base + "moe.gate.weight", _t(np.asarray(moe["gate"]["weight"][mi]))
                 if "e_score_bias" in moe["gate"]:
                     yield base + "moe.router_bias", np.asarray(
@@ -355,6 +469,13 @@ class HetMoEAdapter:
                         for (li, *_rest) in lis
                     ]),
                 )
+        sparse_rows = [r for r in rows if r[6]]
+        if sparse_rows:
+            for suf, path, tr in self._IDX_ENTRIES:
+                put(("indexer",) + path, np.stack([
+                    one(f"model.layers.{li}.{suf}", tr)
+                    for (li, *_r) in sparse_rows
+                ]))
         dense_rows = [r for r in rows if not r[4]]
         if dense_rows:
             for proj in ("gate_proj", "up_proj", "down_proj"):
@@ -364,7 +485,43 @@ class HetMoEAdapter:
                 ]))
         moe_rows = [r for r in rows if r[4]]
         if moe_rows:
-            if self.style == "step3p5":
+            if self.style == "minimax_m3":
+                put(("moe", "gate", "weight"), np.stack([
+                    one(f"model.layers.{li}.block_sparse_moe.gate.weight", True)
+                    for (li, *_r) in moe_rows
+                ]))
+                if cfg.moe.gate_bias_update_speed > 0:
+                    put(("moe", "gate", "e_score_bias"), np.stack([
+                        one(
+                            f"model.layers.{li}.block_sparse_moe."
+                            "e_score_correction_bias",
+                            False,
+                        )
+                        for (li, *_r) in moe_rows
+                    ]))
+                for proj, w in self._M3_PROJ.items():
+                    put(("moe", "experts", proj, "kernel"), np.stack([
+                        np.stack([
+                            one(
+                                f"model.layers.{li}.block_sparse_moe."
+                                f"experts.{e}.{w}.weight",
+                                True,
+                            )
+                            for e in range(E)
+                        ])
+                        for (li, *_r) in moe_rows
+                    ]))
+                if cfg.share_expert_dim:
+                    for proj in ("gate_proj", "up_proj", "down_proj"):
+                        put(("shared_mlp", proj, "kernel"), np.stack([
+                            one(
+                                f"model.layers.{li}.block_sparse_moe."
+                                f"shared_experts.{proj}.weight",
+                                True,
+                            )
+                            for (li, *_r) in moe_rows
+                        ]))
+            elif self.style == "step3p5":
                 put(("moe", "gate", "weight"), np.stack([
                     one(f"model.layers.{li}.moe.gate.weight", True)
                     for (li, *_r) in moe_rows

@@ -23,6 +23,8 @@ from automodel_tpu.models.moe_lm import het_families
 from automodel_tpu.models.moe_lm import het_moe as het_moe_module
 from automodel_tpu.models.omni import model as omni_module
 from automodel_tpu.models.vlm import kimi_vl as kimi_vl_module
+from automodel_tpu.models.vlm import llama_nemotron_vl as llama_nemotron_vl_module
+from automodel_tpu.models.vlm import minimax_m3_vl as minimax_m3_vl_module
 from automodel_tpu.models.vlm import llava as llava_module
 from automodel_tpu.models.vlm import qwen3_vl as qwen3_vl_module
 
@@ -117,6 +119,13 @@ MODEL_ARCH_MAPPING: dict[str, ModelSpec] = {
         "minimax_m2", moe_families.minimax_m2_config, moe_decoder,
         adapter_name="moe_decoder", adapter_kwargs={"style": "minimax"},
     ),
+    # MiniMax M3: mixed sparse/dense MoE with block-level DSA (lightning
+    # indexer top-k key blocks), gemma norms, swigluoai MLPs (reference:
+    # models/minimax_m3_vl/, 2980 LoC — text backbone on the het engine)
+    "MiniMaxM3SparseForCausalLM": ModelSpec(
+        "minimax_m3", het_families.minimax_m3_text_config, het_moe_module,
+        adapter_name="het_moe", adapter_kwargs={"style": "minimax_m3"},
+    ),
     # kimi_k2 is checkpoint-compatible with DeepSeek-V3 (reference:
     # components/models/kimi_k2/__init__.py — a 34-LoC alias of deepseek_v3)
     "KimiK2ForCausalLM": ModelSpec(
@@ -176,11 +185,25 @@ MODEL_ARCH_MAPPING: dict[str, ModelSpec] = {
         "kimi_vl", kimi_vl_module.kimi_vl_config, kimi_vl_module,
         adapter_name="kimi_vl",
     ),
+    # MiniMax M3 VL: CLIP-style 3D-rope tower + projector/patch-merger +
+    # the M3 sparse/dense MoE text backbone (reference: models/minimax_m3_vl)
+    "MiniMaxM3SparseForConditionalGeneration": ModelSpec(
+        "minimax_m3_vl", minimax_m3_vl_module.minimax_m3_vl_config,
+        minimax_m3_vl_module, adapter_name="minimax_m3_vl",
+    ),
     # Qwen3-VL-MoE: deepstack ViT + interleaved-MRoPE qwen3-moe text
     # (reference: models/qwen3_vl_moe, 707 LoC)
     "Qwen3VLMoeForConditionalGeneration": ModelSpec(
         "qwen3_vl_moe", qwen3_vl_module.qwen3_vl_moe_config, qwen3_vl_module,
         adapter_name="qwen3_vl_moe",
+    ),
+    # Llama-Nemotron VL: SigLIP tower + pixel-shuffle + mlp1 projector +
+    # bidirectional llama — a retrieval/reranking EMBEDDING model
+    # (reference: models/llama_nemotron_vl/, registered under the retrieval
+    # tag in _transformers/registry.py:126)
+    "LlamaNemotronVLModel": ModelSpec(
+        "llama_nemotron_vl", llama_nemotron_vl_module.llama_nemotron_vl_config,
+        llama_nemotron_vl_module, adapter_name="llama_nemotron_vl",
     ),
     "LlavaForConditionalGeneration": ModelSpec(
         "llava", llava_module.llava_config, llava_module, adapter_name="llava"

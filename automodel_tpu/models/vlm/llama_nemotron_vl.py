@@ -1,0 +1,288 @@
+"""Llama-Nemotron VL: SigLIP tower → pixel-shuffle ↓ → LN/MLP projector →
+BIDIRECTIONAL llama encoder, pooled for retrieval/reranking embeddings.
+
+The analog of the reference's llama_nemotron_vl (reference: nemo_automodel/
+components/models/llama_nemotron_vl/model.py, 717 LoC — registered under
+the retrieval tag: _transformers/registry.py:126). This is an EMBEDDING
+model, not a generator: a SigLIP vision encoder's patch features are
+space-to-depth downsampled (`pixel_shuffle`, model.py:627, InternVL
+convention, downsample_ratio=0.5 ⇒ 4× fewer tokens at 4× channels),
+projected by `mlp1` (LayerNorm → Linear → GELU → Linear, model.py:458),
+spliced into the token stream at `img_context_token_id` positions, and run
+through a non-causal llama (`LlamaBidirectionalModel`, model.py:260); the
+last hidden state is masked-pooled (avg/last/cls, model.py:190 `pool`).
+
+TPU mapping: the tower is the shared models/vision/vit.py encoder (SigLIP
+flavor: no CLS, no pre-LN, tanh-gelu), the text side the generic dense
+decoder with `causal=False` (the llama_bidirectional config), and pooling
+mirrors loss/infonce.mean_pool so the retrieval recipes can drive it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.layers import dense_init
+from automodel_tpu.models.llm import decoder as text_decoder
+from automodel_tpu.models.llm.families import llama_bidirectional_config
+from automodel_tpu.models.vision import vit
+from automodel_tpu.models.vlm.llava import merge_image_embeddings
+from automodel_tpu.ops.norms import layer_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaNemotronVLConfig:
+    vision: vit.VisionConfig = dataclasses.field(default_factory=vit.VisionConfig)
+    text: Any = None  # TransformerConfig (causal=False)
+    img_context_token_id: int = 128258
+    downsample_ratio: float = 0.5
+    pooling: str = "avg"  # avg | last | cls
+
+    @property
+    def dtype(self):
+        return self.text.dtype
+
+    @property
+    def mtp_num_layers(self) -> int:
+        return 0
+
+    @property
+    def num_image_token(self) -> int:
+        """Merged tokens one image occupies (model.py:432)."""
+        side = self.vision.image_size // self.vision.patch_size
+        return int(side ** 2 * self.downsample_ratio ** 2)
+
+    def flops_per_token(self, seq_len: int) -> float:
+        vis = 6.0 * self.vision.param_count() * self.vision.num_positions
+        return self.text.flops_per_token(seq_len) + vis / max(seq_len, 1)
+
+
+def llama_nemotron_vl_config(hf: Mapping[str, Any], **overrides) -> LlamaNemotronVLConfig:
+    llm_hf = dict(hf["llm_config"])
+    text_overrides = {
+        k: overrides[k]
+        for k in ("dtype", "remat_policy", "attn_impl", "linear_precision")
+        if k in overrides
+    }
+    text = llama_bidirectional_config(llm_hf, **text_overrides)
+    v = dict(hf["vision_config"])
+    vision = vit.VisionConfig.from_hf(
+        v,
+        dtype=text.dtype,
+        remat_policy=text_overrides.get("remat_policy", "full"),
+        feature_layer=int(hf.get("select_layer", -1)),
+    )
+    return LlamaNemotronVLConfig(
+        vision=vision,
+        text=text,
+        img_context_token_id=int(hf.get("img_context_token_id", 128258)),
+        downsample_ratio=float(hf.get("downsample_ratio", 0.5)),
+        pooling=str(hf.get("pooling", llm_hf.get("pooling", "avg"))),
+    )
+
+
+def init(cfg: LlamaNemotronVLConfig, rng: jax.Array) -> dict:
+    kv, kt, kp = jax.random.split(rng, 3)
+    Hv = cfg.vision.hidden_size
+    Ht = cfg.text.hidden_size
+    r = int(1 / cfg.downsample_ratio)
+    k1, k2 = jax.random.split(kp)
+    return {
+        "vision_tower": vit.init(cfg.vision, kv),
+        "mlp1": {
+            "norm": {"scale": jnp.ones((Hv * r * r,)), "bias": jnp.zeros((Hv * r * r,))},
+            "fc1": {"kernel": dense_init(k1, (Hv * r * r, Ht)), "bias": jnp.zeros((Ht,))},
+            "fc2": {"kernel": dense_init(k2, (Ht, Ht)), "bias": jnp.zeros((Ht,))},
+        },
+        "language_model": text_decoder.init(cfg.text, kt),
+    }
+
+
+def param_specs(cfg: LlamaNemotronVLConfig) -> dict:
+    return {
+        "vision_tower": vit.param_specs(cfg.vision),
+        "mlp1": {
+            "norm": {"scale": ("norm",), "bias": ("norm",)},
+            "fc1": {"kernel": ("embed", "mlp"), "bias": ("norm",)},
+            "fc2": {"kernel": ("mlp", "embed"), "bias": ("norm",)},
+        },
+        "language_model": text_decoder.param_specs(cfg.text),
+    }
+
+
+def pixel_shuffle(x: jnp.ndarray, scale: float) -> jnp.ndarray:
+    """(N, h, w, C) → (N, h·s, w·s, C/s²) — the exact InternVL shuffle
+    (reference: model.py:627; view/permute sequence reproduced so channel
+    order matches the checkpoint's mlp1 weights)."""
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, int(w * scale), int(c / scale))
+    x = jnp.transpose(x, (0, 2, 1, 3))
+    x = x.reshape(n, int(h * scale), int(w * scale), int(c / (scale * scale)))
+    return jnp.transpose(x, (0, 2, 1, 3))
+
+
+def encode_images(params: dict, cfg: LlamaNemotronVLConfig, pixel_values: jnp.ndarray):
+    """(B, H, W, 3) → (B, num_image_token, text_hidden) — extract_feature
+    (model.py:643): tower → pixel-shuffle ↓ → mlp1."""
+    feats = vit.forward(params["vision_tower"], cfg.vision, pixel_values)
+    B, N, C = feats.shape
+    side = int(N ** 0.5)
+    x = pixel_shuffle(feats.reshape(B, side, side, C), cfg.downsample_ratio)
+    x = x.reshape(B, -1, x.shape[-1])
+    mp = params["mlp1"]
+    dt = cfg.dtype
+    x = layer_norm(x, mp["norm"]["scale"], mp["norm"]["bias"])
+    x = x.astype(dt) @ mp["fc1"]["kernel"].astype(dt) + mp["fc1"]["bias"].astype(dt)
+    x = jax.nn.gelu(x, approximate=False)
+    return x @ mp["fc2"]["kernel"].astype(dt) + mp["fc2"]["bias"].astype(dt)
+
+
+def forward(
+    params: dict,
+    cfg: LlamaNemotronVLConfig,
+    input_ids: jnp.ndarray,
+    pixel_values: jnp.ndarray,
+    *,
+    positions=None,
+    segment_ids=None,
+    mesh_ctx=None,
+    rules=None,
+    return_hidden: bool = True,
+):
+    """Non-causal encode of the merged image+text sequence. The natural
+    output is the hidden state (return_hidden=True default) — this family
+    is an embedding model; `embed` below applies the retrieval pooling."""
+    image_embeds = encode_images(params, cfg, pixel_values)
+    lm = params["language_model"]
+    token_embeds = jnp.take(lm["embed"]["embedding"], input_ids, axis=0).astype(cfg.dtype)
+    merged = merge_image_embeddings(
+        token_embeds, image_embeds, input_ids == cfg.img_context_token_id
+    )
+    return text_decoder.forward(
+        lm, cfg.text, input_ids,
+        positions=positions, segment_ids=segment_ids,
+        mesh_ctx=mesh_ctx, rules=rules,
+        return_hidden=return_hidden, inputs_embeds=merged,
+    )
+
+
+def embed(
+    params: dict,
+    cfg: LlamaNemotronVLConfig,
+    input_ids: jnp.ndarray,
+    pixel_values: jnp.ndarray,
+    attention_mask: jnp.ndarray,  # (B, S) 1 = real token
+    pooling: str | None = None,
+) -> jnp.ndarray:
+    """(B, text_hidden) pooled embeddings (model.py:190 `pool`)."""
+    hidden = forward(params, cfg, input_ids, pixel_values, return_hidden=True)
+    mask = attention_mask.astype(hidden.dtype)
+    pool = pooling or cfg.pooling
+    if pool == "avg":
+        return (hidden * mask[..., None]).sum(1) / jnp.maximum(
+            mask.sum(1)[..., None], 1.0
+        )
+    if pool == "cls":
+        return hidden[:, 0]
+    if pool == "last":
+        last = jnp.maximum(mask.sum(1).astype(jnp.int32) - 1, 0)
+        return jnp.take_along_axis(hidden, last[:, None, None], axis=1)[:, 0]
+    raise ValueError(f"pooling '{pool}' not supported (avg | cls | last)")
+
+
+# ---------------------------------------------------------------------------
+# HF state-dict adapter
+# ---------------------------------------------------------------------------
+class LlamaNemotronVLAdapter:
+    """HF layout (reference: model.py module tree): tower under
+    `vision_model.vision_model.*` (SiglipVisionModel nests a vision_model),
+    projector `mlp1.{0,1,3}.*` (Sequential LN/Linear/GELU/Linear), text as a
+    BARE LlamaModel under `language_model.*` (no `model.` level, no
+    lm_head — it is an encoder)."""
+
+    def __init__(self, cfg: LlamaNemotronVLConfig):
+        self.cfg = cfg
+
+    def _vit(self):
+        from automodel_tpu.checkpoint.hf_adapter import LlavaAdapter
+
+        return LlavaAdapter(self.cfg)
+
+    def _lm(self):
+        from automodel_tpu.checkpoint.hf_adapter import DenseDecoderAdapter
+
+        return DenseDecoderAdapter(self.cfg.text)
+
+    _MLP1 = [
+        ("mlp1.0.weight", ("norm", "scale"), False),
+        ("mlp1.0.bias", ("norm", "bias"), False),
+        ("mlp1.1.weight", ("fc1", "kernel"), True),
+        ("mlp1.1.bias", ("fc1", "bias"), False),
+        ("mlp1.3.weight", ("fc2", "kernel"), True),
+        ("mlp1.3.bias", ("fc2", "bias"), False),
+    ]
+
+    def from_hf(self, read, shardings=None) -> dict:
+        import numpy as np
+
+        from automodel_tpu.checkpoint.hf_adapter import _get, _set
+
+        def place(subtree, sub_shardings):
+            if sub_shardings is None:
+                return jax.tree.map(jnp.asarray, subtree)
+            return jax.tree.map(jax.device_put, subtree, sub_shardings)
+
+        params: dict = {
+            "vision_tower": place(
+                self._vit()._vit_from_hf(read, "vision_model"),
+                _get(shardings, ("vision_tower",)) if shardings is not None else None,
+            )
+        }
+        mlp1: dict = {}
+        for name, path, tr in self._MLP1:
+            x = np.asarray(read(name))
+            _set(mlp1, path, np.ascontiguousarray(x.T) if tr else x)
+        params["mlp1"] = place(
+            mlp1, _get(shardings, ("mlp1",)) if shardings is not None else None
+        )
+
+        def lm_read(name):
+            # DenseDecoderAdapter asks for model.*-prefixed names and
+            # lm_head.weight; the checkpoint stores a bare LlamaModel.
+            if name.startswith("model."):
+                raise KeyError(name)  # → adapter's bare-model fallback
+            if name == "lm_head.weight":
+                raise KeyError(name)  # encoder: no head; leaf omitted
+            return read("language_model." + name)
+
+        lm_sh = _get(shardings, ("language_model",)) if shardings is not None else None
+        params["language_model"] = self._lm().from_hf(lm_read, shardings=lm_sh)
+        return params
+
+    def to_hf(self, params):
+        import numpy as np
+
+        from automodel_tpu.checkpoint.hf_adapter import _get
+
+        yield from self._vit()._vit_to_hf(params["vision_tower"], "vision_model")
+        for name, path, tr in self._MLP1:
+            x = np.asarray(_get(params["mlp1"], path))
+            yield name, (np.ascontiguousarray(x.T) if tr else x)
+        for name, tensor in self._lm().to_hf(params["language_model"]):
+            if name == "lm_head.weight":
+                continue  # encoder checkpoints carry no head
+            assert name.startswith("model."), name
+            yield "language_model." + name[len("model."):], tensor
+
+
+def _register_adapter():
+    from automodel_tpu.checkpoint.hf_adapter import ADAPTERS
+
+    ADAPTERS["llama_nemotron_vl"] = LlamaNemotronVLAdapter
+
+
+_register_adapter()

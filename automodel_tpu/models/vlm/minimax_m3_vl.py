@@ -1,0 +1,501 @@
+"""MiniMax M3 VL: CLIP-style tower with 3D rope → projector → patch merger
+→ MiniMax M3 (mixed sparse/dense MoE) text backbone.
+
+The analog of the reference's minimax_m3_vl (reference: nemo_automodel/
+components/models/minimax_m3_vl/, 2980 LoC — vision_encoder.py tower,
+model.py `MiniMaxM3SparseForConditionalGeneration`). TPU design notes:
+
+- Vision (vision_encoder.py:126 `MiniMaxM3VisionTransformer`): conv patch
+  embed over (temporal_patch × P × P) voxels with frames duplicated across
+  the temporal patch (folded into the channel dim, checkpoint-invertible —
+  the qwen3_vl idiom), `pre_layrnorm` (checkpoint typo preserved), then
+  bidirectional pre-LN CLIP blocks with separate biased q/k/v/out
+  projections and axis-split 3D NEOX rope: axis_dim = 2·((2·(hd//2)//3)//2)
+  channels per t/h/w axis, angles concatenated then half-split rotated over
+  the first 3·axis_dim channels, tail passes through. Tokens are arranged
+  in SPATIAL-MERGE-BLOCK order (each m×m block contiguous) so the rope
+  positions (vision_encoder.py:149 `_rope_position_freqs`) and the merger's
+  consecutive-m² reshape both hold. Images ⇒ t = 0.
+- Projector then merger (vision_encoder.py:215,228): 2-layer GELU projector
+  (vision → projector_hidden → text), then the patch merger folds m²
+  consecutive projected tokens → projector_hidden → text.
+- Text: the het_moe engine with `minimax_m3_text_config` — per-layer
+  dense/MoE (moe_layer_freq), block-sparse DSA layers, gemma norms,
+  swigluoai. Features are spliced at image_token_index positions; plain
+  integer positions (no MRoPE), so `encode_images` + the generic VLM
+  generate path compose. Each batch row is one image: batching gives the
+  block-diagonal no-cross-image attention the reference builds masks for.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.layers import dense_init
+from automodel_tpu.models.moe_lm import het_moe
+from automodel_tpu.models.moe_lm.het_families import minimax_m3_text_config
+from automodel_tpu.models.vlm.kimi_vl import _layer_norm, _ln_init
+from automodel_tpu.models.vlm.llava import merge_image_embeddings
+from automodel_tpu.ops.rope import apply_rope
+
+
+@dataclasses.dataclass(frozen=True)
+class MiniMaxM3VisionConfig:
+    hidden_size: int = 1280
+    num_heads: int = 16
+    num_layers: int = 32
+    intermediate_size: int = 5120
+    patch_size: int = 14
+    temporal_patch_size: int = 2
+    spatial_merge_size: int = 2
+    rope_theta: float = 10000.0
+    layer_norm_eps: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def axis_dim(self) -> int:
+        """Per-axis rope channels (reference: vision_encoder.py:143)."""
+        rope_dims = 2 * (self.head_dim // 2)
+        return int(2 * ((rope_dims // 3) // 2))
+
+
+@dataclasses.dataclass(frozen=True)
+class MiniMaxM3VLConfig:
+    vision: MiniMaxM3VisionConfig = dataclasses.field(
+        default_factory=MiniMaxM3VisionConfig
+    )
+    text: Any = None  # HetMoEConfig (minimax_m3 body)
+    image_token_id: int = 200025
+    projector_hidden_size: int = 6144
+    projector_bias: bool = True
+    patch_merge_bias: bool = True
+
+    @property
+    def dtype(self):
+        return self.text.dtype
+
+    @property
+    def moe(self):
+        return self.text.moe
+
+    @property
+    def mtp_num_layers(self) -> int:
+        return 0
+
+    def flops_per_token(self, seq_len: int) -> float:
+        v = self.vision
+        vis = v.num_layers * (
+            4 * v.hidden_size ** 2 + 2 * v.hidden_size * v.intermediate_size
+        )
+        return self.text.flops_per_token(seq_len) + 6.0 * vis / max(seq_len, 1)
+
+
+def minimax_m3_vl_config(hf: Mapping[str, Any], **overrides) -> MiniMaxM3VLConfig:
+    v = dict(hf.get("vision_config") or {})
+    comp = dict(v.get("img_token_compression_config") or {})
+    text_hf = dict(hf["text_config"])
+    text_overrides = {
+        k: overrides[k]
+        for k in ("dtype", "remat_policy", "attn_impl", "linear_precision")
+        if k in overrides
+    }
+    text = minimax_m3_text_config(text_hf, **text_overrides)
+    vision = MiniMaxM3VisionConfig(
+        hidden_size=int(v.get("hidden_size", 1280)),
+        num_heads=int(v.get("num_attention_heads", 16)),
+        num_layers=int(v.get("num_hidden_layers", 32)),
+        intermediate_size=int(v.get("intermediate_size", 5120)),
+        patch_size=int(v.get("patch_size", 14)),
+        temporal_patch_size=int(comp.get("temporal_patch_size", 2)),
+        spatial_merge_size=int(comp.get("spatial_merge_size", 2)),
+        rope_theta=float(v.get("rope_theta", 10000.0)),
+        layer_norm_eps=float(v.get("layer_norm_eps", 1e-5)),
+    )
+    return MiniMaxM3VLConfig(
+        vision=vision,
+        text=text,
+        image_token_id=int(hf.get("image_token_index", 200025)),
+        projector_hidden_size=int(hf.get("projector_hidden_size", text.hidden_size)),
+        projector_bias=bool(hf.get("multimodal_projector_bias", True)),
+        patch_merge_bias=bool(hf.get("patch_merge_bias", True)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# vision tower
+# ---------------------------------------------------------------------------
+def _proj2_init(k, din, dhid, dout, bias: bool):
+    k1, k2 = jax.random.split(k)
+    p = {
+        "linear_1": {"kernel": dense_init(k1, (din, dhid))},
+        "linear_2": {"kernel": dense_init(k2, (dhid, dout))},
+    }
+    if bias:
+        p["linear_1"]["bias"] = jnp.zeros((dhid,))
+        p["linear_2"]["bias"] = jnp.zeros((dout,))
+    return p
+
+
+def init_vision(cfg: MiniMaxM3VLConfig, rng: jax.Array) -> dict:
+    v = cfg.vision
+    D, I, P = v.hidden_size, v.intermediate_size, v.patch_size
+    L = v.num_layers
+    Cin = 3 * v.temporal_patch_size
+    m = v.spatial_merge_size
+    T = cfg.text.hidden_size
+    ks = jax.random.split(rng, 8)
+
+    def stack(k, shape):
+        return jnp.stack([dense_init(kk, shape) for kk in jax.random.split(k, L)])
+
+    return {
+        "patch_embed": {
+            "kernel": 0.02 * jax.random.normal(ks[0], (P, P, Cin, D)),
+        },
+        "pre_layrnorm": _ln_init(D),
+        "blocks": {
+            "layer_norm1": {"scale": jnp.ones((L, D)), "bias": jnp.zeros((L, D))},
+            "layer_norm2": {"scale": jnp.ones((L, D)), "bias": jnp.zeros((L, D))},
+            "q_proj": {"kernel": stack(ks[1], (D, D)), "bias": jnp.zeros((L, D))},
+            "k_proj": {"kernel": stack(ks[2], (D, D)), "bias": jnp.zeros((L, D))},
+            "v_proj": {"kernel": stack(ks[3], (D, D)), "bias": jnp.zeros((L, D))},
+            "out_proj": {"kernel": stack(ks[4], (D, D)), "bias": jnp.zeros((L, D))},
+            "fc1": {"kernel": stack(ks[5], (D, I)), "bias": jnp.zeros((L, I))},
+            "fc2": {"kernel": stack(ks[6], (I, D)), "bias": jnp.zeros((L, D))},
+        },
+        "projector": _proj2_init(
+            jax.random.fold_in(ks[7], 0), D, cfg.projector_hidden_size, T,
+            cfg.projector_bias,
+        ),
+        "patch_merger": _proj2_init(
+            jax.random.fold_in(ks[7], 1), T * m * m, cfg.projector_hidden_size, T,
+            cfg.patch_merge_bias,
+        ),
+    }
+
+
+def vision_param_specs(cfg: MiniMaxM3VLConfig) -> dict:
+    def proj2(bias):
+        p = {
+            "linear_1": {"kernel": ("embed", "mlp")},
+            "linear_2": {"kernel": ("mlp", "embed")},
+        }
+        if bias:
+            p["linear_1"]["bias"] = ("norm",)
+            p["linear_2"]["bias"] = ("norm",)
+        return p
+
+    return {
+        "patch_embed": {"kernel": (None, None, None, "embed")},
+        "pre_layrnorm": {"scale": ("norm",), "bias": ("norm",)},
+        "blocks": {
+            "layer_norm1": {"scale": ("layers", "norm"), "bias": ("layers", "norm")},
+            "layer_norm2": {"scale": ("layers", "norm"), "bias": ("layers", "norm")},
+            "q_proj": {"kernel": ("layers", "embed", "heads"), "bias": ("layers", "heads")},
+            "k_proj": {"kernel": ("layers", "embed", "heads"), "bias": ("layers", "heads")},
+            "v_proj": {"kernel": ("layers", "embed", "heads"), "bias": ("layers", "heads")},
+            "out_proj": {"kernel": ("layers", "heads", "embed"), "bias": ("layers", "norm")},
+            "fc1": {"kernel": ("layers", "embed", "mlp"), "bias": ("layers", "mlp")},
+            "fc2": {"kernel": ("layers", "mlp", "embed"), "bias": ("layers", "norm")},
+        },
+        "projector": proj2(cfg.projector_bias),
+        "patch_merger": proj2(cfg.patch_merge_bias),
+    }
+
+
+def _vision_angles(v: MiniMaxM3VisionConfig, gh: int, gw: int) -> jnp.ndarray:
+    """(gh·gw, 3·axis_dim/2) t/h/w angles in merge-block token order
+    (reference: vision_encoder.py:149 `_rope_position_freqs`; images t=0)."""
+    m = v.spatial_merge_size
+    ad = v.axis_dim
+    inv_freq = 1.0 / (v.rope_theta ** (jnp.arange(0, ad, 2, dtype=jnp.float32) / ad))
+    ys, xs = jnp.meshgrid(jnp.arange(gh), jnp.arange(gw), indexing="ij")
+
+    def merge_order(p):
+        p = p.reshape(gh // m, m, gw // m, m)
+        return jnp.transpose(p, (0, 2, 1, 3)).reshape(-1)
+
+    hpos, wpos = merge_order(ys), merge_order(xs)
+    h_ang = hpos[:, None].astype(jnp.float32) * inv_freq[None, :]
+    w_ang = wpos[:, None].astype(jnp.float32) * inv_freq[None, :]
+    t_ang = jnp.zeros_like(h_ang)
+    return jnp.concatenate([t_ang, h_ang, w_ang], axis=-1)
+
+
+def encode_images(params: dict, cfg: MiniMaxM3VLConfig, pixel_values: jnp.ndarray):
+    """pixel_values (B, H, W, 3) → (B, (gh/m)·(gw/m), text_hidden)."""
+    v = cfg.vision
+    B, Himg, Wimg, _ = pixel_values.shape
+    P, m = v.patch_size, v.spatial_merge_size
+    gh, gw = Himg // P, Wimg // P
+    D = v.hidden_size
+    vp = params["visual"]
+    dtype = vp["blocks"]["q_proj"]["kernel"].dtype
+
+    pix = jnp.concatenate([pixel_values] * v.temporal_patch_size, axis=-1)
+    x = jax.lax.conv_general_dilated(
+        pix.astype(dtype), vp["patch_embed"]["kernel"].astype(dtype),
+        window_strides=(P, P), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    # merge-block token order: each m×m spatial block contiguous
+    x = x.reshape(B, gh // m, m, gw // m, m, D)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(B, gh * gw, D)
+    x = _layer_norm(x, vp["pre_layrnorm"])
+
+    angles = _vision_angles(v, gh, gw)[None]  # (1, N, 3·ad/2)
+    Hn, hd = v.num_heads, v.head_dim
+
+    def block(x, lp):
+        y = _layer_norm(x, lp["layer_norm1"])
+        q = (y @ lp["q_proj"]["kernel"] + lp["q_proj"]["bias"]).reshape(B, -1, Hn, hd)
+        k = (y @ lp["k_proj"]["kernel"] + lp["k_proj"]["bias"]).reshape(B, -1, Hn, hd)
+        vv = (y @ lp["v_proj"]["kernel"] + lp["v_proj"]["bias"]).reshape(B, -1, Hn, hd)
+        q = apply_rope(q, None, angles)
+        k = apply_rope(k, None, angles)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+        p = jax.nn.softmax(s * (hd ** -0.5), axis=-1).astype(vv.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", p, vv).reshape(B, -1, D)
+        x = x + attn @ lp["out_proj"]["kernel"] + lp["out_proj"]["bias"]
+        y = _layer_norm(x, lp["layer_norm2"])
+        h = jax.nn.gelu(y @ lp["fc1"]["kernel"] + lp["fc1"]["bias"], approximate=False)
+        return x + h @ lp["fc2"]["kernel"] + lp["fc2"]["bias"]
+
+    def one(carry, lp):
+        return block(carry, lp), None
+
+    x, _ = jax.lax.scan(one, x, vp["blocks"])
+
+    def proj2(x, pp):
+        b1 = pp["linear_1"].get("bias")
+        b2 = pp["linear_2"].get("bias")
+        h = x @ pp["linear_1"]["kernel"].astype(x.dtype)
+        if b1 is not None:
+            h = h + b1.astype(x.dtype)
+        h = jax.nn.gelu(h, approximate=False)
+        h = h @ pp["linear_2"]["kernel"].astype(x.dtype)
+        if b2 is not None:
+            h = h + b2.astype(x.dtype)
+        return h
+
+    x = proj2(x, vp["projector"])                       # (B, N, text)
+    T = x.shape[-1]
+    x = x.reshape(B, (gh // m) * (gw // m), m * m * T)  # m² consecutive → 1
+    return proj2(x, vp["patch_merger"])
+
+
+# ---------------------------------------------------------------------------
+# full model
+# ---------------------------------------------------------------------------
+def init(cfg: MiniMaxM3VLConfig, rng: jax.Array) -> dict:
+    kv, kt = jax.random.split(rng)
+    return {
+        "visual": init_vision(cfg, kv),
+        "language_model": het_moe.init(cfg.text, kt),
+    }
+
+
+def param_specs(cfg: MiniMaxM3VLConfig) -> dict:
+    return {
+        "visual": vision_param_specs(cfg),
+        "language_model": het_moe.param_specs(cfg.text),
+    }
+
+
+def forward(
+    params: dict,
+    cfg: MiniMaxM3VLConfig,
+    input_ids: jnp.ndarray,
+    pixel_values: jnp.ndarray,
+    *,
+    positions=None,
+    segment_ids=None,
+    mesh_ctx=None,
+    rules=None,
+    return_hidden: bool = False,
+    token_mask=None,
+    return_stats: bool = False,
+):
+    """Returns (out, aux_loss[, stats]) — the MoE module protocol."""
+    image_embeds = encode_images(params, cfg, pixel_values)
+    lm = params["language_model"]
+    token_embeds = jnp.take(
+        lm["embed"]["embedding"], input_ids, axis=0
+    ).astype(cfg.dtype)
+    merged = merge_image_embeddings(
+        token_embeds, image_embeds, input_ids == cfg.image_token_id
+    )
+    return het_moe.forward(
+        lm, cfg.text, input_ids,
+        positions=positions, segment_ids=segment_ids,
+        mesh_ctx=mesh_ctx, rules=rules,
+        return_hidden=return_hidden, inputs_embeds=merged,
+        token_mask=token_mask, return_stats=return_stats,
+    )
+
+
+def apply_gate_bias_update(params: dict, cfg: MiniMaxM3VLConfig, tokens_per_expert):
+    lm = het_moe.apply_gate_bias_update(
+        params["language_model"], cfg.text, tokens_per_expert
+    )
+    return {**params, "language_model": lm}
+
+
+# ---------------------------------------------------------------------------
+# HF state-dict adapter
+# ---------------------------------------------------------------------------
+class MiniMaxM3VLAdapter:
+    """HF layout (reference: minimax_m3_vl/state_dict_adapter.py:318): text
+    under `language_model.model.*` / `language_model.lm_head.weight`, tower
+    under `vision_tower.vision_model.*`, projector / patch merger TOP-LEVEL
+    (`multi_modal_projector.*`, `patch_merge_mlp.*`). Text tensors delegate
+    to the het_moe adapter (style minimax_m3)."""
+
+    _LN = [("weight", "scale"), ("bias", "bias")]
+    _BLK = [
+        ("layer_norm1.weight", ("layer_norm1", "scale"), False),
+        ("layer_norm1.bias", ("layer_norm1", "bias"), False),
+        ("layer_norm2.weight", ("layer_norm2", "scale"), False),
+        ("layer_norm2.bias", ("layer_norm2", "bias"), False),
+        ("self_attn.q_proj.weight", ("q_proj", "kernel"), True),
+        ("self_attn.q_proj.bias", ("q_proj", "bias"), False),
+        ("self_attn.k_proj.weight", ("k_proj", "kernel"), True),
+        ("self_attn.k_proj.bias", ("k_proj", "bias"), False),
+        ("self_attn.v_proj.weight", ("v_proj", "kernel"), True),
+        ("self_attn.v_proj.bias", ("v_proj", "bias"), False),
+        ("self_attn.out_proj.weight", ("out_proj", "kernel"), True),
+        ("self_attn.out_proj.bias", ("out_proj", "bias"), False),
+        ("mlp.fc1.weight", ("fc1", "kernel"), True),
+        ("mlp.fc1.bias", ("fc1", "bias"), False),
+        ("mlp.fc2.weight", ("fc2", "kernel"), True),
+        ("mlp.fc2.bias", ("fc2", "bias"), False),
+    ]
+
+    def __init__(self, cfg: MiniMaxM3VLConfig):
+        self.cfg = cfg
+
+    def _lm(self):
+        from automodel_tpu.models.moe_lm.het_families import HetMoEAdapter
+
+        return HetMoEAdapter(self.cfg.text, style="minimax_m3")
+
+    def _proj2_entries(self, node: str, bias: bool):
+        e = [
+            (f"{node}.linear_1.weight", ("linear_1", "kernel"), True),
+            (f"{node}.linear_2.weight", ("linear_2", "kernel"), True),
+        ]
+        if bias:
+            e += [
+                (f"{node}.linear_1.bias", ("linear_1", "bias"), False),
+                (f"{node}.linear_2.bias", ("linear_2", "bias"), False),
+            ]
+        return e
+
+    def from_hf(self, read, shardings=None) -> dict:
+        import numpy as np
+
+        from automodel_tpu.checkpoint.hf_adapter import _get, _set, memo1_reader
+
+        read = memo1_reader(read)
+        v = self.cfg.vision
+        params: dict = {}
+
+        def put(path, value):
+            sh = _get(shardings, path) if shardings is not None else None
+            _set(
+                params, path,
+                jax.device_put(value, sh) if sh is not None else jnp.asarray(value),
+            )
+
+        def one(name, tr):
+            x = np.asarray(read(name))
+            return np.ascontiguousarray(x.T) if tr else x
+
+        # Conv3d (D, 3, tp, P, P) → channel-folded HWIO (P, P, tp*3, D)
+        w = np.asarray(read("vision_tower.vision_model.embeddings.patch_embedding.weight"))
+        D_, C3, TP, P_, _ = w.shape
+        w = np.transpose(w, (3, 4, 2, 1, 0)).reshape(P_, P_, TP * C3, D_)
+        put(("visual", "patch_embed", "kernel"), np.ascontiguousarray(w))
+        for hf_s, nat in self._LN:
+            put(
+                ("visual", "pre_layrnorm", nat),
+                one(f"vision_tower.vision_model.pre_layrnorm.{hf_s}", False),
+            )
+        for suf, path, tr in self._BLK:
+            put(
+                ("visual", "blocks") + path,
+                np.stack([
+                    one(f"vision_tower.vision_model.encoder.layers.{i}.{suf}", tr)
+                    for i in range(v.num_layers)
+                ]),
+            )
+        for node, key, bias in (
+            ("multi_modal_projector", "projector", self.cfg.projector_bias),
+            ("patch_merge_mlp", "patch_merger", self.cfg.patch_merge_bias),
+        ):
+            for suf, path, tr in self._proj2_entries(node, bias):
+                put(("visual", key) + path, one(suf, tr))
+
+        def lm_read(name):
+            return read("language_model." + name)
+
+        lm_sh = _get(shardings, ("language_model",)) if shardings is not None else None
+        params["language_model"] = self._lm().from_hf(lm_read, shardings=lm_sh)
+        return params
+
+    def to_hf(self, params):
+        import numpy as np
+
+        from automodel_tpu.checkpoint.hf_adapter import _get
+
+        v = self.cfg.vision
+
+        def _t(x):
+            return np.ascontiguousarray(np.asarray(x).T)
+
+        vis = params["visual"]
+        k = np.asarray(vis["patch_embed"]["kernel"])  # (P,P,tp*3,D)
+        P_, _, Ctp, D_ = k.shape
+        k = k.reshape(P_, P_, Ctp // 3, 3, D_)
+        yield (
+            "vision_tower.vision_model.embeddings.patch_embedding.weight",
+            np.ascontiguousarray(np.transpose(k, (4, 3, 2, 0, 1))),
+        )
+        for hf_s, nat in self._LN:
+            yield (
+                f"vision_tower.vision_model.pre_layrnorm.{hf_s}",
+                np.asarray(vis["pre_layrnorm"][nat]),
+            )
+        for i in range(v.num_layers):
+            for suf, path, tr in self._BLK:
+                x = np.asarray(_get(vis["blocks"], path)[i])
+                yield (
+                    f"vision_tower.vision_model.encoder.layers.{i}.{suf}",
+                    (_t(x) if tr else x),
+                )
+        for node, key, bias in (
+            ("multi_modal_projector", "projector", self.cfg.projector_bias),
+            ("patch_merge_mlp", "patch_merger", self.cfg.patch_merge_bias),
+        ):
+            for suf, path, tr in self._proj2_entries(node, bias):
+                x = np.asarray(_get(vis[key], path))
+                yield suf, (_t(x) if tr else x)
+
+        for name, tensor in self._lm().to_hf(params["language_model"]):
+            yield "language_model." + name, tensor
+
+
+def _register_adapter():
+    from automodel_tpu.checkpoint.hf_adapter import ADAPTERS
+
+    ADAPTERS["minimax_m3_vl"] = MiniMaxM3VLAdapter
+
+
+_register_adapter()

@@ -111,15 +111,22 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
         return loss_fn
 
     def _update_gate_bias(self, tokens_per_expert) -> None:
-        """DeepSeek aux-free balancing on the nested text backbone."""
-        from automodel_tpu.models.moe_lm.decoder import apply_gate_bias_update
+        """DeepSeek aux-free balancing on the nested text backbone. A VL
+        module may provide its own apply_gate_bias_update over FULL params
+        (minimax_m3_vl: the het-engine gate layout); the moe_lm decoder's
+        nested-language_model update is the default."""
+        own = getattr(self.model_spec.module, "apply_gate_bias_update", None)
+        if own is not None:
+            params = own(self.train_state.params, self.model_cfg, tokens_per_expert)
+        else:
+            from automodel_tpu.models.moe_lm.decoder import apply_gate_bias_update
 
-        lm = apply_gate_bias_update(
-            self.train_state.params["language_model"],
-            self.model_cfg.text,
-            tokens_per_expert,
-        )
-        params = {**self.train_state.params, "language_model": lm}
+            lm = apply_gate_bias_update(
+                self.train_state.params["language_model"],
+                self.model_cfg.text,
+                tokens_per_expert,
+            )
+            params = {**self.train_state.params, "language_model": lm}
         self.train_state = self.train_state._replace(params=params)
 
     # media tensors shard on the batch axis only (their inner dims are

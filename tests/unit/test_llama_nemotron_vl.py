@@ -1,0 +1,123 @@
+"""Llama-Nemotron VL: SigLIP tower + pixel-shuffle + mlp1 + bidirectional
+llama retrieval embeddings (reference: models/llama_nemotron_vl/model.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu.models.registry import get_model_spec
+from automodel_tpu.models.vlm import llama_nemotron_vl as lnv
+
+LNV_HF = {
+    "architectures": ["LlamaNemotronVLModel"],
+    "model_type": "llama_nemotron_vl",
+    "img_context_token_id": 120,
+    "downsample_ratio": 0.5,
+    "select_layer": -1,
+    "pooling": "avg",
+    "vision_config": {
+        "model_type": "siglip_vision_model",
+        "hidden_size": 32, "intermediate_size": 48, "num_hidden_layers": 2,
+        "num_attention_heads": 2, "image_size": 56, "patch_size": 14,
+        "hidden_act": "gelu_pytorch_tanh",
+    },
+    "llm_config": {
+        "architectures": ["LlamaBidirectionalModel"],
+        "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "num_key_value_heads": 2, "pooling": "avg",
+    },
+}
+
+
+def _setup():
+    spec = get_model_spec(LNV_HF)
+    cfg = spec.config_from_hf(LNV_HF, dtype=jnp.float32, remat_policy="none")
+    return spec, cfg, lnv.init(cfg, jax.random.key(0))
+
+
+def _batch(cfg, B=2, S=24):
+    n_img = cfg.num_image_token  # (56/14)² · 0.25 = 4
+    rng = np.random.default_rng(0)
+    text = rng.integers(1, 100, (B, S - n_img), dtype=np.int32)
+    ids = np.concatenate(
+        [text[:, :3], np.full((B, n_img), 120, np.int32), text[:, 3:]], axis=1
+    )
+    pixels = rng.normal(size=(B, 56, 56, 3)).astype(np.float32)
+    return jnp.asarray(ids), jnp.asarray(pixels)
+
+
+def test_config_and_token_count():
+    spec, cfg, params = _setup()
+    assert cfg.text.causal is False
+    assert cfg.num_image_token == 4
+    assert cfg.vision.use_cls_token is False
+    r = int(1 / cfg.downsample_ratio)
+    assert params["mlp1"]["norm"]["scale"].shape == (32 * r * r,)
+
+
+def test_pixel_shuffle_is_exact_space_to_depth():
+    """Pinned to the reference view/permute sequence (model.py:627)."""
+    x = jnp.arange(1 * 4 * 4 * 2, dtype=jnp.float32).reshape(1, 4, 4, 2)
+    y = lnv.pixel_shuffle(x, 0.5)
+    assert y.shape == (1, 2, 2, 8)
+    xs = np.asarray(x)
+
+    # replicate torch view/permute/contiguous-view semantics with numpy
+    t = xs.reshape(1, 4, 2, 4)            # view(n, w, h*s, c/s)
+    t = np.transpose(t, (0, 2, 1, 3))     # permute
+    t = np.ascontiguousarray(t).reshape(1, 2, 2, 8)
+    t = np.transpose(t, (0, 2, 1, 3))
+    np.testing.assert_array_equal(np.asarray(y), t)
+
+
+def test_forward_and_embed():
+    spec, cfg, params = _setup()
+    ids, pixels = _batch(cfg)
+    hidden = lnv.forward(params, cfg, ids, pixels)
+    assert hidden.shape == (2, 24, 32)
+    assert np.isfinite(np.asarray(hidden)).all()
+    # image changes the embedding
+    mask = jnp.ones(ids.shape, jnp.int32)
+    e1 = lnv.embed(params, cfg, ids, pixels, mask)
+    e2 = lnv.embed(params, cfg, ids, pixels + 1.0, mask)
+    assert e1.shape == (2, 32)
+    assert np.abs(np.asarray(e1) - np.asarray(e2)).max() > 1e-6
+    # pooling variants
+    assert lnv.embed(params, cfg, ids, pixels, mask, pooling="last").shape == (2, 32)
+    assert lnv.embed(params, cfg, ids, pixels, mask, pooling="cls").shape == (2, 32)
+
+
+def test_bidirectional_attention():
+    """Non-causal: a change in a LATE token influences an EARLY position's
+    hidden state (impossible under causal masking)."""
+    spec, cfg, params = _setup()
+    ids, pixels = _batch(cfg, B=1)
+    h1 = lnv.forward(params, cfg, ids, pixels)
+    ids2 = ids.at[0, -1].set(int(ids[0, -1]) % 100 + 1)
+    h2 = lnv.forward(params, cfg, ids2, pixels)
+    assert np.abs(np.asarray(h1[0, 0]) - np.asarray(h2[0, 0])).max() > 1e-7
+
+
+@pytest.mark.slow
+def test_adapter_roundtrip():
+    from automodel_tpu.checkpoint.hf_adapter import get_adapter
+
+    spec, cfg, params = _setup()
+    ad = get_adapter(spec.adapter_name, cfg, **spec.adapter_kwargs)
+    sd = dict(ad.to_hf(params))
+    assert "vision_model.vision_model.embeddings.patch_embedding.weight" in sd
+    assert sd["mlp1.0.weight"].shape == (128,)   # LN over 4·Hv
+    assert sd["mlp1.1.weight"].shape == (32, 128)
+    assert "language_model.embed_tokens.weight" in sd      # bare LlamaModel
+    assert "language_model.model.embed_tokens.weight" not in sd
+    assert not any("lm_head" in k for k in sd)
+    p2 = ad.from_hf(lambda k: np.asarray(sd[k]))
+    # checkpoint has no head → restore drops the leaf; compare hidden states
+    p2["language_model"]["lm_head"] = params["language_model"]["lm_head"]
+    ids, pixels = _batch(cfg, B=1)
+    h1 = lnv.forward(params, cfg, ids, pixels)
+    h2 = lnv.forward(jax.tree.map(jnp.asarray, p2), cfg, ids, pixels)
+    np.testing.assert_allclose(np.asarray(h1), np.asarray(h2), atol=1e-5)
