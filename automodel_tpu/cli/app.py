@@ -28,7 +28,9 @@ RECIPE_ALIASES = {
     "llm_train_eagle3": "automodel_tpu.recipes.llm.train_eagle3.TrainEagle3Recipe",
     "llm_train_eagle1": "automodel_tpu.recipes.llm.train_eagle1.TrainEagle1Recipe",
     "llm_train_eagle2": "automodel_tpu.recipes.llm.train_eagle1.TrainEagle2Recipe",
+    "llm_train_dflash": "automodel_tpu.recipes.llm.train_dflash.TrainDFlashRecipe",
     "llm_spec_bench": "automodel_tpu.recipes.llm.spec_bench.SpecAcceptanceBenchRecipe",
+    "llm_dflash_decode_eval": "automodel_tpu.recipes.llm.spec_bench.DFlashDecodeEvalRecipe",
     "dllm_train_ft": "automodel_tpu.recipes.dllm.train_ft.DiffusionLMSFTRecipe",
     "diffusion_train": "automodel_tpu.recipes.diffusion.train.TrainDiffusionRecipe",
     "vlm_finetune": "automodel_tpu.recipes.vlm.finetune.FinetuneRecipeForVLM",
@@ -90,7 +92,8 @@ def print_capabilities() -> None:
             "lora_peft", "knowledge_distillation", "mtp", "fp8_int8_matmul",
             "dropless_moe", "attention_sinks", "kv_cache_generation",
             "mla_latent_cache_decode", "vlm_generation", "chunked_sparse_dsa",
-            "speculative_eagle1_eagle3", "acceptance_length_bench",
+            "speculative_eagle1_eagle3", "speculative_dflash_jetspec",
+            "dflash_decode_eval", "acceptance_length_bench",
             "sampling_eval", "agent_tool_call_sft", "neat_packing",
             "orbax_checkpointing", "hf_safetensors_io", "golden_value_ci",
             "profiler_traces", "wandb_mlflow_trackers",

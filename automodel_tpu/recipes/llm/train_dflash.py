@@ -1,0 +1,175 @@
+"""DFlash block-parallel speculative draft training recipe.
+
+The analog of the reference trainer (reference: nemo_automodel/recipes/llm/
+train_dflash.py, 999 LoC + components/speculative/dflash/): a frozen target
+produces tap-layer hidden states online, the draft trains with the
+block-wise decay-weighted CE (fixed-anchor "dflash" or D2SD
+"variable_prefix"), and block acceptance length is tracked in the metrics
+JSONL. Also covers the JetSpec objective via
+`speculative.causal_blocks: true` (in-block-causal mask,
+reference: dflash/jetspec_core.py).
+
+Reuses the EAGLE-3 recipe's target-build chassis — only the drafter and the
+loss differ. YAML:
+
+    recipe: llm_train_dflash
+    target_model: {hf_config: {...} | pretrained_path: ...}
+    speculative:
+      block_size: 8
+      num_anchors: 64
+      mask_token_id: 0           # tokenizer's MASK/pad id
+      loss_type: dflash          # | variable_prefix
+      loss_decay_gamma: 4.0
+      num_layers: 2              # draft depth (also # target tap layers)
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.parallel import logical_to_shardings
+from automodel_tpu.recipes.llm.train_eagle3 import TrainEagle3Recipe
+from automodel_tpu.recipes.llm.train_ft import _DTYPES
+from automodel_tpu.speculative.dflash import (
+    DFlashConfig,
+    build_target_layer_ids,
+    dflash_block_loss,
+    drafter_param_specs,
+    init_drafter,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class TrainDFlashRecipe(TrainEagle3Recipe):
+    def _build_drafter(self) -> None:
+        cfg = self.cfg
+        scfg = cfg.get("speculative")
+        g = (lambda k, d: (scfg.get(k, d) if scfg else d))
+        t = self.target_cfg
+        L_draft = int(g("num_layers", 2))
+        tap_ids = g("target_layer_ids", None)
+        if tap_ids is None:
+            tap_ids = build_target_layer_ids(t.num_layers, L_draft)
+        self.aux_layer_ids = tuple(int(i) for i in tap_ids)
+        if min(self.aux_layer_ids) < 0 or max(self.aux_layer_ids) >= t.num_layers:
+            raise ValueError(
+                f"speculative.target_layer_ids={self.aux_layer_ids} out of "
+                f"range for a {t.num_layers}-layer target"
+            )
+        self.dflash_cfg = DFlashConfig(
+            vocab_size=t.vocab_size,
+            hidden_size=int(g("hidden_size", 0)) or t.hidden_size,
+            intermediate_size=int(g("intermediate_size", 0)) or t.intermediate_size,
+            num_heads=int(g("num_heads", 0)) or t.num_heads,
+            num_kv_heads=int(g("num_kv_heads", 0)) or t.num_kv_heads,
+            num_layers=L_draft,
+            target_hidden_size=t.hidden_size,
+            num_target_layers_used=len(self.aux_layer_ids),
+            block_size=int(g("block_size", 8)),
+            num_anchors=int(g("num_anchors", 64)),
+            mask_token_id=int(g("mask_token_id", 0)),
+            loss_type=str(g("loss_type", "dflash")),
+            loss_decay_gamma=(
+                float(g("loss_decay_gamma", 0)) or None
+            ),
+            prefix_weight_base=float(g("prefix_weight_base", 0.9)),
+            causal_blocks=bool(g("causal_blocks", False)),
+            rope_theta=t.rope_theta,
+            dtype=_DTYPES[g("dtype", "float32")],
+        )
+        params = init_drafter(self.dflash_cfg, jax.random.key(int(cfg.get("seed", 42))))
+        dshardings = logical_to_shardings(
+            drafter_param_specs(self.dflash_cfg), self.mesh_ctx,
+            shapes=jax.tree.map(lambda p: p.shape, params),
+        )
+        self._init_params = jax.device_put(params, dshardings)
+        self.model_cfg = self.target_cfg
+        self.model_spec = self.target_spec
+        self.peft_cfg = None
+        self.is_moe = False  # the TRAINED model (draft) is dense
+
+    def _make_loss_fn(self):
+        dcfg = self.dflash_cfg
+        target_cfg = self.target_cfg
+        target_module = self.target_spec.module
+        aux_ids = self.aux_layer_ids
+        mesh_ctx = self.mesh_ctx
+        target_is_moe = self.target_is_moe
+        accum = float(self.cfg.get("dataloader.grad_acc_steps", 1))
+
+        def loss_fn(params, batch, rng, target_params):
+            ids = batch["input_ids"]
+            loss_mask = batch["labels"] != -100
+            kw = {}
+            for k in ("positions", "segment_ids"):
+                if k in batch:
+                    kw[k] = batch[k]
+            if target_is_moe:
+                (logits, aux_h), _ = jax.lax.stop_gradient(
+                    target_module.forward(
+                        target_params, target_cfg, ids,
+                        mesh_ctx=mesh_ctx, return_aux_hidden=aux_ids,
+                        token_mask=loss_mask, **kw,
+                    )
+                )
+            else:
+                logits, aux_h = jax.lax.stop_gradient(
+                    target_module.forward(
+                        target_params, target_cfg, ids,
+                        mesh_ctx=mesh_ctx, return_aux_hidden=aux_ids, **kw,
+                    )
+                )
+            del logits  # DFlash conditions on hidden states only
+            A = aux_h.shape[0]
+            B, S = ids.shape
+            # concat the tap layers along features (dflash/draft_qwen3.py:205
+            # extract_context_feature)
+            ctx = jnp.moveaxis(aux_h, 0, -2).reshape(B, S, A * aux_h.shape[-1])
+            lm_head = (
+                target_params["embed"]["embedding"].T
+                if getattr(target_cfg, "tie_word_embeddings", False)
+                else target_params["lm_head"]["kernel"]
+            )
+            loss, m = dflash_block_loss(
+                params, dcfg, ids, ctx, loss_mask, rng,
+                target_params["embed"]["embedding"], lm_head,
+                positions=kw.get("positions"),
+                segment_ids=kw.get("segment_ids"),
+            )
+            return loss, {
+                "num_label_tokens": jnp.float32(1.0),
+                "supervised_tokens": m["valid_tokens"],
+                "draft_accuracy": m["accuracy"] / accum,
+                "accept_length": m["accept_length"] / accum,
+                "valid_blocks": m["valid_blocks"] / accum,
+            }
+
+        return loss_fn
+
+    def save_consolidated_hf(self, out_dir=None):
+        """Serve-ready draft export (SpecForge/SGLang DFlash layout:
+        model.layers.{i}.* + model.fc + model.hidden_norm + model.norm, no
+        embed/lm_head — serving reuses the target's) + config.json carrying
+        dflash_config (reference: dflash/draft_qwen3.py:228)."""
+        import os
+
+        from automodel_tpu.checkpoint.hf_adapter import save_hf_checkpoint
+        from automodel_tpu.speculative.dflash import drafter_hf_config, drafter_to_hf
+
+        out_dir = out_dir or os.path.join(
+            self.cfg.get("checkpoint.checkpoint_dir", "checkpoints"), "hf_draft"
+        )
+        params = jax.device_get(self.train_state.params)
+        sd = drafter_to_hf(params, self.dflash_cfg)
+        save_hf_checkpoint(
+            sd.items(), out_dir,
+            hf_config=drafter_hf_config(
+                self.dflash_cfg, self.aux_layer_ids, self._target_hf_config
+            ),
+        )
+        logger.info("DFlash draft (serve layout) written to %s", out_dir)
+        return out_dir

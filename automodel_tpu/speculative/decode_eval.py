@@ -1,0 +1,142 @@
+"""Offline speculative-decode evaluation for DFlash drafts.
+
+The analog of the reference's decode_eval (reference: components/
+speculative/decode_eval.py + dflash/draft_qwen3.py:322 `spec_generate`):
+run the REAL block-draft → target-verify loop offline and measure accepted
+tokens per round. Greedy speculative decoding is lossless — the committed
+tokens equal the target's own greedy continuation — which doubles as the
+correctness check (tests compare against `inference.generate`).
+
+TPU design: static shapes throughout — the token buffer is padded to
+`prompt + max_new + block_size` and every round runs (a) one full-length
+target forward (positions past the frontier are garbage but, under causal
+attention, cannot influence earlier positions) and (b) one draft forward
+over a single anchored block; the frontier index is a traced scalar, so the
+whole round jits once. O(rounds × full-forward) — an EVAL loop, not a
+serving engine (the reference's serving half drives vLLM/SGLang instead).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.speculative.dflash import (
+    DFlashConfig,
+    dflash_mask,
+    drafter_forward,
+)
+
+
+@partial(jax.jit, static_argnames=("target_module", "target_cfg", "dcfg", "tap_ids", "target_is_moe"))
+def _target_pass(target_module, target_cfg, dcfg, tap_ids, target_is_moe,
+                 target_params, buffer_ids):
+    """Full-length target forward → (logits, concat tap hidden)."""
+    if target_is_moe:
+        (logits, aux_h), _ = target_module.forward(
+            target_params, target_cfg, buffer_ids, return_aux_hidden=tap_ids
+        )
+    else:
+        logits, aux_h = target_module.forward(
+            target_params, target_cfg, buffer_ids, return_aux_hidden=tap_ids
+        )
+    A = aux_h.shape[0]
+    B, S = buffer_ids.shape
+    ctx = jnp.moveaxis(aux_h, 0, -2).reshape(B, S, A * aux_h.shape[-1])
+    return logits, ctx
+
+
+@partial(jax.jit, static_argnames=("dcfg",))
+def _draft_block(dcfg: DFlashConfig, draft_params, embed_table, lm_head_kernel,
+                 buffer_ids, ctx, start):
+    """Draft one block anchored at `start`; returns (bs-1,) drafted ids."""
+    B, L = buffer_ids.shape
+    bs = dcfg.block_size
+    anchor_tok = jax.lax.dynamic_index_in_dim(buffer_ids[0], start, keepdims=False)
+    noise_ids = jnp.full((B, bs), dcfg.mask_token_id, jnp.int32)
+    noise_ids = noise_ids.at[:, 0].set(anchor_tok)
+    noise_embedding = jnp.take(embed_table, noise_ids, axis=0)
+
+    positions = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[None], (B, L))
+    draft_positions = (start + jnp.arange(bs, dtype=jnp.int32))[None]
+    anchors = jnp.full((B, 1), start, jnp.int32)
+    keep = jnp.ones((B, 1), bool)
+    mask = dflash_mask(anchors, keep, L, bs, dcfg.causal_blocks)
+
+    hidden = drafter_forward(
+        draft_params, dcfg, noise_embedding, ctx, positions, draft_positions, mask
+    )
+    logits = jnp.einsum(
+        "bqh,hv->bqv", hidden, lm_head_kernel.astype(hidden.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return jnp.argmax(logits[0, 1:], axis=-1).astype(jnp.int32)  # (bs-1,)
+
+
+def dflash_decode(
+    target_module,
+    target_cfg,
+    target_params,
+    draft_params,
+    dcfg: DFlashConfig,
+    tap_ids: tuple,
+    prompt_ids: jnp.ndarray,    # (1, S_prompt)
+    max_new_tokens: int,
+    target_is_moe: bool = False,
+) -> tuple[jnp.ndarray, dict]:
+    """Greedy block-speculative decode. Returns (output_ids (1, ≥S+new),
+    stats: rounds, accepted_per_round, tokens)."""
+    S = prompt_ids.shape[1]
+    bs = dcfg.block_size
+    L = S + max_new_tokens + bs
+    buf = jnp.zeros((1, L), jnp.int32)
+    buf = jax.lax.dynamic_update_slice(buf, prompt_ids.astype(jnp.int32), (0, 0))
+
+    embed_table = target_params["embed"]["embedding"]
+    lm_head = (
+        embed_table.T
+        if getattr(target_cfg, "tie_word_embeddings", False)
+        else target_params["lm_head"]["kernel"]
+    )
+
+    # bootstrap: the first committed continuation token at position S
+    logits, ctx = _target_pass(
+        target_module, target_cfg, dcfg, tap_ids, target_is_moe, target_params, buf
+    )
+    tok = jnp.argmax(logits[0, S - 1]).astype(jnp.int32)
+    buf = buf.at[0, S].set(tok)
+    start = S
+
+    accepted = []
+    while start < S + max_new_tokens:
+        draft = _draft_block(
+            dcfg, draft_params, embed_table, lm_head, buf, ctx, jnp.int32(start)
+        )
+        buf = jax.lax.dynamic_update_slice(buf, draft[None], (0, start + 1))
+        logits, ctx = _target_pass(
+            target_module, target_cfg, dcfg, tap_ids, target_is_moe, target_params, buf
+        )
+        # posterior[j] = greedy next token after position start+j
+        posterior = jnp.argmax(
+            jax.lax.dynamic_slice(logits, (0, start, 0), (1, bs, logits.shape[-1])),
+            axis=-1,
+        )[0].astype(jnp.int32)
+        match = (draft == posterior[: bs - 1]).astype(jnp.int32)
+        a = int(jnp.cumprod(match).sum())  # accepted draft tokens
+        # commit the accepted prefix + the bonus token from the verifier
+        buf = buf.at[0, start + a + 1].set(posterior[a])
+        accepted.append(a)
+        start = start + a + 1
+
+    out = buf[:, : min(start + 1, S + max_new_tokens)]
+    stats = {
+        "rounds": len(accepted),
+        "accepted_per_round": accepted,
+        "mean_accept_length": float(
+            sum(a + 1 for a in accepted) / max(len(accepted), 1)
+        ),
+        "tokens": int(out.shape[1] - S),
+    }
+    return out, stats

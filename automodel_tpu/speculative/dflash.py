@@ -1,0 +1,548 @@
+"""DFlash block-parallel speculative draft training, TPU-native.
+
+The analog of the reference's DFlash stack (reference: nemo_automodel/
+components/speculative/dflash/core.py `DFlashTrainerModule`,
+draft_qwen3.py `Qwen3DFlashDraftModel`, attention/dflash_mask.py,
+recipes/llm/train_dflash.py), re-designed for JAX:
+
+- The draft is a small non-causal qwen3-style stack over pure-function
+  pytrees: per layer, queries come from the noise (draft-block) tokens only
+  while keys/values are [projected-target-context | noise] — the context is
+  never queried from (draft_qwen3.py:76 docstring), halving attention
+  compute.
+- Anchor sampling is static-shape: N = min(num_anchors, max_anchor+1)
+  blocks always exist; per-sample shortfall is carried by `keep_mask`
+  (the reference's data-dependent `max_n` becomes a padded fixed N — the
+  jit-friendly equivalent; a batch with NO valid anchors yields weight 0
+  instead of the reference's NoValidAnchorsError, and the recipe surfaces
+  `valid_blocks == 0` in metrics).
+- The DFlash visibility mask is built densely in JAX exactly per
+  dflash_mask.py: block b's queries see (a) context strictly before
+  anchor_b (same packed document), (b) their own block — bidirectional for
+  DFlash, in-block-causal for JetSpec (`causal=True`); padding blocks keep
+  in-block attention so no softmax row is empty.
+- Both objectives: "dflash" (fixed anchor, decay w_k = exp(-(k-1)/gamma))
+  and "variable_prefix" (D2SD VP-Drafter: geometric-prior visible prefix,
+  decay re-anchored at the boundary) — core.py:24-35.
+- The draft has NO embed/lm_head of its own: noise ids embed through the
+  frozen TARGET table and logits come from the frozen TARGET head
+  (core.py:191-198) — threaded in as arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.layers import dense_init
+from automodel_tpu.ops.attention import NEG_INF
+from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.rope import apply_rope, rope_frequencies
+
+LOSS_TYPES = ("dflash", "variable_prefix")
+
+
+@dataclasses.dataclass(frozen=True)
+class DFlashConfig:
+    """Draft shape + block objective.
+
+    `target_hidden_size` × `num_target_layers_used` feed `fc`; the draft
+    runs at `hidden_size` (usually the target's)."""
+
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_heads: int
+    num_kv_heads: int
+    num_layers: int = 2
+    head_dim: Optional[int] = None
+    target_hidden_size: Optional[int] = None
+    num_target_layers_used: int = 2
+    block_size: int = 8
+    num_anchors: int = 64
+    mask_token_id: int = 0
+    loss_type: str = "dflash"
+    loss_decay_gamma: Optional[float] = None
+    prefix_weight_base: float = 0.9
+    causal_blocks: bool = False      # True = JetSpec in-block-causal mask
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-6
+    dtype: jnp.dtype = jnp.float32
+
+    def __post_init__(self):
+        if self.loss_type not in LOSS_TYPES:
+            raise ValueError(f"loss_type must be one of {LOSS_TYPES}")
+        if self.block_size < 2:
+            raise ValueError("block_size must be >= 2 (anchor + >=1 target)")
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def resolved_target_hidden(self) -> int:
+        return self.target_hidden_size or self.hidden_size
+
+    @property
+    def min_prefix(self) -> int:
+        """Smallest visible prefix for variable_prefix (core.py:208)."""
+        return min(2, self.block_size - 1)
+
+
+def build_target_layer_ids(num_target_layers: int, num_draft_layers: int) -> tuple:
+    """Spread `num_draft_layers` taps across the target depth
+    (reference: draft_qwen3.py:196)."""
+    if num_draft_layers == 1:
+        return (num_target_layers // 2,)
+    start, end = 1, num_target_layers - 3
+    span = max(end - start, 0)
+    return tuple(
+        int(round(start + (i * span) / (num_draft_layers - 1)))
+        for i in range(num_draft_layers)
+    )
+
+
+# ---------------------------------------------------------------------------
+# draft params
+# ---------------------------------------------------------------------------
+def init_drafter(cfg: DFlashConfig, rng: jax.Array) -> dict:
+    H, I, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    D = cfg.resolved_head_dim
+    Ht, A = cfg.resolved_target_hidden, cfg.num_target_layers_used
+    ks = jax.random.split(rng, 9)
+
+    def stack(k, shape):
+        return jnp.stack([dense_init(kk, shape) for kk in jax.random.split(k, L)])
+
+    return {
+        "fc": {"kernel": dense_init(ks[0], (Ht * A, H))},
+        "hidden_norm": {"scale": jnp.ones((H,))},
+        "layers": {
+            "input_norm": {"scale": jnp.ones((L, H))},
+            "q_proj": {"kernel": stack(ks[1], (H, cfg.num_heads * D))},
+            "k_proj": {"kernel": stack(ks[2], (H, cfg.num_kv_heads * D))},
+            "v_proj": {"kernel": stack(ks[3], (H, cfg.num_kv_heads * D))},
+            "o_proj": {"kernel": stack(ks[4], (cfg.num_heads * D, H))},
+            "q_norm": {"scale": jnp.ones((L, D))},
+            "k_norm": {"scale": jnp.ones((L, D))},
+            "post_attn_norm": {"scale": jnp.ones((L, H))},
+            "gate_proj": {"kernel": stack(ks[5], (H, I))},
+            "up_proj": {"kernel": stack(ks[6], (H, I))},
+            "down_proj": {"kernel": stack(ks[7], (I, H))},
+        },
+        "final_norm": {"scale": jnp.ones((H,))},
+    }
+
+
+def drafter_param_specs(cfg: DFlashConfig) -> dict:
+    return {
+        "fc": {"kernel": ("embed", None)},
+        "hidden_norm": {"scale": ("norm",)},
+        "layers": {
+            "input_norm": {"scale": ("layers", "norm")},
+            "q_proj": {"kernel": ("layers", "embed", "heads")},
+            "k_proj": {"kernel": ("layers", "embed", "kv_heads")},
+            "v_proj": {"kernel": ("layers", "embed", "kv_heads")},
+            "o_proj": {"kernel": ("layers", "heads", "embed")},
+            "q_norm": {"scale": ("layers", "norm")},
+            "k_norm": {"scale": ("layers", "norm")},
+            "post_attn_norm": {"scale": ("layers", "norm")},
+            "gate_proj": {"kernel": ("layers", "embed", "mlp")},
+            "up_proj": {"kernel": ("layers", "embed", "mlp")},
+            "down_proj": {"kernel": ("layers", "mlp", "embed")},
+        },
+        "final_norm": {"scale": ("norm",)},
+    }
+
+
+# ---------------------------------------------------------------------------
+# mask + forward
+# ---------------------------------------------------------------------------
+def dflash_mask(
+    anchors: jnp.ndarray,       # (B, N) anchor sequence positions
+    keep: jnp.ndarray,          # (B, N) bool valid blocks
+    ctx_len: int,
+    block_size: int,
+    causal: bool,
+    ctx_doc: jnp.ndarray | None = None,     # (B, S) packed doc ids
+    anchor_doc: jnp.ndarray | None = None,  # (B, N)
+) -> jnp.ndarray:
+    """(B, N·bs, S + N·bs) bool keep mask — dflash_mask.py semantics:
+    context strictly before the anchor (same doc under packing), own block
+    bidirectional (or in-block causal for JetSpec); padding blocks keep
+    in-block attention so no softmax row is empty."""
+    B, N = anchors.shape
+    bs = block_size
+    Q = N * bs
+    q_idx = jnp.arange(Q)
+    q_block = q_idx // bs
+    kv_ctx = jnp.arange(ctx_len)
+
+    anchor_q = jnp.take(anchors, q_block, axis=1)          # (B, Q)
+    ctx_vis = kv_ctx[None, None, :] < anchor_q[:, :, None]  # (B, Q, S)
+    if ctx_doc is not None:
+        adoc_q = jnp.take(anchor_doc, q_block, axis=1)
+        ctx_vis = ctx_vis & (ctx_doc[:, None, :] == adoc_q[:, :, None])
+    keep_q = jnp.take(keep, q_block, axis=1)               # (B, Q)
+    ctx_vis = ctx_vis & keep_q[:, :, None]
+
+    kv_noise = jnp.arange(Q)
+    noise_vis = q_block[:, None] == (kv_noise // bs)[None, :]   # (Q, Q)
+    if causal:
+        noise_vis = noise_vis & ((kv_noise % bs)[None, :] <= (q_idx % bs)[:, None])
+    noise_vis = jnp.broadcast_to(noise_vis[None], (B, Q, Q))
+    return jnp.concatenate([ctx_vis, noise_vis], axis=-1)
+
+
+def drafter_forward(
+    params: dict,
+    cfg: DFlashConfig,
+    noise_embedding: jnp.ndarray,   # (B, N·bs, H) target-embedded blocks
+    target_hidden: jnp.ndarray,     # (B, S, A·Ht) concatenated tap layers
+    ctx_positions: jnp.ndarray,     # (B, S) rope positions of the context
+    draft_positions: jnp.ndarray,   # (B, N·bs) rope positions of the blocks
+    mask: jnp.ndarray,              # (B, N·bs, S + N·bs) bool keep
+) -> jnp.ndarray:
+    """Returns final-normed draft hidden (B, N·bs, H). Logits come from the
+    frozen target lm_head outside (core.py:539)."""
+    dtype = cfg.dtype
+    D = cfg.resolved_head_dim
+    eps = cfg.rms_norm_eps
+    B, Q, _ = noise_embedding.shape
+
+    ctx = target_hidden.astype(dtype) @ params["fc"]["kernel"].astype(dtype)
+    ctx = rms_norm(ctx, params["hidden_norm"]["scale"], eps)
+    h = noise_embedding.astype(dtype)
+
+    inv_freq = rope_frequencies(D, cfg.rope_theta)
+    kv_positions = jnp.concatenate([ctx_positions, draft_positions], axis=1)
+
+    def layer(h, lp):
+        x = rms_norm(h, lp["input_norm"]["scale"], eps)
+        q = (x @ lp["q_proj"]["kernel"].astype(dtype)).reshape(B, Q, cfg.num_heads, D)
+        # keys/values over [context | noise]; the k/v projections see the
+        # PROJECTED context (fc+hidden_norm output), per draft_qwen3.py:123
+        kv_in = jnp.concatenate([ctx, x], axis=1)
+        k = (kv_in @ lp["k_proj"]["kernel"].astype(dtype)).reshape(
+            B, -1, cfg.num_kv_heads, D
+        )
+        v = (kv_in @ lp["v_proj"]["kernel"].astype(dtype)).reshape(
+            B, -1, cfg.num_kv_heads, D
+        )
+        q = rms_norm(q, lp["q_norm"]["scale"], eps)
+        k = rms_norm(k, lp["k_norm"]["scale"], eps)
+        q = apply_rope(q, draft_positions, inv_freq)
+        k = apply_rope(k, kv_positions, inv_freq)
+
+        Hq, Hkv = cfg.num_heads, cfg.num_kv_heads
+        G = Hq // Hkv
+        qg = q.reshape(B, Q, Hkv, G, D)
+        s = jnp.einsum("bqkgd,btkd->bkgqt", qg, k, preferred_element_type=jnp.float32)
+        s = jnp.where(mask[:, None, None, :, :], s * (D ** -0.5), NEG_INF)
+        p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+        attn = jnp.einsum("bkgqt,btkd->bqkgd", p, v).reshape(B, Q, Hq * D)
+        h = h + attn @ lp["o_proj"]["kernel"].astype(dtype)
+        x = rms_norm(h, lp["post_attn_norm"]["scale"], eps)
+        mlp = jax.nn.silu(x @ lp["gate_proj"]["kernel"].astype(dtype)) * (
+            x @ lp["up_proj"]["kernel"].astype(dtype)
+        )
+        return h + mlp @ lp["down_proj"]["kernel"].astype(dtype), None
+
+    h, _ = jax.lax.scan(layer, h, params["layers"])
+    return rms_norm(h, params["final_norm"]["scale"], eps)
+
+
+# ---------------------------------------------------------------------------
+# anchors + targets
+# ---------------------------------------------------------------------------
+def doc_remaining_from_segments(segment_ids: jnp.ndarray) -> jnp.ndarray:
+    """(B, S) count of REAL tokens after each position in its own document
+    (core.py:58 doc_id bookkeeping, reoriented to segment ids)."""
+    same = segment_ids[:, :, None] == segment_ids[:, None, :]   # (B, S, S)
+    later = jnp.arange(segment_ids.shape[1])
+    after = later[None, None, :] > later[None, :, None]
+    return jnp.sum(same & after, axis=-1).astype(jnp.int32)
+
+
+def sample_anchors(
+    rng: jax.Array,
+    cfg: DFlashConfig,
+    loss_mask: jnp.ndarray,              # (B, S) bool supervised
+    doc_remaining: jnp.ndarray | None,   # (B, S) packed-doc constraint
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Static-shape anchor sampling (core.py:220): uniformly random valid
+    positions, N = min(num_anchors, max_anchor+1) blocks padded by keep."""
+    B, S = loss_mask.shape
+    bs = cfg.block_size
+    max_anchor = max(S - bs, 0)
+    N = min(cfg.num_anchors, max_anchor + 1)
+
+    valid = loss_mask[:, : max_anchor + 1]
+    if doc_remaining is not None:
+        valid = valid & (doc_remaining[:, : max_anchor + 1] >= bs - 1)
+    counts = valid.sum(axis=1)                              # (B,)
+    pri = jax.random.uniform(rng, (B, max_anchor + 1))
+    pri = jnp.where(valid, pri, 2.0)
+    picked = jax.lax.top_k(-pri, N)[1]                      # N smallest pri
+    # invalid picks → a sentinel past the sequence so they sort to the END
+    # (the reference's masked_indices, core.py:263); otherwise a small
+    # invalid index would sort ahead of the real anchors and survive keep
+    picked_valid = jnp.take_along_axis(valid, picked, axis=1)
+    masked = jnp.where(picked_valid, picked, max_anchor + 2)
+    anchors = jnp.sort(masked, axis=1).astype(jnp.int32)
+    keep = jnp.arange(N)[None, :] < jnp.minimum(counts, N)[:, None]
+    anchors = jnp.where(keep, anchors, 0)
+    return anchors, keep
+
+
+def _block_targets(cfg, input_ids, loss_mask, anchors, keep, doc_remaining):
+    """(target_ids, block_mask) each (B, N, bs) — core.py:374."""
+    S = input_ids.shape[1]
+    offs = jnp.arange(cfg.block_size)[None, None, :]
+    label_idx = anchors[:, :, None] + offs
+    valid = label_idx < S
+    if doc_remaining is not None:
+        rem = jnp.take_along_axis(doc_remaining, anchors, axis=1)[:, :, None]
+        valid = valid & (offs <= rem)
+    safe = jnp.clip(label_idx, 0, S - 1)
+    tgt = jnp.take_along_axis(input_ids[:, None, :].repeat(anchors.shape[1], 1), safe, axis=2)
+    lm = jnp.take_along_axis(loss_mask[:, None, :].astype(jnp.float32).repeat(anchors.shape[1], 1), safe, axis=2)
+    return tgt, keep[:, :, None].astype(jnp.float32) * valid.astype(jnp.float32) * lm
+
+
+def compute_accept_len(pred, tgt, valid):
+    """(B, N) accepted-prefix lengths (core.py:120)."""
+    correct = (pred == tgt) | (~valid)
+    prefix = jnp.cumprod(correct.astype(jnp.int32), axis=2) * valid.astype(jnp.int32)
+    return prefix.sum(axis=2).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# training loss
+# ---------------------------------------------------------------------------
+def dflash_block_loss(
+    draft_params: dict,
+    cfg: DFlashConfig,
+    input_ids: jnp.ndarray,        # (B, S)
+    target_hidden: jnp.ndarray,    # (B, S, A·Ht) concatenated tap layers
+    loss_mask: jnp.ndarray,        # (B, S) bool supervised
+    rng: jax.Array,
+    embed_table: jnp.ndarray,      # frozen target (V, H)
+    lm_head_kernel: jnp.ndarray,   # frozen target (H, V)
+    positions: jnp.ndarray | None = None,
+    segment_ids: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, dict]:
+    """One DFlash training step's loss + metrics (core.py:506 forward)."""
+    B, S = input_ids.shape
+    bs = cfg.block_size
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
+    packed = segment_ids is not None
+    doc_remaining = doc_remaining_from_segments(segment_ids) if packed else None
+
+    r_anchor, r_prefix = jax.random.split(rng)
+    anchors, keep = sample_anchors(r_anchor, cfg, loss_mask.astype(bool), doc_remaining)
+    N = anchors.shape[1]
+    offs = jnp.arange(bs)[None, None, :]
+
+    # noise block ids: [anchor, MASK, ...] or a sampled visible prefix (VP)
+    token_pos = anchors[:, :, None] + offs
+    safe_pos = jnp.clip(token_pos, 0, S - 1)
+    real = jnp.take_along_axis(input_ids[:, None, :].repeat(N, 1), safe_pos, axis=2)
+    if cfg.loss_type == "variable_prefix":
+        lo, hi = cfg.min_prefix, bs - 1
+        if hi <= lo:
+            prefix_len = jnp.full((B, N), lo, jnp.int32)
+        else:
+            w = cfg.prefix_weight_base ** jnp.arange(lo, hi + 1, dtype=jnp.float32)
+            prefix_len = lo + jax.random.categorical(
+                r_prefix, jnp.log(w)[None, :], shape=(B, N)
+            ).astype(jnp.int32)
+        visible = offs < prefix_len[:, :, None]
+    else:
+        prefix_len = None
+        visible = offs < 1                                     # anchor only
+    fill = visible & keep[:, :, None] & (token_pos < S)
+    noise_ids = jnp.where(fill, real, cfg.mask_token_id).reshape(B, N * bs)
+    noise_embedding = jnp.take(embed_table, noise_ids, axis=0)
+
+    # block rope positions continue the anchor's (document-local) position
+    base = jnp.take_along_axis(positions, anchors, axis=1)[:, :, None]
+    draft_positions = (base + offs).reshape(B, N * bs)
+
+    if packed:
+        anchor_doc = jnp.take_along_axis(segment_ids, anchors, axis=1)
+        mask = dflash_mask(
+            anchors, keep, S, bs, cfg.causal_blocks,
+            ctx_doc=segment_ids, anchor_doc=anchor_doc,
+        )
+    else:
+        mask = dflash_mask(anchors, keep, S, bs, cfg.causal_blocks)
+
+    hidden = drafter_forward(
+        draft_params, cfg, noise_embedding, target_hidden,
+        positions, draft_positions, mask,
+    )
+    logits = jnp.einsum(
+        "bqh,hv->bqv", hidden, lm_head_kernel.astype(hidden.dtype),
+        preferred_element_type=jnp.float32,
+    ).reshape(B, N, bs, -1)
+
+    tgt, block_mask = _block_targets(
+        cfg, input_ids, loss_mask, anchors, keep, doc_remaining
+    )
+
+    if cfg.loss_type == "variable_prefix":
+        lo = cfg.min_prefix
+        sl = slice(lo, None)
+        o = jnp.arange(lo, bs, dtype=jnp.float32)[None, None, :]
+        supervised = block_mask[:, :, sl] * (
+            o >= prefix_len[:, :, None].astype(jnp.float32)
+        )
+        weights = supervised
+        if cfg.loss_decay_gamma:
+            eff = jnp.maximum(o - prefix_len[:, :, None], 0.0)
+            weights = supervised * jnp.exp(-eff / cfg.loss_decay_gamma)
+        lg, tg = logits[:, :, sl], tgt[:, :, sl]
+    else:
+        # drop block position 0 (the clean anchor, never a target)
+        supervised = block_mask[:, :, 1:]
+        weights = supervised
+        if cfg.loss_decay_gamma:
+            o = jnp.arange(bs - 1, dtype=jnp.float32)[None, None, :]
+            weights = supervised * jnp.exp(-o / cfg.loss_decay_gamma)
+        lg, tg = logits[:, :, 1:], tgt[:, :, 1:]
+
+    logp = jax.nn.log_softmax(lg, axis=-1)
+    nll = -jnp.take_along_axis(logp, tg[..., None], axis=-1)[..., 0]
+    loss = jnp.sum(nll * weights) / jnp.maximum(jnp.sum(weights), 1e-6)
+
+    pred = jnp.argmax(lg, axis=-1)
+    sup_b = supervised > 0
+    valid_tokens = supervised.sum()
+    correct = ((pred == tg) & sup_b).sum()
+    block_accept = compute_accept_len(pred, tg, sup_b)
+    valid_block = sup_b.any(axis=2)
+    valid_blocks = valid_block.sum()
+    accept_sum = ((block_accept + 1.0) * valid_block).sum()
+    metrics = {
+        "valid_tokens": valid_tokens,
+        "accuracy": correct / jnp.maximum(valid_tokens, 1.0),
+        "accept_length": accept_sum / jnp.maximum(valid_blocks, 1.0),
+        "valid_blocks": valid_blocks.astype(jnp.float32),
+    }
+    return loss, metrics
+
+
+# ---------------------------------------------------------------------------
+# HF serve-layout export (SpecForge/SGLang DFlash draft format)
+# ---------------------------------------------------------------------------
+def drafter_to_hf(params: dict, cfg: DFlashConfig) -> dict:
+    """Draft params → serve-layout state dict (draft_qwen3.py module tree:
+    model.layers.{i}.* + model.fc + model.hidden_norm + model.norm; the
+    draft ships no embed/lm_head — serving reuses the target's)."""
+    import numpy as np
+
+    def t(x):
+        return np.ascontiguousarray(np.asarray(jax.device_get(x)).T)
+
+    sd = {
+        "model.fc.weight": t(params["fc"]["kernel"]),
+        "model.hidden_norm.weight": np.asarray(jax.device_get(params["hidden_norm"]["scale"])),
+        "model.norm.weight": np.asarray(jax.device_get(params["final_norm"]["scale"])),
+    }
+    L = cfg.num_layers
+    lay = params["layers"]
+    per = [
+        ("input_layernorm.weight", ("input_norm", "scale"), False),
+        ("self_attn.q_proj.weight", ("q_proj", "kernel"), True),
+        ("self_attn.k_proj.weight", ("k_proj", "kernel"), True),
+        ("self_attn.v_proj.weight", ("v_proj", "kernel"), True),
+        ("self_attn.o_proj.weight", ("o_proj", "kernel"), True),
+        ("self_attn.q_norm.weight", ("q_norm", "scale"), False),
+        ("self_attn.k_norm.weight", ("k_norm", "scale"), False),
+        ("post_attention_layernorm.weight", ("post_attn_norm", "scale"), False),
+        ("mlp.gate_proj.weight", ("gate_proj", "kernel"), True),
+        ("mlp.up_proj.weight", ("up_proj", "kernel"), True),
+        ("mlp.down_proj.weight", ("down_proj", "kernel"), True),
+    ]
+    import numpy as np
+
+    for i in range(L):
+        for suf, path, tr in per:
+            x = lay
+            for p in path:
+                x = x[p]
+            x = np.asarray(jax.device_get(x[i]))
+            sd[f"model.layers.{i}.{suf}"] = (
+                np.ascontiguousarray(x.T) if tr else x
+            )
+    return sd
+
+
+def drafter_from_hf(read_fn, cfg: DFlashConfig) -> dict:
+    """Serve-layout state dict → draft params (round-trip inverse)."""
+    import numpy as np
+
+    params = {
+        "fc": {"kernel": jnp.asarray(np.asarray(read_fn("model.fc.weight")).T)},
+        "hidden_norm": {"scale": jnp.asarray(read_fn("model.hidden_norm.weight"))},
+        "final_norm": {"scale": jnp.asarray(read_fn("model.norm.weight"))},
+    }
+    per = [
+        ("input_layernorm.weight", ("input_norm", "scale"), False),
+        ("self_attn.q_proj.weight", ("q_proj", "kernel"), True),
+        ("self_attn.k_proj.weight", ("k_proj", "kernel"), True),
+        ("self_attn.v_proj.weight", ("v_proj", "kernel"), True),
+        ("self_attn.o_proj.weight", ("o_proj", "kernel"), True),
+        ("self_attn.q_norm.weight", ("q_norm", "scale"), False),
+        ("self_attn.k_norm.weight", ("k_norm", "scale"), False),
+        ("post_attention_layernorm.weight", ("post_attn_norm", "scale"), False),
+        ("mlp.gate_proj.weight", ("gate_proj", "kernel"), True),
+        ("mlp.up_proj.weight", ("up_proj", "kernel"), True),
+        ("mlp.down_proj.weight", ("down_proj", "kernel"), True),
+    ]
+    layers: dict = {}
+    for suf, path, tr in per:
+        stacked = np.stack([
+            np.asarray(read_fn(f"model.layers.{i}.{suf}")).T if tr
+            else np.asarray(read_fn(f"model.layers.{i}.{suf}"))
+            for i in range(cfg.num_layers)
+        ])
+        node = layers
+        for p in path[:-1]:
+            node = node.setdefault(p, {})
+        node[path[-1]] = jnp.asarray(stacked)
+    params["layers"] = layers
+    return params
+
+
+def drafter_hf_config(
+    cfg: DFlashConfig, target_layer_ids: tuple, target_hf_config: dict | None = None
+) -> dict:
+    """config.json for the exported draft (draft_qwen3.py:228 dflash_config
+    keys the serving side dispatches on)."""
+    t = target_hf_config or {}
+    return {
+        "architectures": ["Qwen3DFlashDraftModel"],
+        "model_type": "qwen3",
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.hidden_size,
+        "intermediate_size": cfg.intermediate_size,
+        "num_attention_heads": cfg.num_heads,
+        "num_key_value_heads": cfg.num_kv_heads,
+        "head_dim": cfg.resolved_head_dim,
+        "num_hidden_layers": cfg.num_layers,
+        "num_target_layers": int(t.get("num_hidden_layers", 0)) or None,
+        "block_size": cfg.block_size,
+        "rope_theta": cfg.rope_theta,
+        "rms_norm_eps": cfg.rms_norm_eps,
+        "dflash_config": {
+            "target_layer_ids": list(target_layer_ids),
+            "mask_token_id": cfg.mask_token_id,
+        },
+        "max_position_embeddings": int(t.get("max_position_embeddings", 131072)),
+    }

@@ -1,0 +1,213 @@
+"""DFlash block-parallel speculative draft: mask semantics, block loss,
+export round-trip, training recipe, and lossless offline decode.
+
+Reference: nemo_automodel/components/speculative/dflash/ +
+attention/dflash_mask.py + recipes/llm/train_dflash.py.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu.speculative.dflash import (
+    DFlashConfig,
+    build_target_layer_ids,
+    dflash_block_loss,
+    dflash_mask,
+    doc_remaining_from_segments,
+    drafter_from_hf,
+    drafter_to_hf,
+    init_drafter,
+    sample_anchors,
+)
+
+TINY = DFlashConfig(
+    vocab_size=128, hidden_size=32, intermediate_size=64,
+    num_heads=4, num_kv_heads=2, num_layers=2, head_dim=8,
+    num_target_layers_used=2, block_size=4, num_anchors=6,
+    mask_token_id=0, loss_decay_gamma=2.0,
+)
+
+
+def test_mask_semantics():
+    """Pinned to dflash_mask.py: ctx strictly before the anchor, own block
+    only, bidirectional in-block (DFlash) vs in-block-causal (JetSpec),
+    padding blocks keep in-block rows non-empty."""
+    anchors = jnp.asarray([[3, 7]])
+    keep = jnp.asarray([[True, False]])
+    S, bs = 10, 4
+    m = np.asarray(dflash_mask(anchors, keep, S, bs, causal=False))
+    assert m.shape == (1, 8, S + 8)
+    # block 0 (queries 0-3): ctx < 3 visible, 3.. not
+    assert m[0, 0, :3].all() and not m[0, 0, 3:S].any()
+    assert m[0, 3, :3].all() and not m[0, 3, 3:S].any()
+    # in-block bidirectional; other block invisible
+    assert m[0, 0, S : S + 4].all() and not m[0, 0, S + 4 :].any()
+    assert m[0, 3, S : S + 4].all()
+    # padding block 1: NO ctx, but keeps its own block (no empty rows)
+    assert not m[0, 4, :S].any()
+    assert m[0, 4, S + 4 : S + 8].all()
+    assert m.any(axis=-1).all()  # no fully-masked query row
+
+    mc = np.asarray(dflash_mask(anchors, keep, S, bs, causal=True))
+    # JetSpec: in-block causal — query offset 1 sees offsets 0,1 only
+    assert mc[0, 1, S : S + 2].all() and not mc[0, 1, S + 2 : S + 4].any()
+
+    # packed-doc gating: ctx restricted to the anchor's document
+    ctx_doc = jnp.asarray([[0, 0, 0, 0, 0, 1, 1, 1, 1, 1]])
+    anchor_doc = jnp.asarray([[1, 1]])
+    anchors2 = jnp.asarray([[7, 7]])
+    md = np.asarray(dflash_mask(
+        anchors2, jnp.asarray([[True, True]]), S, bs, False,
+        ctx_doc=ctx_doc, anchor_doc=anchor_doc,
+    ))
+    # anchor 7 in doc 1: sees ctx 5,6 (doc 1, < 7) but NOT doc 0 tokens
+    assert md[0, 0, 5:7].all() and not md[0, 0, :5].any()
+
+
+def test_doc_remaining_and_anchor_sampling():
+    seg = jnp.asarray([[0, 0, 0, 1, 1, 1, 1, 1]])
+    rem = np.asarray(doc_remaining_from_segments(seg))
+    np.testing.assert_array_equal(rem[0], [2, 1, 0, 4, 3, 2, 1, 0])
+
+    cfg = TINY  # block_size 4 → anchor needs rem >= 3
+    loss_mask = jnp.ones((1, 8), bool)
+    anchors, keep = sample_anchors(jax.random.key(0), cfg, loss_mask, jnp.asarray(rem))
+    a = sorted(np.asarray(anchors)[np.asarray(keep)])
+    # only positions 3 and 4 keep the whole block inside document 1
+    assert a == [3, 4]
+
+
+def test_block_loss_runs_and_vp_variant():
+    rng = np.random.default_rng(0)
+    B, S, A = 2, 32, 2
+    ids = jnp.asarray(rng.integers(1, 128, (B, S), dtype=np.int32))
+    ctx = jnp.asarray(rng.normal(size=(B, S, A * 32)).astype(np.float32))
+    loss_mask = jnp.ones((B, S), bool)
+    embed = jnp.asarray(rng.normal(size=(128, 32)).astype(np.float32) * 0.02)
+    head = jnp.asarray(rng.normal(size=(32, 128)).astype(np.float32) * 0.02)
+    params = init_drafter(TINY, jax.random.key(0))
+
+    loss, m = dflash_block_loss(
+        params, TINY, ids, ctx, loss_mask, jax.random.key(1), embed, head
+    )
+    assert np.isfinite(float(loss)) and float(loss) > 0
+    assert float(m["valid_blocks"]) > 0
+    assert 1.0 <= float(m["accept_length"]) <= TINY.block_size
+
+    # gradient flows to the draft only (embed/head enter as frozen arrays)
+    g = jax.grad(
+        lambda p: dflash_block_loss(
+            p, TINY, ids, ctx, loss_mask, jax.random.key(1), embed, head
+        )[0]
+    )(params)
+    assert all(np.isfinite(np.asarray(x)).all() for x in jax.tree.leaves(g))
+    assert any(float(jnp.abs(x).max()) > 0 for x in jax.tree.leaves(g))
+
+    import dataclasses
+
+    vp_cfg = dataclasses.replace(TINY, loss_type="variable_prefix")
+    loss_vp, m_vp = dflash_block_loss(
+        params, vp_cfg, ids, ctx, loss_mask, jax.random.key(1), embed, head
+    )
+    assert np.isfinite(float(loss_vp))
+    # VP supervises fewer positions (visible prefixes are excluded)
+    assert float(m_vp["valid_tokens"]) <= float(m["valid_tokens"])
+
+
+def test_target_layer_ids():
+    assert build_target_layer_ids(32, 1) == (16,)
+    ids = build_target_layer_ids(32, 3)
+    assert len(ids) == 3 and ids[0] == 1 and ids[-1] == 29
+
+
+def test_export_roundtrip():
+    params = init_drafter(TINY, jax.random.key(3))
+    sd = drafter_to_hf(params, TINY)
+    assert "model.fc.weight" in sd and "model.hidden_norm.weight" in sd
+    assert "model.layers.1.self_attn.q_norm.weight" in sd
+    assert not any("embed_tokens" in k or "lm_head" in k for k in sd)
+    p2 = drafter_from_hf(lambda k: sd[k], TINY)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+TARGET_HF = {
+    "architectures": ["LlamaForCausalLM"],
+    "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+    "num_hidden_layers": 4, "num_attention_heads": 4,
+    "num_key_value_heads": 2,
+}
+
+
+@pytest.mark.recipe
+def test_dflash_recipe_trains_and_exports(tmp_path):
+    from automodel_tpu.cli.app import resolve_recipe_class
+    from automodel_tpu.config import ConfigNode
+
+    cfg = ConfigNode({
+        "seed": 7,
+        "run_dir": str(tmp_path),
+        "auto_resume": False,
+        "recipe": "llm_train_dflash",
+        "target_model": {"hf_config": TARGET_HF, "dtype": "float32",
+                         "remat_policy": "none"},
+        "speculative": {"block_size": 4, "num_anchors": 8, "num_layers": 2,
+                        "loss_decay_gamma": 2.0},
+        "distributed": {"dp_shard": -1},
+        "dataset": {
+            "_target_": "automodel_tpu.datasets.mock.MockDatasetConfig",
+            "num_samples": 32, "seq_len": 32, "vocab_size": 128,
+        },
+        "dataloader": {"microbatch_size": 8, "grad_acc_steps": 1},
+        "optimizer": {"name": "adamw", "lr": 1e-3},
+        "lr_scheduler": {"style": "constant", "warmup_steps": 0},
+        "step_scheduler": {"max_steps": 3, "ckpt_every_steps": 100},
+        "checkpoint": {"enabled": False},
+    })
+    r = resolve_recipe_class(cfg)(cfg)
+    r.setup()
+    r.run_train_validation_loop()
+    recs = [json.loads(l) for l in open(tmp_path / "training.jsonl") if l.strip()]
+    assert len(recs) == 3
+    assert all(np.isfinite(x["loss"]) for x in recs)
+    assert all("accept_length" in x for x in recs)
+
+    out = r.save_consolidated_hf(str(tmp_path / "hf_draft"))
+    cfg_json = json.loads(open(tmp_path / "hf_draft" / "config.json").read())
+    assert cfg_json["dflash_config"]["target_layer_ids"]
+    assert cfg_json["block_size"] == 4
+
+
+@pytest.mark.slow
+def test_dflash_decode_is_lossless():
+    """Greedy speculative decoding commits EXACTLY the target's greedy
+    continuation regardless of draft quality — the correctness property of
+    the verify loop (a random draft just accepts less)."""
+    from automodel_tpu.inference.generate import GenerateConfig, generate
+    from automodel_tpu.models.registry import get_model_spec
+    from automodel_tpu.speculative.decode_eval import dflash_decode
+
+    spec = get_model_spec(TARGET_HF)
+    tcfg = spec.config_from_hf(TARGET_HF, dtype=jnp.float32, remat_policy="none")
+    tparams = spec.module.init(tcfg, jax.random.key(0))
+    dcfg = TINY
+    dparams = init_drafter(dcfg, jax.random.key(1))
+
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(1, 128, (1, 8), dtype=np.int32))
+    max_new = 12
+    out, stats = dflash_decode(
+        spec.module, tcfg, tparams, dparams, dcfg, (1, 2), prompt, max_new
+    )
+    ref = generate(
+        tparams, tcfg, prompt, jax.random.key(0),
+        GenerateConfig(max_new_tokens=max_new),
+    )
+    n = min(out.shape[1], ref.shape[1])
+    np.testing.assert_array_equal(np.asarray(out[:, :n]), np.asarray(ref[:, :n]))
+    assert stats["rounds"] >= 1
+    assert stats["mean_accept_length"] >= 1.0
