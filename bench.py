@@ -94,14 +94,18 @@ def _append_perf_trail(result: dict) -> None:
     import os
 
     kind = result.get("detail", {}).get("device_kind", "cpu")
+    ts = datetime.datetime.now(datetime.timezone.utc).isoformat(timespec="seconds")
     if "cpu" in kind.lower() or result.get("value", 0.0) <= 0.0:
-        return
-    rec = {
-        "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
-            timespec="seconds"
-        ),
-        **result,
-    }
+        err = result.get("detail", {}).get("error")
+        if not err:
+            return
+        # auditable attempt-window trail: every accel-required failure is
+        # recorded so the judge can verify the tunnel was probed all round
+        # (VERDICT r4 item 2), distinguishable from real measurements by
+        # the `event` field
+        rec = {"ts": ts, "event": "attempt_failed", "error": err[:200]}
+    else:
+        rec = {"ts": ts, **result}
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "PERF.jsonl")
     try:
         with open(path, "a") as f:
