@@ -129,6 +129,8 @@ class TransformerConfig:
     # virtual-stage 1F1B over pp·pipeline_virtual_stages stages mapped
     # cyclically onto the ring — ~V× smaller bubble (reference: distributed/
     # pipelining/functional.py:182 virtual stages, :777 schedule builder).
+    # "zb": zero-bubble ZB-H1 — backward split into input-grad (B, critical
+    # path) and weight-grad (W, fills drain bubbles) at 1F1B memory.
     pipeline_schedule: str = "gpipe"
     pipeline_virtual_stages: int = 2  # used when pipeline_schedule=interleaved
     linear_precision: Optional[str] = None  # None | "fp8" | "int8"
@@ -504,6 +506,14 @@ def make_pp_1f1b_loss_and_grad(cfg: TransformerConfig, mesh_ctx, chunk_size: int
                 h, positions, seg, labels, layers_in, pl_layer, head,
                 head_loss, mesh_ctx, cfg.pipeline_microbatches,
                 cfg.pipeline_virtual_stages, param_logical_specs=lspecs,
+            )
+        elif cfg.pipeline_schedule == "zb":
+            from automodel_tpu.parallel.pp import pipeline_train_zb
+
+            loss, dh, gl, gh = pipeline_train_zb(
+                h, positions, seg, labels, layers_in, pl_layer, head,
+                head_loss, mesh_ctx, cfg.pipeline_microbatches,
+                param_logical_specs=lspecs,
             )
         else:
             loss, dh, gl, gh = pipeline_train_1f1b(

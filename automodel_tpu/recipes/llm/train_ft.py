@@ -209,10 +209,12 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             if v is not None:
                 overrides[k] = conv(v)
         sched = str(overrides.get("pipeline_schedule", "gpipe")).strip().lower()
-        if sched not in ("gpipe", "1f1b", "interleaved"):
+        if sched in ("zbv", "zero_bubble"):
+            sched = "zb"
+        if sched not in ("gpipe", "1f1b", "interleaved", "zb"):
             raise ValueError(
-                f"pipeline_schedule must be 'gpipe', '1f1b' or 'interleaved', "
-                f"got {overrides['pipeline_schedule']!r}"
+                f"pipeline_schedule must be 'gpipe', '1f1b', 'interleaved' "
+                f"or 'zb' (zero-bubble), got {overrides['pipeline_schedule']!r}"
             )
         v = int(overrides.get("pipeline_virtual_stages", 1) or 1)
         if sched == "interleaved" and v < 2:
@@ -439,7 +441,7 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         if (
             self.mesh_ctx.sizes["pp"] <= 1
             or getattr(self.model_cfg, "pipeline_schedule", "gpipe")
-            not in ("1f1b", "interleaved")
+            not in ("1f1b", "interleaved", "zb")
         ):
             return None
         for blocker, why in (
@@ -449,8 +451,8 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         ):
             if blocker:
                 raise NotImplementedError(
-                    f"pipeline_schedule=1f1b does not yet support {why}; "
-                    "use the default gpipe schedule"
+                    f"pipeline_schedule={self.model_cfg.pipeline_schedule} "
+                    f"does not yet support {why}; use the default gpipe schedule"
                 )
         from automodel_tpu.models.llm.decoder import make_pp_1f1b_loss_and_grad
 
