@@ -22,6 +22,11 @@ class MockDatasetConfig:
     seed: int = 0
     packed: bool = False
     docs_per_sample: int = 4  # packed only
+    # packed only: force document boundaries at multiples of `align` (plus
+    # the random interior cuts) so no document crosses an align-sized
+    # sub-buffer — the capacity-aligned packing blockdiag CP needs
+    # (set align = seq_len // cp; see parallel/cp.py blockdiag sharder)
+    align: int = 0
 
     def build(self) -> "MockDataset":
         return MockDataset(self)
@@ -47,6 +52,10 @@ class MockDataset:
         if c.packed:
             # synthetic document boundaries → segment ids + per-doc positions
             cuts = np.sort(rng.choice(np.arange(1, c.seq_len), c.docs_per_sample - 1, replace=False))
+            if c.align:
+                cuts = np.unique(np.concatenate(
+                    [cuts, np.arange(c.align, c.seq_len, c.align)]
+                ))
             seg = np.zeros(c.seq_len, np.int32)
             pos = np.zeros(c.seq_len, np.int32)
             prev = 0

@@ -29,6 +29,11 @@ class PackedSequenceConfig:
     # (min-heap); materializes all documents first but packs tighter
     # (reference: datasets/llm/neat_packing.py `greedy_knapsack`).
     strategy: str = "first_fit"
+    # capacity alignment for blockdiag CP: no document crosses a multiple of
+    # `align` inside the row (docs longer than align are truncated to it);
+    # set align = seq_len // cp so the per-document CP layout always packs
+    # (parallel/cp.py BlockDiagContextParallelSharder). 0 = off.
+    align: int = 0
 
 
 def pack_documents(
@@ -71,10 +76,19 @@ def pack_documents(
     elif config.strategy != "first_fit":
         raise ValueError(f"unknown packing strategy {config.strategy!r}")
 
+    A = config.align
+    if A and (A <= 0 or S % A != 0):
+        raise ValueError(f"packing align={A} must divide seq_len={S}")
+
     for doc in docs:
-        ids = np.asarray(doc["input_ids"], np.int32)[:S]
+        cap = min(S, A) if A else S
+        ids = np.asarray(doc["input_ids"], np.int32)[:cap]
         labels = np.asarray(doc["labels"], np.int32)[: len(ids)]
         n = len(ids)
+        if A and (offset % A) + n > A:
+            # skip to the next align boundary so the doc stays inside one
+            # align-sized sub-buffer (pad slots keep segment 0)
+            offset = ((offset // A) + 1) * A
         if offset + n > S:
             yield flush()
         buf_ids[offset : offset + n] = ids

@@ -132,6 +132,11 @@ class TransformerConfig:
     # "zb": zero-bubble ZB-H1 — backward split into input-grad (B, critical
     # path) and weight-grad (W, fills drain bubbles) at 1F1B memory.
     pipeline_schedule: str = "gpipe"
+    # blockdiag CP (distributed.cp_layout: blockdiag): documents are
+    # rank-local (parallel/cp.py BlockDiagContextParallelSharder), so
+    # attention runs LOCAL per cp shard instead of the ring — the reference
+    # blockdiag_cp/ package's per-document exchange, collapsed to zero
+    cp_blockdiag: bool = False
     pipeline_virtual_stages: int = 2  # used when pipeline_schedule=interleaved
     linear_precision: Optional[str] = None  # None | "fp8" | "int8"
 
@@ -763,7 +768,20 @@ def attention_block(h, lp, cfg: TransformerConfig, positions, segment_ids, inv_f
 
     sinks = lp.get("sinks") if cfg.attention_sinks else None
     if mesh_ctx is not None and mesh_ctx.sizes["cp"] > 1:
-        if manual:
+        if cfg.cp_blockdiag and not manual:
+            # per-document layout: all keys a query needs are rank-local
+            from automodel_tpu.parallel.cp import local_cp_attention
+
+            attn = local_cp_attention(
+                q, k, v, positions, segment_ids, mesh_ctx,
+                causal=cfg.causal,
+                sliding_window=sliding_window,
+                logits_soft_cap=cfg.attn_soft_cap,
+                scale=cfg.attn_scale,
+                sinks=sinks,
+                attn_impl=cfg.attn_impl,
+            )
+        elif manual:
             from automodel_tpu.parallel.cp import ring_attention
 
             attn = ring_attention(

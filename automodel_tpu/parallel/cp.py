@@ -20,7 +20,6 @@ attention wiring moe/parallelizer.py:749-800). TPU-native design:
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Any
 
 import jax
@@ -90,6 +89,167 @@ class ContextParallelSharder:
         perm = self.permutation(seq_len)
         local = seq_len // self.cp_size
         return perm[rank * local : (rank + 1) * local]
+
+
+# ---------------------------------------------------------------------------
+# per-document (blockdiag) CP layout: whole documents per rank → NO exchange
+# ---------------------------------------------------------------------------
+def document_pack_permutation(segment_row: np.ndarray, cp_size: int) -> np.ndarray:
+    """perm[i] = source index of the token placed at layout slot i, packing
+    WHOLE documents onto cp ranks (first-fit decreasing by length).
+
+    The TPU-native answer to the reference's blockdiag_cp exchange
+    (reference: distributed/blockdiag_cp/exchange.py — differentiable
+    all-gather / left-halo / a2av collectives restricted to same-document
+    blocks): with packed attention already block-diagonal per document,
+    placing each document entirely on one rank makes every key a query
+    needs LOCAL — the per-document exchange collapses to none at all.
+    Raises when a document exceeds the per-rank capacity S/cp (those need
+    the ring layout, which handles any span)."""
+    S = segment_row.shape[0]
+    assert S % cp_size == 0, (S, cp_size)
+    cap = S // cp_size
+    # contiguous document spans (packing emits docs back-to-back);
+    # vectorized — this runs per row per batch in the host data path
+    cuts = (np.flatnonzero(np.diff(segment_row)) + 1).tolist()
+    bounds = [0] + cuts + [S]
+    docs = [(bounds[j], bounds[j + 1]) for j in range(len(bounds) - 1)]
+    # capacity-aligned packing (datasets/packing.py align=S/cp): no doc
+    # crosses a rank boundary already → identity layout, nothing to move
+    if all(lo // cap == (hi - 1) // cap for lo, hi in docs):
+        return np.arange(S)
+    too_big = [d for d in docs if d[1] - d[0] > cap]
+    if too_big:
+        raise ValueError(
+            f"blockdiag CP: document of {too_big[0][1] - too_big[0][0]} tokens "
+            f"exceeds the per-rank capacity {cap} (= seq {S} / cp {cp_size}); "
+            "use distributed.cp_layout: balanced (the ring handles documents "
+            "of any span)"
+        )
+    loads = [0] * cp_size
+    assign: list[list[tuple]] = [[] for _ in range(cp_size)]
+    for d in sorted(docs, key=lambda d: d[0] - d[1]):  # longest first
+        r = min(
+            (r for r in range(cp_size) if loads[r] + (d[1] - d[0]) <= cap),
+            key=lambda r: loads[r],
+            default=None,
+        )
+        if r is None:
+            raise ValueError(
+                f"blockdiag CP: documents do not fit cp={cp_size} ranks of "
+                f"capacity {cap} (first-fit-decreasing overflow); repack with "
+                "a multiple-of-capacity target or use cp_layout: balanced"
+            )
+        assign[r].append(d)
+        loads[r] += d[1] - d[0]
+    perm = np.empty(S, np.int64)
+    i = 0
+    for r in range(cp_size):
+        for lo, hi in sorted(assign[r]):  # preserve order within the rank
+            perm[i : i + hi - lo] = np.arange(lo, hi)
+            i += hi - lo
+    assert i == S  # capacities sum to S, so every token lands exactly once
+    return perm
+
+
+@dataclasses.dataclass
+class BlockDiagContextParallelSharder:
+    """Per-document CP sharder: permutes each packed row so whole documents
+    land on single cp ranks (document_pack_permutation above); positions /
+    labels / segment ids ride the same per-row permutation. Attention then
+    runs LOCAL per shard (`cp_blockdiag` on the model config) — zero ring
+    steps. Requires packed batches (segment_ids) whose documents fit S/cp."""
+
+    cp_size: int
+    seq_keys: tuple = ("input_ids", "labels", "positions", "segment_ids", "loss_mask")
+
+    def shard_batch(self, batch: dict) -> dict:
+        if "segment_ids" not in batch:
+            raise ValueError(
+                "blockdiag CP needs packed batches with segment_ids; use a "
+                "packing dataset or distributed.cp_layout: balanced"
+            )
+        seg = np.asarray(batch["segment_ids"])
+        seq_len = seg.shape[-1]
+        flat = seg.reshape(-1, seq_len)
+        perms = np.stack([
+            document_pack_permutation(row, self.cp_size) for row in flat
+        ]).reshape(seg.shape)
+        if "positions" not in batch:
+            batch = {**batch, "positions": np.broadcast_to(
+                np.arange(seq_len, dtype=np.int32), batch["input_ids"].shape
+            )}
+        out = {}
+        for k, v in batch.items():
+            if k in self.seq_keys and getattr(v, "ndim", 0) >= 2 and v.shape[-1] == seq_len:
+                out[k] = np.take_along_axis(np.asarray(v), perms, axis=-1)
+            else:
+                out[k] = v
+        return out
+
+
+def _cp_shard_map_attention(inner_fn, mesh_ctx, q, k, v, positions,
+                            segment_ids, sinks):
+    """Shared shard_map wrapper for the CP attention variants: batch on the
+    data axes, sequence on cp, heads on tp; sinks (per-q-head) ride the tp
+    axis. `inner_fn(q, k, v, positions, segment_ids, sinks=None)` runs
+    per-shard."""
+    batch = ("dp_replicate", "dp_shard", "ep")
+    qkv_spec = P(batch, "cp", "tp", None)
+    tok_spec = P(batch, "cp")
+    in_specs = [qkv_spec, qkv_spec, qkv_spec, tok_spec, tok_spec]
+    args = [q, k, v, positions, segment_ids]
+    if sinks is not None:
+        in_specs.append(P("tp"))
+        args.append(sinks)
+    return jax.shard_map(
+        inner_fn,
+        mesh=mesh_ctx.mesh,
+        in_specs=tuple(in_specs),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )(*args)
+
+
+def local_cp_attention(
+    q, k, v,
+    positions, segment_ids,
+    mesh_ctx: MeshContext,
+    *,
+    causal: bool = True,
+    sliding_window: int | None = None,
+    logits_soft_cap: float | None = None,
+    scale: float | None = None,
+    sinks=None,
+    attn_impl: str = "auto",
+):
+    """Blockdiag-CP attention: every document is rank-local (the sharder's
+    contract), so attention is one LOCAL flash per cp shard — no ppermute
+    ring, no exchange. segment/position masking inside the shard keeps
+    cross-document isolation identical to the ring's."""
+    from automodel_tpu.ops.attention import dot_product_attention
+
+    if segment_ids is None:
+        # zero-segment defaulting (the ring's behavior) would silently cut
+        # a genuinely rank-spanning sequence at shard boundaries here —
+        # the local path is only valid under the per-document contract
+        raise ValueError(
+            "blockdiag CP local attention requires packed segment_ids "
+            "(every document rank-local); got none — use the ring layout "
+            "for unpacked sequences"
+        )
+
+    def fn(q, k, v, positions, segment_ids, sinks=None):
+        return dot_product_attention(
+            q, k, v, causal=causal, segment_ids=segment_ids,
+            positions=positions, sliding_window=sliding_window,
+            logits_soft_cap=logits_soft_cap, scale=scale,
+            sinks=sinks, impl=attn_impl,
+        )
+
+    return _cp_shard_map_attention(
+        fn, mesh_ctx, q, k, v, positions, segment_ids, sinks
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -238,42 +398,18 @@ def ring_dot_product_attention(
     attn_impl: str = "auto",
 ):
     """shard_map wrapper: GSPMD everywhere else, explicit ring on `cp`."""
-    batch = ("dp_replicate", "dp_shard", "ep")
-    qkv_spec = P(batch, "cp", "tp", None)
-    tok_spec = P(batch, "cp")
-
     if segment_ids is None:
         segment_ids = jnp.zeros(positions.shape, jnp.int32)
 
-    fn = functools.partial(
-        ring_attention,
-        axis_name="cp",
-        causal=causal,
-        sliding_window=sliding_window,
-        logits_soft_cap=logits_soft_cap,
-        scale=scale,
-        attn_impl=attn_impl,
+    def fn(q, k, v, positions, segment_ids, sinks=None):
+        return ring_attention(
+            q, k, v, positions, segment_ids,
+            axis_name="cp", causal=causal,
+            sliding_window=sliding_window,
+            logits_soft_cap=logits_soft_cap,
+            scale=scale, sinks=sinks, attn_impl=attn_impl,
+        )
+
+    return _cp_shard_map_attention(
+        fn, mesh_ctx, q, k, v, positions, segment_ids, sinks
     )
-    in_specs = [qkv_spec, qkv_spec, qkv_spec, tok_spec, tok_spec]
-    args = [q, k, v, positions, segment_ids]
-    if sinks is not None:
-        # sinks are per-q-head → sharded with the head (tp) axis
-        in_specs.append(P("tp"))
-        args.append(sinks)
-
-        def fn(q, k, v, positions, segment_ids, sinks):  # noqa: F811
-            return ring_attention(
-                q, k, v, positions, segment_ids,
-                axis_name="cp", causal=causal,
-                sliding_window=sliding_window,
-                logits_soft_cap=logits_soft_cap,
-                scale=scale, sinks=sinks, attn_impl=attn_impl,
-            )
-
-    return jax.shard_map(
-        fn,
-        mesh=mesh_ctx.mesh,
-        in_specs=tuple(in_specs),
-        out_specs=qkv_spec,
-        check_vma=False,
-    )(*args)

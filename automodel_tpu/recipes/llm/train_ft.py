@@ -226,6 +226,18 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             raise ValueError(f"pipeline_virtual_stages must be >= 1, got {v}")
         if "pipeline_schedule" in overrides:
             overrides["pipeline_schedule"] = sched
+        # per-document CP layout (reference: distributed/blockdiag_cp/):
+        # whole documents per cp rank → local attention, zero exchange
+        layout = str(
+            (dist_node.get("cp_layout") if dist_node is not None else None)
+            or "balanced"
+        ).strip().lower()
+        if layout not in ("balanced", "blockdiag"):
+            raise ValueError(
+                f"distributed.cp_layout must be 'balanced' or 'blockdiag', got {layout!r}"
+            )
+        if layout == "blockdiag":
+            overrides["cp_blockdiag"] = True
 
         pretrained = mcfg.get("pretrained_path", None)
         if pretrained:
@@ -519,11 +531,49 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
 
         Gated on the module's CP_PERMUTATION_SAFE flag — SSM/linear-attention
         hybrids and the layout-order MTP head must see natural order."""
-        from automodel_tpu.parallel.cp import ContextParallelSharder
+        from automodel_tpu.parallel.cp import (
+            BlockDiagContextParallelSharder,
+            ContextParallelSharder,
+        )
 
         self.cp_sharder = None
         cp = self.mesh_ctx.sizes["cp"]
-        if cp <= 1 or not bool(self.cfg.get("distributed.cp_load_balanced", True)):
+        if cp <= 1:
+            return
+        if getattr(self.model_cfg, "cp_blockdiag", False):
+            # per-document layout (blockdiag): whole docs per rank; the
+            # model runs local attention (decoder.attention_block). Docs
+            # stay contiguous/ordered, but the BUFFER order changes — the
+            # same order-sensitivity gate as the balanced layout applies.
+            if not getattr(self.model_spec.module, "CP_PERMUTATION_SAFE", False):
+                raise NotImplementedError(
+                    f"cp_layout=blockdiag: model {self.model_spec.name} is "
+                    "sequence-order-sensitive (SSM/linear-attention buffer "
+                    "order); use cp_layout: balanced with "
+                    "cp_load_balanced: false"
+                )
+            if getattr(self.model_cfg, "mtp_num_layers", 0) > 0:
+                # the MTP head shifts in LAYOUT order (moe_lm/decoder.py
+                # CP_PERMUTATION_SAFE note) — a non-identity doc repack
+                # would supervise wrong next-token targets
+                raise NotImplementedError(
+                    "cp_layout=blockdiag with MTP heads: the MTP shift is "
+                    "layout-order-sensitive; use cp_layout: balanced with "
+                    "cp_load_balanced: false"
+                )
+            if self.mesh_ctx.sizes["pp"] > 1:
+                # the pipeline's manual path runs the ring regardless —
+                # the configured zero-exchange layout would silently pay
+                # full ring cost with an imbalanced doc-grouped layout
+                raise NotImplementedError(
+                    "cp_layout=blockdiag inside pipeline parallelism is not "
+                    "wired (the pp path uses ring attention); use "
+                    "cp_layout: balanced with pp"
+                )
+            self.cp_sharder = BlockDiagContextParallelSharder(cp_size=cp)
+            logger.info("cp=%d: blockdiag per-document layout enabled", cp)
+            return
+        if not bool(self.cfg.get("distributed.cp_load_balanced", True)):
             return
         safe = getattr(self.model_spec.module, "CP_PERMUTATION_SAFE", False)
         if getattr(self.model_cfg, "mtp_num_layers", 0) > 0:

@@ -109,3 +109,32 @@ def test_mock_packed_has_boundaries():
     # positions restart at document boundaries
     jumps = np.flatnonzero(np.diff(s["segment_ids"]))
     assert (s["positions"][jumps + 1] == 0).all()
+
+
+def test_packing_capacity_align():
+    """align=S/cp: no document crosses an align boundary (the blockdiag CP
+    contract); over-align docs are truncated to one sub-buffer."""
+    import numpy as np
+
+    from automodel_tpu.datasets.packing import PackedSequenceConfig, pack_documents
+
+    docs = [
+        {"input_ids": np.arange(1, 13), "labels": np.arange(1, 13)},   # 12
+        {"input_ids": np.arange(1, 9), "labels": np.arange(1, 9)},     # 8
+        {"input_ids": np.arange(1, 25), "labels": np.arange(1, 25)},   # 24 > align
+        {"input_ids": np.arange(1, 6), "labels": np.arange(1, 6)},     # 5
+    ]
+    rows = list(pack_documents(docs, PackedSequenceConfig(seq_len=32, align=16)))
+    for row in rows:
+        seg = row["segment_ids"]
+        for d in set(seg[seg > 0]):
+            idx = np.nonzero(seg == d)[0]
+            assert idx[0] // 16 == idx[-1] // 16, (d, idx)   # one sub-buffer
+            assert len(idx) <= 16
+    # every document appears; the 24-doc is truncated to one sub-buffer (16)
+    lengths = sorted(
+        int((row["segment_ids"] == d).sum())
+        for row in rows
+        for d in set(row["segment_ids"][row["segment_ids"] > 0])
+    )
+    assert lengths == [5, 8, 12, 16]
