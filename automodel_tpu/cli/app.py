@@ -33,6 +33,7 @@ RECIPE_ALIASES = {
     "llm_dflash_decode_eval": "automodel_tpu.recipes.llm.spec_bench.DFlashDecodeEvalRecipe",
     "dllm_train_ft": "automodel_tpu.recipes.dllm.train_ft.DiffusionLMSFTRecipe",
     "diffusion_train": "automodel_tpu.recipes.diffusion.train.TrainDiffusionRecipe",
+    "bagel_finetune": "automodel_tpu.recipes.multimodal.bagel.BagelRecipe",
     "vlm_finetune": "automodel_tpu.recipes.vlm.finetune.FinetuneRecipeForVLM",
     "vlm_kd": "automodel_tpu.recipes.vlm.kd.KDRecipeForVLM",
     "vlm_generate": "automodel_tpu.recipes.vlm.generate.GenerateRecipeForVLM",

@@ -1,0 +1,77 @@
+"""Mock BAGEL mixed-modal dataset: text + und image + gen latent per row.
+
+The hermetic stand-in for the reference's BAGEL collator output
+(reference: bagel/model.py forward docstring — packed text/vit/vae spans):
+each sample packs [text | VIT span | text | VAE span | text] with
+token_type marking the spans, a mock image for the understanding tower,
+a mock VAE latent for the flow-matching branch, and a raw timestep.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+IGNORE_INDEX = -100
+
+
+@dataclasses.dataclass
+class MockBagelDatasetConfig:
+    num_samples: int = 64
+    seq_len: int = 64
+    vocab_size: int = 128
+    image_size: int = 56
+    patch_size: int = 14
+    latent_size: int = 8       # VAE latent H=W
+    latent_patch: int = 2
+    z_channels: int = 4
+    visual_gen: bool = True
+    seed: int = 0
+
+    def build(self):
+        return MockBagelDataset(self)
+
+
+class MockBagelDataset:
+    def __init__(self, config: MockBagelDatasetConfig):
+        self.config = config
+        c = config
+        self.n_vit = (c.image_size // c.patch_size) ** 2
+        self.n_vae = (c.latent_size // c.latent_patch) ** 2 if c.visual_gen else 0
+        need = self.n_vit + self.n_vae + 8
+        if c.seq_len < need:
+            raise ValueError(f"seq_len {c.seq_len} < required {need}")
+
+    def __len__(self) -> int:
+        return self.config.num_samples
+
+    def __getitem__(self, idx: int) -> dict:
+        c = self.config
+        rng = np.random.default_rng(c.seed * 7919 + idx)
+        S = c.seq_len
+        ids = rng.integers(1, c.vocab_size, S + 1, dtype=np.int32)
+        token_type = np.zeros(S, np.int32)
+        # [text(4) | vit | text... | vae | text(tail)]
+        v0 = 4
+        token_type[v0 : v0 + self.n_vit] = 1
+        if self.n_vae:
+            g0 = v0 + self.n_vit + 2
+            token_type[g0 : g0 + self.n_vae] = 2
+        labels = ids[1:].copy()
+        # only text positions are CE-supervised
+        labels[token_type != 0] = IGNORE_INDEX
+        sample = {
+            "input_ids": ids[:-1],
+            "labels": labels,
+            "token_type": token_type,
+            "pixel_values": rng.normal(
+                size=(c.image_size, c.image_size, 3)
+            ).astype(np.float32),
+        }
+        if c.visual_gen:
+            sample["latents"] = rng.normal(
+                size=(c.z_channels, c.latent_size, c.latent_size)
+            ).astype(np.float32)
+            sample["timesteps"] = np.float32(rng.normal())
+        return sample

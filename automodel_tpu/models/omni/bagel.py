@@ -1,0 +1,734 @@
+"""BAGEL: unified multimodal understanding + generation (MoT decoder).
+
+The analog of the reference's bagel family (reference: nemo_automodel/
+components/models/bagel/, 4227 LoC — model.py `BagelForUnifiedMultimodal`,
+modeling_qwen2_packed.py `Qwen2MoTDecoderLayer`, attention_masks.py
+`create_sparse_mask`, embeddings.py, connector.py). One model both
+UNDERSTANDS images (SigLIP tower → connector → text stream, CE loss) and
+GENERATES them (VAE latents → flow-matching velocity head, MSE loss), with
+a Mixture-of-Transformers text backbone: every projection/norm has an
+understanding expert and a `*_moe_gen` GENERATION sibling, routed by token
+type, sharing one attention pattern.
+
+TPU-native design decisions:
+
+- BATCHED (B, S) layout with a per-token `token_type` array (0=text, 1=vit,
+  2=vae) instead of the reference's flat packed sequence + scatter indexes.
+  The reference's index_put routing becomes compute-both + `where` select:
+  for a 2-expert MoT that costs 2× the linear FLOPs but keeps every shape
+  static under jit (attention, which both experts share, dominates at
+  scale). The packed-attention mask predicates (attention_masks.py:69-83)
+  translate to array form: causal by row OR same bidirectional region;
+  keys in a NOISE region visible only to that region; same sample.
+- The generation path is flow matching exactly per the reference
+  (model.py:494-530): t ~ sigmoid(raw), shifted t' = s·t/(1+(s-1)t),
+  x_t = (1-t')·clean + t'·noise, velocity target = noise - clean, and
+  `llm2vae` zero-initialized so stage 2 starts with zero MSE signal.
+- Grid position embeddings are the reference's FROZEN 2D sin/cos tables
+  (embeddings.py:76 `BagelGridPositionEmbedding`): stored as buffers in the
+  param tree, excluded from `trainable`, regenerated at init.
+- The VAE stays outside this module (reference model.py docstring): the
+  recipe feeds already-encoded latents.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.layers import dense_init, embed_init
+from automodel_tpu.models.vision import vit
+from automodel_tpu.ops.attention import NEG_INF
+from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.rope import apply_rope, rope_frequencies
+
+TEXT, VIT, VAE = 0, 1, 2  # token_type values
+
+
+@dataclasses.dataclass(frozen=True)
+class BagelConfig:
+    # text backbone (qwen2-shaped: qkv bias, o no-bias, optional qk norm)
+    vocab_size: int = 152064
+    hidden_size: int = 3584
+    intermediate_size: int = 18944
+    num_layers: int = 28
+    num_heads: int = 28
+    num_kv_heads: int = 4
+    head_dim: Optional[int] = None
+    rope_theta: float = 1000000.0
+    rms_norm_eps: float = 1e-6
+    qk_norm: bool = True
+    visual_gen: bool = True        # MoT + flow-matching head (stage 2)
+    freeze_und: bool = False       # stage-2 option: train gen experts only
+    # understanding side
+    vision: vit.VisionConfig = dataclasses.field(default_factory=vit.VisionConfig)
+    connector_act: str = "gelu_tanh"
+    vit_max_num_patch_per_side: int = 70
+    # generation side
+    latent_patch_size: int = 2
+    max_latent_size: int = 32
+    timestep_shift: float = 1.0
+    z_channels: int = 16
+    timestep_embed_size: int = 256
+    # execution
+    dtype: Any = jnp.bfloat16
+    remat_policy: str = "full"
+    # attention runs through ops.attention.xla_attention with the explicit
+    # mixed-modal keep mask (no flash path for this mask shape yet)
+    mtp_num_layers: int = 0  # chassis compatibility
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def patch_latent_dim(self) -> int:
+        return self.latent_patch_size ** 2 * self.z_channels
+
+    def flops_per_token(self, seq_len: int) -> float:
+        D = self.resolved_head_dim
+        H = self.hidden_size
+        attn = H * D * (self.num_heads + 2 * self.num_kv_heads) + self.num_heads * D * H
+        mlp = 3 * H * self.intermediate_size
+        experts = 2 if self.visual_gen else 1
+        n = (
+            self.vocab_size * H * 2
+            + self.num_layers * (attn + mlp) * experts
+            + self.vision.param_count()
+        )
+        return 6.0 * n + 6 * self.num_layers * self.num_heads * D * seq_len
+
+
+def bagel_config(hf: Mapping[str, Any], **overrides) -> BagelConfig:
+    """HF BagelConfig layout (reference: bagel/configuration.py): nested
+    llm_config/text_config (qwen2) + vision_config (siglip) + vit_*/latent
+    scalars + vae_config {z_channels, downsample}."""
+    t = dict(hf.get("llm_config") or hf.get("text_config") or {})
+    v = dict(hf.get("vision_config") or {})
+    vae = dict(hf.get("vae_config") or {})
+    heads = int(t.get("num_attention_heads", 28))
+    vision_kw = dict(remat_policy=overrides.get("remat_policy", "full"))
+    vision = vit.VisionConfig.from_hf(v, **vision_kw)
+    kw = dict(
+        vocab_size=int(t.get("vocab_size", 152064)),
+        hidden_size=int(t.get("hidden_size", 3584)),
+        intermediate_size=int(t.get("intermediate_size", 18944)),
+        num_layers=int(t.get("num_hidden_layers", 28)),
+        num_heads=heads,
+        num_kv_heads=int(t.get("num_key_value_heads", heads)),
+        head_dim=t.get("head_dim"),
+        rope_theta=float(t.get("rope_theta", 1000000.0)),
+        rms_norm_eps=float(t.get("rms_norm_eps", 1e-6)),
+        qk_norm=bool(t.get("qk_norm", True)),
+        visual_gen=bool(hf.get("visual_gen", True)),
+        freeze_und=bool(t.get("freeze_und", False)),
+        vision=vision,
+        vit_max_num_patch_per_side=int(hf.get("vit_max_num_patch_per_side", 70)),
+        latent_patch_size=int(hf.get("latent_patch_size", 2)),
+        max_latent_size=int(hf.get("max_latent_size", 32)),
+        timestep_shift=float(hf.get("timestep_shift", 1.0)),
+        z_channels=int(vae.get("z_channels", 16)),
+    )
+    kw.update({
+        k: v for k, v in overrides.items()
+        if k in ("dtype", "remat_policy")
+    })
+    return BagelConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# frozen 2D sin/cos grid table (reference: embeddings.py:46-76)
+# ---------------------------------------------------------------------------
+def sincos_grid_table(embed_dim: int, grid_size: int) -> jnp.ndarray:
+    """(grid_size², embed_dim); x features then y, sin block then cos."""
+    half = embed_dim // 2
+    pair = half // 2
+    freqs = 10000.0 ** (-jnp.arange(pair, dtype=jnp.float32) / pair)
+    ys, xs = jnp.meshgrid(
+        jnp.arange(grid_size, dtype=jnp.float32),
+        jnp.arange(grid_size, dtype=jnp.float32),
+        indexing="ij",
+    )
+
+    def enc(p):
+        ph = p.reshape(-1, 1) * freqs[None, :]
+        return jnp.concatenate([jnp.sin(ph), jnp.cos(ph)], axis=-1)
+
+    return jnp.concatenate([enc(xs), enc(ys)], axis=1).astype(jnp.float32)
+
+
+def timestep_features(t: jnp.ndarray, width: int) -> jnp.ndarray:
+    """(N, width) cos|sin features (reference: embeddings.py:96)."""
+    half = width // 2
+    freqs = jnp.exp(
+        -math.log(10000.0) * jnp.arange(half, dtype=jnp.float32) / half
+    )
+    ph = t[:, None].astype(jnp.float32) * freqs[None, :]
+    return jnp.concatenate([jnp.cos(ph), jnp.sin(ph)], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# params
+# ---------------------------------------------------------------------------
+def _lin(k, din, dout, bias=True):
+    p = {"kernel": dense_init(k, (din, dout))}
+    if bias:
+        p["bias"] = jnp.zeros((dout,))
+    return p
+
+
+def init(cfg: BagelConfig, rng: jax.Array) -> dict:
+    H, I, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    D = cfg.resolved_head_dim
+    ks = jax.random.split(rng, 16)
+
+    def stack(k, shape, bias_width=None):
+        kk = jax.random.split(k, L)
+        p = {"kernel": jnp.stack([dense_init(x, shape) for x in kk])}
+        if bias_width is not None:
+            p["bias"] = jnp.zeros((L, bias_width))
+        return p
+
+    def layer_group(base_key):
+        kq, kk_, kv, ko, kg, ku, kd = jax.random.split(base_key, 7)
+        g = {
+            "input_norm": {"scale": jnp.ones((L, H))},
+            "q_proj": stack(kq, (H, cfg.num_heads * D), cfg.num_heads * D),
+            "k_proj": stack(kk_, (H, cfg.num_kv_heads * D), cfg.num_kv_heads * D),
+            "v_proj": stack(kv, (H, cfg.num_kv_heads * D), cfg.num_kv_heads * D),
+            "o_proj": stack(ko, (cfg.num_heads * D, H)),
+            "post_attn_norm": {"scale": jnp.ones((L, H))},
+            "gate_proj": stack(kg, (H, I)),
+            "up_proj": stack(ku, (H, I)),
+            "down_proj": stack(kd, (I, H)),
+        }
+        if cfg.qk_norm:
+            g["q_norm"] = {"scale": jnp.ones((L, D))}
+            g["k_norm"] = {"scale": jnp.ones((L, D))}
+        return g
+
+    lm: dict = {
+        "embed": {"embedding": embed_init(ks[0], (cfg.vocab_size, H))},
+        "layers": {"und": layer_group(ks[1])},
+        "final_norm": {"und": {"scale": jnp.ones((H,))}},
+        "lm_head": {"kernel": dense_init(ks[2], (H, cfg.vocab_size))},
+    }
+    if cfg.visual_gen:
+        lm["layers"]["gen"] = layer_group(ks[3])
+        lm["final_norm"]["gen"] = {"scale": jnp.ones((H,))}
+
+    params: dict = {
+        "language_model": lm,
+        "vit_model": vit.init(cfg.vision, ks[4]),
+        "connector": {
+            "fc1": _lin(ks[5], cfg.vision.hidden_size, H),
+            "fc2": _lin(ks[6], H, H),
+        },
+        # NOTE: the frozen sin/cos grid tables (vit_pos_embed /
+        # latent_pos_embed) are NOT parameters — the reference keeps them
+        # requires_grad=False (embeddings.py:72); here they are deterministic
+        # jit-time constants recomputed in forward, so they can neither
+        # receive gradients nor weight-decay drift. The HF adapter still
+        # round-trips the checkpoint keys.
+    }
+    if cfg.visual_gen:
+        params["time_embedder"] = {
+            "fc1": _lin(ks[7], cfg.timestep_embed_size, H),
+            "fc2": _lin(ks[8], H, H),
+        }
+        params["vae2llm"] = _lin(ks[9], cfg.patch_latent_dim, H)
+        # zero-init: stage 2 starts with the MSE head contributing nothing
+        # (reference: model.py:210-213)
+        params["llm2vae"] = {
+            "kernel": jnp.zeros((H, cfg.patch_latent_dim)),
+            "bias": jnp.zeros((cfg.patch_latent_dim,)),
+        }
+    return params
+
+
+def param_specs(cfg: BagelConfig) -> dict:
+    H = cfg.hidden_size
+
+    def lin_spec(din_ax, dout_ax, bias=True):
+        p = {"kernel": (din_ax, dout_ax)}
+        if bias:
+            p["bias"] = ("norm",)
+        return p
+
+    def layer_group():
+        g = {
+            "input_norm": {"scale": ("layers", "norm")},
+            "q_proj": {"kernel": ("layers", "embed", "heads"), "bias": ("layers", "heads")},
+            "k_proj": {"kernel": ("layers", "embed", "kv_heads"), "bias": ("layers", "kv_heads")},
+            "v_proj": {"kernel": ("layers", "embed", "kv_heads"), "bias": ("layers", "kv_heads")},
+            "o_proj": {"kernel": ("layers", "heads", "embed")},
+            "post_attn_norm": {"scale": ("layers", "norm")},
+            "gate_proj": {"kernel": ("layers", "embed", "mlp")},
+            "up_proj": {"kernel": ("layers", "embed", "mlp")},
+            "down_proj": {"kernel": ("layers", "mlp", "embed")},
+        }
+        if cfg.qk_norm:
+            g["q_norm"] = {"scale": ("layers", "norm")}
+            g["k_norm"] = {"scale": ("layers", "norm")}
+        return g
+
+    lm = {
+        "embed": {"embedding": ("vocab", "embed")},
+        "layers": {"und": layer_group()},
+        "final_norm": {"und": {"scale": ("norm",)}},
+        "lm_head": {"kernel": ("embed", "vocab")},
+    }
+    if cfg.visual_gen:
+        lm["layers"]["gen"] = layer_group()
+        lm["final_norm"]["gen"] = {"scale": ("norm",)}
+    specs = {
+        "language_model": lm,
+        "vit_model": vit.param_specs(cfg.vision),
+        "connector": {
+            "fc1": lin_spec("embed", "mlp"),
+            "fc2": lin_spec("mlp", "embed"),
+        },
+    }
+    if cfg.visual_gen:
+        specs["time_embedder"] = {
+            "fc1": lin_spec(None, "embed"),
+            "fc2": lin_spec("embed", "embed"),
+        }
+        specs["vae2llm"] = lin_spec(None, "embed")
+        specs["llm2vae"] = lin_spec("embed", None)
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# packed multimodal mask (reference: attention_masks.py:60-83, array form)
+# ---------------------------------------------------------------------------
+def bagel_attention_mask(token_type, segment_ids):
+    """(B, S, S) bool: same sample ∧ (row-causal ∨ same bidirectional
+    region) ∧ (key not in a noise region ∨ same noise region). Regions are
+    per (sample, modality): all vit tokens of a sample form one full
+    region, all vae tokens one noise region (one image + one latent per
+    sample — the batched layout's contract)."""
+    B, S = token_type.shape
+    seg = segment_ids if segment_ids is not None else jnp.zeros((B, S), jnp.int32)
+    full_id = jnp.where(token_type > 0, seg * 2 + (token_type - 1), -1)
+    noise_id = jnp.where(token_type == VAE, seg, -1)
+    rows = jnp.arange(S)
+    causal = rows[:, None] >= rows[None, :]
+    same_region = (full_id[:, :, None] == full_id[:, None, :]) & (
+        full_id[:, :, None] >= 0
+    )
+    keep = causal[None] | same_region
+    key_noise = noise_id[:, None, :] >= 0
+    keep &= (~key_noise) | (noise_id[:, :, None] == noise_id[:, None, :])
+    keep &= seg[:, :, None] == seg[:, None, :]
+    return keep
+
+
+# ---------------------------------------------------------------------------
+# MoT forward
+# ---------------------------------------------------------------------------
+def _mot_linear(x, und, gen, gen_mask):
+    """where(gen, x@gen, x@und) — both experts on all tokens (static
+    shapes; the reference scatters instead, modeling_qwen2_packed.py:648)."""
+    yu = x @ und["kernel"].astype(x.dtype)
+    if "bias" in und:
+        yu = yu + und["bias"].astype(x.dtype)
+    if gen is None:
+        return yu
+    yg = x @ gen["kernel"].astype(x.dtype)
+    if "bias" in gen:
+        yg = yg + gen["bias"].astype(x.dtype)
+    return jnp.where(gen_mask[..., None], yg, yu)
+
+
+def _mot_norm(x, und_scale, gen_scale, gen_mask, eps):
+    yu = rms_norm(x, und_scale, eps)
+    if gen_scale is None:
+        return yu
+    yg = rms_norm(x, gen_scale, eps)
+    return jnp.where(gen_mask[..., None], yg, yu)
+
+
+def forward(
+    params: dict,
+    cfg: BagelConfig,
+    input_ids: jnp.ndarray,        # (B, S) text ids (anything at non-text slots)
+    token_type: jnp.ndarray,       # (B, S) 0=text 1=vit 2=vae
+    *,
+    pixel_values: jnp.ndarray | None = None,   # (B, H, W, 3) und image
+    latents: jnp.ndarray | None = None,        # (B, C, Hl, Wl) VAE latents
+    timesteps: jnp.ndarray | None = None,      # (B,) raw (pre-sigmoid) t
+    rng: jax.Array | None = None,              # flow-matching noise
+    positions: jnp.ndarray | None = None,
+    segment_ids: jnp.ndarray | None = None,
+    mesh_ctx=None,
+    rules=None,
+    return_hidden: bool = False,
+    **_ignored,
+):
+    """Returns (out, gen_out) — `out` is logits or the und-normed hidden;
+    `gen_out` is None in understanding-only mode, else a dict with the
+    flow-matching pieces (velocity_pred, target, t_shifted) at every
+    position (mask by token_type == VAE ∧ t > 0 in the loss; reference:
+    model.py:556-581)."""
+    from automodel_tpu.models.common.layers import cast_params
+
+    params = cast_params(params, cfg.dtype)
+    B, S = input_ids.shape
+    H = cfg.hidden_size
+    D = cfg.resolved_head_dim
+    eps = cfg.rms_norm_eps
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
+    if segment_ids is None:
+        segment_ids = jnp.zeros((B, S), jnp.int32)
+
+    lm = params["language_model"]
+    h = jnp.take(lm["embed"]["embedding"], input_ids, axis=0).astype(cfg.dtype)
+
+    # --- understanding branch: tower → connector → +grid pos → scatter ----
+    if pixel_values is not None:
+        feats = vit.forward(params["vit_model"], cfg.vision, pixel_values)
+        c = params["connector"]
+        x = feats.astype(cfg.dtype) @ c["fc1"]["kernel"].astype(cfg.dtype) + c["fc1"]["bias"].astype(cfg.dtype)
+        x = jax.nn.gelu(x, approximate=True)
+        x = x @ c["fc2"]["kernel"].astype(cfg.dtype) + c["fc2"]["bias"].astype(cfg.dtype)
+        side = cfg.vision.image_size // cfg.vision.patch_size
+        gy, gx = jnp.meshgrid(jnp.arange(side), jnp.arange(side), indexing="ij")
+        grid_pos = (gy * cfg.vit_max_num_patch_per_side + gx).reshape(-1)
+        # frozen sin/cos grid table: a jit-time constant, not a param
+        table = sincos_grid_table(H, cfg.vit_max_num_patch_per_side)
+        x = x + jnp.take(table, grid_pos, axis=0).astype(cfg.dtype)[None]
+        from automodel_tpu.models.vlm.llava import merge_image_embeddings
+
+        h = merge_image_embeddings(h, x, token_type == VIT)
+
+    # --- generation branch: latents → x_t tokens → scatter ----------------
+    gen_ctx = None
+    if cfg.visual_gen and latents is not None:
+        assert timesteps is not None and rng is not None, (
+            "visual_gen forward needs timesteps and rng for flow matching"
+        )
+        p = cfg.latent_patch_size
+        C = cfg.z_channels
+        _, _, Hl, Wl = latents.shape
+        hh, ww = Hl // p, Wl // p
+        lat = latents[:, :, : hh * p, : ww * p].reshape(B, C, hh, p, ww, p)
+        clean = jnp.einsum("bchpwq->bhwpqc", lat).reshape(B, hh * ww, p * p * C)
+        noise = jax.random.normal(rng, clean.shape, clean.dtype)
+        t = jax.nn.sigmoid(timesteps.astype(jnp.float32))
+        s = cfg.timestep_shift
+        t = s * t / (1 + (s - 1) * t)                       # (B,)
+        x_t = (1 - t[:, None, None]) * clean + t[:, None, None] * noise
+        te = params["time_embedder"]
+        tf = timestep_features(t, cfg.timestep_embed_size)
+        temb = tf @ te["fc1"]["kernel"] + te["fc1"]["bias"]
+        temb = jax.nn.silu(temb) @ te["fc2"]["kernel"] + te["fc2"]["bias"]
+        gy, gx = jnp.meshgrid(jnp.arange(hh), jnp.arange(ww), indexing="ij")
+        lat_pos = (gy * cfg.max_latent_size + gx).reshape(-1)
+        lpe = jnp.take(
+            sincos_grid_table(H, cfg.max_latent_size), lat_pos, axis=0
+        )
+        v2l = params["vae2llm"]
+        tok = (
+            x_t.astype(cfg.dtype) @ v2l["kernel"].astype(cfg.dtype)
+            + v2l["bias"].astype(cfg.dtype)
+            + temb[:, None, :].astype(cfg.dtype)
+            + lpe[None].astype(cfg.dtype)
+        )
+        from automodel_tpu.models.vlm.llava import merge_image_embeddings
+
+        h = merge_image_embeddings(h, tok, token_type == VAE)
+        gen_ctx = (clean, noise, t)
+
+    # --- MoT decoder -------------------------------------------------------
+    gen_mask = token_type == VAE
+
+    def _freeze(x):
+        """freeze_und (stage-2 option): detach und-token activations so the
+        understanding experts receive no gradients — applied at every layer
+        input AND to the post-projection q/k/v und slices, matching the
+        reference's per-slice detaches (modeling_qwen2_packed.py:662-706)."""
+        if not cfg.freeze_und:
+            return x
+        gm = gen_mask.reshape(gen_mask.shape + (1,) * (x.ndim - 2))
+        return jnp.where(gm, x, jax.lax.stop_gradient(x))
+
+    h = _freeze(h)
+    keep = bagel_attention_mask(token_type, segment_ids)
+    inv_freq = rope_frequencies(D, cfg.rope_theta)
+    und_l = lm["layers"]["und"]
+    gen_l = lm["layers"].get("gen")
+    Hq, Hkv = cfg.num_heads, cfg.num_kv_heads
+    G = Hq // Hkv
+    remat = cfg.remat_policy not in (None, "none")
+
+    def one_layer(h, i):
+        h = _freeze(h)
+        lu = jax.tree.map(lambda x: x[i], und_l)
+        lg = jax.tree.map(lambda x: x[i], gen_l) if gen_l is not None else None
+
+        def g(name):
+            return None if lg is None else lg[name]
+
+        x = _mot_norm(
+            h, lu["input_norm"]["scale"],
+            None if lg is None else lg["input_norm"]["scale"], gen_mask, eps,
+        )
+        q = _mot_linear(x, lu["q_proj"], g("q_proj"), gen_mask)
+        k = _mot_linear(x, lu["k_proj"], g("k_proj"), gen_mask)
+        v = _mot_linear(x, lu["v_proj"], g("v_proj"), gen_mask)
+        q = q.reshape(B, S, Hq, D)
+        k = k.reshape(B, S, Hkv, D)
+        v = v.reshape(B, S, Hkv, D)
+        if cfg.qk_norm:
+            q = _mot_norm(q, lu["q_norm"]["scale"],
+                          None if lg is None else lg["q_norm"]["scale"],
+                          gen_mask[..., None], eps)
+            k = _mot_norm(k, lu["k_norm"]["scale"],
+                          None if lg is None else lg["k_norm"]["scale"],
+                          gen_mask[..., None], eps)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        q, k, v = _freeze(q), _freeze(k), _freeze(v)
+        from automodel_tpu.ops.attention import xla_attention
+
+        attn = xla_attention(q, k, v, mask=keep).reshape(B, S, Hq * D)
+        h = h + _mot_linear(attn, lu["o_proj"], g("o_proj"), gen_mask)
+
+        x = _mot_norm(
+            h, lu["post_attn_norm"]["scale"],
+            None if lg is None else lg["post_attn_norm"]["scale"], gen_mask, eps,
+        )
+        gate = jax.nn.silu(_mot_linear(x, lu["gate_proj"], g("gate_proj"), gen_mask))
+        up = _mot_linear(x, lu["up_proj"], g("up_proj"), gen_mask)
+        h = h + _mot_linear(gate * up, lu["down_proj"], g("down_proj"), gen_mask)
+        return h
+
+    step = jax.checkpoint(one_layer) if remat else one_layer
+    for i in range(cfg.num_layers):
+        h = step(h, i)
+
+    fn = lm["final_norm"]
+    h = _mot_norm(
+        h, fn["und"]["scale"],
+        fn["gen"]["scale"] if "gen" in fn else None, gen_mask, eps,
+    )
+
+    gen_out = None
+    if gen_ctx is not None:
+        clean, noise, t = gen_ctx
+        l2v = params["llm2vae"]
+        pred_full = h @ l2v["kernel"].astype(h.dtype) + l2v["bias"].astype(h.dtype)
+        # gather the vae slots back into latent-grid order (inverse of the
+        # merge scatter): slot j of the latent grid sits at the j-th VAE
+        # position of the row
+        order = jnp.cumsum(gen_mask.astype(jnp.int32), axis=1) - 1
+        N = clean.shape[1]
+        idx = jnp.where(gen_mask, order, N)  # invalid → dropped bucket
+        pred = jnp.zeros((B, N + 1, cfg.patch_latent_dim), pred_full.dtype)
+        pred = pred.at[jnp.arange(B)[:, None], idx].set(pred_full)
+        pred = pred[:, :N]
+        gen_out = {
+            "velocity_pred": pred.astype(jnp.float32),
+            "target": (noise - clean).astype(jnp.float32),
+            "t": t,
+        }
+
+    if return_hidden:
+        return h, gen_out
+    logits = jnp.einsum(
+        "bsh,hv->bsv", h, lm["lm_head"]["kernel"].astype(h.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return logits, gen_out
+
+
+def bagel_losses(
+    logits_or_hidden,
+    gen_out,
+    labels: jnp.ndarray,         # (B, S) -100 at unsupervised
+    token_type: jnp.ndarray,
+    timesteps: jnp.ndarray | None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(ce_sum, n_ce_tokens, mse_mean) — CE over supervised text positions,
+    MSE over generation latents at t>0 (reference: model.py:556-581; the
+    -inf sentinel timesteps sigmoid to 0 and drop out)."""
+    from automodel_tpu.loss import cross_entropy_sum
+
+    del timesteps  # the shifted t rides gen_out; kept for API clarity
+    ce, n = cross_entropy_sum(logits_or_hidden, labels)
+    mse = jnp.float32(0.0)
+    if gen_out is not None:
+        d = (gen_out["velocity_pred"] - gen_out["target"]) ** 2
+        w = (gen_out["t"] > 0).astype(jnp.float32)[:, None]     # (B, 1)
+        mse = jnp.sum(d.mean(-1) * w) / jnp.maximum(w.sum() * d.shape[1], 1.0)
+    return ce, n, mse
+
+
+# ---------------------------------------------------------------------------
+# HF state-dict adapter (reference: bagel/state_dict_adapter.py —
+# ema.safetensors layout: language_model.model.* with *_moe_gen siblings,
+# vit_model.vision_model.*, connector.*, top-level pos tables + gen linears)
+# ---------------------------------------------------------------------------
+class BagelAdapter:
+    def __init__(self, cfg: BagelConfig):
+        self.cfg = cfg
+
+    _LAYER = [
+        ("input_layernorm{g}.weight", ("input_norm", "scale"), False),
+        ("self_attn.q_proj{g}.weight", ("q_proj", "kernel"), True),
+        ("self_attn.q_proj{g}.bias", ("q_proj", "bias"), False),
+        ("self_attn.k_proj{g}.weight", ("k_proj", "kernel"), True),
+        ("self_attn.k_proj{g}.bias", ("k_proj", "bias"), False),
+        ("self_attn.v_proj{g}.weight", ("v_proj", "kernel"), True),
+        ("self_attn.v_proj{g}.bias", ("v_proj", "bias"), False),
+        ("self_attn.o_proj{g}.weight", ("o_proj", "kernel"), True),
+        ("post_attention_layernorm{g}.weight", ("post_attn_norm", "scale"), False),
+    ]
+    _QKN = [
+        ("self_attn.q_norm{g}.weight", ("q_norm", "scale"), False),
+        ("self_attn.k_norm{g}.weight", ("k_norm", "scale"), False),
+    ]
+
+    def _mlp_name(self, expert: str, proj: str) -> str:
+        return (
+            f"mlp.{proj}.weight" if expert == "und" else f"mlp_moe_gen.{proj}.weight"
+        )
+
+    def _layer_entries(self, expert: str):
+        g = "" if expert == "und" else "_moe_gen"
+        rows = [(suf.format(g=g), path, tr) for suf, path, tr in self._LAYER]
+        if self.cfg.qk_norm:
+            rows += [(suf.format(g=g), path, tr) for suf, path, tr in self._QKN]
+        return rows
+
+    def _experts(self):
+        return ("und", "gen") if self.cfg.visual_gen else ("und",)
+
+    _GEN_TOP = [
+        ("time_embedder.mlp.0.weight", ("time_embedder", "fc1", "kernel"), True),
+        ("time_embedder.mlp.0.bias", ("time_embedder", "fc1", "bias"), False),
+        ("time_embedder.mlp.2.weight", ("time_embedder", "fc2", "kernel"), True),
+        ("time_embedder.mlp.2.bias", ("time_embedder", "fc2", "bias"), False),
+        ("vae2llm.weight", ("vae2llm", "kernel"), True),
+        ("vae2llm.bias", ("vae2llm", "bias"), False),
+        ("llm2vae.weight", ("llm2vae", "kernel"), True),
+        ("llm2vae.bias", ("llm2vae", "bias"), False),
+    ]
+    _CONN = [
+        ("connector.fc1.weight", ("connector", "fc1", "kernel"), True),
+        ("connector.fc1.bias", ("connector", "fc1", "bias"), False),
+        ("connector.fc2.weight", ("connector", "fc2", "kernel"), True),
+        ("connector.fc2.bias", ("connector", "fc2", "bias"), False),
+    ]
+
+    def from_hf(self, read, shardings=None) -> dict:
+        import numpy as np
+
+        from automodel_tpu.checkpoint.hf_adapter import LlavaAdapter, _get, _set
+
+        cfg = self.cfg
+        params: dict = {}
+
+        def put(path, value):
+            sh = _get(shardings, path) if shardings is not None else None
+            _set(
+                params, path,
+                jax.device_put(value, sh) if sh is not None else jnp.asarray(value),
+            )
+
+        def one(name, tr):
+            x = np.asarray(read(name))
+            return np.ascontiguousarray(x.T) if tr else x
+
+        lmp = "language_model."
+        put(("language_model", "embed", "embedding"), one(lmp + "model.embed_tokens.weight", False))
+        put(("language_model", "final_norm", "und", "scale"), one(lmp + "model.norm.weight", False))
+        put(("language_model", "lm_head", "kernel"), one(lmp + "lm_head.weight", True))
+        if cfg.visual_gen:
+            put(("language_model", "final_norm", "gen", "scale"),
+                one(lmp + "model.norm_moe_gen.weight", False))
+        for expert in self._experts():
+            for suf, path, tr in self._layer_entries(expert):
+                put(("language_model", "layers", expert) + path, np.stack([
+                    one(f"{lmp}model.layers.{i}.{suf}", tr)
+                    for i in range(cfg.num_layers)
+                ]))
+            for proj in ("gate_proj", "up_proj", "down_proj"):
+                put(("language_model", "layers", expert, proj, "kernel"), np.stack([
+                    one(f"{lmp}model.layers.{i}.{self._mlp_name(expert, proj)}", True)
+                    for i in range(cfg.num_layers)
+                ]))
+        for suf, path, tr in self._CONN:
+            put(path, one(suf, tr))
+        # SigLIP tower: reuse the shared ViT mapping under vit_model.
+        vt = LlavaAdapter(cfg)._vit_from_hf(read, "vit_model")
+        sub = _get(shardings, ("vit_model",)) if shardings is not None else None
+        params["vit_model"] = (
+            jax.tree.map(jax.device_put, vt, sub) if sub is not None
+            else jax.tree.map(jnp.asarray, vt)
+        )
+        if cfg.visual_gen:
+            for suf, path, tr in self._GEN_TOP:
+                put(path, one(suf, tr))
+        return params
+
+    def to_hf(self, params):
+        import numpy as np
+
+        from automodel_tpu.checkpoint.hf_adapter import LlavaAdapter, _get
+
+        cfg = self.cfg
+
+        def _t(x):
+            return np.ascontiguousarray(np.asarray(x).T)
+
+        lm = params["language_model"]
+        yield "language_model.model.embed_tokens.weight", np.asarray(lm["embed"]["embedding"])
+        yield "language_model.model.norm.weight", np.asarray(lm["final_norm"]["und"]["scale"])
+        yield "language_model.lm_head.weight", _t(lm["lm_head"]["kernel"])
+        if cfg.visual_gen:
+            yield "language_model.model.norm_moe_gen.weight", np.asarray(
+                lm["final_norm"]["gen"]["scale"]
+            )
+        for expert in self._experts():
+            grp = lm["layers"][expert]
+            for i in range(cfg.num_layers):
+                for suf, path, tr in self._layer_entries(expert):
+                    x = np.asarray(_get(grp, path)[i])
+                    yield f"language_model.model.layers.{i}.{suf}", (_t(x) if tr else x)
+                for proj in ("gate_proj", "up_proj", "down_proj"):
+                    yield (
+                        f"language_model.model.layers.{i}.{self._mlp_name(expert, proj)}",
+                        _t(grp[proj]["kernel"][i]),
+                    )
+        for suf, path, tr in self._CONN:
+            x = np.asarray(_get(params, path))
+            yield suf, (_t(x) if tr else x)
+        # the frozen tables are computed constants, not params — emit the
+        # checkpoint keys the reference layout expects
+        yield "vit_pos_embed.pos_embed", np.asarray(
+            sincos_grid_table(cfg.hidden_size, cfg.vit_max_num_patch_per_side)
+        )
+        yield from LlavaAdapter(cfg)._vit_to_hf(params["vit_model"], "vit_model")
+        if cfg.visual_gen:
+            for suf, path, tr in self._GEN_TOP:
+                x = np.asarray(_get(params, path))
+                yield suf, (_t(x) if tr else x)
+            yield "latent_pos_embed.pos_embed", np.asarray(
+                sincos_grid_table(cfg.hidden_size, cfg.max_latent_size)
+            )
+
+
+def _register_adapter():
+    from automodel_tpu.checkpoint.hf_adapter import ADAPTERS
+
+    ADAPTERS["bagel"] = BagelAdapter
+
+
+_register_adapter()

@@ -21,6 +21,7 @@ from automodel_tpu.models.moe_lm import families as moe_families
 from automodel_tpu.models.moe_lm import gemma4 as gemma4_module
 from automodel_tpu.models.moe_lm import het_families
 from automodel_tpu.models.moe_lm import het_moe as het_moe_module
+from automodel_tpu.models.omni import bagel as bagel_module
 from automodel_tpu.models.omni import model as omni_module
 from automodel_tpu.models.vlm import kimi_vl as kimi_vl_module
 from automodel_tpu.models.vlm import llama_nemotron_vl as llama_nemotron_vl_module
@@ -178,6 +179,15 @@ MODEL_ARCH_MAPPING: dict[str, ModelSpec] = {
     # qwen2_5_omni) — towers + projectors around a dense decoder backbone
     "OmniForConditionalGeneration": ModelSpec(
         "omni", omni_module.omni_config, omni_module, adapter_name="omni"
+    ),
+    # BAGEL: unified multimodal understanding + generation — MoT decoder
+    # with und/gen expert siblings, SigLIP tower, flow-matching latent head
+    # (reference: components/models/bagel/, 4227 LoC)
+    "BagelForUnifiedMultimodal": ModelSpec(
+        "bagel", bagel_module.bagel_config, bagel_module, adapter_name="bagel"
+    ),
+    "BagelForConditionalGeneration": ModelSpec(
+        "bagel", bagel_module.bagel_config, bagel_module, adapter_name="bagel"
     ),
     # Kimi-VL: MoonViT tower + 2×2-merge projector + DeepSeek-V3 MoE text
     # (reference: models/kimivl, 908 LoC)

@@ -1,0 +1,74 @@
+"""BAGEL unified-multimodal training recipe: joint CE + flow-matching MSE.
+
+The analog of the reference's BAGEL training path (reference:
+recipes/multimodal + components/models/bagel/model.py forward): stage 1
+(understanding only, `visual_gen: false`) is plain CE; stage 2 adds the
+MSE over flow-matching velocities for t2i samples, with the total loss
+ce + mse_weight · mse (the reference returns both per-token losses and the
+trainer combines them).
+
+YAML: `recipe: bagel_finetune`; batches carry token_type / pixel_values /
+latents / timesteps (see datasets.bagel_mock.MockBagelDatasetConfig).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.recipes.llm.train_ft import TrainFinetuneRecipeForNextTokenPrediction
+
+logger = logging.getLogger(__name__)
+
+
+class BagelRecipe(TrainFinetuneRecipeForNextTokenPrediction):
+    # (accum, batch)-sharded media; token_type is a SEQUENCE tensor and
+    # shards with input_ids
+    MEDIA_KEYS = ("pixel_values", "latents", "timesteps")
+
+    def _make_global(self, batch_np: dict):
+        from automodel_tpu.datasets.loader import make_global_batch
+
+        seq_sh = self.mesh_ctx.sharding(None, "batch", None)
+        media_sh = self.mesh_ctx.sharding(None, "batch")
+        shardings = {
+            k: (media_sh if k in self.MEDIA_KEYS else seq_sh) for k in batch_np
+        }
+        return make_global_batch(batch_np, self.mesh_ctx, shardings)
+
+    def _make_loss_fn(self):
+        module = self.model_spec.module
+        model_cfg = self.model_cfg
+        mesh_ctx = self.mesh_ctx
+        mse_weight = float(self.cfg.get("loss.mse_weight", 1.0))
+        accum = float(self.cfg.get("dataloader.grad_acc_steps", 1))
+
+        from automodel_tpu.models.omni.bagel import bagel_losses
+
+        def loss_fn(params, batch, rng, *extra):
+            logits, gen_out = module.forward(
+                params, model_cfg, batch["input_ids"], batch["token_type"],
+                pixel_values=batch.get("pixel_values"),
+                latents=batch.get("latents"),
+                timesteps=batch.get("timesteps"),
+                rng=rng,
+                positions=batch.get("positions"),
+                segment_ids=batch.get("segment_ids"),
+                mesh_ctx=mesh_ctx,
+            )
+            ce, n, mse = bagel_losses(
+                logits, gen_out, batch["labels"], batch["token_type"],
+                batch.get("timesteps"),
+            )
+            # ce is a SUM over supervised tokens; mse a mean — scale mse by
+            # the token count so the ce/n normalization downstream leaves it
+            # a per-step mean term, matching the reference's separate-loss
+            # accounting
+            total = ce + mse_weight * mse * jnp.maximum(n, 1.0)
+            # scalar metrics are summed over grad-accum microbatches by the
+            # train step; pre-divide so the logged value is the mean
+            return total, {"num_label_tokens": n, "mse": mse / accum}
+
+        return loss_fn

@@ -37,6 +37,7 @@ WRITE = bool(os.environ.get("AM_WRITE_PINS"))
 
 _TEXT = "text"
 _VLM = "vlm"
+_BAGEL = "bagel"
 
 FAMILIES = {
     "baichuan": (_TEXT, {
@@ -259,6 +260,21 @@ FAMILIES = {
             "num_key_value_heads": 2, "pooling": "avg",
         },
     }),
+    "bagel": (_BAGEL, {
+        "architectures": ["BagelForUnifiedMultimodal"], "model_type": "bagel",
+        "visual_gen": True,
+        "llm_config": {
+            "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "num_key_value_heads": 2, "qk_norm": True,
+        },
+        "vision_config": {
+            "hidden_size": 32, "intermediate_size": 48, "num_hidden_layers": 2,
+            "num_attention_heads": 2, "image_size": 56, "patch_size": 14,
+        },
+        "vit_max_num_patch_per_side": 8, "latent_patch_size": 2,
+        "max_latent_size": 8, "vae_config": {"z_channels": 4, "downsample": 8},
+    }),
 }
 
 
@@ -278,7 +294,21 @@ def _run(name):
     spec = get_model_spec(hf)
     cfg = spec.config_from_hf(hf, dtype=jnp.float32, remat_policy="none")
     params = spec.module.init(cfg, jax.random.key(0))
-    if kind == _VLM:
+    if kind == _BAGEL:
+        rng = np.random.default_rng(0)
+        B, S = 2, 40
+        ids = jnp.asarray(rng.integers(1, 100, (B, S), dtype=np.int32))
+        tt = np.zeros((B, S), np.int32)
+        tt[:, 2:18] = 1
+        tt[:, 20:36] = 2
+        pix = jnp.asarray(rng.normal(size=(B, 56, 56, 3)).astype(np.float32))
+        lat = jnp.asarray(rng.normal(size=(B, 4, 8, 8)).astype(np.float32))
+        t = jnp.asarray(rng.normal(size=(B,)).astype(np.float32))
+        out, _gen = spec.module.forward(
+            params, cfg, ids, jnp.asarray(tt), pixel_values=pix,
+            latents=lat, timesteps=t, rng=jax.random.key(1),
+        )
+    elif kind == _VLM:
         tok = int(
             hf.get("image_token_id")
             or hf.get("image_token_index")
