@@ -34,6 +34,7 @@ RECIPE_ALIASES = {
     "dllm_train_ft": "automodel_tpu.recipes.dllm.train_ft.DiffusionLMSFTRecipe",
     "diffusion_train": "automodel_tpu.recipes.diffusion.train.TrainDiffusionRecipe",
     "bagel_finetune": "automodel_tpu.recipes.multimodal.bagel.BagelRecipe",
+    "multimodal_pretrain": "automodel_tpu.recipes.multimodal.pretrain.PretrainRecipeForMultimodal",
     "vlm_finetune": "automodel_tpu.recipes.vlm.finetune.FinetuneRecipeForVLM",
     "vlm_kd": "automodel_tpu.recipes.vlm.kd.KDRecipeForVLM",
     "vlm_generate": "automodel_tpu.recipes.vlm.generate.GenerateRecipeForVLM",
@@ -86,8 +87,9 @@ def print_capabilities() -> None:
         "architectures": sorted(MODEL_ARCH_MAPPING),
         "recipes": sorted(RECIPE_ALIASES),
         "parallelism": [
-            "dp_replicate", "dp_shard(fsdp)", "tp", "cp(ring, load-balanced)",
-            "ep(dropless ragged-a2a)", "pp(gpipe|1f1b|interleaved)",
+            "dp_replicate", "dp_shard(fsdp)", "tp",
+            "cp(ring load-balanced | blockdiag per-document)",
+            "ep(dropless ragged-a2a)", "pp(gpipe|1f1b|interleaved|zb)",
         ],
         "features": [
             "lora_peft", "knowledge_distillation", "mtp", "fp8_int8_matmul",
@@ -98,6 +100,7 @@ def print_capabilities() -> None:
             "sampling_eval", "agent_tool_call_sft", "neat_packing",
             "orbax_checkpointing", "hf_safetensors_io", "golden_value_ci",
             "profiler_traces", "wandb_mlflow_trackers",
+            "bagel_unified_multimodal", "flow_matching_adapters",
         ],
     }
     print(json.dumps(caps, indent=2))

@@ -191,6 +191,10 @@ class MockLatentDatasetConfig:
     channels: int = 4
     num_classes: int = 0
     num_patterns: int = 8
+    # text conditioning (the SimpleAdapter/Wan layout): emit a deterministic
+    # per-pattern text embedding (text_len, text_dim); 0 = off
+    text_dim: int = 0
+    text_len: int = 8
     seed: int = 0
 
     def build(self) -> "MockLatentDataset":
@@ -216,4 +220,9 @@ class MockLatentDataset:
         out = {"latents": lat.astype(np.float32)}
         if c.num_classes > 0:
             out["class_labels"] = np.int32(pid % c.num_classes)
+        if c.text_dim > 0:
+            trng = np.random.default_rng(c.seed * 31 + pid)  # per-pattern
+            out["text_embeddings"] = trng.normal(
+                0, 1, (c.text_len, c.text_dim)
+            ).astype(np.float32)
         return out

@@ -1,0 +1,102 @@
+"""Flow-matching model adapters: decouple the training pipeline from
+model-specific conditioning.
+
+The analog of the reference's adapter layer (reference: nemo_automodel/
+components/flow_matching/adapters/base.py `ModelAdapter` +
+`FlowMatchingContext`, simple.py `SimpleAdapter` — the Wan-style
+hidden_states/timestep/encoder_hidden_states interface; flux.py/
+qwen_image.py follow the same contract with richer inputs). An adapter
+turns a `FlowMatchingContext` into model inputs and runs the forward; the
+diffusion recipe stays model-agnostic.
+
+Adapters here:
+- "class": the class-conditional DiT path (labels + CFG label dropout).
+- "simple": Wan-layout text conditioning — `encoder_hidden_states` from
+  the batch's `text_embeddings`, with CFG dropout zeroing the embeddings
+  (base.py cfg_dropout_prob semantics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class FlowMatchingContext:
+    """What the pipeline hands every adapter (reference: base.py:30)."""
+
+    noisy_latents: jnp.ndarray   # (B, H, W, C) x_sigma
+    latents: jnp.ndarray         # (B, H, W, C) clean
+    sigma: jnp.ndarray           # (B,)
+    batch: Dict[str, Any]
+    rng: jax.Array               # CFG dropout randomness
+    cfg_dropout_prob: float = 0.0
+
+
+class ClassConditionalAdapter:
+    """The DiT class-label path (CFG drops to the null class)."""
+
+    name = "class"
+
+    def prepare_inputs(self, cfg, context: FlowMatchingContext) -> dict:
+        labels = context.batch.get("class_labels")
+        if labels is not None and cfg.num_classes > 0 and context.cfg_dropout_prob > 0:
+            drop = jax.random.uniform(context.rng, (labels.shape[0],)) < context.cfg_dropout_prob
+            labels = jnp.where(drop, cfg.num_classes, labels)
+        return {
+            "latents": context.noisy_latents,
+            "sigma": context.sigma,
+            "class_labels": labels,
+        }
+
+    def forward(self, module, params, cfg, inputs, mesh_ctx=None):
+        return module.forward(params, cfg, mesh_ctx=mesh_ctx, **inputs)
+
+
+class SimpleAdapter:
+    """Wan-style text conditioning (reference: adapters/simple.py): the
+    batch carries precomputed `text_embeddings` (B, L, Dtext); CFG dropout
+    zeroes whole samples' embeddings (the null condition)."""
+
+    name = "simple"
+
+    def prepare_inputs(self, cfg, context: FlowMatchingContext) -> dict:
+        text = context.batch.get("text_embeddings")
+        if text is None:
+            raise ValueError(
+                "SimpleAdapter needs batch['text_embeddings'] "
+                "(B, L, cross_attention_dim)"
+            )
+        if context.cfg_dropout_prob > 0:
+            drop = (
+                jax.random.uniform(context.rng, (text.shape[0],))
+                < context.cfg_dropout_prob
+            )
+            text = jnp.where(drop[:, None, None], 0.0, text)
+        return {
+            "latents": context.noisy_latents,
+            "sigma": context.sigma,
+            "encoder_hidden_states": text,
+        }
+
+    def forward(self, module, params, cfg, inputs, mesh_ctx=None):
+        return module.forward(params, cfg, mesh_ctx=mesh_ctx, **inputs)
+
+
+ADAPTERS = {
+    "class": ClassConditionalAdapter,
+    "simple": SimpleAdapter,
+}
+
+
+def get_flow_adapter(name: str):
+    try:
+        return ADAPTERS[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown flow-matching adapter '{name}' (known: {sorted(ADAPTERS)})"
+        ) from None

@@ -65,6 +65,7 @@ def _dit_config_to_hf(cfg: dit.DiTConfig) -> dict:
         "num_heads": cfg.num_heads,
         "mlp_ratio": cfg.mlp_ratio,
         "num_classes": cfg.num_classes,
+        "cross_attention_dim": cfg.cross_attention_dim,
     }
 
 
@@ -74,6 +75,7 @@ def _dit_config_from_hf(d: dict, **overrides) -> dit.DiTConfig:
         for k in (
             "input_size", "patch_size", "in_channels", "hidden_size",
             "num_layers", "num_heads", "mlp_ratio", "num_classes",
+            "cross_attention_dim",
         )
         if k in d
     }
@@ -211,6 +213,7 @@ class AutoDiffusionPipeline:
         batch_size: int = 1,
         *,
         class_labels: jnp.ndarray | None = None,
+        text_embeddings: jnp.ndarray | None = None,  # (B, L, Dtext) SimpleAdapter
         guidance_scale: float = 1.0,
         num_inference_steps: int = 16,
         decode: bool = True,
@@ -225,18 +228,33 @@ class AutoDiffusionPipeline:
             guidance_scale != 1.0 and class_labels is not None and cfg.num_classes > 0
         )
 
+        text_kw = {}
+        if cfg.cross_attention_dim > 0:
+            if text_embeddings is None:
+                raise ValueError(
+                    "this pipeline's transformer is text-conditioned "
+                    "(cross_attention_dim > 0); pass text_embeddings"
+                )
+            text_kw["encoder_hidden_states"] = text_embeddings
+
         def velocity(x, sigma):
             if not use_cfg:
                 return dit.forward(
                     self.transformer_params, cfg, x.astype(cfg.dtype), sigma,
-                    class_labels=class_labels,
+                    class_labels=class_labels, **text_kw,
                 ).astype(jnp.float32)
             null = jnp.full_like(class_labels, cfg.num_classes)
+            tk = (
+                {"encoder_hidden_states": jnp.concatenate(
+                    [text_kw["encoder_hidden_states"]] * 2
+                )}
+                if text_kw else {}
+            )
             v2 = dit.forward(
                 self.transformer_params, cfg,
                 jnp.concatenate([x, x]).astype(cfg.dtype),
                 jnp.concatenate([sigma, sigma]),
-                class_labels=jnp.concatenate([class_labels, null]),
+                class_labels=jnp.concatenate([class_labels, null]), **tk,
             ).astype(jnp.float32)
             v_c, v_u = jnp.split(v2, 2)
             return v_u + guidance_scale * (v_c - v_u)

@@ -35,6 +35,12 @@ class DiTConfig:
     num_heads: int = 6
     mlp_ratio: float = 4.0
     num_classes: int = 0          # 0 = unconditional
+    # Wan-style text conditioning (reference: flow_matching/adapters/
+    # simple.py — hidden_states/timestep/encoder_hidden_states interface):
+    # per-block cross-attention over (B, L, cross_attention_dim) text
+    # embeddings; 0 = off. The cross-attn out kernel is zero-init so
+    # conditioning starts neutral.
+    cross_attention_dim: int = 0
     dtype: jnp.dtype = jnp.float32
     remat_policy: Optional[str] = "full"
     scan_unroll: int = 1
@@ -97,6 +103,11 @@ def init(cfg: DiTConfig, rng: jax.Array) -> dict:
         params["class_embed"] = {
             "embedding": 0.02 * jax.random.normal(ks[8], (cfg.num_classes + 1, H))
         }  # +1 = the CFG null class
+    if cfg.cross_attention_dim > 0:
+        kq, kkv = jax.random.split(ks[9])
+        params["layers"]["xq"] = {"kernel": stack(kq, (H, H))}
+        params["layers"]["xkv"] = {"kernel": stack(kkv, (cfg.cross_attention_dim, 2 * H))}
+        params["layers"]["xout"] = {"kernel": jnp.zeros((L, H, H))}
     return params
 
 
@@ -122,6 +133,10 @@ def param_specs(cfg: DiTConfig) -> dict:
     }
     if cfg.num_classes > 0:
         specs["class_embed"] = {"embedding": (None, "embed")}
+    if cfg.cross_attention_dim > 0:
+        specs["layers"]["xq"] = {"kernel": ("layers", "embed", "heads")}
+        specs["layers"]["xkv"] = {"kernel": ("layers", None, "heads")}
+        specs["layers"]["xout"] = {"kernel": ("layers", "heads", "embed")}
     return specs
 
 
@@ -160,6 +175,7 @@ def forward(
     latents: jnp.ndarray,         # (B, H, W, C) noisy input x_σ
     sigma: jnp.ndarray,           # (B,)
     class_labels: jnp.ndarray | None = None,  # (B,) int; num_classes = null
+    encoder_hidden_states: jnp.ndarray | None = None,  # (B, L, Dtext)
     mesh_ctx=None,
 ) -> jnp.ndarray:
     """Predict the velocity field, same shape as `latents`."""
@@ -187,6 +203,16 @@ def forward(
         c = c + jnp.take(params["class_embed"]["embedding"], labels, axis=0)
     c = jax.nn.silu(c)
 
+    if cfg.cross_attention_dim > 0:
+        if encoder_hidden_states is None:
+            raise ValueError(
+                "cross_attention_dim > 0 requires encoder_hidden_states "
+                "(the SimpleAdapter text-conditioning contract)"
+            )
+        text = encoder_hidden_states.astype(cfg.dtype)
+    else:
+        text = None
+
     def block(h, lp):
         mod = c @ lp["mod"]["kernel"] + lp["mod"]["bias"]          # (B, 6H)
         s1, sc1, g1, s2, sc2, g2 = jnp.split(mod[:, None, :], 6, axis=-1)
@@ -195,6 +221,13 @@ def forward(
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         attn = dot_product_attention(q, k, v, causal=False, impl="xla")
         h = h + g1 * (attn.reshape(B, -1, Hn * D) @ lp["attn_out"]["kernel"])
+        if text is not None:
+            xq = (_ln(h) @ lp["xq"]["kernel"]).reshape(B, -1, Hn, D)
+            xkv = (text @ lp["xkv"]["kernel"]).reshape(B, -1, 2, Hn, D)
+            xa = dot_product_attention(
+                xq, xkv[:, :, 0], xkv[:, :, 1], causal=False, impl="xla"
+            )
+            h = h + xa.reshape(B, -1, Hn * D) @ lp["xout"]["kernel"]
         m_in = _ln(h) * (1 + sc2) + s2
         mlp = jax.nn.gelu(m_in @ lp["mlp_in"]["kernel"], approximate=True)
         h = h + g2 * (mlp @ lp["mlp_out"]["kernel"])

@@ -84,17 +84,31 @@ class TrainDiffusionRecipe(TrainFinetuneRecipeForNextTokenPrediction):
             raise ValueError(
                 f"flow_matching.weighting must be none|linear, got {self.fm_weighting}"
             )
+        from automodel_tpu.diffusion.adapters import get_flow_adapter
+
+        # model adapter (reference: flow_matching/adapters/): "class" =
+        # class-conditional DiT; "simple" = Wan-layout text conditioning
+        self.flow_adapter = get_flow_adapter(
+            str(cfg.get("model_adapter", "class"))
+        )
+        if self.flow_adapter.name == "simple" and self.model_cfg.cross_attention_dim <= 0:
+            raise ValueError(
+                "model_adapter: simple needs dit.cross_attention_dim > 0"
+            )
 
     def _build_tokenizer(self):
         return None
 
     def _make_loss_fn(self):
+        from automodel_tpu.diffusion.adapters import FlowMatchingContext
+
         model_cfg = self.model_cfg
         mesh_ctx = self.mesh_ctx
         scheme, shift = self.fm_scheme, self.fm_shift
         weighting = self.fm_weighting
         drop_p = self.cfg_drop_prob
         accum = float(self.cfg.get("dataloader.grad_acc_steps", 1))
+        adapter = self.flow_adapter
 
         def loss_fn(params, batch, rng, *extra):
             x0 = batch["latents"]
@@ -106,16 +120,13 @@ class TrainDiffusionRecipe(TrainFinetuneRecipeForNextTokenPrediction):
             x1 = jax.random.normal(k_noise, x0.shape, jnp.float32)
             x_sigma = interpolate(x0.astype(jnp.float32), x1, sigma)
 
-            labels = batch.get("class_labels")
-            if labels is not None and model_cfg.num_classes > 0 and drop_p > 0:
-                # classifier-free guidance: drop conditioning to the null class
-                drop = jax.random.uniform(k_drop, (B,)) < drop_p
-                labels = jnp.where(drop, model_cfg.num_classes, labels)
-
-            v = dit.forward(
-                params, model_cfg, x_sigma.astype(model_cfg.dtype), sigma,
-                class_labels=labels, mesh_ctx=mesh_ctx,
+            ctx = FlowMatchingContext(
+                noisy_latents=x_sigma.astype(model_cfg.dtype),
+                latents=x0, sigma=sigma, batch=batch, rng=k_drop,
+                cfg_dropout_prob=drop_p,
             )
+            inputs = adapter.prepare_inputs(model_cfg, ctx)
+            v = adapter.forward(dit, params, model_cfg, inputs, mesh_ctx=mesh_ctx)
             loss_sum, n = flow_matching_loss(
                 v, x0, x1, sigma, weighting=weighting, shift=shift
             )

@@ -1,0 +1,14 @@
+"""Multimodal (BAGEL) pretraining entrypoint.
+
+The analog of the reference's recipes/multimodal/pretrain.py — a subclass
+alias of the finetune/bagel recipe: the training step is identical and
+pretraining behavior is selected by the YAML model initializer (no
+pretrained_path = from-scratch init) and the data mixture."""
+
+from __future__ import annotations
+
+from automodel_tpu.recipes.multimodal.bagel import BagelRecipe
+
+
+class PretrainRecipeForMultimodal(BagelRecipe):
+    pass
