@@ -443,10 +443,12 @@ class MoEDecoderAdapter:
             return f"model.layers.{i}.mlp.router.weight"
         if self.style == "hunyuan":
             return f"model.layers.{i}.mlp.gate.wg.weight"
+        if self.style == "hy_mt2":
+            return f"model.layers.{i}.mlp.router.gate.weight"
         return f"model.layers.{i}.mlp.gate.weight"
 
     def _shared_base(self, i: int) -> str:
-        if self.style == "hunyuan":
+        if self.style in ("hunyuan", "hy_mt2"):
             return f"model.layers.{i}.mlp.shared_mlp"
         return f"model.layers.{i}.mlp.shared_experts"
 
@@ -459,6 +461,8 @@ class MoEDecoderAdapter:
             return f"model.layers.{i}.block_sparse_moe.e_score_correction_bias"
         if self.style == "bailing":
             return f"model.layers.{i}.mlp.gate.expert_bias"
+        if self.style == "hy_mt2":
+            return f"model.layers.{i}.mlp.expert_bias"
         return f"model.layers.{i}.mlp.gate.e_score_correction_bias"
 
     def _dense(self) -> DenseDecoderAdapter:

@@ -163,6 +163,11 @@ def _mla_attn_with_cache(h, lp, cfg, positions, inv_freq, cache_c, cache_kr,
     q = q.reshape(B, Sq, n, dn + dr)
     q_nope, q_rope = q[..., :dn], q[..., dn:]
     q_rope = apply_rope(q_rope, positions, inv_freq)
+    if cfg.mla_qpe_scaling_beta is not None:
+        sc = 1.0 + cfg.mla_qpe_scaling_beta * jnp.log1p(
+            jnp.floor(positions.astype(jnp.float32) / cfg.mla_qpe_scaling_orig_max)
+        )
+        q_rope = q_rope * sc[:, :, None, None].astype(q_rope.dtype)
 
     kv = _mm(x, lp["kv_down_proj"]["kernel"], prec)
     c_kv, k_rope = kv[..., :r], kv[..., r:]

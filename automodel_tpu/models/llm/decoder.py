@@ -95,6 +95,11 @@ class TransformerConfig:
     mla_qk_nope_head_dim: int = 128
     mla_qk_rope_head_dim: int = 64
     mla_v_head_dim: int = 128
+    # Mistral-4 llama4-style position-dependent q-rope scaling
+    # (reference: mistral4/model.py:52 _get_llama_4_attn_scale):
+    # q_pe *= 1 + beta * log(1 + floor(pos / orig_max)); None = off
+    mla_qpe_scaling_beta: Optional[float] = None
+    mla_qpe_scaling_orig_max: int = 8192
     # DSA (DeepSeek sparse attention, V3.2/V4): lightning-indexer top-k
     # sparse MLA. None → dense MLA. (reference: deepseek_v4/layers.py)
     dsa_index_topk: Optional[int] = None

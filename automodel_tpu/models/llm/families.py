@@ -206,3 +206,27 @@ def baichuan_config(hf: Mapping[str, Any], **overrides) -> TransformerConfig:
     kw["normalized_lm_head"] = True
     kw.update(overrides)
     return TransformerConfig(**kw)
+
+
+def ministral3_config(hf: Mapping[str, Any], **overrides) -> TransformerConfig:
+    """Ministral3ForCausalLM (reference: models/mistral3/model.py:50
+    Ministral3Config): mistral body with an explicit head_dim, optional
+    sliding window, and rope_theta nested under rope_parameters."""
+    kw = _base_kwargs(hf)
+    rp = hf.get("rope_parameters") or {}
+    if rp.get("rope_theta"):
+        kw["rope_theta"] = float(rp["rope_theta"])
+    if hf.get("sliding_window"):
+        kw["sliding_window"] = int(hf["sliding_window"])
+    kw["attention_bias"] = bool(hf.get("attention_bias", False))
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def ministral_bidirectional_config(hf: Mapping[str, Any], **overrides) -> TransformerConfig:
+    """Ministral3BidirectionalModel (reference: models/
+    ministral_bidirectional/model.py:36): the ministral retrieval encoder
+    with causal masking removed; pooling is applied by the recipes."""
+    kw_over = dict(overrides)
+    kw_over["causal"] = False
+    return ministral3_config(hf, **kw_over)

@@ -143,6 +143,12 @@ def _mla_qkv(x, lp, cfg, positions, constrain, inv_freq):
     q = q.reshape(B, S, n, dn + dr)
     q_nope, q_rope = q[..., :dn], q[..., dn:]
     q_rope = apply_rope(q_rope, positions, inv_freq)
+    if cfg.mla_qpe_scaling_beta is not None:
+        # mistral4 llama4-style scaling (reference: mistral4/model.py:52)
+        sc = 1.0 + cfg.mla_qpe_scaling_beta * jnp.log1p(
+            jnp.floor(positions.astype(jnp.float32) / cfg.mla_qpe_scaling_orig_max)
+        )
+        q_rope = q_rope * sc[:, :, None, None].astype(q_rope.dtype)
     q = jnp.concatenate([q_nope, q_rope], axis=-1)
     q = constrain(q, ("act_batch", "act_seq", "act_heads", None))
 

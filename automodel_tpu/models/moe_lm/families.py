@@ -329,3 +329,56 @@ def gpt_oss_config(hf: Mapping[str, Any], **overrides) -> MoETransformerConfig:
     moe_overrides = overrides.pop("moe", None)
     kw.update(overrides)
     return MoETransformerConfig(moe=moe_overrides or moe, first_k_dense=0, **kw)
+
+
+def hy_mt2_config(hf: Mapping[str, Any], **overrides) -> MoETransformerConfig:
+    """HyMT2ForCausalLM (reference: models/hy_mt2/, 964 LoC — Tencent
+    Hy-MT2-30B-A3B translation MoE): GQA with per-head pre-rope qk-norm,
+    dense layer 0 + MoE (128 routed top-8 + 1 shared), router sigmoid via
+    moe_router_use_sigmoid, optional expert selection bias."""
+    kw = _base_kwargs(hf)
+    kw["qk_norm"] = bool(hf.get("qk_norm", True))
+    kw["attention_bias"] = bool(hf.get("attention_bias", False))
+    moe_inter = int(hf.get("expert_hidden_dim") or hf["moe_intermediate_size"])
+    n_shared = int(hf.get("num_shared_experts", 0) or 0)
+    moe = MoEConfig(
+        n_routed_experts=int(hf["num_experts"]),
+        n_shared_experts=n_shared,
+        experts_per_token=int(hf["num_experts_per_tok"]),
+        moe_intermediate_size=moe_inter,
+        shared_expert_intermediate_size=(
+            int(hf.get("shared_expert_intermediate_size") or moe_inter * n_shared)
+            if n_shared else None
+        ),
+        score_func="sigmoid" if hf.get("moe_router_use_sigmoid", True) else "softmax",
+        norm_topk_prob=bool(hf.get("route_norm", True)),
+        route_scale=float(hf.get("router_scaling_factor", 1.0) or 1.0),
+        gate_bias_update_speed=(
+            0.001 if bool(hf.get("moe_router_enable_expert_bias", False)) else 0.0
+        ),
+    )
+    first_k = int(hf.get("first_k_dense_replace", 1))
+    moe_overrides = overrides.pop("moe", None)
+    kw.update(overrides)
+    return MoETransformerConfig(moe=moe_overrides or moe, first_k_dense=first_k, **kw)
+
+
+def mistral4_config(hf: Mapping[str, Any], **overrides) -> MoETransformerConfig:
+    """Mistral4ForCausalLM (reference: models/mistral4/, 1483 LoC): the
+    DeepSeek-V3 MLA+MoE body with llama4-style position-dependent q-rope
+    scaling (model.py:52 `_get_llama_4_attn_scale` via
+    rope_parameters.llama_4_scaling_beta)."""
+    cfg = deepseek_v3_moe_config(hf, **overrides)
+    rp = hf.get("rope_parameters") or hf.get("rope_scaling") or {}
+    beta = rp.get("llama_4_scaling_beta")
+    if beta:
+        import dataclasses as _dc
+
+        cfg = _dc.replace(
+            cfg,
+            mla_qpe_scaling_beta=float(beta),
+            mla_qpe_scaling_orig_max=int(
+                rp.get("original_max_position_embeddings", 8192)
+            ),
+        )
+    return cfg

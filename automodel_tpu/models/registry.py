@@ -44,6 +44,14 @@ class ModelSpec:
 MODEL_ARCH_MAPPING: dict[str, ModelSpec] = {
     "LlamaForCausalLM": ModelSpec("llama", families.llama_config, decoder),
     "MistralForCausalLM": ModelSpec("mistral", families.mistral_config, decoder),
+    "Ministral3ForCausalLM": ModelSpec(
+        "ministral3", families.ministral3_config, decoder
+    ),
+    # Ministral bidirectional retrieval encoder (reference: models/
+    # ministral_bidirectional, 188 LoC)
+    "Ministral3BidirectionalModel": ModelSpec(
+        "ministral_bidirectional", families.ministral_bidirectional_config, decoder
+    ),
     "Qwen2ForCausalLM": ModelSpec("qwen2", families.qwen2_config, decoder),
     "Qwen3ForCausalLM": ModelSpec("qwen3", families.qwen3_config, decoder),
     "Gemma2ForCausalLM": ModelSpec("gemma2", families.gemma2_config, decoder),
@@ -78,6 +86,23 @@ MODEL_ARCH_MAPPING: dict[str, ModelSpec] = {
     "Glm4MoeForCausalLM": ModelSpec(
         "glm4_moe", moe_families.glm4_moe_config, moe_decoder,
         adapter_name="moe_decoder",
+    ),
+    # GLM4-MoE-Lite: the GLM4 MoE body on MLA attention (reference:
+    # models/glm4_moe_lite/, 387 LoC — reuses deepseek MLA + glm4 adapter)
+    "Glm4MoeLiteForCausalLM": ModelSpec(
+        "glm4_moe_lite", moe_families.deepseek_v3_moe_config, moe_decoder,
+        adapter_name="moe_decoder", adapter_kwargs={"style": "deepseek"},
+    ),
+    # Hy-MT2 translation MoE (reference: models/hy_mt2/, 964 LoC)
+    "HyMT2ForCausalLM": ModelSpec(
+        "hy_mt2", moe_families.hy_mt2_config, moe_decoder,
+        adapter_name="moe_decoder", adapter_kwargs={"style": "hy_mt2"},
+    ),
+    # Mistral4: DSv3 MLA+MoE body + llama4 position-dependent q-rope
+    # scaling (reference: models/mistral4/, 1483 LoC)
+    "Mistral4ForCausalLM": ModelSpec(
+        "mistral4", moe_families.mistral4_config, moe_decoder,
+        adapter_name="moe_decoder", adapter_kwargs={"style": "deepseek"},
     ),
     # Gemma4-MoE (VL composite; text decoder — reference: models/gemma4_moe,
     # parallel dense+MoE FFN, KV sharing, Gemma4Gate router)
@@ -194,6 +219,16 @@ MODEL_ARCH_MAPPING: dict[str, ModelSpec] = {
     "KimiVLForConditionalGeneration": ModelSpec(
         "kimi_vl", kimi_vl_module.kimi_vl_config, kimi_vl_module,
         adapter_name="kimi_vl",
+    ),
+    # Kimi-K2.5 VL: MoonViT3d (divided space/time pos emb; image t=0) +
+    # DeepseekV3 text (reference: models/kimi_k25_vl/, 1593 LoC)
+    "KimiK25VLForConditionalGeneration": ModelSpec(
+        "kimi_k25_vl", kimi_vl_module.kimi_k25_vl_config, kimi_vl_module,
+        adapter_name="kimi_vl", adapter_kwargs={"style": "k25"},
+    ),
+    "KimiK25ForConditionalGeneration": ModelSpec(
+        "kimi_k25_vl", kimi_vl_module.kimi_k25_vl_config, kimi_vl_module,
+        adapter_name="kimi_vl", adapter_kwargs={"style": "k25"},
     ),
     # MiniMax M3 VL: CLIP-style 3D-rope tower + projector/patch-merger +
     # the M3 sparse/dense MoE text backbone (reference: models/minimax_m3_vl)

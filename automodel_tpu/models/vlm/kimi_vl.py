@@ -42,6 +42,11 @@ class MoonViTConfig:
     intermediate_size: int = 4304
     merge_kernel: tuple = (2, 2)
     rope_theta: float = 10000.0
+    # Kimi-K2.5 (MoonViT3d): divided space/time position embeddings — the
+    # temporal part is a FIXED 1D sincos table (reference: kimi_k25_vl/
+    # model.py:190 get_1d_sincos_pos_embed). Image inputs sit at t=0, whose
+    # sincos vector is a deterministic constant added to every patch.
+    temporal_pos_emb: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -207,6 +212,12 @@ def vision_forward(params: dict, cfg: MoonViTConfig, pixel_values: jnp.ndarray) 
     ) + params["patch_embed"]["proj"]["bias"].astype(dtype)
     x = x.reshape(B, gh * gw, D)
 
+    if cfg.temporal_pos_emb:
+        # t=0 row of the fixed temporal sincos table: sin(0)=0 | cos(0)=1
+        D_ = cfg.hidden_size
+        half = D_ // 2
+        t0 = jnp.concatenate([jnp.zeros((half,)), jnp.ones((D_ - half,))])
+        x = x + t0.astype(x.dtype)
     pe = params["patch_embed"]["pos_emb"]["weight"]
     if pe.shape[:2] != (gh, gw):
         pe = jax.image.resize(pe, (gh, gw, D), method="bicubic")
@@ -326,6 +337,21 @@ def forward(
     )
 
 
+def kimi_k25_vl_config(hf, **overrides) -> KimiVLConfig:
+    """KimiK25VLForConditionalGeneration (reference: models/kimi_k25_vl/,
+    1593 LoC — MoonViT3d + DeepseekV3 text): the kimi_vl geometry plus the
+    divided space/time position embedding. Image inputs sit at t=0 of the
+    FIXED temporal sincos table (a deterministic constant; video temporal
+    attention is image-only-skipped, the reference's stance for several VL
+    onboardings)."""
+    cfg = kimi_vl_config(hf, **overrides)
+    import dataclasses as _dc
+
+    return _dc.replace(
+        cfg, vision=_dc.replace(cfg.vision, temporal_pos_emb=True)
+    )
+
+
 # ---------------------------------------------------------------------------
 # HF state-dict adapter
 # ---------------------------------------------------------------------------
@@ -334,8 +360,18 @@ class KimiVLAdapter:
     `language_model.model.*` + `language_model.lm_head.*` (deepseek naming
     inside — delegated to MoEDecoderAdapter with a key-prefix shim)."""
 
-    def __init__(self, cfg: KimiVLConfig):
+    def __init__(self, cfg: KimiVLConfig, style: str = "kimi"):
         self.cfg = cfg
+        # "k25": Kimi-K2.5 checkpoint names the projector mm_projector with
+        # Sequential indices (reference: kimi_k25_vl/state_dict_adapter.py:
+        # 208-211 linear_1→proj.0, linear_2→proj.2)
+        self.style = style
+
+    def _proj_name(self, suf: str) -> str:
+        if self.style == "k25":
+            suf = suf.replace("linear_1.", "proj.0.").replace("linear_2.", "proj.2.")
+            return "mm_projector." + suf
+        return "multi_modal_projector." + suf
 
     def _lm(self):
         from automodel_tpu.checkpoint.hf_adapter import MoEDecoderAdapter
@@ -404,7 +440,7 @@ class KimiVLAdapter:
                 ]),
             )
         for suf, path, tr in self._PROJ:
-            put(("projector",) + path, one("multi_modal_projector." + suf, tr))
+            put(("projector",) + path, one(self._proj_name(suf), tr))
 
         def lm_read(name):
             if name == "lm_head.weight":
@@ -442,7 +478,7 @@ class KimiVLAdapter:
                 yield f"vision_tower.encoder.blocks.{i}.{suf}", (_t(x) if tr else x)
         for suf, path, tr in self._PROJ:
             x = np.asarray(_get(params["projector"], path))
-            yield "multi_modal_projector." + suf, (_t(x) if tr else x)
+            yield self._proj_name(suf), (_t(x) if tr else x)
         for name, tensor in self._lm().to_hf(params["language_model"]):
             if name == "lm_head.weight":
                 yield "language_model.lm_head.weight", tensor

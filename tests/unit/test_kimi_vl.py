@@ -182,3 +182,45 @@ def test_kimi_vl_generate_conditions_on_image():
         GenerateConfig(max_new_tokens=6),
     )
     assert not np.array_equal(np.asarray(out1), np.asarray(out2))
+
+
+def test_kimi_k25_vl_variant():
+    """K2.5: temporal t=0 sincos constant live; mm_projector.proj.{0,2}
+    checkpoint naming round-trips (reference: kimi_k25_vl/
+    state_dict_adapter.py:208)."""
+    import dataclasses
+
+    from automodel_tpu.checkpoint.hf_adapter import get_adapter
+    from automodel_tpu.models.registry import get_model_spec
+
+    hf = dict(KIMI_HF, architectures=["KimiK25VLForConditionalGeneration"])
+    spec = get_model_spec(hf)
+    cfg = spec.config_from_hf(hf, dtype=jnp.float32, remat_policy="none")
+    assert cfg.vision.temporal_pos_emb
+    params = kimi_vl.init(cfg, jax.random.key(0))
+
+    # the t=0 temporal constant changes the tower output vs the plain tower
+    cfg_plain = dataclasses.replace(
+        cfg, vision=dataclasses.replace(cfg.vision, temporal_pos_emb=False)
+    )
+    rng = np.random.default_rng(0)
+    pix = jnp.asarray(rng.normal(size=(1, 56, 56, 3)).astype(np.float32))
+    f1 = kimi_vl.encode_images(params, cfg, pix)
+    f2 = kimi_vl.encode_images(params, cfg_plain, pix)
+    assert np.abs(np.asarray(f1) - np.asarray(f2)).max() > 1e-6
+
+    ad = get_adapter(spec.adapter_name, cfg, **spec.adapter_kwargs)
+    sd = dict(ad.to_hf(params))
+    assert "mm_projector.proj.0.weight" in sd
+    assert "mm_projector.proj.2.bias" in sd
+    assert "mm_projector.pre_norm.weight" in sd
+    assert not any(k.startswith("multi_modal_projector.") for k in sd)
+    p2 = ad.from_hf(lambda k: np.asarray(sd[k]))
+    ids = jnp.asarray(
+        np.concatenate([np.full((1, 4), 120), rng.integers(1, 100, (1, 8))], 1),
+        jnp.int32,
+    )
+    o1 = kimi_vl.forward(params, cfg, ids, pix)
+    o2 = kimi_vl.forward(jax.tree.map(jnp.asarray, p2), cfg, ids, pix)
+    for a, b in zip(jax.tree.leaves(o1), jax.tree.leaves(o2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)

@@ -474,3 +474,139 @@ def test_step3p5_recipe_trains(tmp_path):
     recs = [json.loads(l) for l in open(tmp_path / "training.jsonl") if l.strip()]
     assert len(recs) == 3
     assert all(np.isfinite(x["loss"]) for x in recs)
+
+
+MINISTRAL_HF = {
+    "architectures": ["Ministral3BidirectionalModel"],
+    "model_type": "ministral3",
+    "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+    "num_hidden_layers": 2, "num_attention_heads": 4,
+    "num_key_value_heads": 2, "head_dim": 8,
+    "rope_parameters": {"rope_theta": 1000000.0},
+    "sliding_window": 16, "pooling": "avg",
+}
+
+
+def test_ministral3_and_bidirectional():
+    spec = get_model_spec(MINISTRAL_HF)
+    cfg = spec.config_from_hf(MINISTRAL_HF, dtype=jnp.float32, remat_policy="none")
+    assert cfg.causal is False
+    assert cfg.rope_theta == 1000000.0 and cfg.sliding_window == 16
+    params = decoder.init(cfg, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (2, 12), 0, 128)
+    h1 = decoder.forward(params, cfg, ids, return_hidden=True)
+    # bidirectional: a LATE token change moves an EARLY hidden state
+    ids2 = ids.at[0, -1].set((int(ids[0, -1]) + 1) % 128)
+    h2 = decoder.forward(params, cfg, ids2, return_hidden=True)
+    assert np.abs(np.asarray(h1[0, 0]) - np.asarray(h2[0, 0])).max() > 1e-7
+
+    causal_hf = dict(MINISTRAL_HF, architectures=["Ministral3ForCausalLM"])
+    cfg_c = get_model_spec(causal_hf).config_from_hf(
+        causal_hf, dtype=jnp.float32, remat_policy="none"
+    )
+    assert cfg_c.causal is True
+
+
+GLM_LITE_HF = {
+    "architectures": ["Glm4MoeLiteForCausalLM"],
+    "model_type": "glm4_moe_lite",
+    "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+    "num_hidden_layers": 2, "num_attention_heads": 4,
+    "n_routed_experts": 4, "n_shared_experts": 1,
+    "num_experts_per_tok": 2, "moe_intermediate_size": 16,
+    "first_k_dense_replace": 1, "norm_topk_prob": True,
+    "routed_scaling_factor": 1.0, "n_group": 2, "topk_group": 2,
+    "kv_lora_rank": 16, "q_lora_rank": 12,
+    "qk_nope_head_dim": 8, "qk_rope_head_dim": 8, "v_head_dim": 8,
+}
+
+
+def test_glm4_moe_lite_is_mla_moe():
+    from automodel_tpu.models.moe_lm import decoder as moe_decoder
+
+    spec = get_model_spec(GLM_LITE_HF)
+    cfg = spec.config_from_hf(GLM_LITE_HF, dtype=jnp.float32, remat_policy="none")
+    assert cfg.attention_type == "mla" and cfg.first_k_dense == 1
+    assert cfg.moe.score_func == "sigmoid"
+    params = moe_decoder.init(cfg, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (2, 8), 0, 128)
+    logits, _ = moe_decoder.forward(params, cfg, ids)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+HY_MT2_HF = {
+    "architectures": ["HyMT2ForCausalLM"],
+    "model_type": "hy_mt2",
+    "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+    "num_hidden_layers": 2, "num_attention_heads": 4,
+    "num_key_value_heads": 2, "head_dim": 8, "qk_norm": True,
+    "num_experts": 4, "num_experts_per_tok": 2, "num_shared_experts": 1,
+    "expert_hidden_dim": 16, "moe_intermediate_size": 16,
+    "moe_router_use_sigmoid": True, "moe_router_enable_expert_bias": True,
+    "first_k_dense_replace": 1, "rope_theta": 11158840.0,
+}
+
+
+def test_hy_mt2_adapter_roundtrip():
+    from automodel_tpu.checkpoint.hf_adapter import get_adapter
+    from automodel_tpu.models.moe_lm import decoder as moe_decoder
+
+    spec = get_model_spec(HY_MT2_HF)
+    cfg = spec.config_from_hf(HY_MT2_HF, dtype=jnp.float32, remat_policy="none")
+    assert cfg.qk_norm and cfg.first_k_dense == 1
+    assert cfg.moe.score_func == "sigmoid"
+    assert cfg.moe.gate_bias_update_speed > 0
+    params = moe_decoder.init(cfg, jax.random.key(0))
+    ad = get_adapter(spec.adapter_name, cfg, **spec.adapter_kwargs)
+    sd = dict(ad.to_hf(params))
+    # the Hy-MT2 on-disk layout (reference: hy_mt2/state_dict_adapter.py)
+    assert "model.layers.1.mlp.router.gate.weight" in sd
+    assert "model.layers.1.mlp.expert_bias" in sd
+    assert "model.layers.1.mlp.shared_mlp.up_proj.weight" in sd
+    assert not any(".mlp.gate.weight" in k for k in sd)
+    p2 = ad.from_hf(lambda k: np.asarray(sd[k]))
+    ids = jax.random.randint(jax.random.key(2), (2, 8), 0, 128)
+    o1, _ = moe_decoder.forward(params, cfg, ids)
+    o2, _ = moe_decoder.forward(jax.tree.map(jnp.asarray, p2), cfg, ids)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), atol=1e-5)
+
+
+MISTRAL4_HF = {
+    "architectures": ["Mistral4ForCausalLM"],
+    "model_type": "mistral4",
+    "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+    "num_hidden_layers": 2, "num_attention_heads": 4,
+    "n_routed_experts": 4, "n_shared_experts": 1,
+    "num_experts_per_tok": 2, "moe_intermediate_size": 16,
+    "first_k_dense_replace": 1, "norm_topk_prob": True,
+    "routed_scaling_factor": 1.0,
+    "kv_lora_rank": 16, "q_lora_rank": 12,
+    "qk_nope_head_dim": 8, "qk_rope_head_dim": 8, "v_head_dim": 8,
+    "rope_parameters": {
+        "rope_theta": 10000.0, "llama_4_scaling_beta": 0.1,
+        "original_max_position_embeddings": 8,
+    },
+}
+
+
+def test_mistral4_llama4_qpe_scaling():
+    """Positions past orig_max get the llama4 log scaling on q_pe — the
+    forward must differ from the unscaled config exactly there."""
+    import dataclasses
+
+    from automodel_tpu.models.moe_lm import decoder as moe_decoder
+
+    spec = get_model_spec(MISTRAL4_HF)
+    cfg = spec.config_from_hf(MISTRAL4_HF, dtype=jnp.float32, remat_policy="none")
+    assert cfg.mla_qpe_scaling_beta == 0.1
+    assert cfg.mla_qpe_scaling_orig_max == 8
+    params = moe_decoder.init(cfg, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (1, 16), 0, 128)
+    l_scaled, _ = moe_decoder.forward(params, cfg, ids)
+    cfg_off = dataclasses.replace(cfg, mla_qpe_scaling_beta=None)
+    l_plain, _ = moe_decoder.forward(params, cfg_off, ids)
+    d = np.abs(np.asarray(l_scaled) - np.asarray(l_plain)).max(axis=-1)[0]
+    # positions 0..7: floor(pos/8)=0 → scale 1 → identical
+    assert d[:8].max() < 1e-6, d[:8]
+    # positions 8..: scale > 1 → outputs differ
+    assert d[8:].max() > 1e-6, d[8:]
