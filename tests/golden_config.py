@@ -104,6 +104,24 @@ def _dllm_cfg(run_dir: str) -> ConfigNode:
     return cfg
 
 
+def _cp_cfg(run_dir: str) -> ConfigNode:
+    """Ring-CP convergence pin: the cp=2 load-balanced layout must track the
+    committed loss curve step-for-step (the long-context parallelism path)."""
+    cfg = _base(run_dir)
+    cfg.set("distributed", {"dp_shard": -1, "cp": 2})
+    return cfg
+
+
+def _pp_cfg(run_dir: str) -> ConfigNode:
+    """1F1B pipeline convergence pin (explicit fwd/bwd interleave path)."""
+    cfg = _base(run_dir)
+    cfg.set("distributed", {
+        "dp_shard": -1, "pp": 2, "pipeline_schedule": "1f1b",
+        "pipeline_microbatches": 2,
+    })
+    return cfg
+
+
 #: name → config factory; each family has a committed training.jsonl
 GOLDEN_RECIPES = {
     "dense": golden_cfg,
@@ -111,6 +129,8 @@ GOLDEN_RECIPES = {
     "lora": _lora_cfg,
     "vlm": _vlm_cfg,
     "dllm": _dllm_cfg,
+    "cp": _cp_cfg,
+    "pp_1f1b": _pp_cfg,
 }
 
 

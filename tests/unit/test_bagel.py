@@ -60,6 +60,7 @@ def _batch(cfg, B=2, S=40):
     )
 
 
+@pytest.mark.slow
 def test_config_and_init_shapes():
     spec, cfg, params = _setup()
     assert cfg.visual_gen and cfg.qk_norm
@@ -96,6 +97,7 @@ def test_attention_mask_semantics():
     assert not m2[4, 0] and not m2[6, 3]
 
 
+@pytest.mark.slow
 def test_forward_joint_losses():
     spec, cfg, params = _setup()
     ids, tt, pix, lat, t = _batch(cfg)
@@ -118,6 +120,7 @@ def test_forward_joint_losses():
     np.testing.assert_allclose(float(mse), expect, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_gen_expert_routing_is_live():
     """Zeroing the GEN experts changes vae-token hidden states but leaves
     pure-text rows untouched (the MoT contract)."""

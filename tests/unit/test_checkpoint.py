@@ -94,6 +94,7 @@ def _moe_cfg():
     )
 
 
+@pytest.mark.slow
 def test_moe_hf_roundtrip(tmp_path):
     cfg = _moe_cfg()
     params = moe_decoder.init(cfg, jax.random.key(0))

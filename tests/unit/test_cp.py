@@ -48,6 +48,7 @@ def test_sharder_contract():
     np.testing.assert_array_equal(idx0, out["positions"][0][:8])
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("cp", [2, 4])
 @pytest.mark.parametrize("balanced", [False, True])
 def test_ring_attention_matches_oracle(cp, balanced):
@@ -78,6 +79,7 @@ def test_ring_attention_matches_oracle(cp, balanced):
     )
 
 
+@pytest.mark.slow
 def test_ring_attention_grads_match():
     cp = 4
     ctx = MeshConfig(cp=cp, dp_shard=2).build()
@@ -124,6 +126,7 @@ def test_ring_attention_packed_segments():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_decoder_with_cp_matches_single_device():
     """Full decoder forward under cp=2 (ring path) == single-device."""
     cfg = TransformerConfig(
@@ -148,6 +151,7 @@ def test_decoder_with_cp_matches_single_device():
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=5e-4, atol=5e-4)
 
 
+@pytest.mark.slow
 def test_ring_flash_kernel_parity():
     """cp=2 ring where each shard's S_loc (128) engages the Pallas flash
     kernel (position-causal mode, interpret on CPU) — fwd + grads vs cp=1."""

@@ -67,6 +67,7 @@ def test_segment_ids_isolate_documents():
     np.testing.assert_allclose(l1[0, 4:], l2[0, 4:], rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_feature_variants_forward():
     for kw in (
         dict(attention_bias=True),

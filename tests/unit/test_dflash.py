@@ -81,6 +81,7 @@ def test_doc_remaining_and_anchor_sampling():
     assert a == [3, 4]
 
 
+@pytest.mark.slow
 def test_block_loss_runs_and_vp_variant():
     rng = np.random.default_rng(0)
     B, S, A = 2, 32, 2

@@ -62,6 +62,7 @@ def test_indexer_scores_shape_and_nonneg_heads():
     assert bool(jnp.isfinite(s).all())
 
 
+@pytest.mark.slow
 def test_sparse_equals_dense_when_topk_covers_all():
     from automodel_tpu.models.llm import mla
     from automodel_tpu.models.llm.decoder import init_attention_layers
@@ -174,6 +175,7 @@ def test_dsv4_recipe_smoke(tmp_path):
     assert all(np.isfinite(x["loss"]) for x in recs)
 
 
+@pytest.mark.slow
 def test_chunked_sparse_matches_oracle():
     """The blockwise two-phase path == the dense-mask oracle (fwd + the
     indexer-KL aux), including gradient routing (indexer only via KL)."""
@@ -224,6 +226,7 @@ def test_chunked_sparse_matches_oracle():
     assert float(gnorm2) > 0.0
 
 
+@pytest.mark.slow
 def test_chunked_sparse_glm_index_share_parity():
     """IndexShare carries indices in the chunked path; shared-layer reuse
     matches the oracle's mask reuse."""

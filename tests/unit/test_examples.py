@@ -1,0 +1,77 @@
+"""Example-YAML surface tests (reference discipline: tests/ci_tests/ —
+generated per-recipe configs, every one exercised).
+
+Fast tier: every example parses, its recipe class resolves, and (when it
+carries a tiny hf_config) the model spec + config builder accept it.
+Recipe tier: every HERMETIC smoke (mock dataset + /tmp run_dir) actually
+trains end-to-end in-process.
+"""
+
+import pathlib
+
+import numpy as np
+import pytest
+
+from automodel_tpu.cli.app import resolve_recipe_class
+from automodel_tpu.config import ConfigNode
+from automodel_tpu.config.loader import load_yaml
+
+EXAMPLES = sorted(
+    pathlib.Path(__file__).parent.parent.parent.glob("examples/**/*.yaml")
+)
+assert len(EXAMPLES) >= 70, f"example surface shrank: {len(EXAMPLES)}"
+
+
+def _load(path) -> ConfigNode:
+    return load_yaml(str(path))
+
+
+def _is_hermetic(cfg: ConfigNode) -> bool:
+    ds = cfg.get("dataset")
+    tgt = ds.get("_target_", "") if ds is not None else ""
+    mock = "mock" in str(tgt).lower() or "bagel_mock" in str(tgt)
+    run_dir = str(cfg.get("run_dir", ""))
+    return mock and run_dir.startswith("/tmp")
+
+
+@pytest.mark.parametrize("path", EXAMPLES, ids=lambda p: str(p.relative_to(p.parents[2])))
+def test_example_parses_and_resolves(path):
+    cfg = _load(path)
+    cls = resolve_recipe_class(cfg)
+    assert cls is not None
+    mcfg = cfg.get("model")
+    hf = mcfg.get("hf_config") if mcfg is not None else None
+    if hf is not None and "architectures" in hf:
+        from automodel_tpu.models.registry import get_model_spec
+
+        hf_d = hf.to_dict() if hasattr(hf, "to_dict") else dict(hf)
+        spec = get_model_spec(hf_d)
+        # the config builder must accept the YAML's tiny config
+        spec.config_from_hf(hf_d, remat_policy="none")
+
+
+_SMOKES = [p for p in EXAMPLES if _is_hermetic(_load(p))]
+
+
+@pytest.mark.recipe
+@pytest.mark.parametrize(
+    "path", _SMOKES, ids=lambda p: str(p.relative_to(p.parents[2]))
+)
+def test_example_smoke_trains(path, tmp_path, monkeypatch):
+    """Run every hermetic example end-to-end (redirected run_dir)."""
+    import json
+
+    cfg = _load(path)
+    cfg.set("run_dir", str(tmp_path))
+    # keep every smoke cheap regardless of the YAML's own step budget
+    if cfg.get("step_scheduler") is not None:
+        cfg.set("step_scheduler.max_steps", min(
+            int(cfg.get("step_scheduler.max_steps", 2)), 2
+        ))
+    r = resolve_recipe_class(cfg)(cfg)
+    r.setup()
+    r.run_train_validation_loop()
+    out = tmp_path / "training.jsonl"
+    if out.exists():  # bench/eval-style recipes write other artifacts
+        recs = [json.loads(l) for l in open(out) if l.strip()]
+        assert recs and all(np.isfinite(x["loss"]) for x in recs)

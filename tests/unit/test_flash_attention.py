@@ -49,6 +49,7 @@ CASES = {
 }
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("name", sorted(CASES))
 def test_fwd_parity(name):
     kw = dict(CASES[name])
@@ -72,6 +73,7 @@ def test_fwd_packed_segments():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_fwd_noncausal_window_block_skip():
     """S=512 with window 100 and 128-blocks: kv blocks fully outside the
     two-sided window are skipped by _run_predicate; parity proves no valid
@@ -129,6 +131,7 @@ def test_unsupported_shapes_raise():
         flash_attention(q, q, q)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("D", [64, 96])
 def test_narrow_head_dim_padded(D):
     """head_dim 64/96 (gpt-oss, qwen2-0.5B class) runs via lane padding."""
@@ -146,6 +149,7 @@ def test_narrow_head_dim_padded(D):
         )
 
 
+@pytest.mark.slow
 def test_mla_shaped_heads():
     """MLA: q/k head_dim (192) differs from v head_dim (128)."""
     key = jax.random.key(5)
@@ -167,6 +171,7 @@ def test_mla_shaped_heads():
         )
 
 
+@pytest.mark.slow
 def test_sinks_parity():
     """gpt-oss attention sinks: fwd/bwd parity incl. the sink gradient."""
     q, k, v = _rand_qkv(jax.random.key(6), S=256, Hq=4, Hkv=2)
@@ -211,6 +216,7 @@ def test_traced_sliding_window():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_position_causal_asymmetric_kv():
     """Ring-step mode: kv carries its own global positions/segments."""
     B, S, H, D = 1, 128, 2, 128

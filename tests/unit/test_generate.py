@@ -52,6 +52,7 @@ def test_temperature_sampling_valid_and_varied():
     assert not np.array_equal(np.asarray(a), np.asarray(b))  # keys differ
 
 
+@pytest.mark.slow
 def test_mla_matches_naive():
     """MLA absorbed latent-cache decode == full re-forward (VERDICT r3 #9:
     the MLA decode path previously raised NotImplementedError)."""
@@ -119,6 +120,7 @@ def test_moe_mla_matches_naive():
     np.testing.assert_array_equal(np.asarray(fast), np.asarray(ids))
 
 
+@pytest.mark.slow
 def test_sliding_window_matches_naive():
     import dataclasses
 
@@ -130,6 +132,7 @@ def test_sliding_window_matches_naive():
     np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
 
 
+@pytest.mark.slow
 def test_alternating_windows_and_sinks_match_naive():
     """gemma2/gpt-oss shape: per-layer sliding/global pattern + sinks."""
     import dataclasses
@@ -149,6 +152,7 @@ def test_alternating_windows_and_sinks_match_naive():
     np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
 
 
+@pytest.mark.slow
 def test_eos_early_stop_pads_with_eos():
     """After EOS is sampled, all subsequent tokens are EOS."""
     params = decoder.init(CFG, jax.random.key(0))
@@ -167,6 +171,7 @@ def test_eos_early_stop_pads_with_eos():
     assert (gen_tokens[first:] == eos).all()
 
 
+@pytest.mark.slow
 def test_top_k_top_p_sampling():
     """top-k restricts samples to the k best tokens; top-p to the nucleus."""
     from automodel_tpu.inference.generate import _filter_logits

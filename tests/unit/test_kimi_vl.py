@@ -184,6 +184,7 @@ def test_kimi_vl_generate_conditions_on_image():
     assert not np.array_equal(np.asarray(out1), np.asarray(out2))
 
 
+@pytest.mark.slow
 def test_kimi_k25_vl_variant():
     """K2.5: temporal t=0 sincos constant live; mm_projector.proj.{0,2}
     checkpoint naming round-trips (reference: kimi_k25_vl/

@@ -73,6 +73,7 @@ def test_pixel_shuffle_is_exact_space_to_depth():
     np.testing.assert_array_equal(np.asarray(y), t)
 
 
+@pytest.mark.slow
 def test_forward_and_embed():
     spec, cfg, params = _setup()
     ids, pixels = _batch(cfg)
@@ -90,6 +91,7 @@ def test_forward_and_embed():
     assert lnv.embed(params, cfg, ids, pixels, mask, pooling="cls").shape == (2, 32)
 
 
+@pytest.mark.slow
 def test_bidirectional_attention():
     """Non-causal: a change in a LATE token influences an EARLY position's
     hidden state (impossible under causal masking)."""

@@ -95,6 +95,7 @@ def test_m3_config_mapping():
     assert float(jnp.abs(params["final_norm"]["scale"]).max()) == 0.0
 
 
+@pytest.mark.slow
 def test_m3_accepts_linear_precision_override():
     """The recipe forwards model.linear_precision to every config builder;
     the het engine must accept it (int8 path smoke)."""
@@ -109,6 +110,7 @@ def test_m3_accepts_linear_precision_override():
     assert np.isfinite(np.asarray(logits)).all()
 
 
+@pytest.mark.slow
 def test_m3_forward_finite_and_sparse_is_live():
     spec, cfg, params = _text_setup()
     rng = np.random.default_rng(0)
@@ -156,6 +158,7 @@ def test_select_sparse_blocks_semantics():
     assert keep[0, 0, q].sum() <= 2 * 4
 
 
+@pytest.mark.slow
 def test_m3_sparse_equals_dense_when_budget_covers_all():
     """topk_blocks ≥ num_blocks ⇒ every causal block selected ⇒ sparse
     attention equals dense attention exactly."""
@@ -175,6 +178,7 @@ def test_m3_sparse_equals_dense_when_budget_covers_all():
     np.testing.assert_allclose(np.asarray(l_sp), np.asarray(l_d), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_m3_packed_documents_match_separate_forwards():
     """Packed batch (document-local positions + segment_ids) with a FULL
     selection budget: every token's logits must equal the unpacked

@@ -78,6 +78,7 @@ QWEN3_5_MOE_HF = {
 }
 
 
+@pytest.mark.slow
 def test_qwen3_5_moe_adapter_roundtrip():
     """to_hf emits the Qwen3.5 layout (separate GDN projections, stacked
     experts, language_model prefix) and from_hf inverts it exactly."""
@@ -105,6 +106,7 @@ def test_qwen3_5_moe_adapter_roundtrip():
     np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_qwen3_5_dense_config():
     hf = {
         "architectures": ["Qwen3_5ForCausalLM"],
@@ -181,6 +183,7 @@ def test_glm_dsa_index_share_ignores_shared_layer_indexer():
     assert np.abs(np.asarray(base_f) - np.asarray(zeroed_f)).max() > 1e-6
 
 
+@pytest.mark.slow
 def test_glm_dsa_adapter_roundtrip_index_share():
     """Export omits indexer keys for shared layers (matching HF); import
     zero-fills them; the round-trip reproduces logits exactly."""
@@ -229,6 +232,7 @@ def _gemma4_setup():
     return spec, cfg, params, gemma4
 
 
+@pytest.mark.slow
 def test_gemma4_forward_and_kv_sharing():
     """Layers 2/3 share layer 0/1's K/V (same-type): zeroing a shared
     layer's k/v kernels must not change the output."""
@@ -315,6 +319,7 @@ LING_HF = {
 }
 
 
+@pytest.mark.slow
 def test_ling_v2_adapter_fused_qkv_roundtrip():
     """Ling 2.0 (BailingMoeV2): fused query_key_value / attention.dense /
     word_embeddings naming round-trips exactly."""
@@ -547,6 +552,7 @@ HY_MT2_HF = {
 }
 
 
+@pytest.mark.slow
 def test_hy_mt2_adapter_roundtrip():
     from automodel_tpu.checkpoint.hf_adapter import get_adapter
     from automodel_tpu.models.moe_lm import decoder as moe_decoder
@@ -589,6 +595,7 @@ MISTRAL4_HF = {
 }
 
 
+@pytest.mark.slow
 def test_mistral4_llama4_qpe_scaling():
     """Positions past orig_max get the llama4 log scaling on q_pe — the
     forward must differ from the unscaled config exactly there."""

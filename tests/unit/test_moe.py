@@ -137,6 +137,7 @@ def test_moe_decoder_specs_match():
         assert p.ndim == len(s), f"{p.shape} vs {s}"
 
 
+@pytest.mark.slow
 def test_moe_sharded_ep_matches_single_device():
     ctx = MeshConfig(dp_shard=2, ep=4).build()
     params = moe_decoder.init(MOE_LM, jax.random.key(0))
@@ -336,6 +337,7 @@ def test_yarn_rope_and_rope_permutation():
     np.testing.assert_array_equal(_permute_k_rope(fwd, 3, 4, inverse=True), kv)
 
 
+@pytest.mark.slow
 def test_dropless_matches_capacity_with_ample_headroom():
     """With no drops possible, dropless == capacity dispatch exactly."""
     import dataclasses as dc
@@ -378,6 +380,7 @@ def test_dropless_no_drops_under_imbalance():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow
 def test_dropless_grads_and_masked_tokens():
     import dataclasses as dc
 
@@ -395,6 +398,7 @@ def test_dropless_grads_and_masked_tokens():
         assert np.isfinite(np.asarray(leaf)).all()
 
 
+@pytest.mark.slow
 def test_gpt_oss_end_to_end(tmp_path):
     """gpt-oss: attention sinks + alternating windows + biased router +
     fused-gate_up swigluoai experts; forward, sinks effect, HF roundtrip."""
@@ -531,6 +535,7 @@ def test_mtp_masks_document_boundaries():
     assert float(n) == 4
 
 
+@pytest.mark.slow
 def test_dropless_ep_matches_ep1_oracle():
     """EP-distributed dropless dispatch (bucketed A2A, DeepEP semantics —
     reference: moe/megatron/fused_a2a.py:139,238) must match the ep=1
@@ -585,6 +590,7 @@ def test_dropless_ep_matches_ep1_oracle():
             )
 
 
+@pytest.mark.slow
 def test_dropless_ep_full_decoder_train_step():
     """dispatcher=dropless with ep=2 through the FULL MoE decoder forward
     (mesh_ctx threaded decoder → moe_forward → shard_map dispatch)."""
@@ -683,6 +689,7 @@ def _emulated_ragged_a2a(x, out, in_off, send_sz, out_off, recv_sz, axis_name):
     return out
 
 
+@pytest.mark.slow
 def test_dropless_ep_ragged_matches_dense():
     """The TPU ragged-A2A EP path (metadata: counts all_gather → offsets)
     must route identically to the dense-bucket path — verified on CPU via a

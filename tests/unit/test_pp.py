@@ -33,6 +33,7 @@ def _setup(pp, dp):
     return ctx, params, jax.device_put(params, sh)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("pp,dp", [(2, 1), (4, 1), (2, 4)])
 def test_pp_forward_matches_single_device(pp, dp):
     ctx, params, sharded = _setup(pp, dp)
@@ -154,6 +155,7 @@ def test_1f1b_schedule_tables():
               {"pp": 2, "cp": 2, "dp_shard": 2}],
     ids=["pp2xdp4", "pp4xdp2", "pp2xcp2xdp2"],
 )
+@pytest.mark.slow
 def test_1f1b_train_parity(sizes):
     """1F1B explicit fwd/bwd pipeline: loss + all grads match end-to-end
     autodiff of the same stacked-layer + head computation."""
@@ -298,6 +300,7 @@ def test_zero_bubble_schedule_tables_valid():
     "sizes", [{"pp": 2, "dp_shard": 4}, {"pp": 4, "dp_shard": 2}],
     ids=["pp2xdp4", "pp4xdp2"],
 )
+@pytest.mark.slow
 def test_zb_train_parity(sizes):
     """Zero-bubble split-backward pipeline: loss + all grads match
     end-to-end autodiff (B computes only dx; W reproduces exactly the

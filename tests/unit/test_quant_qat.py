@@ -85,6 +85,7 @@ def test_qat_transform_delayed_enable_and_kernel_only():
     assert QATConfig(enabled=False).make_param_transform() is None
 
 
+@pytest.mark.slow
 def test_train_step_with_qat_transform_trains():
     """A tiny regression under make_train_step with QAT on from step 0:
     loss must decrease and gradients must reach the master weights."""
@@ -128,6 +129,7 @@ def test_fp8_dequant_rejects_mismatched_scale_grid():
     np.testing.assert_array_equal(out, np.full((160, 96), 2.0, np.float32))
 
 
+@pytest.mark.slow
 def test_qat_with_peft_raises():
     """QAT's kernel transform cannot see LoRA trees — the recipe must
     refuse the combination loudly instead of silently not quantizing."""

@@ -70,6 +70,7 @@ def test_qwen3_vl_forward_and_deepstack():
     assert np.abs(np.asarray(hidden) - np.asarray(h2)).max() > 1e-5
 
 
+@pytest.mark.slow
 def test_qwen3_vl_text_only_matches_plain_decoder():
     """With no image tokens, MRoPE collapses to standard rope (t=h=w=index)
     and deepstack injects zeros — forward must equal the plain MoE decoder."""
@@ -132,6 +133,7 @@ def test_qwen3_vl_generate_matches_naive():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))
 
 
+@pytest.mark.slow
 def test_qwen3_vl_decode_rope_origin():
     """prepare_generation: the first decoded token's rope position resumes
     at max(pos3)+1 — NOT at the prompt length (the image block compresses

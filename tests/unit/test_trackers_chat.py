@@ -3,6 +3,7 @@
 import json
 
 import numpy as np
+import pytest
 
 
 def test_null_tracker_jsonl(tmp_path):
@@ -18,6 +19,7 @@ def test_null_tracker_jsonl(tmp_path):
     assert recs[-1]["_status"] == "FINISHED"
 
 
+@pytest.mark.slow
 def test_recipe_with_tracker(tmp_path):
     from tests.unit.test_recipe import _smoke_cfg
     from automodel_tpu.cli.app import resolve_recipe_class

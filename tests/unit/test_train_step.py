@@ -35,6 +35,7 @@ def _make_batch(key, accum, mb, seq):
     return {"input_ids": ids[..., :-1], "labels": ids[..., 1:]}
 
 
+@pytest.mark.slow
 def test_train_loss_decreases_memorization():
     params = decoder.init(CFG, jax.random.key(0))
     sched = LRSchedulerConfig(warmup_steps=2, decay_steps=100, style="constant").build(1e-2)
@@ -99,6 +100,7 @@ def test_sharded_train_step_runs_and_matches():
     np.testing.assert_allclose(float(m_ref["grad_norm"]), float(m_shd["grad_norm"]), rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_hsdp_sharded_train_step_matches():
     """HSDP (dp_replicate x dp_shard) == single-device step."""
     ctx = MeshConfig(dp_replicate=2, dp_shard=2, tp=2).build()

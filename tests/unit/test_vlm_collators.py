@@ -114,6 +114,7 @@ def test_vlm_sft_image_marker_expands_in_place(tmp_path):
     assert window != marker_toks
 
 
+@pytest.mark.slow
 def test_vlm_sft_feeds_recipe(tmp_path):
     """End-to-end: the real collator drives the VLM finetune recipe."""
     from automodel_tpu.cli.app import resolve_recipe_class
