@@ -22,9 +22,23 @@ import orbax.checkpoint as ocp
 logger = logging.getLogger(__name__)
 
 
+def is_remote_path(path: str) -> bool:
+    """True for fsspec-style URIs (gs://…, s3://…, file://…) that orbax/
+    tensorstore reads directly — no local directory creation or abspath
+    resolution applies to them. Windows drive letters (C:\\…) are NOT
+    URIs."""
+    scheme, sep, _ = str(path).partition("://")
+    return bool(sep) and scheme.isalnum() and len(scheme) > 1
+
+
 @dataclasses.dataclass
 class CheckpointingConfig:
-    """(reference: checkpoint/config.py:89-180 CheckpointingConfig)."""
+    """(reference: checkpoint/config.py:89-180 CheckpointingConfig).
+
+    `checkpoint_dir` accepts a local path or a remote fsspec-style URI
+    (`gs://bucket/run1`); remote targets are handed to orbax verbatim —
+    tensorstore does the bucket I/O, so multi-host TPU jobs checkpoint
+    without a shared filesystem."""
 
     enabled: bool = True
     checkpoint_dir: str = "checkpoints"
@@ -42,16 +56,20 @@ class CheckpointingConfig:
 class Checkpointer:
     def __init__(self, config: CheckpointingConfig):
         self.config = config
-        os.makedirs(config.checkpoint_dir, exist_ok=True)
+        if is_remote_path(config.checkpoint_dir):
+            # remote URI: no local mkdir/abspath; orbax+tensorstore handle
+            # object-store semantics (creation is implicit on write)
+            root = config.checkpoint_dir.rstrip("/")
+        else:
+            os.makedirs(config.checkpoint_dir, exist_ok=True)
+            root = os.path.abspath(config.checkpoint_dir)
         options = ocp.CheckpointManagerOptions(
             max_to_keep=config.max_recent_checkpoints,
             enable_async_checkpointing=config.async_save,
             best_fn=(lambda m: m[config.best_metric]) if config.best_metric else None,
             best_mode=config.best_mode if config.best_metric else "min",
         )
-        self._mgr = ocp.CheckpointManager(
-            os.path.abspath(config.checkpoint_dir), options=options
-        )
+        self._mgr = ocp.CheckpointManager(root, options=options)
 
     # -- save ------------------------------------------------------------
     def save(self, step: int, state: Any, extra: dict | None = None,

@@ -720,6 +720,17 @@ def save_hf_checkpoint(
     consolidate_safetensors_files_on_every_rank)."""
     from safetensors.numpy import save_file
 
+    from automodel_tpu.checkpoint.checkpointer import is_remote_path
+
+    if is_remote_path(out_dir):
+        # os.makedirs would silently create a LOCAL './gs:/…' tree and the
+        # safetensors would die with the job's ephemeral disk
+        raise NotImplementedError(
+            f"consolidated HF export writes local safetensors files; "
+            f"{out_dir!r} is a remote URI (orbax step checkpoints DO support "
+            "remote checkpoint_dir) — export to a local directory via "
+            "save_consolidated_hf(out_dir=...) and sync it to the bucket"
+        )
     os.makedirs(out_dir, exist_ok=True)
     # Stream: flush each shard to a temp-named file as soon as it fills so
     # host memory peaks at ONE shard, then rename once the count is known.

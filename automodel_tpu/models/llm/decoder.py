@@ -18,6 +18,7 @@ resolved by parallel/sharding.py.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Any, Optional
 
 import jax
@@ -429,17 +430,24 @@ def _pp_layer_setup(layers_params, cfg: TransformerConfig, mesh_ctx, freq_for):
 
 
 def make_pp_1f1b_loss_and_grad(cfg: TransformerConfig, mesh_ctx, chunk_size: int = 1024):
-    """Explicit 1F1B value-and-grad for the dense decoder — the training-path
-    analog of `forward` + autodiff under pp, with the 1F1B memory bound (at
-    most pp stashed microbatch inputs per stage instead of all M boundary
-    activations; reference schedule zoo: distributed/pipelining/
-    functional.py:777 — here the schedule is precomputed action tables inside
-    one lax.scan, parallel/pp.py:219).
+    """Explicit 1F1B value-and-grad for the dense AND MoE decoders — the
+    training-path analog of `forward` + autodiff under pp, with the 1F1B
+    memory bound (at most pp stashed microbatch inputs per stage instead of
+    all M boundary activations; reference schedule zoo: distributed/
+    pipelining/functional.py:777 — here the schedule is precomputed action
+    tables inside one lax.scan, parallel/pp.py:219).
 
-    Returns grad_fn(params, batch, rng) -> (grads, ce_sum, aux) pluggable
-    into training.make_train_step(grad_fn=...). The head (final norm +
-    lm-head/tied-embed + fused linear CE) runs fused into the last stage's
-    backward so logits are never materialized.
+    Returns grad_fn(params, batch, rng) -> (grads, ce_sum_plus_aux, aux)
+    pluggable into training.make_train_step(grad_fn=...). The head (final
+    norm + lm-head/tied-embed + fused linear CE) runs fused into the last
+    stage's backward so logits are never materialized.
+
+    MoE configs (cfg.moe set) run the dropless expert dispatch INSIDE each
+    stage's step — the ep all-to-all overlaps with other stages' compute
+    (moe_lm.decoder._pp_moe_layer_setup). Their load-balance aux is folded
+    into the differentiated scalar pre-scaled by the global label-token
+    count (the `combine_losses` contract), and the returned aux dict
+    carries `tokens_per_expert` (Lm, E) for gate-bias updates / metrics.
     """
     from automodel_tpu.loss import fused_linear_cross_entropy
     from automodel_tpu.parallel.pp import (
@@ -448,6 +456,21 @@ def make_pp_1f1b_loss_and_grad(cfg: TransformerConfig, mesh_ctx, chunk_size: int
     )
 
     tie = cfg.tie_word_embeddings
+    is_moe = getattr(cfg, "moe", None) is not None
+    layers_key = "moe_layers" if is_moe else "layers"
+    if is_moe:
+        if getattr(cfg, "first_k_dense", 0) > 0:
+            raise NotImplementedError(
+                f"pipeline_schedule={cfg.pipeline_schedule} with "
+                "first_k_dense > 0 (heterogeneous layer stacks don't fit one "
+                "scanned stage pytree); use the default gpipe schedule"
+            )
+        if getattr(cfg, "mtp_num_layers", 0) > 0:
+            raise NotImplementedError(
+                f"pipeline_schedule={cfg.pipeline_schedule} with the MTP "
+                "head (it shifts outside the pipelined stack); use the "
+                "default gpipe schedule"
+            )
 
     def grad_fn(params, batch, rng):
         del rng  # no dropout in the decoder
@@ -462,6 +485,7 @@ def make_pp_1f1b_loss_and_grad(cfg: TransformerConfig, mesh_ctx, chunk_size: int
         seg = batch.get("segment_ids")
         if seg is None:
             seg = jnp.zeros_like(positions)
+        n = jnp.sum((labels != -100).astype(jnp.float32))
 
         inv_freq = rope_frequencies(cfg.rope_dim, cfg.rope_theta, cfg.rope_scaling)
         freq_for = make_freq_for(cfg, inv_freq)
@@ -473,15 +497,32 @@ def make_pp_1f1b_loss_and_grad(cfg: TransformerConfig, mesh_ctx, chunk_size: int
 
             return wrapped
 
-        layers_in, lspecs, pl_layer, uniform = _pp_layer_setup(
-            params["layers"], cfg, mesh_ctx, freq_for
-        )
-        if not uniform:
-            raise NotImplementedError(
-                f"pipeline_schedule={cfg.pipeline_schedule} with mixed "
-                "per-layer sliding windows (the window aux arrays are "
-                "non-differentiable scan inputs); use gpipe for this model"
+        if is_moe:
+            from automodel_tpu.models.moe_lm.decoder import _pp_moe_layer_setup
+
+            layers_in, lspecs, pl_layer, extras_specs = _pp_moe_layer_setup(
+                params[layers_key], cfg, mesh_ctx, freq_for
             )
+            # aux contract: each (stage, microbatch) chunk contributes
+            # aux·scale to the differentiated sum; scale = n / n_chunks makes
+            # the total n·mean(chunk aux) — combine_losses' n·aux with aux
+            # the per-microbatch chunk-mean estimator (see pipeline_layers)
+            n_chunks = cfg.pipeline_microbatches * math.prod(
+                mesh_ctx.sizes[a]
+                for a in ("dp_replicate", "dp_shard", "ep", "cp")
+            )
+            aux_kw = {"aux_scale": n / n_chunks, "extras_specs": extras_specs}
+        else:
+            layers_in, lspecs, pl_layer, uniform = _pp_layer_setup(
+                params[layers_key], cfg, mesh_ctx, freq_for
+            )
+            if not uniform:
+                raise NotImplementedError(
+                    f"pipeline_schedule={cfg.pipeline_schedule} with mixed "
+                    "per-layer sliding windows (the window aux arrays are "
+                    "non-differentiable scan inputs); use gpipe for this model"
+                )
+            aux_kw = {}
         pl_layer = cast_layer(pl_layer)
 
         def embed_fwd(embed_p):
@@ -512,35 +553,42 @@ def make_pp_1f1b_loss_and_grad(cfg: TransformerConfig, mesh_ctx, chunk_size: int
             return ce
 
         if cfg.pipeline_schedule == "interleaved":
-            loss, dh, gl, gh = pipeline_train_interleaved(
+            out = pipeline_train_interleaved(
                 h, positions, seg, labels, layers_in, pl_layer, head,
                 head_loss, mesh_ctx, cfg.pipeline_microbatches,
                 cfg.pipeline_virtual_stages, param_logical_specs=lspecs,
+                **aux_kw,
             )
         elif cfg.pipeline_schedule == "zb":
             from automodel_tpu.parallel.pp import pipeline_train_zb
 
-            loss, dh, gl, gh = pipeline_train_zb(
+            out = pipeline_train_zb(
                 h, positions, seg, labels, layers_in, pl_layer, head,
                 head_loss, mesh_ctx, cfg.pipeline_microbatches,
-                param_logical_specs=lspecs,
+                param_logical_specs=lspecs, **aux_kw,
             )
         else:
-            loss, dh, gl, gh = pipeline_train_1f1b(
+            out = pipeline_train_1f1b(
                 h, positions, seg, labels, layers_in, pl_layer, head,
                 head_loss, mesh_ctx, cfg.pipeline_microbatches,
-                param_logical_specs=lspecs,
+                param_logical_specs=lspecs, **aux_kw,
             )
+        if is_moe:
+            loss, dh, gl, gh, extras = out
+        else:
+            loss, dh, gl, gh = out
         (d_embed,) = embed_vjp(dh.astype(h.dtype))
-        grads = {"layers": gl, "final_norm": gh["final_norm"]}
+        grads = {layers_key: gl, "final_norm": gh["final_norm"]}
         if tie:
             grads["embed"] = jax.tree.map(jnp.add, d_embed, gh["embed"])
         else:
             grads["embed"] = d_embed
             grads["lm_head"] = gh["lm_head"]
         grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
-        n = jnp.sum((labels != -100).astype(jnp.float32))
-        return grads, loss, {"num_label_tokens": n}
+        aux = {"num_label_tokens": n}
+        if is_moe:
+            aux["tokens_per_expert"] = extras["tokens_per_expert"]
+        return grads, loss, aux
 
     return grad_fn
 

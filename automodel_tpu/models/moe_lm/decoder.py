@@ -162,6 +162,139 @@ def param_specs(cfg: MoETransformerConfig) -> dict:
     return specs
 
 
+def _pp_moe_layer_setup(moe_layers_params, cfg: MoETransformerConfig, mesh_ctx, freq_for):
+    """Per-stage MoE layer fn for the pipeline executors (parallel/pp.py).
+
+    The MoE analog of llm.decoder._pp_layer_setup: inside the pipeline
+    shard_map every collective is manual — attention psums its o_proj over
+    `tp`, and the dropless expert dispatch issues its all-to-all over `ep`
+    confined to THIS stage's step, so it overlaps with other stages'
+    compute instead of fencing the whole program (the PP×EP composition,
+    TorchTitan-style).
+
+    Layer contract (pp.py `layer_aux=True` / `aux_scale` mode):
+      pl_layer(h, lp, pos, seg[, token_mask]) ->
+        (h, aux_scalar, {"tokens_per_expert": (E,)})
+    aux is this layer's load-balance loss over the shard's LOCAL tokens; the
+    executors psum over (data axes, pp). The GPipe forward threads the
+    optional per-microbatch token_mask (pad tokens excluded from routing /
+    aux, matching the GSPMD scan); the explicit 1F1B/ZB schedules do not —
+    pad tokens route normally there (their CE contribution is still masked
+    by labels == -100 in the head loss).
+
+    Returns (layers_in, lspecs, pl_layer, extras_specs).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from automodel_tpu.moe.experts import (
+        dropless_ep_shardmap_body,
+        experts_forward_dropless,
+        shared_expert_forward,
+    )
+    from automodel_tpu.moe.gate import gate_forward
+
+    windows = layer_windows(cfg)
+    if len(set(windows)) != 1:
+        raise NotImplementedError(
+            "MoE pipeline with mixed per-layer sliding windows; use the "
+            "GSPMD (non-pipelined) path for this model"
+        )
+    tp = mesh_ctx.sizes["tp"]
+    ep = mesh_ctx.sizes["ep"]
+    moe_cfg = cfg.moe
+    if cfg.attention_type == "mla" and (tp > 1 or mesh_ctx.sizes["cp"] > 1):
+        raise NotImplementedError(
+            "pp×tp / pp×cp with MLA attention: the manual-collective layer "
+            "mode is implemented for standard GQA attention only"
+        )
+    if moe_cfg.dispatcher != "dropless":
+        raise NotImplementedError(
+            "MoE inside the pipeline shard_map requires the dropless "
+            "dispatcher (the capacity einsum path relies on GSPMD to place "
+            "its all-to-all); set model.moe_dispatcher: dropless"
+        )
+    if moe_cfg.n_routed_experts % max(ep, 1) != 0:
+        raise ValueError(
+            f"n_routed_experts={moe_cfg.n_routed_experts} not divisible by "
+            f"ep={ep}"
+        )
+    if tp > 1:
+        if cfg.num_heads % tp or cfg.num_kv_heads % tp:
+            raise ValueError(
+                f"pp×tp needs num_heads={cfg.num_heads}, "
+                f"num_kv_heads={cfg.num_kv_heads} divisible by tp={tp}"
+            )
+        if moe_cfg.n_shared_experts > 0 and moe_cfg.shared_intermediate % tp:
+            raise ValueError(
+                f"pp×tp needs shared_intermediate={moe_cfg.shared_intermediate} "
+                f"divisible by tp={tp}"
+            )
+        cfg_pl = dataclasses.replace(
+            cfg,
+            num_heads=cfg.num_heads // tp,
+            num_kv_heads=cfg.num_kv_heads // tp,
+            head_dim=cfg.resolved_head_dim,  # pin before num_heads changes
+        )
+    else:
+        cfg_pl = cfg
+    window = windows[0]
+    identity = lambda x, axes: x  # noqa: E731  (GSPMD constraints inert here)
+
+    def pl_layer(hh, lp, pos, sg, tok_mask=None):
+        h = attention_block(
+            hh, lp, cfg_pl, pos, sg, freq_for(window), identity, window,
+            mesh_ctx, manual=True,
+        )
+        x = rms_norm(
+            h, lp["post_attn_norm"]["scale"], cfg.rms_norm_eps,
+            cfg.zero_centered_norm,
+        )
+        B, S, H = x.shape
+        flat = x.reshape(B * S, H)
+        mp = lp["moe"]
+        weights, indices, aux, stats = gate_forward(
+            mp["gate"], moe_cfg, flat,
+            token_mask=None if tok_mask is None else tok_mask.reshape(B * S),
+        )
+        if ep > 1:
+            routed = dropless_ep_shardmap_body(
+                mp["experts"], moe_cfg, flat, weights, indices, axis_name="ep"
+            )
+        else:
+            routed = experts_forward_dropless(
+                mp["experts"], moe_cfg, flat, weights, indices
+            )
+        out = routed
+        if moe_cfg.n_shared_experts > 0:
+            out = out + shared_expert_forward(
+                mp["shared"], moe_cfg, flat,
+                tp_axis="tp" if tp > 1 else None,  # mlp-dim slices → psum
+            )
+        h = h + out.reshape(B, S, H).astype(h.dtype)
+        return h, aux, {"tokens_per_expert": stats["tokens_per_expert"]}
+
+    lspecs = param_specs(cfg)["moe_layers"]
+    extras_specs = {"tokens_per_expert": P("pp", None)}  # stacked layer dim
+    return moe_layers_params, lspecs, pl_layer, extras_specs
+
+
+def _pp_pipeline_compatible(cfg: MoETransformerConfig, mesh_ctx) -> bool:
+    """Whether the pipelined (shard_map) MoE path covers this config; the
+    out-of-scope remainder falls back to the GSPMD layer scan."""
+    use_dsa = cfg.attention_type == "mla" and cfg.dsa_index_topk is not None
+    return (
+        cfg.first_k_dense == 0
+        and cfg.moe.dispatcher == "dropless"
+        and not use_dsa
+        and len(set(layer_windows(cfg))) == 1
+        and not (
+            cfg.attention_type == "mla"
+            and (mesh_ctx.sizes["tp"] > 1 or mesh_ctx.sizes["cp"] > 1)
+        )
+        and cfg.moe.n_routed_experts % mesh_ctx.sizes["ep"] == 0
+    )
+
+
 def forward(
     params: dict,
     cfg: MoETransformerConfig,
@@ -226,6 +359,45 @@ def forward(
         freq_for = lambda w: rope_angles  # noqa: E731
     windows = layer_windows(cfg)
     Lm, E = cfg.num_moe_layers, cfg.moe.n_routed_experts
+
+    pp_ok = (
+        mesh_ctx is not None
+        and mesh_ctx.sizes["pp"] > 1
+        and _pp_pipeline_compatible(cfg, mesh_ctx)
+        and routing_override is None
+        and not return_routing
+        and return_aux_hidden is None
+        and deepstack_embeds is None
+        and rope_angles is None
+    )
+    if pp_ok:
+        # Pipelined GPipe forward: one shard_map over the whole mesh, expert
+        # A2A confined to each stage's step (see _pp_moe_layer_setup). The
+        # GSPMD scan below stays as the fallback for out-of-scope configs
+        # (first_k_dense > 0, DSA, capacity dispatcher, deepstack, replay).
+        from automodel_tpu.parallel.pp import pipeline_layers
+
+        seg = segment_ids if segment_ids is not None else jnp.zeros_like(positions)
+        layers_in, lspecs, pl_layer, extras_specs = _pp_moe_layer_setup(
+            params["moe_layers"], cfg, mesh_ctx, freq_for
+        )
+        h, aux_loss, extras = pipeline_layers(
+            h, positions, seg, layers_in, pl_layer, mesh_ctx,
+            cfg.pipeline_microbatches, remat_policy=cfg.remat_policy,
+            param_logical_specs=lspecs, layer_aux=True,
+            extras_specs=extras_specs, token_mask=token_mask,
+        )
+        h = constrain(h, ("act_batch", "act_seq", "act_embed"))
+        h = rms_norm(
+            h, params["final_norm"]["scale"], cfg.rms_norm_eps,
+            cfg.zero_centered_norm,
+        )
+        out = h if return_hidden else unembed(params, cfg, h)
+        if return_stats:
+            return out, aux_loss, {
+                "tokens_per_expert": extras["tokens_per_expert"]
+            }
+        return out, aux_loss
 
     def _deepstack(h, gidx):
         return deepstack_inject(h, gidx, deepstack_embeds)

@@ -359,6 +359,63 @@ def _dropless_ep_local(params, cfg, x, weights, indices, *, axis_name, bucket,
     return jnp.zeros((T, H), dtype).at[token_of].add(ys * w_sorted[:, None])
 
 
+def shared_expert_forward(
+    params: dict,
+    cfg: MoEConfig,
+    flat: jnp.ndarray,  # (T, H)
+    *,
+    tp_axis: str | None = None,
+) -> jnp.ndarray:
+    """Dense shared-expert branch added to the routed output (DeepSeek /
+    Qwen-MoE style). One implementation for both execution modes: under
+    GSPMD (moe/layer.py) leave `tp_axis=None`; inside the pipeline
+    shard_map (moe_lm `_pp_moe_layer_setup`) pass the mesh axis so the
+    mlp-dim-sharded down-proj partials are psummed manually."""
+    dtype = flat.dtype
+    u = flat @ params["up_proj"]["kernel"].astype(dtype)
+    if cfg.shared_expert_is_gated:
+        g = flat @ params["gate_proj"]["kernel"].astype(dtype)
+        inner = gated_combine(g, u, cfg.shared_expert_activation, cfg.swiglu_limit)
+    else:
+        inner = _EXPERT_ACT[cfg.shared_expert_activation](u)
+    out = inner @ params["down_proj"]["kernel"].astype(dtype)
+    if tp_axis is not None:
+        out = jax.lax.psum(out, tp_axis)
+    if cfg.shared_expert_gated:
+        out = out * jax.nn.sigmoid(flat @ params["gate"]["kernel"].astype(dtype))
+    return out
+
+
+def dropless_ep_shardmap_body(
+    params: dict,
+    cfg: MoEConfig,
+    x: jnp.ndarray,        # (T_loc, H) — this shard's tokens
+    weights: jnp.ndarray,  # (T_loc, K)
+    indices: jnp.ndarray,  # (T_loc, K)
+    *,
+    axis_name: str = "ep",
+    ragged: bool | None = None,
+) -> jnp.ndarray:
+    """Dropless EP dispatch for callers ALREADY inside a shard_map over a
+    mesh containing `axis_name` — the pipeline-stage entry point: the pp
+    schedules (parallel/pp.py) run each stage's layer scan inside one
+    full-mesh shard_map, so the expert A2A must be issued as a manual
+    collective confined to that stage's step (it overlaps with other
+    stages' compute instead of fencing the whole program).
+
+    `params` holds the LOCAL expert slice (E/ep experts, dim 0); token rows
+    are this shard's. bucket = the dropless worst case for the local rows
+    (every (token, slot) pair could target one peer).
+    """
+    if ragged is None:
+        ragged = jax.default_backend() == "tpu"
+    bucket = max(8, x.shape[0] * cfg.experts_per_token)
+    return _dropless_ep_local(
+        params, cfg, x, weights, indices,
+        axis_name=axis_name, bucket=bucket, ragged=ragged,
+    )
+
+
 def experts_forward_dropless_ep(
     params: dict,
     cfg: MoEConfig,

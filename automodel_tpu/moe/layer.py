@@ -105,20 +105,7 @@ def moe_forward(
         routed = experts_forward(params["experts"], cfg, flat, dispatch, combine, constrain)
     out = routed
     if cfg.n_shared_experts > 0:
-        from automodel_tpu.moe.experts import _EXPERT_ACT, gated_combine
+        from automodel_tpu.moe.experts import shared_expert_forward
 
-        sp = params["shared"]
-        dtype = x.dtype
-        u = flat @ sp["up_proj"]["kernel"].astype(dtype)
-        if cfg.shared_expert_is_gated:
-            g = flat @ sp["gate_proj"]["kernel"].astype(dtype)
-            inner = gated_combine(g, u, cfg.shared_expert_activation, cfg.swiglu_limit)
-        else:
-            inner = _EXPERT_ACT[cfg.shared_expert_activation](u)
-        shared_out = inner @ sp["down_proj"]["kernel"].astype(dtype)
-        if cfg.shared_expert_gated:
-            shared_out = shared_out * jax.nn.sigmoid(
-                flat @ sp["gate"]["kernel"].astype(dtype)
-            )
-        out = out + shared_out
+        out = out + shared_expert_forward(params["shared"], cfg, flat)
     return out.reshape(B, S, H).astype(x.dtype), aux_loss, stats

@@ -28,6 +28,7 @@ GPipe (P-1)/(M+P-1).
 from __future__ import annotations
 
 import logging
+import math
 from typing import Any, Callable
 
 import jax
@@ -75,7 +76,10 @@ def pipeline_layers(
     batch_axes: tuple = ("dp_replicate", "dp_shard", "ep"),
     remat_policy: str | None = "full",
     param_logical_specs: Any = None,
-) -> jnp.ndarray:
+    layer_aux: bool = False,
+    extras_specs: Any = None,
+    token_mask: jnp.ndarray | None = None,
+):
     """Run the stacked layers as a pp-staged pipeline; returns (B, S, H).
 
     positions/segment_ids travel with their microbatch through the ring so
@@ -85,6 +89,26 @@ def pipeline_layers(
     in-shard ring attention — decoder `manual=True` mode); head/mlp param
     dims stay sharded on `tp` when `param_logical_specs` names them
     (layer_fn psums the partial o/down projections over tp).
+
+    `layer_aux=True` switches the layer contract to
+    `layer_fn(h, lp, pos, seg) -> (h, aux_scalar, extras_pytree)` — the MoE
+    mode: per-layer load-balance losses accumulate across (stage,
+    microbatch) into one global scalar — the MEAN over (data-shard,
+    microbatch) token chunks, summed over layers (psum over pp + token
+    axes, then / n_chunks). The switch loss is a product of per-token
+    means, so the global-gate value is not recoverable from chunk scalars;
+    the chunk-mean is the standard per-microbatch estimator (equal to the
+    global value under uniform routing stats) — and the
+    per-layer `extras` leaves (e.g. tokens_per_expert (E,)) stack over the
+    layer dim and come back (L, ...) with `extras_specs` out-specs (use
+    P("pp", ...) for the stacked layer dim). Returns (out, aux, extras).
+
+    `token_mask` ((B, S) bool, False = pad/ignored; layer_aux mode only)
+    extends the contract to `layer_fn(h, lp, pos, seg, mask)` so routing /
+    aux stats exclude masked tokens, matching the GSPMD scan path. The mask
+    does NOT ride the ppermute ring: every pp rank holds all microbatches'
+    token arrays (same in_spec as positions), so stage p just indexes its
+    current microbatch `t - p` directly.
     """
     pp = mesh_ctx.sizes["pp"]
     B, S, H = h.shape
@@ -100,30 +124,78 @@ def pipeline_layers(
     h_mb = h.reshape(M, B // M, S, H)
     pos_mb = positions.reshape(M, B // M, S)
     seg_mb = segment_ids.reshape(M, B // M, S)
+    has_mask = layer_aux and token_mask is not None
+    n_chunks = M * math.prod(
+        mesh_ctx.sizes[a] for a in tuple(batch_axes) + ("cp",)
+    )
 
-    def run(h_mb, pos_mb, seg_mb, params_local):
+    def run(h_mb, pos_mb, seg_mb, params_local, *maybe_mask):
         # inside shard_map: h_mb (M, B_loc, S, H); params leaves (L/pp, ...)
         p_idx = lax.axis_index("pp")
         n_stage = lax.axis_size("pp")
         T = M + n_stage - 1
+        mask_mb = maybe_mask[0] if has_mask else None
 
-        def apply_stage(x, pos, seg):
+        def apply_stage(x, pos, seg, tm=None):
             from automodel_tpu.models.common.layers import maybe_remat
+
+            if layer_aux:
+                def body(c, lp):
+                    y, a, e = (
+                        layer_fn(c, lp, pos, seg, tm)
+                        if has_mask else layer_fn(c, lp, pos, seg)
+                    )
+                    return y, (a, e)
+
+                y, (auxs, extras) = lax.scan(
+                    maybe_remat(body, remat_policy), x, params_local
+                )
+                return y, jnp.sum(auxs).astype(jnp.float32), extras
 
             def body(c, lp):
                 return layer_fn(c, lp, pos, seg), None
 
             y, _ = lax.scan(maybe_remat(body, remat_policy), x, params_local)
-            return y
+            return y, jnp.float32(0.0), ()
+
+        if layer_aux:
+            ex_shapes = jax.eval_shape(
+                lambda p: apply_stage(
+                    h_mb[0], pos_mb[0], seg_mb[0],
+                    mask_mb[0] if has_mask else None,
+                )[2],
+                params_local,
+            )
+            ex0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), ex_shapes)
+        else:
+            ex0 = ()
 
         def tick(carry, t):
-            (act, pos, seg), outputs = carry
+            (act, pos, seg), outputs, aux_acc, ex_acc = carry
             m = jnp.clip(t, 0, M - 1)
             is_first = p_idx == 0
             x = jnp.where(is_first, h_mb[m], act)
             pos = jnp.where(is_first, pos_mb[m], pos)
             seg = jnp.where(is_first, seg_mb[m], seg)
-            y = apply_stage(x, pos, seg)
+            # stage p works on microbatch t - p; its token mask is read from
+            # the (rank-complete) mask_mb rather than streamed with the act
+            tm = (
+                mask_mb[jnp.clip(t - p_idx, 0, M - 1)] if has_mask else None
+            )
+            y, aux, ex = apply_stage(x, pos, seg, tm)
+            # stage p holds real data for microbatch t - p on ticks
+            # p <= t < p + M; off-window ticks recompute clipped garbage that
+            # must not leak into the aux/stat accumulators
+            valid = jnp.logical_and(t >= p_idx, t - p_idx < M)
+            # aux_acc is carried as shape (1,), not a scalar: jax 0.4.37's
+            # shard_map linearization mis-promotes scalar scan residuals
+            # (broadcast-in-dim shape mismatch under grad); any rank>=1
+            # carry avoids the bug
+            aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
+            ex_acc = jax.tree.map(
+                lambda a, e: a + jnp.where(valid, e, jnp.zeros_like(e)),
+                ex_acc, ex,
+            )
             out_idx = t - (n_stage - 1)
             write = jnp.logical_and(out_idx >= 0, p_idx == n_stage - 1)
             outputs = lax.cond(
@@ -136,11 +208,14 @@ def pipeline_layers(
             )
             perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
             stream = lax.ppermute((y, pos, seg), "pp", perm)
-            return (stream, outputs), None
+            return (stream, outputs, aux_acc, ex_acc), None
 
         init_stream = (jnp.zeros_like(h_mb[0]), pos_mb[0], seg_mb[0])
-        (_, outputs), _ = lax.scan(
-            tick, (init_stream, jnp.zeros_like(h_mb)), jnp.arange(T)
+        (_, outputs, aux_acc, ex_acc), _ = lax.scan(
+            tick,
+            (init_stream, jnp.zeros_like(h_mb), jnp.zeros((1,), jnp.float32),
+             ex0),
+            jnp.arange(T),
         )
         # Only the last stage's buffer is real; every pp rank needs it because
         # the head (final norm + lm-head/loss) runs under GSPMD outside this
@@ -151,21 +226,32 @@ def pipeline_layers(
         outputs = lax.psum(
             jnp.where(p_idx == n_stage - 1, outputs, jnp.zeros_like(outputs)), "pp"
         )
-        return outputs
+        data_axes = tuple(batch_axes) + ("cp",)
+        # each stage's aux covers its own layers → sum over pp; each token
+        # shard routes its own tokens → mean over the (data shard,
+        # microbatch) chunks (replicated over tp already — tp ranks see
+        # identical tokens)
+        aux_acc = lax.psum(aux_acc[0], data_axes + ("pp",)) / n_chunks
+        ex_acc = jax.tree.map(lambda e: lax.psum(e, data_axes), ex_acc)
+        return outputs, aux_acc, ex_acc
 
     act_spec = P(None, batch_axes, "cp", None)  # (M, B, S_cp, H)
     tok_spec = P(None, batch_axes, "cp")
-    out = jax.shard_map(
+    mask_ops = (token_mask.reshape(M, B // M, S),) if has_mask else ()
+    out, aux, extras = jax.shard_map(
         run,
         mesh=mesh_ctx.mesh,
         in_specs=(
             act_spec, tok_spec, tok_spec,
             _param_specs_pp(stacked_params, param_logical_specs),
-        ),
-        out_specs=act_spec,
+        ) + ((tok_spec,) if has_mask else ()),
+        out_specs=(act_spec, P(), extras_specs if layer_aux else ()),
         check_vma=False,
-    )(h_mb, pos_mb, seg_mb, stacked_params)
-    return out.reshape(B, S, H)
+    )(h_mb, pos_mb, seg_mb, stacked_params, *mask_ops)
+    out = out.reshape(B, S, H)
+    if layer_aux:
+        return out, aux, extras
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -326,6 +412,8 @@ def pipeline_train_1f1b(
     num_microbatches: int,
     batch_axes: tuple = ("dp_replicate", "dp_shard", "ep"),
     param_logical_specs: Any = None,
+    aux_scale: jnp.ndarray | None = None,
+    extras_specs: Any = None,
 ) -> tuple:
     """1F1B training pipeline: returns (loss_sum, d_h, layer_grads, head_grads).
 
@@ -339,10 +427,21 @@ def pipeline_train_1f1b(
     Grads come back already reduced: layer_grads sharded (pp on dim 0),
     head_grads and d_h replicated. Compose with `jax.vjp` of the embedding
     outside. Loss/grad parity vs end-to-end autodiff: tests/unit/test_pp.py.
+
+    `aux_scale` (a traced scalar, e.g. the global label-token count) enables
+    the MoE layer contract `layer_fn -> (h, aux, extras)`: every stage's
+    backward adds `aux_scale · aux` into the differentiated scalar, so the
+    expert-dispatch A2A and its gradients stay confined to that stage's step
+    while load-balance gradients flow. The per-layer `extras` pytree (e.g.
+    tokens_per_expert (E,)) accumulates over microbatches, stacks over the
+    stage's layers, and is returned as a fifth output with `extras_specs`
+    out-specs (P("pp", ...) on the stacked layer dim). The returned loss is
+    then ce_sum + aux_scale·Σaux — the `combine_losses` contract.
     """
     pp = mesh_ctx.sizes["pp"]
     B, S, H = h.shape
     M = num_microbatches
+    has_aux = aux_scale is not None
     _check_microbatch_split(B, M, mesh_ctx, batch_axes)
     fwd_tab, bwd_tab = one_f_one_b_tables(M, pp)
     T = fwd_tab.shape[0]
@@ -355,8 +454,9 @@ def pipeline_train_1f1b(
     pos_mb = positions.reshape(M, B // M, S)
     seg_mb = segment_ids.reshape(M, B // M, S)
     lab_mb = labels.reshape(M, B // M, S)
+    scale_in = jnp.asarray(aux_scale if has_aux else 0.0, jnp.float32)
 
-    def run(h_mb, pos_mb, seg_mb, lab_mb, params_local, head_local):
+    def run(h_mb, pos_mb, seg_mb, lab_mb, params_local, head_local, scale):
         p_idx = lax.axis_index("pp")
         n_stage = lax.axis_size("pp")
         is_last = p_idx == n_stage - 1
@@ -364,42 +464,65 @@ def pipeline_train_1f1b(
         btab = jnp.asarray(bwd_tab)
 
         def stage(x, params, pos, seg):
+            if has_aux:
+                def body(c, lp):
+                    y, a, e = layer_fn(c, lp, pos, seg)
+                    return y, (a, e)
+
+                y, (auxs, extras) = lax.scan(body, x, params)
+                return y, jnp.sum(auxs).astype(jnp.float32), extras
+
             def body(c, lp):
                 return layer_fn(c, lp, pos, seg), None
 
             y, _ = lax.scan(body, x, params)
-            return y
+            return y, jnp.float32(0.0), ()
 
         def full_bwd(x, params, head, pos, seg, lab, dy):
             """Backward of one microbatch at this stage: last stage fuses the
-            head+loss (ignoring dy), others pull the streamed cotangent."""
+            head+loss (ignoring dy), others pull the streamed cotangent. The
+            has_aux report carries (loss_contribution, per-layer extras).
 
-            def fwd_last(xx, pp_, hh_):
-                return head_loss_fn(stage(xx, pp_, pos, seg), hh_, lab).astype(
-                    jnp.float32
-                )
+            stage() is hoisted OUT of the is_last cond: its collectives (cp
+            ring hops, tp psums, ep A2As) must execute rank-uniformly — pp
+            ranks take different branches, and branch-divergent collectives
+            deadlock the CPU runtime's global rendezvous (reproduced:
+            pp×cp 1F1B dryrun hang). The cond keeps only local head/vdot
+            math, so the head matmul still runs on the last stage alone."""
 
-            def fwd_mid(xx, pp_, hh_):
-                del hh_
-                y = stage(xx, pp_, pos, seg)
-                return jnp.vdot(y.astype(jnp.float32), dy.astype(jnp.float32))
+            def fwd(xx, pp_, hh_):
+                y, aux, ex = stage(xx, pp_, pos, seg)
+                sa = aux * scale
+                # cond operands stay explicit arrays — 0.4.37 shard_map
+                # linearization mishandles captured/scalar cond residuals
+                s = lax.cond(
+                    is_last,
+                    lambda yy, hh: head_loss_fn(yy, hh, lab).astype(jnp.float32),
+                    lambda yy, hh: jnp.vdot(
+                        yy.astype(jnp.float32), dy.astype(jnp.float32)
+                    ),
+                    y, hh_,
+                ) + sa
+                return s, (jnp.where(is_last, s, sa), ex)
 
-            loss, vjp = jax.vjp(
-                lambda xx, pp_, hh_: lax.cond(
-                    is_last, fwd_last, fwd_mid, xx, pp_, hh_
-                ),
-                x, params, head,
-            )
-            dx, dparams, dhead = vjp(jnp.ones((), loss.dtype))
-            return jnp.where(is_last, loss, 0.0), dx, dparams, dhead
+            out, vjp, (rep, extras) = jax.vjp(fwd, x, params, head, has_aux=True)
+            dx, dparams, dhead = vjp(jnp.ones((), out.dtype))
+            return rep, dx, dparams, dhead, extras
 
         zeros_g = jax.tree.map(jnp.zeros_like, params_local)
         zeros_h = jax.tree.map(jnp.zeros_like, head_local)
         stash0 = jnp.zeros((n_stage,) + h_mb.shape[1:], h_mb.dtype)
+        ex0 = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype),
+            jax.eval_shape(
+                lambda p: stage(h_mb[0], p, pos_mb[0], seg_mb[0])[2],
+                params_local,
+            ),
+        )
 
         def tick(carry, t):
             (fstream, bstream, fstash, bstash, stash,
-             gacc, hacc, dh_acc, loss_acc) = carry
+             gacc, hacc, dh_acc, loss_acc, ex_acc) = carry
             mf = jnp.take(ftab[t], p_idx)
             mb = jnp.take(btab[t], p_idx)
 
@@ -437,13 +560,13 @@ def pipeline_train_1f1b(
                 lax.dynamic_update_index_in_dim(stash, x_in, mf_c % n_stage, 0),
                 stash,
             )
-            y = stage(x_in, params_local, pos_mb[mf_c], seg_mb[mf_c])
+            y, _, _ = stage(x_in, params_local, pos_mb[mf_c], seg_mb[mf_c])
             fout = jnp.where(mf >= 0, y, jnp.zeros_like(y))
 
             # ---- backward slot ----
             mb_c = jnp.clip(mb, 0, M - 1)
             x_b = stash[mb_c % n_stage]
-            loss_i, dx, dparams, dhead = full_bwd(
+            loss_i, dx, dparams, dhead, ex = full_bwd(
                 x_b, params_local, head_local,
                 pos_mb[mb_c], seg_mb[mb_c], lab_mb[mb_c], bstash[mb_c % n_stage],
             )
@@ -453,6 +576,9 @@ def pipeline_train_1f1b(
             )
             hacc = jax.tree.map(
                 lambda a, g: a + jnp.where(do_b, g, jnp.zeros_like(g)), hacc, dhead
+            )
+            ex_acc = jax.tree.map(
+                lambda a, e: a + jnp.where(do_b, e, jnp.zeros_like(e)), ex_acc, ex
             )
             dh_acc = jnp.where(
                 jnp.logical_and(do_b, p_idx == 0),
@@ -468,7 +594,7 @@ def pipeline_train_1f1b(
             bstream = lax.ppermute(bout, "pp", bwd_perm)
             return (
                 fstream, bstream, fstash, bstash, stash,
-                gacc, hacc, dh_acc, loss_acc,
+                gacc, hacc, dh_acc, loss_acc, ex_acc,
             ), None
 
         carry0 = (
@@ -481,34 +607,45 @@ def pipeline_train_1f1b(
             zeros_h,
             jnp.zeros_like(h_mb),
             jnp.zeros((), jnp.float32),
+            ex0,
         )
-        (_, _, _, _, _, gacc, hacc, dh_acc, loss_acc), _ = lax.scan(
+        (_, _, _, _, _, gacc, hacc, dh_acc, loss_acc, ex_acc), _ = lax.scan(
             tick, carry0, jnp.arange(T)
         )
         # Manual-collective grad reduction (the transpose of shard_map would
         # have inserted these in the autodiff path): param grads are partial
         # per data shard → psum over batch+cp; NOT over tp (activations are
         # tp-replicated so per-rank grads are already correct for each
-        # rank's param slice). Layer grads stay on their own pp stage; head
-        # grads / loss / d_h are made consistent across pp.
+        # rank's param slice) and NOT over axes a leaf is sharded on (an
+        # ep-sharded expert slice already holds its complete grad — every
+        # token routed to it arrived through the A2A). Layer grads stay on
+        # their own pp stage; head grads / loss / d_h are made consistent
+        # across pp.
         data_axes = tuple(batch_axes) + ("cp",)
-        gacc = jax.tree.map(lambda g: lax.psum(g, data_axes), gacc)
+        gacc = jax.tree.map(
+            lambda g, s: lax.psum(g, _grad_reduce_axes(s, data_axes)),
+            gacc, pspecs,
+        )
         hacc = jax.tree.map(lambda g: lax.psum(g, data_axes + ("pp",)), hacc)
         dh_acc = lax.psum(dh_acc, "pp")
         loss_acc = lax.psum(loss_acc, data_axes + ("pp",))
-        return loss_acc, dh_acc, gacc, hacc
+        ex_acc = jax.tree.map(lambda e: lax.psum(e, data_axes), ex_acc)
+        return loss_acc, dh_acc, gacc, hacc, ex_acc
 
     act_spec = P(None, batch_axes, "cp", None)
     tok_spec = P(None, batch_axes, "cp")
     pspecs = _param_specs_pp(stacked_params, param_logical_specs)
     hspec = jax.tree.map(lambda x: P(*([None] * x.ndim)), head_params)
-    loss, dh, gl, gh = jax.shard_map(
+    loss, dh, gl, gh, ex = jax.shard_map(
         run,
         mesh=mesh_ctx.mesh,
-        in_specs=(act_spec, tok_spec, tok_spec, tok_spec, pspecs, hspec),
-        out_specs=(P(), act_spec, pspecs, hspec),
+        in_specs=(act_spec, tok_spec, tok_spec, tok_spec, pspecs, hspec, P()),
+        out_specs=(P(), act_spec, pspecs, hspec,
+                   extras_specs if has_aux else ()),
         check_vma=False,
-    )(h_mb, pos_mb, seg_mb, lab_mb, stacked_params, head_params)
+    )(h_mb, pos_mb, seg_mb, lab_mb, stacked_params, head_params, scale_in)
+    if has_aux:
+        return loss, dh.reshape(B, S, H), gl, gh, ex
     return loss, dh.reshape(B, S, H), gl, gh
 
 
@@ -601,9 +738,14 @@ def pipeline_train_zb(
     num_microbatches: int,
     batch_axes: tuple = ("dp_replicate", "dp_shard", "ep"),
     param_logical_specs: Any = None,
+    aux_scale: jnp.ndarray | None = None,
+    extras_specs: Any = None,
 ) -> tuple:
     """Zero-bubble (ZB-H1) training pipeline — pipeline_train_1f1b's
-    interface with the backward split into B and W passes.
+    interface with the backward split into B and W passes, including the
+    MoE layer-aux contract (`aux_scale`/`extras_specs`, see 1F1B): aux
+    gradients split naturally — B's x-only vjp carries the aux input-grad,
+    W's param-only vjp the aux weight-grad; extras are reported by B.
 
     B computes only the input gradient (XLA dead-code-eliminates the
     weight-grad matmuls from the x-only vjp) and streams it upstream at
@@ -624,6 +766,7 @@ def pipeline_train_zb(
     pp = mesh_ctx.sizes["pp"]
     B, S, H = h.shape
     M = num_microbatches
+    has_aux = aux_scale is not None
     _check_microbatch_split(B, M, mesh_ctx, batch_axes)
     fwd_tab, bwd_tab, wgt_tab = zero_bubble_tables(M, pp)
     T = fwd_tab.shape[0]
@@ -636,8 +779,9 @@ def pipeline_train_zb(
     pos_mb = positions.reshape(M, B // M, S)
     seg_mb = segment_ids.reshape(M, B // M, S)
     lab_mb = labels.reshape(M, B // M, S)
+    scale_in = jnp.asarray(aux_scale if has_aux else 0.0, jnp.float32)
 
-    def run(h_mb, pos_mb, seg_mb, lab_mb, params_local, head_local):
+    def run(h_mb, pos_mb, seg_mb, lab_mb, params_local, head_local, scale):
         p_idx = lax.axis_index("pp")
         n_stage = lax.axis_size("pp")
         is_last = p_idx == n_stage - 1
@@ -646,56 +790,77 @@ def pipeline_train_zb(
         wtab = jnp.asarray(wgt_tab)
 
         def stage(x, params, pos, seg):
+            if has_aux:
+                def body(c, lp):
+                    y, a, e = layer_fn(c, lp, pos, seg)
+                    return y, (a, e)
+
+                y, (auxs, extras) = lax.scan(body, x, params)
+                return y, jnp.sum(auxs).astype(jnp.float32), extras
+
             def body(c, lp):
                 return layer_fn(c, lp, pos, seg), None
 
             y, _ = lax.scan(body, x, params)
-            return y
+            return y, jnp.float32(0.0), ()
 
         def b_pass(x, pos, seg, lab, dy):
-            """Input-grad-only backward (weight grads are W's job)."""
+            """Input-grad-only backward (weight grads are W's job). stage()
+            runs OUTSIDE the is_last cond — collectives must be rank-uniform
+            (see pipeline_train_1f1b.full_bwd)."""
 
-            def fwd_last(xx):
-                return head_loss_fn(
-                    stage(xx, params_local, pos, seg), head_local, lab
-                ).astype(jnp.float32)
+            def fwd(xx):
+                y, aux, ex = stage(xx, params_local, pos, seg)
+                sa = aux * scale
+                s = lax.cond(
+                    is_last,
+                    lambda yy: head_loss_fn(yy, head_local, lab).astype(
+                        jnp.float32
+                    ),
+                    lambda yy: jnp.vdot(
+                        yy.astype(jnp.float32), dy.astype(jnp.float32)
+                    ),
+                    y,
+                ) + sa
+                return s, (jnp.where(is_last, s, sa), ex)
 
-            def fwd_mid(xx):
-                y = stage(xx, params_local, pos, seg)
-                return jnp.vdot(y.astype(jnp.float32), dy.astype(jnp.float32))
-
-            loss, vjp = jax.vjp(
-                lambda xx: lax.cond(is_last, fwd_last, fwd_mid, xx), x
-            )
-            (dx,) = vjp(jnp.ones((), loss.dtype))
-            return jnp.where(is_last, loss, 0.0), dx
+            out, vjp, (rep, ex) = jax.vjp(fwd, x, has_aux=True)
+            (dx,) = vjp(jnp.ones((), out.dtype))
+            return rep, dx, ex
 
         def w_pass(x, pos, seg, lab, dy):
-            """Weight-grad-only backward against the stashed input/cotangent."""
+            """Weight-grad-only backward against the stashed input/cotangent.
+            Same hoisted-stage structure as b_pass."""
 
-            def fwd_last(pp_, hh_):
-                return head_loss_fn(stage(x, pp_, pos, seg), hh_, lab).astype(
-                    jnp.float32
-                )
+            def fwd(pp_, hh_):
+                y, aux, _ = stage(x, pp_, pos, seg)
+                sa = aux * scale
+                return lax.cond(
+                    is_last,
+                    lambda yy, hh: head_loss_fn(yy, hh, lab).astype(jnp.float32),
+                    lambda yy, hh: jnp.vdot(
+                        yy.astype(jnp.float32), dy.astype(jnp.float32)
+                    ),
+                    y, hh_,
+                ) + sa
 
-            def fwd_mid(pp_, hh_):
-                del hh_
-                y = stage(x, pp_, pos, seg)
-                return jnp.vdot(y.astype(jnp.float32), dy.astype(jnp.float32))
-
-            _, vjp = jax.vjp(
-                lambda pp_, hh_: lax.cond(is_last, fwd_last, fwd_mid, pp_, hh_),
-                params_local, head_local,
-            )
+            _, vjp = jax.vjp(fwd, params_local, head_local)
             return vjp(jnp.ones((), jnp.float32))
 
         zeros_g = jax.tree.map(jnp.zeros_like, params_local)
         zeros_h = jax.tree.map(jnp.zeros_like, head_local)
         stash0 = jnp.zeros((n_stage,) + h_mb.shape[1:], h_mb.dtype)
+        ex0 = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype),
+            jax.eval_shape(
+                lambda p: stage(h_mb[0], p, pos_mb[0], seg_mb[0])[2],
+                params_local,
+            ),
+        )
 
         def tick(carry, t):
             (fstream, bstream, fstash, bstash, stash,
-             gacc, hacc, dh_acc, loss_acc) = carry
+             gacc, hacc, dh_acc, loss_acc, ex_acc) = carry
             mf = jnp.take(ftab[t], p_idx)
             mb = jnp.take(btab[t], p_idx)
             mw = jnp.take(wtab[t], p_idx)
@@ -732,12 +897,12 @@ def pipeline_train_zb(
                 lax.dynamic_update_index_in_dim(stash, x_in, mf_c % n_stage, 0),
                 stash,
             )
-            y = stage(x_in, params_local, pos_mb[mf_c], seg_mb[mf_c])
+            y, _, _ = stage(x_in, params_local, pos_mb[mf_c], seg_mb[mf_c])
             fout = jnp.where(mf >= 0, y, jnp.zeros_like(y))
 
             # ---- B slot: input grad only ----
             mb_c = jnp.clip(mb, 0, M - 1)
-            loss_i, dx = b_pass(
+            loss_i, dx, ex = b_pass(
                 stash[mb_c % n_stage], pos_mb[mb_c], seg_mb[mb_c],
                 lab_mb[mb_c], bstash[mb_c % n_stage],
             )
@@ -748,6 +913,9 @@ def pipeline_train_zb(
                 dh_acc,
             )
             loss_acc = loss_acc + jnp.where(do_b, loss_i, 0.0)
+            ex_acc = jax.tree.map(
+                lambda a, e: a + jnp.where(do_b, e, jnp.zeros_like(e)), ex_acc, ex
+            )
 
             # ---- W slot: weight grads against stashed input + cotangent ----
             mw_c = jnp.clip(mw, 0, M - 1)
@@ -770,7 +938,7 @@ def pipeline_train_zb(
             bstream = lax.ppermute(bout, "pp", bwd_perm)
             return (
                 fstream, bstream, fstash, bstash, stash,
-                gacc, hacc, dh_acc, loss_acc,
+                gacc, hacc, dh_acc, loss_acc, ex_acc,
             ), None
 
         carry0 = (
@@ -783,28 +951,36 @@ def pipeline_train_zb(
             zeros_h,
             jnp.zeros_like(h_mb),
             jnp.zeros((), jnp.float32),
+            ex0,
         )
-        (_, _, _, _, _, gacc, hacc, dh_acc, loss_acc), _ = lax.scan(
+        (_, _, _, _, _, gacc, hacc, dh_acc, loss_acc, ex_acc), _ = lax.scan(
             tick, carry0, jnp.arange(T)
         )
         data_axes = tuple(batch_axes) + ("cp",)
-        gacc = jax.tree.map(lambda g: lax.psum(g, data_axes), gacc)
+        gacc = jax.tree.map(
+            lambda g, s: lax.psum(g, _grad_reduce_axes(s, data_axes)),
+            gacc, pspecs,
+        )
         hacc = jax.tree.map(lambda g: lax.psum(g, data_axes + ("pp",)), hacc)
         dh_acc = lax.psum(dh_acc, "pp")
         loss_acc = lax.psum(loss_acc, data_axes + ("pp",))
-        return loss_acc, dh_acc, gacc, hacc
+        ex_acc = jax.tree.map(lambda e: lax.psum(e, data_axes), ex_acc)
+        return loss_acc, dh_acc, gacc, hacc, ex_acc
 
     act_spec = P(None, batch_axes, "cp", None)
     tok_spec = P(None, batch_axes, "cp")
     pspecs = _param_specs_pp(stacked_params, param_logical_specs)
     hspec = jax.tree.map(lambda x: P(*([None] * x.ndim)), head_params)
-    loss, dh, gl, gh = jax.shard_map(
+    loss, dh, gl, gh, ex = jax.shard_map(
         run,
         mesh=mesh_ctx.mesh,
-        in_specs=(act_spec, tok_spec, tok_spec, tok_spec, pspecs, hspec),
-        out_specs=(P(), act_spec, pspecs, hspec),
+        in_specs=(act_spec, tok_spec, tok_spec, tok_spec, pspecs, hspec, P()),
+        out_specs=(P(), act_spec, pspecs, hspec,
+                   extras_specs if has_aux else ()),
         check_vma=False,
-    )(h_mb, pos_mb, seg_mb, lab_mb, stacked_params, head_params)
+    )(h_mb, pos_mb, seg_mb, lab_mb, stacked_params, head_params, scale_in)
+    if has_aux:
+        return loss, dh.reshape(B, S, H), gl, gh, ex
     return loss, dh.reshape(B, S, H), gl, gh
 
 
@@ -842,6 +1018,8 @@ def pipeline_train_interleaved(
     virtual: int,
     batch_axes: tuple = ("dp_replicate", "dp_shard", "ep"),
     param_logical_specs: Any = None,
+    aux_scale: jnp.ndarray | None = None,
+    extras_specs: Any = None,
 ) -> tuple:
     """Interleaved (virtual-stage) 1F1B: S = pp·virtual stages mapped
     cyclically onto the pp ring (stage s on device s % pp) — the Megatron
@@ -863,6 +1041,7 @@ def pipeline_train_interleaved(
     M = num_microbatches
     V = virtual
     Svirt = pp * V
+    has_aux = aux_scale is not None
     _check_microbatch_split(B, M, mesh_ctx, batch_axes)
     L = jax.tree.leaves(stacked_params)[0].shape[0]
     assert L % Svirt == 0, f"{L} layers not divisible by pp*virtual={Svirt}"
@@ -882,8 +1061,9 @@ def pipeline_train_interleaved(
     seg_mb = segment_ids.reshape(M, B // M, Sq)
     lab_mb = labels.reshape(M, B // M, Sq)
     K = min(Svirt, M)  # stash depth: in-flight per stage ≤ Svirt, consecutive
+    scale_in = jnp.asarray(aux_scale if has_aux else 0.0, jnp.float32)
 
-    def run(h_mb, pos_mb, seg_mb, lab_mb, params_local, head_local):
+    def run(h_mb, pos_mb, seg_mb, lab_mb, params_local, head_local, scale):
         p_idx = lax.axis_index("pp")
         P = lax.axis_size("pp")
         ftab = jnp.asarray(fwd_tab)
@@ -895,49 +1075,66 @@ def pipeline_train_interleaved(
                 params_local,
             )
 
-        def stage(x, v, pos, seg):
+        def chunk_scan(x, cparams, pos, seg):
+            """One virtual stage's layer scan → (y, aux_sum, extras)."""
+            if has_aux:
+                def body(c, lp):
+                    y, a, e = layer_fn(c, lp, pos, seg)
+                    return y, (a, e)
+
+                y, (auxs, extras) = lax.scan(body, x, cparams)
+                return y, jnp.sum(auxs).astype(jnp.float32), extras
+
             def body(c, lp):
                 return layer_fn(c, lp, pos, seg), None
 
-            y, _ = lax.scan(body, x, chunk_params(v))
-            return y
+            y, _ = lax.scan(body, x, cparams)
+            return y, jnp.float32(0.0), ()
+
+        def stage(x, v, pos, seg):
+            return chunk_scan(x, chunk_params(v), pos, seg)[0]
 
         def full_bwd(x, v, head, pos, seg, lab, dy, is_last):
-            def fwd_last(xx, pp_, hh_):
-                def body(c, lp):
-                    return layer_fn(c, lp, pos, seg), None
+            # chunk_scan OUTSIDE the is_last cond — collectives must be
+            # rank-uniform (see pipeline_train_1f1b.full_bwd)
+            def fwd(xx, pp_, hh_):
+                y, aux, ex = chunk_scan(xx, pp_, pos, seg)
+                sa = aux * scale
+                s = lax.cond(
+                    is_last,
+                    lambda yy, hh: head_loss_fn(yy, hh, lab).astype(jnp.float32),
+                    lambda yy, hh: jnp.vdot(
+                        yy.astype(jnp.float32), dy.astype(jnp.float32)
+                    ),
+                    y, hh_,
+                ) + sa
+                return s, (jnp.where(is_last, s, sa), ex)
 
-                y, _ = lax.scan(body, xx, pp_)
-                return head_loss_fn(y, hh_, lab).astype(jnp.float32)
-
-            def fwd_mid(xx, pp_, hh_):
-                del hh_
-
-                def body(c, lp):
-                    return layer_fn(c, lp, pos, seg), None
-
-                y, _ = lax.scan(body, xx, pp_)
-                return jnp.vdot(y.astype(jnp.float32), dy.astype(jnp.float32))
-
-            loss, vjp = jax.vjp(
-                lambda xx, pp_, hh_: lax.cond(
-                    is_last, fwd_last, fwd_mid, xx, pp_, hh_
-                ),
-                x, chunk_params(v), head,
+            out, vjp, (rep, ex) = jax.vjp(
+                fwd, x, chunk_params(v), head, has_aux=True
             )
-            dx, dparams, dhead = vjp(jnp.ones((), loss.dtype))
-            return jnp.where(is_last, loss, 0.0), dx, dparams, dhead
+            dx, dparams, dhead = vjp(jnp.ones((), out.dtype))
+            return rep, dx, dparams, dhead, ex
 
         zeros_g = jax.tree.map(jnp.zeros_like, params_local)
         zeros_h = jax.tree.map(jnp.zeros_like, head_local)
         stash0 = jnp.zeros((V, K) + h_mb.shape[1:], h_mb.dtype)
+        # extras accumulate per LOCAL layer row (V·chunk rows, permuted
+        # order — un-permuted with the grads outside)
+        ex0 = jax.tree.map(
+            lambda s: jnp.zeros((V * chunk,) + s.shape[1:], s.dtype),
+            jax.eval_shape(
+                lambda p: chunk_scan(h_mb[0], p, pos_mb[0], seg_mb[0])[2],
+                chunk_params(0),
+            ),
+        )
 
         def decode(a):
             return a // M, a % M  # (vstage, microbatch); a < 0 → idle
 
         def tick(carry, t):
             (fstream, bstream, fstash, bstash, stash,
-             gacc, hacc, dh_acc, loss_acc) = carry
+             gacc, hacc, dh_acc, loss_acc, ex_acc) = carry
             fa = jnp.take(ftab[t], p_idx)
             ba = jnp.take(btab[t], p_idx)
 
@@ -1009,7 +1206,7 @@ def pipeline_train_interleaved(
             vb, mb = decode(jnp.maximum(ba, 0))
             x_b = jnp.take(stash, vb, axis=0)[mb % K]
             is_last = jnp.logical_and(vb == V - 1, p_idx == P - 1)
-            loss_i, dx, dparams, dhead = full_bwd(
+            loss_i, dx, dparams, dhead, ex = full_bwd(
                 x_b, vb, head_local, pos_mb[mb], seg_mb[mb], lab_mb[mb],
                 jnp.take(bstash, vb, axis=0)[mb % K], is_last,
             )
@@ -1025,6 +1222,18 @@ def pipeline_train_interleaved(
                     a,
                 ),
                 gacc, dparams,
+            )
+            ex_acc = jax.tree.map(
+                lambda a, e: jnp.where(
+                    do_b,
+                    lax.dynamic_update_slice_in_dim(
+                        a,
+                        lax.dynamic_slice_in_dim(a, vb * chunk, chunk, 0) + e,
+                        vb * chunk, 0,
+                    ),
+                    a,
+                ),
+                ex_acc, ex,
             )
             hacc = jax.tree.map(
                 lambda a, g: a + jnp.where(do_b, g, jnp.zeros_like(g)), hacc, dhead
@@ -1043,7 +1252,7 @@ def pipeline_train_interleaved(
             bstream = lax.ppermute(bout, "pp", bwd_perm)
             return (
                 fstream, bstream, fstash, bstash, stash,
-                gacc, hacc, dh_acc, loss_acc,
+                gacc, hacc, dh_acc, loss_acc, ex_acc,
             ), None
 
         carry0 = (
@@ -1053,36 +1262,67 @@ def pipeline_train_interleaved(
             zeros_g, zeros_h,
             jnp.zeros_like(h_mb),
             jnp.zeros((), jnp.float32),
+            ex0,
         )
-        (_, _, _, _, _, gacc, hacc, dh_acc, loss_acc), _ = lax.scan(
+        (_, _, _, _, _, gacc, hacc, dh_acc, loss_acc, ex_acc), _ = lax.scan(
             tick, carry0, jnp.arange(T)
         )
         data_axes = tuple(batch_axes) + ("cp",)
-        gacc = jax.tree.map(lambda g: lax.psum(g, data_axes), gacc)
+        gacc = jax.tree.map(
+            lambda g, s: lax.psum(g, _grad_reduce_axes(s, data_axes)),
+            gacc, pspecs,
+        )
         hacc = jax.tree.map(lambda g: lax.psum(g, data_axes + ("pp",)), hacc)
         dh_acc = lax.psum(dh_acc, "pp")
         loss_acc = lax.psum(loss_acc, data_axes + ("pp",))
-        return loss_acc, dh_acc, gacc, hacc
+        ex_acc = jax.tree.map(lambda e: lax.psum(e, data_axes), ex_acc)
+        return loss_acc, dh_acc, gacc, hacc, ex_acc
 
     act_spec = P(None, batch_axes, "cp", None)
     tok_spec = P(None, batch_axes, "cp")
     pspecs = _param_specs_pp(params_perm, param_logical_specs)
     hspec = jax.tree.map(lambda x: P(*([None] * x.ndim)), head_params)
-    loss, dh, gl, gh = jax.shard_map(
+    loss, dh, gl, gh, ex = jax.shard_map(
         run,
         mesh=mesh_ctx.mesh,
-        in_specs=(act_spec, tok_spec, tok_spec, tok_spec, pspecs, hspec),
-        out_specs=(P(), act_spec, pspecs, hspec),
+        in_specs=(act_spec, tok_spec, tok_spec, tok_spec, pspecs, hspec, P()),
+        out_specs=(P(), act_spec, pspecs, hspec,
+                   extras_specs if has_aux else ()),
         check_vma=False,
-    )(h_mb, pos_mb, seg_mb, lab_mb, params_perm, head_params)
+    )(h_mb, pos_mb, seg_mb, lab_mb, params_perm, head_params, scale_in)
     gl = jax.tree.map(lambda x: x[inv], gl)  # back to natural layer order
+    if has_aux:
+        # extras rows follow the permuted layer order like the grads
+        ex = jax.tree.map(lambda x: x[inv], ex)
+        return loss, dh.reshape(B, Sq, H), gl, gh, ex
     return loss, dh.reshape(B, Sq, H), gl, gh
 
 
 #: logical param axes that stay sharded inside the pipeline shard_map;
 #: everything else (fsdp/embed dims) is gathered at the boundary — the
-#: per-step FSDP-unshard analog.
-_PP_MANUAL_AXES = {"layers": "pp", "heads": "tp", "kv_heads": "tp", "mlp": "tp"}
+#: per-step FSDP-unshard analog. `expert` stays on ep so each pipeline
+#: stage's MoE dispatch exchanges tokens over its own ragged A2A step.
+_PP_MANUAL_AXES = {
+    "layers": "pp", "heads": "tp", "kv_heads": "tp", "mlp": "tp",
+    "expert": "ep",
+}
+
+
+def _grad_reduce_axes(spec, data_axes: tuple) -> tuple:
+    """Data axes to psum a param grad over inside the pipeline shard_map:
+    every data axis the leaf is NOT sharded on. An ep-sharded expert slice
+    already holds its complete grad — every token routed to its experts
+    arrived through the A2A — so psum over ep would mix grads of DIFFERENT
+    experts living at the same buffer offset on different ranks."""
+    named = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            named.update(entry)
+        else:
+            named.add(entry)
+    return tuple(a for a in data_axes if a not in named)
 
 
 def _param_specs_pp(stacked_params, logical=None):

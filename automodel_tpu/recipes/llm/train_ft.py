@@ -447,35 +447,60 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
 
     def _make_grad_fn(self):
         """Explicit-gradient path: `distributed.pipeline_schedule: 1f1b`
-        routes training through the 1F1B interleave (decoder.
-        make_pp_1f1b_loss_and_grad) instead of autodiff over the GPipe
-        forward. Returns None for every other configuration."""
+        (or `zb` / `interleaved`) routes training through the explicit
+        fwd/bwd interleave (decoder.make_pp_1f1b_loss_and_grad) instead of
+        autodiff over the GPipe forward. Returns None for every other
+        configuration.
+
+        MoE decoders run the dropless expert dispatch inside each stage's
+        step (ep A2A overlapped with other stages' compute); PEFT composes
+        by vjp-ing the LoRA merge around the pipeline's explicit grads. The
+        only remaining fence is QAT, whose straight-through param transform
+        must live inside a differentiated function."""
         if (
             self.mesh_ctx.sizes["pp"] <= 1
             or getattr(self.model_cfg, "pipeline_schedule", "gpipe")
             not in ("1f1b", "interleaved", "zb")
         ):
             return None
-        for blocker, why in (
-            (self.is_moe, "MoE decoders"),
-            (self.peft_cfg is not None, "PEFT/LoRA"),
-            (self.cfg.get("qat.enabled", False), "QAT"),
-        ):
-            if blocker:
-                raise NotImplementedError(
-                    f"pipeline_schedule={self.model_cfg.pipeline_schedule} "
-                    f"does not yet support {why}; use the default gpipe schedule"
-                )
+        if self.cfg.get("qat.enabled", False):
+            raise NotImplementedError(
+                f"pipeline_schedule={self.model_cfg.pipeline_schedule} "
+                "does not yet support QAT (the fake-quant param transform "
+                "needs autodiff around it); use the default gpipe schedule"
+            )
         from automodel_tpu.models.llm.decoder import make_pp_1f1b_loss_and_grad
 
         logger.info(
-            "pipeline schedule: %s (explicit fwd/bwd interleave)",
+            "pipeline schedule: %s (explicit fwd/bwd interleave%s%s)",
             self.model_cfg.pipeline_schedule,
+            ", MoE-in-pipeline" if self.is_moe else "",
+            ", LoRA merge-vjp" if self.peft_cfg is not None else "",
         )
-        return make_pp_1f1b_loss_and_grad(
+        pp_grad = make_pp_1f1b_loss_and_grad(
             self.model_cfg, self.mesh_ctx,
             chunk_size=int(self.cfg.get("loss.chunk_size", 1024)),
         )
+        peft_cfg = self.peft_cfg
+        if peft_cfg is None:
+            return pp_grad
+
+        from automodel_tpu.peft.lora import merge_lora
+
+        def peft_grad_fn(lora, batch, rng, base_params):
+            # d(lora) = dmerge^T · d(merged): the pipeline computes explicit
+            # grads w.r.t. the merged weights; the LoRA factor grads come
+            # from the vjp of the (cheap, linear-ish) merge outside the
+            # pipeline shard_map.
+            merged, merge_vjp = jax.vjp(
+                lambda lo: merge_lora(base_params, lo, peft_cfg), lora
+            )
+            g_m, loss, aux = pp_grad(merged, batch, rng)
+            g_m = jax.tree.map(lambda g, p: g.astype(p.dtype), g_m, merged)
+            (d_lora,) = merge_vjp(g_m)
+            return jax.tree.map(lambda g: g.astype(jnp.float32), d_lora), loss, aux
+
+        return peft_grad_fn
 
     # ------------------------------------------------------------------
     def _build_tokenizer(self):

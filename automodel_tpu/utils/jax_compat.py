@@ -1,0 +1,80 @@
+"""Compatibility layer for older jax releases (>= 0.4.37).
+
+The codebase targets the modern jax API surface — `jax.shard_map` with
+`check_vma`, `jax.sharding.use_mesh`, `lax.ragged_all_to_all`. Hosts that
+ship an older jaxlib (e.g. the CPU-only CI container on jax 0.4.37) still
+have the same functionality under the pre-stabilization names:
+
+- `jax.shard_map(..., check_vma=)`  → `jax.experimental.shard_map.shard_map
+  (..., check_rep=)` — identical semantics; `check_vma` renamed from
+  `check_rep` when shard_map graduated out of experimental.
+- `jax.sharding.use_mesh(mesh)`     → the `Mesh` object itself, which has
+  been a context manager since 0.4.x.
+- `lax.ragged_all_to_all`           → no pre-stabilization spelling exists;
+  install a stub that raises with guidance (every CPU code path already
+  selects the dense `all_to_all` layout via `ragged=False`, so the stub
+  only fires if a TPU-only path is forced on an old host).
+
+`install()` is idempotent and a no-op on modern jax; it runs once at
+`automodel_tpu` import time so every entry point (tests, recipes, bench,
+__graft_entry__) sees one consistent surface.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def _shard_map_compat(f=None, /, *, mesh=None, in_specs=None, out_specs=None,
+                      check_vma=True, **kwargs):
+    from jax.experimental.shard_map import shard_map as _sm
+
+    kwargs.pop("axis_names", None)  # new-API-only knob; default = all axes
+    if f is None:  # decorator form: jax.shard_map(mesh=..., ...)(f)
+        return lambda fn: _shard_map_compat(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma, **kwargs,
+        )
+    return _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma, **kwargs,
+    )
+
+
+def _axis_size_compat(axis_name):
+    """`lax.axis_size` predecessor: read the bound axis env (concrete int,
+    usable in shapes — `lax.psum(1, name)` would be traced)."""
+    from jax._src.core import get_axis_env
+
+    if isinstance(axis_name, (tuple, list)):
+        import math
+
+        return math.prod(_axis_size_compat(a) for a in axis_name)
+    return get_axis_env().axis_size(axis_name)
+
+
+def _ragged_all_to_all_missing(*args, **kwargs):
+    raise NotImplementedError(
+        "lax.ragged_all_to_all is unavailable on this jax "
+        f"({jax.__version__}); the dropless EP dispatch must run with "
+        "ragged=False (dense bucket all_to_all) on this host — see "
+        "moe/experts.py:_dropless_ep_local"
+    )
+
+
+def install() -> None:
+    """Idempotently bridge the old jax API surface to the modern names."""
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _shard_map_compat
+    if not hasattr(jax.sharding, "use_mesh"):
+        # Mesh is itself a context manager (sets the ambient resource env);
+        # use_mesh only adds sharding-in-types plumbing we don't rely on.
+        jax.sharding.use_mesh = lambda mesh: mesh
+    if not hasattr(lax, "axis_size"):
+        lax.axis_size = _axis_size_compat
+    if not hasattr(lax, "ragged_all_to_all"):
+        lax.ragged_all_to_all = _ragged_all_to_all_missing
+
+
+install()

@@ -150,6 +150,11 @@ def main() -> None:
         help="auto: probe the accelerator, fall back to a tiny CPU run; "
         "accel: require the accelerator (fail fast if unusable); cpu: force CPU",
     )
+    ap.add_argument(
+        "--no-headline", action="store_true",
+        help="emit only the llama-MFU metric (skip the flash-vs-XLA, MoE "
+        "dropless, and long-context CP probes that ride the same window)",
+    )
     args = ap.parse_args()
 
     fallback = None
@@ -197,6 +202,22 @@ def main() -> None:
                 "vs_baseline": 0.0,
                 "detail": {"error": repr(e)[:500], "fallback": fallback},
             }
+    if not args.no_headline and "error" not in result["detail"]:
+        # all four headline metrics ride ONE successful probe window —
+        # including the medium-OOM→small retry (VERDICT r5 "next round"
+        # item 2): the tunnel may be down again by the next invocation, so
+        # never waste a working backend. Sized by the backend actually
+        # probing, not the preset: --platform cpu must get the CPU shapes.
+        import jax
+
+        try:
+            result["headline"] = _run_headline(jax.default_backend() != "cpu")
+            result["headline"]["llama_pretrain_mfu_pct"] = {
+                "value": result["value"], "unit": result["unit"],
+                "detail": dict(result["detail"]),
+            }
+        except Exception as e3:  # noqa: BLE001 — keep the MFU line
+            result["headline"] = {"error": repr(e3)[:300]}
     _append_perf_trail(result)
     print(json.dumps(result))
 
@@ -292,6 +313,227 @@ def _run(args) -> dict:
             "loss": float(m["loss"]),
         },
     }
+
+
+def _time_best(fn, *args, windows: int = 3, inner: int = 3) -> float:
+    """Best-of-N windows of `inner` calls each (see the MFU loop: external
+    interference only slows a window down), returns seconds per call."""
+    out = fn(*args)
+    import jax
+
+    jax.block_until_ready(out)  # compile outside the window
+    best = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        best = min(best, (time.perf_counter() - t0) / inner)
+    return best
+
+
+def _headline_attention(accel: bool) -> dict:
+    """Flash-kernel vs XLA-attention microbench on one causal GQA shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from automodel_tpu.ops.attention import dot_product_attention
+
+    B, S, Hq, Hkv, D = (4, 2048, 16, 8, 128) if accel else (2, 256, 4, 2, 64)
+    ks = jax.random.split(jax.random.key(0), 3)
+    dt = jnp.bfloat16 if accel else jnp.float32
+    q = jax.random.normal(ks[0], (B, S, Hq, D), dt)
+    k = jax.random.normal(ks[1], (B, S, Hkv, D), dt)
+    v = jax.random.normal(ks[2], (B, S, Hkv, D), dt)
+    out = {"shape": {"B": B, "S": S, "Hq": Hq, "Hkv": Hkv, "D": D}}
+
+    xla = jax.jit(lambda q, k, v: dot_product_attention(q, k, v, impl="xla"))
+    out["xla_ms"] = round(_time_best(xla, q, k, v) * 1e3, 3)
+    try:
+        fl = jax.jit(lambda q, k, v: dot_product_attention(q, k, v, impl="flash"))
+        out["flash_ms"] = round(_time_best(fl, q, k, v) * 1e3, 3)
+        out["speedup"] = round(out["xla_ms"] / out["flash_ms"], 3)
+    except Exception as e:  # noqa: BLE001 — pallas needs a TPU backend
+        out["flash_ms"] = None
+        out["error"] = f"flash kernel unavailable: {repr(e)[:160]}"
+    return out
+
+
+def _headline_moe(accel: bool) -> dict:
+    """Dropless MoE train-step time (the sort + ragged GEMM + A2A path)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from automodel_tpu.distributed import MeshConfig
+    from automodel_tpu.loss import fused_linear_cross_entropy
+    from automodel_tpu.loss.utils import combine_losses
+    from automodel_tpu.models.moe_lm import decoder as moe_decoder
+    from automodel_tpu.models.moe_lm.decoder import MoETransformerConfig
+    from automodel_tpu.moe.config import MoEConfig
+    from automodel_tpu.optim import OptimizerConfig
+    from automodel_tpu.parallel import logical_to_shardings
+    from automodel_tpu.training import init_train_state, make_train_step
+
+    ctx = MeshConfig(ep=-1).build() if accel else MeshConfig().build()
+    if accel:
+        cfg = MoETransformerConfig(
+            vocab_size=32768, hidden_size=1024, intermediate_size=2048,
+            num_layers=4, num_heads=16, num_kv_heads=8, first_k_dense=1,
+            moe=MoEConfig(
+                n_routed_experts=max(8, 2 * ctx.sizes["ep"]),
+                n_shared_experts=1, experts_per_token=2,
+                moe_intermediate_size=512, shared_expert_intermediate_size=512,
+                aux_loss_coeff=0.01, dispatcher="dropless",
+            ),
+            dtype=jnp.bfloat16, remat_policy="full", attn_impl="auto",
+        )
+        batch, seq = 4, 2048
+    else:
+        cfg = MoETransformerConfig(
+            vocab_size=512, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=4, num_kv_heads=2, first_k_dense=0,
+            moe=MoEConfig(
+                n_routed_experts=4, n_shared_experts=1, experts_per_token=2,
+                moe_intermediate_size=32, shared_expert_intermediate_size=32,
+                aux_loss_coeff=0.01, dispatcher="dropless",
+            ),
+            dtype=jnp.float32, remat_policy="none", attn_impl="xla",
+        )
+        batch, seq = 4, 128
+    div = ctx.batch_size_divisor
+    batch = ((batch + div - 1) // div) * div
+    params = moe_decoder.init(cfg, jax.random.key(0))
+    params = jax.device_put(params, logical_to_shardings(
+        moe_decoder.param_specs(cfg), ctx,
+        shapes=jax.tree.map(lambda p: p.shape, params),
+    ))
+
+    def loss_fn(p, b, rng):
+        hidden, aux = moe_decoder.forward(
+            p, cfg, b["input_ids"], return_hidden=True, mesh_ctx=ctx
+        )
+        ce, n = fused_linear_cross_entropy(
+            hidden, p["lm_head"]["kernel"], b["labels"], chunk_size=2048
+        )
+        return combine_losses(ce, n, aux)
+
+    tx = OptimizerConfig(lr=1e-4).build()
+    state = init_train_state(params, tx)
+    step_fn = jax.jit(make_train_step(loss_fn, tx), donate_argnums=0)
+    ids = np.random.default_rng(0).integers(
+        1, cfg.vocab_size, (1, batch, seq + 1)
+    )
+    b = jax.device_put(
+        {"input_ids": jnp.asarray(ids[..., :-1], jnp.int32),
+         "labels": jnp.asarray(ids[..., 1:], jnp.int32)},
+        ctx.sharding(None, "batch", None),
+    )
+    state, m = step_fn(state, b, jax.random.key(0))
+    jax.block_until_ready(m["loss"])
+    best = float("inf")
+    for w in range(3):
+        t0 = time.perf_counter()
+        state, m = step_fn(state, b, jax.random.key(w))
+        jax.block_until_ready(m["loss"])
+        best = min(best, time.perf_counter() - t0)
+    return {
+        "step_ms": round(best * 1e3, 2),
+        "tokens_per_sec": round(batch * seq / best, 1),
+        "config": {
+            "experts": cfg.moe.n_routed_experts, "ep": ctx.sizes["ep"],
+            "layers": cfg.num_layers, "hidden": cfg.hidden_size,
+            "batch": batch, "seq": seq,
+        },
+    }
+
+
+def _headline_cp(accel: bool) -> dict:
+    """Long-context step time: 32k tokens under ring-CP when the mesh has
+    ≥2 devices (cp=-1 soaks them), else the single-chip 32k step."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from automodel_tpu.distributed import MeshConfig
+    from automodel_tpu.loss import fused_linear_cross_entropy
+    from automodel_tpu.models.llm import decoder
+    from automodel_tpu.models.llm.decoder import TransformerConfig
+    from automodel_tpu.optim import OptimizerConfig
+    from automodel_tpu.parallel import logical_to_shardings
+    from automodel_tpu.training import init_train_state, make_train_step
+
+    n_dev = len(jax.devices())
+    cp = n_dev if n_dev > 1 else 1
+    ctx = MeshConfig(cp=cp, dp_shard=1).build()
+    if accel:
+        cfg = TransformerConfig(
+            vocab_size=32768, hidden_size=1024, intermediate_size=4096,
+            num_layers=4, num_heads=16, num_kv_heads=8,
+            rope_theta=500000.0, dtype=jnp.bfloat16, remat_policy="full",
+            attn_impl="auto",
+        )
+        seq = 32768
+    else:
+        cfg = TransformerConfig(
+            vocab_size=512, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=4, num_kv_heads=2,
+            dtype=jnp.float32, remat_policy="none", attn_impl="xla",
+        )
+        seq = 1024 * max(1, cp)
+    params = decoder.init(cfg, jax.random.key(0))
+    params = jax.device_put(params, logical_to_shardings(
+        decoder.param_specs(cfg), ctx,
+        shapes=jax.tree.map(lambda p: p.shape, params),
+    ))
+
+    def loss_fn(p, b, rng):
+        hidden = decoder.forward(
+            p, cfg, b["input_ids"], return_hidden=True, mesh_ctx=ctx
+        )
+        return fused_linear_cross_entropy(
+            hidden, p["lm_head"]["kernel"], b["labels"], chunk_size=2048
+        )
+
+    tx = OptimizerConfig(lr=1e-4).build()
+    state = init_train_state(params, tx)
+    step_fn = jax.jit(make_train_step(loss_fn, tx), donate_argnums=0)
+    ids = np.random.default_rng(0).integers(1, cfg.vocab_size, (1, 1, seq + 1))
+    b = jax.device_put(
+        {"input_ids": jnp.asarray(ids[..., :-1], jnp.int32),
+         "labels": jnp.asarray(ids[..., 1:], jnp.int32)},
+        ctx.sharding(None, "batch", "cp"),
+    )
+    state, m = step_fn(state, b, jax.random.key(0))
+    jax.block_until_ready(m["loss"])
+    best = float("inf")
+    for w in range(3):
+        t0 = time.perf_counter()
+        state, m = step_fn(state, b, jax.random.key(w))
+        jax.block_until_ready(m["loss"])
+        best = min(best, time.perf_counter() - t0)
+    return {
+        "step_ms": round(best * 1e3, 2),
+        "tokens_per_sec": round(seq / best, 1),
+        "config": {"seq": seq, "cp": cp, "hidden": cfg.hidden_size,
+                   "layers": cfg.num_layers},
+    }
+
+
+def _run_headline(accel: bool) -> dict:
+    """The other three headline metrics, each isolated so one failure never
+    costs the window (the MFU number is merged in by the caller)."""
+    out = {}
+    for name, fn in (
+        ("flash_vs_xla_attention", _headline_attention),
+        ("moe_dropless_step", _headline_moe),
+        ("cp_long_context_step", _headline_cp),
+    ):
+        try:
+            out[name] = fn(accel)
+        except Exception as e:  # noqa: BLE001 — isolate per metric
+            out[name] = {"error": repr(e)[:300]}
+    return out
 
 
 if __name__ == "__main__":

@@ -186,6 +186,7 @@ def test_bagel_adapter_roundtrip():
 
 
 @pytest.mark.recipe
+@pytest.mark.slow  # compile-heavy recipe; bagel fwd/adapter tests stay tier-1
 def test_bagel_recipe_trains(tmp_path):
     from automodel_tpu.cli.app import resolve_recipe_class
     from automodel_tpu.config import ConfigNode

@@ -163,3 +163,66 @@ def test_retention(tmp_path):
     steps = sorted(int(d) for d in os.listdir(tmp_path / "r") if d.isdigit())
     assert steps == [3, 4]
     ckpt.close()
+
+
+def test_is_remote_path_classification():
+    from automodel_tpu.checkpoint.checkpointer import is_remote_path
+
+    assert is_remote_path("gs://bucket/run1")
+    assert is_remote_path("s3://bucket/ckpt")
+    assert is_remote_path("file://node/shared/ckpt")
+    assert not is_remote_path("checkpoints")
+    assert not is_remote_path("/abs/local/dir")
+    assert not is_remote_path("./rel/dir")
+    assert not is_remote_path("C://weird-windows-ish")  # drive letter, not a scheme
+
+
+def test_consolidated_hf_export_rejects_remote_uri():
+    """save_hf_checkpoint writes LOCAL safetensors; a remote out_dir (e.g.
+    checkpoint_dir: gs://… + save_consolidated) must fail fast instead of
+    silently materializing a local './gs:/…' tree the job loses."""
+    from automodel_tpu.checkpoint.hf_adapter import save_hf_checkpoint
+
+    with pytest.raises(NotImplementedError, match="remote URI"):
+        save_hf_checkpoint(iter([]), "gs://bucket/run1/hf")
+
+
+def test_remote_checkpoint_dir_skips_local_fs(monkeypatch, tmp_path):
+    """gs:// checkpoint_dir goes to orbax VERBATIM — no makedirs/abspath
+    (multi-host TPU jobs checkpoint to a bucket, not a shared filesystem).
+    The bucket I/O itself belongs to tensorstore, so the manager is mocked."""
+    import orbax.checkpoint as ocp
+
+    from automodel_tpu.checkpoint import checkpointer as ckpt_mod
+
+    seen = {}
+
+    class FakeManager:
+        def __init__(self, root, options=None):
+            seen["root"] = root
+
+        def wait_until_finished(self):
+            pass
+
+        def close(self):
+            pass
+
+    real_makedirs = os.makedirs
+
+    def forbidden(*a, **k):
+        raise AssertionError("os.makedirs must not run for a remote URI")
+
+    monkeypatch.setattr(ocp, "CheckpointManager", FakeManager)
+    monkeypatch.setattr(ckpt_mod.os, "makedirs", forbidden)
+    ckpt = CheckpointingConfig(
+        checkpoint_dir="gs://bucket/run1/", async_save=False
+    ).build()
+    assert seen["root"] == "gs://bucket/run1"  # trailing slash normalized only
+    ckpt.close()
+
+    # local dirs keep the old behavior: created + absolutized
+    monkeypatch.setattr(ckpt_mod.os, "makedirs", real_makedirs)
+    local = CheckpointingConfig(checkpoint_dir=str(tmp_path / "loc")).build()
+    assert os.path.isdir(tmp_path / "loc")
+    assert os.path.isabs(seen["root"]) and seen["root"].endswith("loc")
+    local.close()

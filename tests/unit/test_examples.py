@@ -50,7 +50,41 @@ def test_example_parses_and_resolves(path):
         spec.config_from_hf(hf_d, remat_policy="none")
 
 
-_SMOKES = [p for p in EXAMPLES if _is_hermetic(_load(p))]
+#: hermetic by shape but not runnable on the CPU smoke host — excluded with
+#: a reason, never silently (test_example_parses_and_resolves still covers
+#: them)
+_SMOKE_EXCLUDE = {
+    # 1.1B × 2048-seq benchmark: a single CPU step takes longer than the
+    # whole smoke tier; meaningful only on an accelerator
+    "examples/llm_benchmark/llama_1b_bench.yaml",
+}
+
+#: compile-heaviest smokes (≥15s on the 1-core host, --durations audit) whose
+#: recipes already have a dedicated tier-1 recipe test — slow tier keeps the
+#: end-to-end YAML coverage without blowing the 870s smoke budget
+_SLOW_SMOKES = {
+    "examples/multimodal/omni_mock_smoke.yaml",      # test_omni recipe test
+    "examples/multimodal/bagel_smoke.yaml",          # test_bagel recipe test
+    "examples/vlm_finetune/minimax_m3_vl_smoke.yaml",  # test_minimax_m3
+    "examples/multimodal/pretrain_smoke.yaml",       # test_vlm recipe tests
+    "examples/llm_finetune/deepseek_v4_dsa_smoke.yaml",  # test_dsa recipe smoke
+    "examples/llm_finetune/qwen3_next_smoke.yaml",   # test_hf_parity logits
+    "examples/vlm_kd/llava_kd_smoke.yaml",           # test_recipe_matrix KD
+    "examples/llm_finetune/mimo_v2_flash_smoke.yaml",  # test_model_tail + pin
+    "examples/llm_finetune/gemma4_moe_smoke.yaml",   # test_model_tail + pin
+}
+
+_SMOKES = [
+    pytest.param(
+        p,
+        marks=[pytest.mark.slow]
+        if str(p.relative_to(p.parents[2])) in _SLOW_SMOKES
+        else [],
+    )
+    for p in EXAMPLES
+    if _is_hermetic(_load(p))
+    and str(p.relative_to(p.parents[2])) not in _SMOKE_EXCLUDE
+]
 
 
 @pytest.mark.recipe
@@ -72,6 +106,18 @@ def test_example_smoke_trains(path, tmp_path, monkeypatch):
     r.setup()
     r.run_train_validation_loop()
     out = tmp_path / "training.jsonl"
-    if out.exists():  # bench/eval-style recipes write other artifacts
-        recs = [json.loads(l) for l in open(out) if l.strip()]
-        assert recs and all(np.isfinite(x["loss"]) for x in recs)
+    recs = (
+        [json.loads(l) for l in open(out) if l.strip()] if out.exists() else []
+    )
+    if recs:
+        assert all(np.isfinite(x["loss"]) for x in recs)
+    else:
+        # eval/generate-style recipes log no train steps (the metrics logger
+        # still touches training.jsonl) — they must leave their own artifact
+        arts = [
+            p for p in (
+                "generations.jsonl", "decode_eval.jsonl", "acceptance.jsonl",
+            )
+            if (tmp_path / p).exists() and (tmp_path / p).stat().st_size > 0
+        ]
+        assert arts, "recipe produced neither train records nor an eval artifact"

@@ -128,6 +128,7 @@ def test_kimi_vl_recipe_trains(tmp_path):
 
 
 @pytest.mark.recipe
+@pytest.mark.slow  # KD over two MoE models: heaviest compile in the file
 def test_kimi_vl_kd_moe_student_and_teacher(tmp_path):
     """VLM KD with MoE student AND teacher (both kimi-vl): the tuple-return
     teacher path and the gate-bias stats must both flow."""

@@ -295,6 +295,7 @@ def test_m3_vl_adapter_roundtrip():
 
 
 @pytest.mark.recipe
+@pytest.mark.slow  # compile-heavy VL recipe; m3_vl numerics pinned in test_model_pins
 def test_m3_vl_recipe_trains(tmp_path):
     from automodel_tpu.cli.app import resolve_recipe_class
     from automodel_tpu.config import ConfigNode

@@ -115,6 +115,7 @@ def test_omni_adapter_roundtrip(tmp_path):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), err_msg=str(pa))
 
 
+@pytest.mark.slow  # compile-heavy recipe; omni fwd/adapter tests stay tier-1
 def test_multimodal_recipe_trains(tmp_path):
     from automodel_tpu.cli.app import resolve_recipe_class
     from automodel_tpu.config import ConfigNode

@@ -1,0 +1,313 @@
+"""PP×EP: MoE expert dispatch inside the pipeline shard_map.
+
+The flagship composition (DeepSeek-V3 PP4×EP64, Kimi-K2 PP8×EP32 per
+BASELINE.md): dropless expert dispatch runs inside each pipeline stage's
+step — the ep all-to-all is confined to that stage so it overlaps other
+stages' compute — under both the GPipe (autodiff) and explicit-gradient
+(1F1B / ZB-H1 / interleaved) schedules."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu.distributed import MeshConfig
+from automodel_tpu.loss import fused_linear_cross_entropy
+from automodel_tpu.models.llm import decoder
+from automodel_tpu.models.moe_lm import decoder as moe_decoder
+from automodel_tpu.models.moe_lm.decoder import MoETransformerConfig
+from automodel_tpu.moe import MoEConfig
+from automodel_tpu.parallel import logical_to_shardings
+
+CFG = MoETransformerConfig(
+    vocab_size=64,
+    hidden_size=32,
+    intermediate_size=48,
+    num_layers=2,
+    num_heads=4,
+    num_kv_heads=2,
+    first_k_dense=0,  # the pipelined stack must be uniform
+    moe=MoEConfig(
+        n_routed_experts=4,
+        n_shared_experts=1,
+        experts_per_token=2,
+        moe_intermediate_size=16,
+        shared_expert_intermediate_size=16,
+        aux_loss_coeff=0.01,
+        dispatcher="dropless",
+    ),
+    dtype=jnp.float32,
+    remat_policy="none",
+    pipeline_microbatches=2,
+)
+
+
+def _setup(cfg, sizes):
+    ctx = MeshConfig(**sizes).build()
+    params = moe_decoder.init(cfg, jax.random.key(0))
+    sh = logical_to_shardings(
+        moe_decoder.param_specs(cfg), ctx,
+        shapes=jax.tree.map(lambda p: p.shape, params),
+    )
+    return ctx, params, jax.device_put(params, sh)
+
+
+def _batch(ctx, B=8, S=17):
+    ids = jax.random.randint(jax.random.key(2), (B, S), 0, 64)
+    inputs, labels = ids[:, :-1], ids[:, 1:]
+    return (
+        jax.device_put(inputs, ctx.sharding("batch", None)),
+        jax.device_put(labels, ctx.sharding("batch", None)),
+    )
+
+
+@pytest.mark.slow
+def test_moe_gpipe_pipeline_matches_single_device():
+    """GPipe pipelined MoE forward (expert A2A inside each stage's step)
+    == the GSPMD layer scan on one device — logits exactly; the aux
+    load-balance loss only in order of magnitude (the pipeline computes
+    the per-microbatch chunk-mean estimator, the global gate a product of
+    whole-batch means — not the same statistic)."""
+    cfg = dataclasses.replace(CFG, num_layers=4, pipeline_microbatches=4)
+    ctx, params, sharded = _setup(cfg, {"pp": 2, "ep": 2, "dp_shard": 2})
+    ids = jax.random.randint(jax.random.key(1), (16, 8), 0, 64)
+    ref, ref_aux = moe_decoder.forward(params, cfg, ids)
+
+    ids_in = jax.device_put(ids, ctx.sharding("batch", None))
+    out, aux = jax.jit(
+        lambda p, i: moe_decoder.forward(p, cfg, i, mesh_ctx=ctx)
+    )(sharded, ids_in)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=2e-3, atol=2e-3)
+    assert 0.2 < float(aux) / float(ref_aux) < 5.0, (float(aux), float(ref_aux))
+
+    # grads THROUGH the pipelined dispatch (autodiff over the shard_map,
+    # ragged A2A transpose included) == single-device autodiff
+    def loss(p, mesh, i):
+        h, a = moe_decoder.forward(p, cfg, i, mesh_ctx=mesh, return_hidden=True)
+        return jnp.mean(h**2) + 0.01 * a
+
+    g_ref = jax.grad(lambda p: loss(p, None, ids))(params)
+    g_pp = jax.jit(jax.grad(lambda p: loss(p, ctx, ids_in)))(sharded)
+    for (path, a), (_, b) in zip(
+        jax.tree_util.tree_leaves_with_path(g_ref),
+        jax.tree_util.tree_leaves_with_path(g_pp),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=3e-4,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_moe_gpipe_pipeline_threads_token_mask():
+    """Pad tokens stay out of routing / aux stats on the pipelined GPipe
+    forward, matching the GSPMD scan (the recipe always passes
+    token_mask=(labels != -100) for MoE): tokens_per_expert counts only
+    mask-True tokens, and the masked aux tracks the GSPMD value."""
+    ctx, params, sharded = _setup(CFG, {"pp": 2, "ep": 2})
+    B, S = 8, 16
+    ids = jax.random.randint(jax.random.key(3), (B, S), 0, 64)
+    mask = np.array(jax.random.bernoulli(jax.random.key(4), 0.75, (B, S)))
+    mask[0, 0] = True  # keep at least one routed token per program
+    ids_in = jax.device_put(ids, ctx.sharding("batch", None))
+    mask_in = jax.device_put(jnp.asarray(mask), ctx.sharding("batch", None))
+
+    fwd = jax.jit(
+        lambda p, i, m: moe_decoder.forward(
+            p, CFG, i, mesh_ctx=ctx, token_mask=m, return_stats=True
+        )
+    )
+    _, aux_m, stats = fwd(sharded, ids_in, mask_in)
+    K, E, L = CFG.moe.experts_per_token, CFG.moe.n_routed_experts, CFG.num_layers
+    tpe = np.asarray(stats["tokens_per_expert"])
+    assert tpe.shape == (L, E)
+    assert float(tpe.sum()) == mask.sum() * K * L  # pad (token, slot)s dropped
+
+    # all-True mask keeps every (token, slot); and the masked aux is the
+    # same statistic the (mask-honoring) GSPMD scan computes, up to the
+    # chunk-mean-vs-global estimator difference
+    ones = jax.device_put(jnp.ones((B, S), bool), ctx.sharding("batch", None))
+    _, _, stats_u = fwd(sharded, ids_in, ones)
+    assert float(np.asarray(stats_u["tokens_per_expert"]).sum()) == B * S * K * L
+    _, ref_aux = moe_decoder.forward(params, CFG, ids, token_mask=jnp.asarray(mask))
+    assert 0.2 < float(aux_m) / float(ref_aux) < 5.0, (float(aux_m), float(ref_aux))
+
+
+@pytest.mark.parametrize("sched", ["1f1b", "zb"])
+def test_moe_explicit_schedule_matches_gpipe_autodiff(sched):
+    """ISSUE 1 acceptance: explicit 1F1B / ZB-H1 gradients on a tiny MoE ==
+    end-to-end autodiff over the (pipelined) GPipe path. Both run the same
+    per-chunk aux estimator, so loss AND grads match to float32 noise."""
+    _run_explicit_schedule_parity(sched)
+
+
+@pytest.mark.slow
+def test_moe_explicit_interleaved_matches_gpipe_autodiff():
+    _run_explicit_schedule_parity("interleaved", num_layers=4, virtual=2)
+
+
+def _run_explicit_schedule_parity(sched, num_layers=2, virtual=1):
+    # fake_balanced_gate pins the routing: live top-k is discontinuous, so
+    # two differently-compiled-but-equivalent programs (explicit schedule vs
+    # GPipe autodiff) can flip near-tie expert assignments on ~1e-7
+    # activation noise and diverge by O(1) — the dispatch/A2A/expert-grad
+    # machinery under test is identical either way
+    cfg = dataclasses.replace(
+        CFG, num_layers=num_layers, pipeline_schedule=sched,
+        pipeline_virtual_stages=virtual,
+        moe=dataclasses.replace(CFG.moe, fake_balanced_gate=True),
+    )
+    ctx, params, sharded = _setup(cfg, {"pp": 2, "ep": 2})
+    inputs, labels = _batch(ctx)
+    n = float(np.sum(np.asarray(labels) != -100))
+
+    def ref_loss(p):
+        hidden, aux = moe_decoder.forward(
+            p, cfg, inputs, mesh_ctx=ctx, return_hidden=True
+        )
+        ce, _ = fused_linear_cross_entropy(
+            hidden, p["lm_head"]["kernel"], labels, chunk_size=64
+        )
+        return ce + aux * n  # the combine_losses contract
+
+    ref_ce, ref_grads = jax.jit(jax.value_and_grad(ref_loss))(sharded)
+
+    grad_fn = decoder.make_pp_1f1b_loss_and_grad(cfg, ctx, chunk_size=64)
+    batch = {"input_ids": inputs, "labels": labels}
+    grads, ce, aux = jax.jit(grad_fn)(sharded, batch, jax.random.key(0))
+
+    np.testing.assert_allclose(float(ce), float(ref_ce), rtol=1e-5)
+    tpe = aux["tokens_per_expert"]
+    assert tpe.shape == (cfg.num_layers, cfg.moe.n_routed_experts)
+    # every (token, slot) routed exactly once per MoE layer
+    assert float(tpe.sum()) == inputs.size * cfg.moe.experts_per_token * cfg.num_layers
+    for (path, a), (_, b) in zip(
+        jax.tree_util.tree_leaves_with_path(grads),
+        jax.tree_util.tree_leaves_with_path(ref_grads),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+@pytest.mark.parametrize("sched", ["1f1b", "zb"])
+def test_layer_aux_contract_parity(sched):
+    """The layer-aux plumbing itself (aux_scale fold-in, extras
+    accumulation, aux grads through the explicit bwd) against autodiff over
+    pipeline_layers, with a smooth synthetic aux layer — no top-k
+    discontinuity, so this parity is exact by construction and complements
+    the routing-pinned MoE test above."""
+    from automodel_tpu.loss import fused_linear_cross_entropy
+    from automodel_tpu.parallel.pp import (
+        pipeline_layers,
+        pipeline_train_1f1b,
+        pipeline_train_zb,
+    )
+
+    ctx = MeshConfig(pp=2, ep=2, dp_shard=2).build()
+    B, S, H, M, L = 8, 16, 32, 2, 4
+    ks = jax.random.split(jax.random.key(0), 4)
+    layers = {"w": jax.random.normal(ks[0], (L, H), jnp.float32) * 0.1}
+    head = {"kernel": jax.random.normal(ks[1], (H, 64), jnp.float32) * 0.05}
+    h = jax.random.normal(ks[2], (B, S, H), jnp.float32)
+    lab = jax.random.randint(ks[3], (B, S), 0, 64)
+    pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
+    seg = jnp.zeros_like(pos)
+    h, pos, seg, lab = (
+        jax.device_put(h, ctx.sharding("batch", None, None)),
+        jax.device_put(pos, ctx.sharding("batch", None)),
+        jax.device_put(seg, ctx.sharding("batch", None)),
+        jax.device_put(lab, ctx.sharding("batch", None)),
+    )
+    lspecs = {"w": ("layers", None)}
+    ex_specs = {"stat": jax.sharding.PartitionSpec("pp", None)}
+    SCALE, n_chunks = 7.0, M * 4  # dp_shard·ep·cp data chunks per microbatch
+
+    def layer_fn(hh, lp, p_, s_):
+        y = hh * (1.0 + 0.01 * lp["w"][None, None, :])
+        aux = (y.astype(jnp.float32) ** 2).mean() * 0.01
+        return y, aux, {"stat": jnp.ones((2,), jnp.float32)}
+
+    def head_loss(h_mb, head_p, lab_mb):
+        ce, _ = fused_linear_cross_entropy(
+            h_mb, head_p["kernel"], lab_mb, chunk_size=64
+        )
+        return ce.astype(jnp.float32)
+
+    def ref_loss(lp, hd):
+        out, aux, _ = pipeline_layers(
+            h, pos, seg, lp, layer_fn, ctx, M, remat_policy="none",
+            param_logical_specs=lspecs, layer_aux=True, extras_specs=ex_specs,
+        )
+        mb = out.reshape(M, B // M, S, H)
+        lab_mb = lab.reshape(M, B // M, S)
+        ce = sum(head_loss(mb[i], hd, lab_mb[i]) for i in range(M))
+        return ce + aux * SCALE * n_chunks  # chunk-mean × per-chunk scale
+
+    ref, (g_ref, gh_ref) = jax.jit(
+        jax.value_and_grad(ref_loss, argnums=(0, 1))
+    )(layers, head)
+
+    train = pipeline_train_1f1b if sched == "1f1b" else pipeline_train_zb
+    loss, dh, gl, gh, ex = jax.jit(lambda lp, hd: train(
+        h, pos, seg, lab, lp, layer_fn, hd, head_loss, ctx, M,
+        param_logical_specs=lspecs, aux_scale=jnp.float32(SCALE),
+        extras_specs=ex_specs,
+    ))(layers, head)
+
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+    # every (layer, microbatch, data-chunk) contributes one ones(2) stat
+    np.testing.assert_allclose(np.asarray(ex["stat"]), 8.0)
+    for a, b in zip(jax.tree.leaves(gl), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4)
+    for a, b in zip(jax.tree.leaves(gh), jax.tree.leaves(gh_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4)
+
+
+def test_moe_pipeline_rejects_first_k_dense():
+    cfg = dataclasses.replace(CFG, first_k_dense=1, pipeline_schedule="1f1b")
+    ctx = MeshConfig(pp=2, ep=2).build()
+    with pytest.raises(NotImplementedError, match="first_k_dense"):
+        decoder.make_pp_1f1b_loss_and_grad(cfg, ctx)(
+            None, {"input_ids": jnp.zeros((4, 8), jnp.int32),
+                   "labels": jnp.zeros((4, 8), jnp.int32)},
+            jax.random.key(0),
+        )
+
+
+def test_moe_pipeline_rejects_capacity_dispatcher():
+    from automodel_tpu.models.moe_lm.decoder import _pp_moe_layer_setup
+
+    cfg = dataclasses.replace(
+        CFG, moe=dataclasses.replace(CFG.moe, dispatcher="capacity")
+    )
+    ctx = MeshConfig(pp=2, ep=2).build()
+    with pytest.raises(NotImplementedError, match="dropless"):
+        _pp_moe_layer_setup(None, cfg, ctx, lambda w: None)
+
+
+def test_grad_fn_fence_is_qat_only():
+    """The _make_grad_fn fence list is down to QAT: MoE and PEFT both build
+    a grad_fn; QAT still raises and names the gpipe workaround."""
+    from types import SimpleNamespace
+
+    from automodel_tpu.config import ConfigNode
+    from automodel_tpu.recipes.llm.train_ft import (
+        TrainFinetuneRecipeForNextTokenPrediction as R,
+    )
+
+    cfg = dataclasses.replace(CFG, pipeline_schedule="1f1b")
+    ctx = MeshConfig(pp=2, ep=2).build()
+
+    def fake(qat=False, peft=None, moe=True):
+        return SimpleNamespace(
+            mesh_ctx=ctx, model_cfg=cfg, is_moe=moe, peft_cfg=peft,
+            cfg=ConfigNode({"qat": {"enabled": qat}, "loss": {"chunk_size": 64}}),
+        )
+
+    assert callable(R._make_grad_fn(fake()))  # MoE: lifted
+    assert callable(R._make_grad_fn(fake(peft=SimpleNamespace())))  # PEFT: lifted
+    with pytest.raises(NotImplementedError, match="gpipe"):
+        R._make_grad_fn(fake(qat=True))

@@ -174,6 +174,7 @@ def test_qwen3_vl_adapter_roundtrip():
 
 
 @pytest.mark.recipe
+@pytest.mark.slow  # qwen3_vl_moe example smoke + model pin cover the family
 def test_qwen3_vl_recipe_trains(tmp_path):
     from automodel_tpu.cli.app import resolve_recipe_class
     from automodel_tpu.config import ConfigNode

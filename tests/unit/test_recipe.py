@@ -289,6 +289,7 @@ def test_profiling_trace_capture(tmp_path):
     ), "no trace files written"
 
 
+@pytest.mark.slow  # 1f1b-from-config covers the explicit-schedule recipe wiring in tier-1
 def test_recipe_pipeline_interleaved_from_config(tmp_path):
     """`distributed.pipeline_schedule: interleaved` (virtual-stage 1F1B)
     matches gpipe losses step for step."""
@@ -312,3 +313,65 @@ def test_recipe_pipeline_interleaved_from_config(tmp_path):
     np.testing.assert_allclose(
         losses["interleaved"], losses["gpipe"], rtol=1e-4, atol=1e-5
     )
+
+
+@pytest.mark.slow  # ~20s compile; unit grad-parity (test_pp_moe) guards tier-1
+def test_recipe_pipeline_moe_pp_ep_from_config(tmp_path):
+    """The flagship PP×EP composition from config: MoE under the explicit
+    1F1B and ZB schedules (fence lifted, ISSUE 1) matches the gpipe step
+    losses. pp=2 puts BOTH paths on the pipelined MoE forward, so the
+    per-chunk aux estimator is identical across schedules."""
+    losses = {}
+    for sched in ("gpipe", "1f1b", "zb"):
+        cfg = _smoke_cfg(
+            tmp_path / sched,
+            **{
+                "step_scheduler.max_steps": 3,
+                "checkpoint.enabled": False,
+                "auto_resume": False,
+            },
+        )
+        cfg.set("model.hf_config", {
+            "architectures": ["Qwen3MoeForCausalLM"],
+            "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "num_key_value_heads": 2, "num_experts": 4,
+            "num_experts_per_tok": 2, "moe_intermediate_size": 16,
+            "router_aux_loss_coef": 0.01,
+        })
+        # pinned routing: cross-schedule loss parity needs routing-stable
+        # programs (live top-k flips near-ties on compile-level fp noise)
+        cfg.set("model.fake_balanced_gate", True)
+        cfg.set("distributed", {
+            "pp": 2, "ep": 2, "dp_shard": 2,
+            "pipeline_schedule": sched, "pipeline_microbatches": 2,
+        })
+        _, losses[sched] = _run_and_read_losses(cfg)
+    np.testing.assert_allclose(losses["1f1b"], losses["gpipe"], rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(losses["zb"], losses["gpipe"], rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.slow  # ~20s compile; unit grad-parity (test_pp_moe) guards tier-1
+def test_recipe_pipeline_peft_1f1b_from_config(tmp_path):
+    """PEFT × explicit 1F1B (the merge-vjp composition in _make_grad_fn)
+    matches PEFT × gpipe losses; base weights stay frozen."""
+    losses = {}
+    for sched in ("gpipe", "1f1b"):
+        cfg = _smoke_cfg(
+            tmp_path / sched,
+            **{
+                "step_scheduler.max_steps": 3,
+                "checkpoint.enabled": False,
+                "auto_resume": False,
+            },
+        )
+        cfg.set("peft", {"r": 4, "alpha": 8.0, "target_modules": ["q_proj", "v_proj"]})
+        cfg.set("distributed", {
+            "pp": 2, "dp_shard": 4,
+            "pipeline_schedule": sched, "pipeline_microbatches": 2,
+        })
+        recipe, losses[sched] = _run_and_read_losses(cfg)
+        n_train = sum(p.size for p in jax.tree.leaves(recipe.train_state.params))
+        n_base = sum(p.size for p in jax.tree.leaves(recipe.base_params))
+        assert n_train < n_base / 10
+    np.testing.assert_allclose(losses["1f1b"], losses["gpipe"], rtol=1e-4, atol=1e-5)

@@ -127,6 +127,7 @@ def test_vlm_recipe_trains(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow  # teacher+student VLM compile; KD path stays tier-1 via llava_kd_smoke example
 def test_vlm_kd_recipe_trains(tmp_path):
     """VLM distillation: frozen llava teacher → llava student, pixel
     values through BOTH forwards, fused hidden-space KD loss
