@@ -29,6 +29,7 @@ RECIPE_ALIASES = {
     "llm_train_eagle1": "automodel_tpu.recipes.llm.train_eagle1.TrainEagle1Recipe",
     "llm_train_eagle2": "automodel_tpu.recipes.llm.train_eagle1.TrainEagle2Recipe",
     "llm_train_dflash": "automodel_tpu.recipes.llm.train_dflash.TrainDFlashRecipe",
+    "llm_serve": "automodel_tpu.recipes.llm.serve.ServeRecipe",
     "llm_spec_bench": "automodel_tpu.recipes.llm.spec_bench.SpecAcceptanceBenchRecipe",
     "llm_dflash_decode_eval": "automodel_tpu.recipes.llm.spec_bench.DFlashDecodeEvalRecipe",
     "dllm_train_ft": "automodel_tpu.recipes.dllm.train_ft.DiffusionLMSFTRecipe",

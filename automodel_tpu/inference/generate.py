@@ -63,25 +63,12 @@ class GenerateConfig:
 
 
 def _filter_logits(logits: jnp.ndarray, gen: "GenerateConfig") -> jnp.ndarray:
-    """Static top-k / top-p filtering (HF sampling semantics: top-k first,
-    then nucleus over the surviving distribution; k=0/None and p>=1/None
-    mean "off", p<=0 keeps the single best token — min_tokens_to_keep=1)."""
-    if gen.top_k is not None and gen.top_k > 0:
-        kth = jax.lax.top_k(logits, min(gen.top_k, logits.shape[-1]))[0][..., -1:]
-        logits = jnp.where(logits < kth, NEG_INF, logits)
-    if gen.top_p is not None and gen.top_p < 1.0:
-        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        # keep tokens whose PRECEDING cumulative mass is < top_p (so the
-        # token that crosses the threshold is included — HF convention)
-        keep_sorted = (cum - probs) < gen.top_p
-        # threshold logit = smallest kept sorted logit; always keep >= 1
-        # token (HF min_tokens_to_keep) — also guards top_p <= 0
-        n_keep = jnp.maximum(jnp.sum(keep_sorted, axis=-1, keepdims=True), 1)
-        thresh = jnp.take_along_axis(sorted_logits, n_keep - 1, axis=-1)
-        logits = jnp.where(logits < thresh, NEG_INF, logits)
-    return logits
+    """Compat shim over the public `inference.sampling.filter_logits` (the
+    implementation lives there so het_generate and the serving engine share
+    it without importing a private symbol)."""
+    from automodel_tpu.inference.sampling import filter_logits
+
+    return filter_logits(logits, gen.top_k, gen.top_p)
 
 
 def _attend(q, keys, values, mask_len, cfg, *, q_positions, window=None, sinks=None):
@@ -139,22 +126,21 @@ def _gqa_attn_with_cache(h, lp, cfg, positions, inv_freq, cache_k, cache_v,
     return h + attn_out, cache_k, cache_v
 
 
-def _mla_attn_with_cache(h, lp, cfg, positions, inv_freq, cache_c, cache_kr,
-                         write_at, attend_len, window=None):
-    """MLA attention sub-block over the absorbed latent cache.
+def mla_absorbed_inputs(x, lp, cfg, positions, inv_freq):
+    """Shared MLA absorbed-decode projections (this module's dense-cache
+    decode AND the paged serving engine — one implementation so a scaling/
+    norm tweak can never silently break their token-parity contract).
 
-    cache_c (B,T,r) holds the rms-normed kv latent; cache_kr (B,T,dr) the
-    rotated shared key-rope head. Scores/values are taken in latent space by
-    folding the kv up-projection halves into q and out respectively — the
-    exact-algebra absorbed form of models/llm/mla.py `_mla_qkv` + attention.
-    """
-    B, Sq, H = h.shape
+    Returns (q_abs, q_rope, c_kv, k_rope, w_uv): q_abs (B,S,n,r) is q_nope
+    folded through the key half of the kv up-projection (scores are taken in
+    latent space), c_kv (B,S,r) the rms-normed kv latent and k_rope (B,S,dr)
+    the rotated shared key-rope head (the two cached quantities), and w_uv
+    (r,n,dv) the value half the caller applies after the softmax."""
+    B, Sq, _ = x.shape
     n = cfg.num_heads
     dn, dr, dv = cfg.mla_qk_nope_head_dim, cfg.mla_qk_rope_head_dim, cfg.mla_v_head_dim
     r = cfg.mla_kv_lora_rank
     prec = cfg.linear_precision
-
-    x = rms_norm(h, lp["input_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
     if cfg.mla_q_lora_rank:
         q_lat = rms_norm(_mm(x, lp["q_down_proj"]["kernel"], prec), lp["q_norm"]["scale"], cfg.rms_norm_eps)
         q = _mm(q_lat, lp["q_up_proj"]["kernel"], prec)
@@ -173,13 +159,34 @@ def _mla_attn_with_cache(h, lp, cfg, positions, inv_freq, cache_c, cache_kr,
     c_kv, k_rope = kv[..., :r], kv[..., r:]
     c_kv = rms_norm(c_kv, lp["kv_norm"]["scale"], cfg.rms_norm_eps)
     k_rope = apply_rope(k_rope[:, :, None, :], positions, inv_freq)[:, :, 0, :]
+    W = lp["kv_up_proj"]["kernel"].reshape(r, n, dn + dv)
+    w_uk, w_uv = W[..., :dn], W[..., dn:]
+    q_abs = jnp.einsum("bsnd,rnd->bsnr", q_nope, w_uk)
+    return q_abs, q_rope, c_kv, k_rope, w_uv
+
+
+def _mla_attn_with_cache(h, lp, cfg, positions, inv_freq, cache_c, cache_kr,
+                         write_at, attend_len, window=None):
+    """MLA attention sub-block over the absorbed latent cache.
+
+    cache_c (B,T,r) holds the rms-normed kv latent; cache_kr (B,T,dr) the
+    rotated shared key-rope head. Scores/values are taken in latent space by
+    folding the kv up-projection halves into q and out respectively — the
+    exact-algebra absorbed form of models/llm/mla.py `_mla_qkv` + attention.
+    """
+    B, Sq, H = h.shape
+    n = cfg.num_heads
+    dn, dr, dv = cfg.mla_qk_nope_head_dim, cfg.mla_qk_rope_head_dim, cfg.mla_v_head_dim
+    prec = cfg.linear_precision
+
+    x = rms_norm(h, lp["input_norm"]["scale"], cfg.rms_norm_eps, cfg.zero_centered_norm)
+    q_abs, q_rope, c_kv, k_rope, w_uv = mla_absorbed_inputs(
+        x, lp, cfg, positions, inv_freq
+    )
     cache_c = jax.lax.dynamic_update_slice(cache_c, c_kv.astype(cache_c.dtype), (0, write_at, 0))
     cache_kr = jax.lax.dynamic_update_slice(cache_kr, k_rope.astype(cache_kr.dtype), (0, write_at, 0))
 
-    W = lp["kv_up_proj"]["kernel"].reshape(r, n, dn + dv)
-    w_uk, w_uv = W[..., :dn], W[..., dn:]
     # absorbed scores: (q_nope · W_uk) · c  +  q_rope · k_rope
-    q_abs = jnp.einsum("bsnd,rnd->bsnr", q_nope, w_uk)
     s = jnp.einsum("bsnr,btr->bnst", q_abs, cache_c, preferred_element_type=jnp.float32)
     s = s + jnp.einsum("bsnd,btd->bnst", q_rope, cache_kr, preferred_element_type=jnp.float32)
     scale = cfg.attn_scale if cfg.attn_scale is not None else (dn + dr) ** -0.5

@@ -102,7 +102,7 @@ def het_generate(
     prompt_embeds: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Returns (B, S_prompt + max_new_tokens) token ids (greedy / sampled)."""
-    from automodel_tpu.inference.generate import _filter_logits
+    from automodel_tpu.inference.sampling import filter_logits
     from automodel_tpu.models.common.layers import cast_params
 
     params = cast_params(params, cfg.dtype)
@@ -231,7 +231,7 @@ def het_generate(
     def sample(logits, key):
         if gen.temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        logits = _filter_logits(logits / gen.temperature, gen)
+        logits = filter_logits(logits / gen.temperature, gen.top_k, gen.top_p)
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
     first = sample(logits, rng)

@@ -1,0 +1,63 @@
+"""Shared token-sampling primitives (top-k / top-p / temperature).
+
+The one implementation of HF-style logit filtering used by every decoding
+surface: the batch-synchronous `inference/generate.py`, the heterogeneous
+engine `inference/het_generate.py`, and the continuous-batching serving
+engine (`serving/engine.py`, which applies it per request slot). Promoted
+out of `generate.py` so nothing imports a private symbol cross-module.
+
+Semantics (HF `TopKLogitsWarper` / `TopPLogitsWarper`): top-k first, then
+nucleus over the surviving distribution; `k=0/None` and `p>=1/None` mean
+"off"; `p<=0` keeps the single best token (min_tokens_to_keep=1).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.ops.attention import NEG_INF
+
+
+def filter_logits(
+    logits: jnp.ndarray,
+    top_k: int | None = None,
+    top_p: float | None = None,
+) -> jnp.ndarray:
+    """Static top-k / top-p filtering over the last axis; killed entries are
+    set to NEG_INF. `top_k`/`top_p` must be static (they shape a `lax.top_k`
+    and a sort)."""
+    if top_k is not None and top_k > 0:
+        kth = jax.lax.top_k(logits, min(top_k, logits.shape[-1]))[0][..., -1:]
+        logits = jnp.where(logits < kth, NEG_INF, logits)
+    if top_p is not None and top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens whose PRECEDING cumulative mass is < top_p (so the
+        # token that crosses the threshold is included — HF convention)
+        keep_sorted = (cum - probs) < top_p
+        # threshold logit = smallest kept sorted logit; always keep >= 1
+        # token (HF min_tokens_to_keep) — also guards top_p <= 0
+        n_keep = jnp.maximum(jnp.sum(keep_sorted, axis=-1, keepdims=True), 1)
+        thresh = jnp.take_along_axis(sorted_logits, n_keep - 1, axis=-1)
+        logits = jnp.where(logits < thresh, NEG_INF, logits)
+    return logits
+
+
+def sample_token(
+    logits: jnp.ndarray,
+    key: jax.Array,
+    *,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+) -> jnp.ndarray:
+    """Greedy (temperature <= 0) or filtered categorical sampling over the
+    last axis. `temperature` must be static here — the serving engine, which
+    needs a per-slot TRACED temperature, composes `filter_logits` with its
+    own `jnp.where(temp > 0, ...)` select instead."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = filter_logits(logits / temperature, top_k, top_p)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

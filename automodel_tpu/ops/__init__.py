@@ -1,11 +1,17 @@
 from automodel_tpu.ops.attention import dot_product_attention, make_attention_mask, xla_attention
 from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.paged_attention import (
+    ragged_paged_attention,
+    ragged_paged_mla_attention,
+)
 from automodel_tpu.ops.rope import RopeScalingConfig, apply_rope, rope_frequencies
 
 __all__ = [
     "dot_product_attention",
     "make_attention_mask",
     "xla_attention",
+    "ragged_paged_attention",
+    "ragged_paged_mla_attention",
     "rms_norm",
     "RopeScalingConfig",
     "apply_rope",

@@ -1,0 +1,197 @@
+"""Ragged paged attention over a paged KV pool (serving decode/prefill op).
+
+The attention core of the continuous-batching serving engine
+(`automodel_tpu/serving/engine.py`), after arXiv:2604.15464 (Ragged Paged
+Attention): query tokens arrive as ONE flat ragged batch — decode tokens
+from many requests interleaved with chunked-prefill tokens — and the KV
+cache lives in fixed-size pages of a global pool, indexed per token through
+a page table. Nothing is padded per request and no dense (B, T) cache is
+ever materialized.
+
+Two backends, dispatched like ops/attention.py's flash path:
+
+- XLA reference (this file): gather each token's pages from the pool and
+  run masked softmax attention — pure gather/einsum, runs (and is tested)
+  under `JAX_PLATFORMS=cpu`, and is the correctness oracle for the kernel.
+- Pallas TPU kernel (`ops/pallas/ragged_paged_attention.py`): streams pages
+  through VMEM with the page table as a scalar-prefetch BlockSpec index map
+  (no gathered (T, P, page, ...) intermediate in HBM); raises
+  NotImplementedError for unsupported features (sliding windows, sinks) so
+  this dispatcher can fall back to the reference.
+
+Layouts (see serving/kv_pages.py for the pool):
+
+- GQA:  k_pages/v_pages (N, ps, Hkv, D); q (T, Hq, D).
+- MLA:  c_pages (N, ps, r) rms-normed kv latents, kr_pages (N, ps, dr)
+  rotated shared key-rope head; queries come pre-absorbed — q_abs (T, n, r)
+  is q_nope folded through the kv up-projection's key half, q_rope (T, n, dr)
+  — and the output is returned in LATENT space (T, n, r): the caller applies
+  the value half of the up-projection (exactly `inference/generate.py`'s
+  absorbed decode, paged).
+
+Per token t: positions[t] is its sequence position; it attends to pool slots
+whose global kv index `page_idx * ps + offset` is <= positions[t] within its
+own page table row. Page tables are dense prefixes (pages allocated in
+order), so the position bound alone masks both the causal future *and*
+unallocated page-table padding (which must still hold a VALID page index —
+the pool's trash page — to keep gathers in bounds). positions[t] < 0 marks a
+pad row: fully masked, output 0.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.ops.attention import NEG_INF
+
+
+def _gather_mask(page_tables, positions, page_size, T, P, window=None):
+    """(T, P*ps) attend mask from positions (pads → all-False)."""
+    kv_idx = jnp.arange(P * page_size, dtype=jnp.int32)
+    mask = kv_idx[None, :] <= positions[:, None]  # causal + allocation bound
+    if window is not None:
+        # window == 0 → global (the layer-scan convention of generate.py)
+        dist = positions[:, None] - kv_idx[None, :]
+        mask = jnp.logical_and(mask, (window == 0) | (dist < window))
+    return mask
+
+
+def ragged_paged_attention_xla(
+    q: jnp.ndarray,            # (T, Hq, D)
+    k_pages: jnp.ndarray,      # (N, ps, Hkv, D)
+    v_pages: jnp.ndarray,      # (N, ps, Hkv, Dv)
+    page_tables: jnp.ndarray,  # (T, P) int32 — per-TOKEN page table row
+    positions: jnp.ndarray,    # (T,) int32; -1 = pad row
+    *,
+    scale: float,
+    window=None,               # traced per-layer window; 0/None = global
+    soft_cap: float | None = None,
+    sinks: jnp.ndarray | None = None,  # (Hq,) learned sink logits
+) -> jnp.ndarray:
+    """Gather-based reference; returns (T, Hq, Dv) with pad rows zeroed."""
+    T, Hq, D = q.shape
+    N, ps, Hkv, _ = k_pages.shape
+    P = page_tables.shape[1]
+    G = Hq // Hkv
+
+    # gather each token's pages → a contiguous per-token KV view
+    keys = k_pages[page_tables].reshape(T, P * ps, Hkv, D)
+    values = v_pages[page_tables].reshape(T, P * ps, Hkv, v_pages.shape[-1])
+
+    qg = q.reshape(T, Hkv, G, D)
+    s = jnp.einsum("tkgd,tckd->tkgc", qg, keys, preferred_element_type=jnp.float32)
+    s = s * scale
+    if soft_cap is not None:
+        s = soft_cap * jnp.tanh(s / soft_cap)
+    mask = _gather_mask(page_tables, positions, ps, T, P, window)
+    s = jnp.where(mask[:, None, None, :], s, NEG_INF)
+    if sinks is not None:
+        sink = jnp.broadcast_to(
+            sinks.astype(jnp.float32).reshape(1, Hkv, G, 1), (T, Hkv, G, 1)
+        )
+        s = jnp.concatenate([s, sink], axis=-1)
+    p = jax.nn.softmax(s, axis=-1)
+    if sinks is not None:
+        p = p[..., :-1]
+    # pad rows (positions < 0): every slot masked → softmax is uniform junk
+    # (or all mass on the sink); zero the output explicitly
+    p = jnp.where(positions[:, None, None, None] >= 0, p, 0.0)
+    o = jnp.einsum("tkgc,tckd->tkgd", p.astype(values.dtype), values)
+    return o.reshape(T, Hq, values.shape[-1])
+
+
+def ragged_paged_mla_attention_xla(
+    q_abs: jnp.ndarray,        # (T, n, r) — q_nope absorbed through W_uk
+    q_rope: jnp.ndarray,       # (T, n, dr)
+    c_pages: jnp.ndarray,      # (N, ps, r) kv latents
+    kr_pages: jnp.ndarray,     # (N, ps, dr) shared rotated key-rope head
+    page_tables: jnp.ndarray,  # (T, P)
+    positions: jnp.ndarray,    # (T,)
+    *,
+    scale: float,
+    window=None,
+) -> jnp.ndarray:
+    """Absorbed-MLA reference; returns latent-space outputs (T, n, r)."""
+    T, n, r = q_abs.shape
+    N, ps, _ = c_pages.shape
+    P = page_tables.shape[1]
+
+    c = c_pages[page_tables].reshape(T, P * ps, r)
+    kr = kr_pages[page_tables].reshape(T, P * ps, kr_pages.shape[-1])
+    s = jnp.einsum("tnr,tcr->tnc", q_abs, c, preferred_element_type=jnp.float32)
+    s = s + jnp.einsum("tnd,tcd->tnc", q_rope, kr, preferred_element_type=jnp.float32)
+    s = s * scale
+    mask = _gather_mask(page_tables, positions, ps, T, P, window)
+    s = jnp.where(mask[:, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(positions[:, None, None] >= 0, p, 0.0)
+    return jnp.einsum("tnc,tcr->tnr", p.astype(c.dtype), c)
+
+
+def ragged_paged_attention(
+    q, k_pages, v_pages, page_tables, positions,
+    *,
+    scale: float | None = None,
+    window=None,
+    soft_cap: float | None = None,
+    sinks=None,
+    impl: str = "auto",
+):
+    """GQA entry. impl: "xla" | "pallas" | "auto" (pallas on TPU, with a
+    shape/feature-based fallback to the reference — the flash dispatch
+    pattern of ops/attention.py)."""
+    scale = scale if scale is not None else float(q.shape[-1]) ** -0.5
+    resolved = impl
+    if impl == "auto":
+        resolved = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if resolved == "pallas":
+        from automodel_tpu.ops.pallas.ragged_paged_attention import (
+            paged_attention_kernel,
+        )
+
+        try:
+            return paged_attention_kernel(
+                q, k_pages, v_pages, page_tables, positions,
+                scale=scale, soft_cap=soft_cap, window=window, sinks=sinks,
+            )
+        except NotImplementedError:
+            resolved = "xla"
+    if resolved == "xla":
+        return ragged_paged_attention_xla(
+            q, k_pages, v_pages, page_tables, positions,
+            scale=scale, window=window, soft_cap=soft_cap, sinks=sinks,
+        )
+    raise ValueError(f"Unknown paged attention impl '{impl}'")
+
+
+def ragged_paged_mla_attention(
+    q_abs, q_rope, c_pages, kr_pages, page_tables, positions,
+    *,
+    scale: float,
+    window=None,
+    impl: str = "auto",
+):
+    """MLA (absorbed latent-cache) entry; same dispatch contract as the GQA
+    one. Returns latent-space outputs (T, n, r)."""
+    resolved = impl
+    if impl == "auto":
+        resolved = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if resolved == "pallas":
+        from automodel_tpu.ops.pallas.ragged_paged_attention import (
+            paged_mla_attention_kernel,
+        )
+
+        try:
+            return paged_mla_attention_kernel(
+                q_abs, q_rope, c_pages, kr_pages, page_tables, positions,
+                scale=scale, window=window,
+            )
+        except NotImplementedError:
+            resolved = "xla"
+    if resolved == "xla":
+        return ragged_paged_mla_attention_xla(
+            q_abs, q_rope, c_pages, kr_pages, page_tables, positions,
+            scale=scale, window=window,
+        )
+    raise ValueError(f"Unknown paged attention impl '{impl}'")

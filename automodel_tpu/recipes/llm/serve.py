@@ -1,0 +1,170 @@
+"""Offline serving recipe: continuous-batching paged-KV generation to JSONL.
+
+The engine-loop analog of the reference's serving benches (reference:
+recipes bench_vllm/bench_sglang drive external engines; here the engine is
+in-repo — serving/engine.py): load a checkpoint (or init from config), feed
+the dataset's prompts through `ServingEngine.serve_batch` as a ragged
+request stream with staggered arrivals, write one JSON record per request,
+and log throughput/latency counters through the MetricLogger.
+
+YAML:
+
+    recipe: llm_serve
+    model: {hf_config: {...} | pretrained_path: ...}
+    dataset: {...}                    # rows provide the prompts
+    serving:
+      page_size: 16
+      num_pages: 2048
+      max_slots: 16
+      pages_per_slot: 64              # max context = pages_per_slot * page_size
+      token_budget: 64                # step rows (decode + prefill chunks)
+      prefill_chunk: 48
+      max_new_tokens: 64
+      temperature: 0.0                # per-request; 0 → greedy
+      top_k: null                     # engine-wide static filters
+      top_p: null
+      eos_token_id: null
+      arrival_stride: 2               # admit 1 request per N engine steps
+      max_prompt_len: null
+    max_requests: 64
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+import jax
+import numpy as np
+
+from automodel_tpu.config import parse_args_and_load_config
+from automodel_tpu.recipes.llm.train_ft import (
+    TrainFinetuneRecipeForNextTokenPrediction,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class ServeRecipe(TrainFinetuneRecipeForNextTokenPrediction):
+    """Reuses the train chassis (model build + checkpoint load + dataloader
+    + loggers); replaces the train loop with a continuous-batching serve."""
+
+    def setup(self) -> None:
+        self.cfg.set("checkpoint.enabled", False)
+        self.cfg.set("auto_resume", False)
+        super().setup()
+
+    def _requests(self, serving, serve_cfg):
+        """Dataset rows → ragged Request stream (pad-stripped prompts,
+        staggered arrivals). Prompts are always clamped to what the engine
+        can actually hold (`pages_per_slot*page_size - max_new_tokens`) so a
+        long dataset row degrades to a truncated prompt instead of blowing
+        up Scheduler.submit after the model build has been paid."""
+        from automodel_tpu.serving import Request
+
+        max_requests = int(self.cfg.get("max_requests", 64))
+        stride = int(serving.get("arrival_stride", 2)) if serving else 2
+        max_new = int(serving.get("max_new_tokens", 64)) if serving else 64
+        temp = float(serving.get("temperature", 0.0)) if serving else 0.0
+        eos = serving.get("eos_token_id") if serving else None
+        cap = serve_cfg.pages_per_slot * serve_cfg.page_size - max_new
+        if cap < 1:
+            raise ValueError(
+                f"serving.max_new_tokens={max_new} leaves no room for a "
+                f"prompt (max context = {cap + max_new} tokens)"
+            )
+        max_prompt = serving.get("max_prompt_len") if serving else None
+        max_prompt = min(int(max_prompt), cap) if max_prompt else cap
+        pad_id = getattr(getattr(self, "_tokenizer", None), "pad_token_id", None)
+
+        reqs = []
+        for mb in self.dataloader:
+            for row in np.asarray(mb["input_ids"]).reshape(-1, np.asarray(mb["input_ids"]).shape[-1]):
+                toks = [int(t) for t in row]
+                if pad_id is not None:
+                    while len(toks) > 1 and toks[-1] == pad_id:
+                        toks.pop()
+                toks = toks[:max_prompt]
+                reqs.append(Request(
+                    prompt=toks, max_new_tokens=max_new, temperature=temp,
+                    eos_token_id=eos, seed=len(reqs),
+                    arrival=len(reqs) // max(stride, 1),
+                ))
+                if len(reqs) >= max_requests:
+                    return reqs
+        return reqs
+
+    def run_train_validation_loop(self) -> None:
+        from automodel_tpu.serving import ServingConfig, ServingEngine
+
+        cfg = self.cfg
+        node = cfg.get("serving")
+        get = (lambda k, d: node.get(k, d)) if node is not None else (lambda k, d: d)
+        serve_cfg = ServingConfig(
+            page_size=int(get("page_size", 16)),
+            num_pages=int(get("num_pages", 2048)),
+            max_slots=int(get("max_slots", 16)),
+            pages_per_slot=int(get("pages_per_slot", 64)),
+            token_budget=int(get("token_budget", 64)),
+            prefill_chunk=(
+                int(get("prefill_chunk", 0)) or None
+            ),
+            top_k=(int(get("top_k", 0)) or None),
+            top_p=(float(get("top_p", 0.0)) or None),
+        )
+        params = self.train_state.params
+        if self.peft_cfg is not None:
+            from automodel_tpu.peft.lora import merge_lora
+
+            params = merge_lora(self.base_params, params, self.peft_cfg)
+        # the engine is a single-chip step this round (multi-chip serving =
+        # roadmap): pull the chassis' mesh-sharded params onto the default
+        # device so the step keeps ONE compiled signature
+        params = jax.tree.map(lambda x: np.asarray(x), params)
+        engine = ServingEngine(params, self.model_cfg, serve_cfg)
+        reqs = self._requests(node, serve_cfg)
+        logger.info("serving %d requests (%s)", len(reqs), serve_cfg)
+        # serving counters get their own JSONL (training.jsonl stays a
+        # train-loss trail for the golden/parity tooling)
+        from automodel_tpu.loggers.metric_logger import MetricLogger
+
+        serve_logger = MetricLogger(
+            os.path.join(cfg.get("run_dir", "."), "serving.jsonl")
+        )
+        res = engine.serve_batch(
+            reqs, metric_logger=serve_logger, log_every=16,
+        )
+        serve_logger.close()
+        tokenizer = getattr(self, "_tokenizer", None)
+        out_path = os.path.join(cfg.get("run_dir", "."), "generations.jsonl")
+        with open(out_path, "w") as f:
+            for req in res["requests"]:
+                rec = {
+                    "rid": req.rid,
+                    "prompt_ids": list(req.prompt),
+                    "generated_ids": list(req.generated),
+                    "finish_reason": req.finish_reason,
+                    "preemptions": req.preemptions,
+                }
+                if tokenizer is not None:
+                    rec["text"] = tokenizer.decode(rec["generated_ids"])
+                f.write(json.dumps(rec) + "\n")
+        summary = {"metric": "serving_decode", **res["stats"]}
+        print(json.dumps(summary))
+        logger.info("wrote %d generations to %s", len(res["requests"]), out_path)
+        for t in self.trackers:
+            t.finish()
+        self.metric_logger.close()
+        self.val_logger.close()
+
+
+def main(argv=None) -> None:
+    cfg = parse_args_and_load_config(argv)
+    recipe = ServeRecipe(cfg)
+    recipe.setup()
+    recipe.run_train_validation_loop()
+
+
+if __name__ == "__main__":
+    main()

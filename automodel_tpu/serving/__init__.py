@@ -1,0 +1,21 @@
+"""Continuous-batching serving engine over a paged KV cache.
+
+- kv_pages.py:  global page pool + per-request page tables (GQA + MLA)
+- scheduler.py: admission / chunked-prefill / preemption scheduling
+- engine.py:    the jitted fixed-shape step + serve_batch() host loop
+- ops/paged_attention.py holds the ragged paged-attention op it runs on.
+"""
+
+from automodel_tpu.serving.engine import Request, ServingConfig, ServingEngine
+from automodel_tpu.serving.kv_pages import PageAllocator, pages_for
+from automodel_tpu.serving.scheduler import Scheduler, StepPlan
+
+__all__ = [
+    "PageAllocator",
+    "Request",
+    "Scheduler",
+    "ServingConfig",
+    "ServingEngine",
+    "StepPlan",
+    "pages_for",
+]

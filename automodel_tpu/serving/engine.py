@@ -1,0 +1,404 @@
+"""Continuous-batching serving engine: one jitted fixed-shape step + loop.
+
+The serving analog of `inference/generate.py` (which stays the batch-
+synchronous offline path): requests of any length join and leave a running
+batch freely. The device-side step function has ONE compiled signature for
+the whole serving run —
+
+    step(params, pool, batch) -> (pool, sampled_tokens, logprobs)
+
+where `batch` is the fixed-shape `StepPlan` the scheduler packs (a flat
+`token_budget`-row ragged token batch: decode rows of many requests
+interleaved with chunked-prefill rows), `pool` is the paged KV cache
+(kv_pages.py; donated, so the update is in-place buffer reuse), and the
+sampled token per slot comes back for the host scheduler to absorb. No
+shape in the step depends on which requests are active, how long they are,
+or how many pages they hold — requests joining/leaving NEVER recompile
+(pinned by the jit cache-miss counter test in tier-1).
+
+Layer math is shared with generate.py (project_qkv / mlp_inner / the MoE
+stack split); only attention differs — the ragged paged op from
+ops/paged_attention.py (XLA gather reference on CPU, Pallas kernel on TPU),
+with the MLA absorbed-decode algebra reproduced over the latent page pool.
+
+Sampling runs inside the jit: greedy where a slot's temperature <= 0, else
+top-k/top-p (static, engine-wide) filtered categorical with the key derived
+as fold_in(key(slot seed), position) — deterministic per request and stable
+across preempt-and-requeue recompute.
+
+`serve_batch()` is the offline API (recipes/llm/serve.py wires it to the
+CLI): submit a list of requests with arrival times, drive steps until
+drained, return per-request outputs + throughput/latency counters (logged
+through loggers/metric_logger.MetricLogger when one is passed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from automodel_tpu.inference.generate import (
+    _dense_mlp,
+    _embed,
+    _moe_mlp,
+    mla_absorbed_inputs,
+)
+from automodel_tpu.inference.sampling import filter_logits
+from automodel_tpu.models.common.layers import cast_params
+from automodel_tpu.models.llm.decoder import (
+    _dense,
+    layer_windows,
+    project_qkv,
+    unembed,
+)
+from automodel_tpu.ops.paged_attention import (
+    ragged_paged_attention,
+    ragged_paged_mla_attention,
+)
+from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.quant import matmul as _mm
+from automodel_tpu.ops.rope import rope_frequencies
+from automodel_tpu.serving.kv_pages import apply_defrag, init_pool
+from automodel_tpu.serving.scheduler import Request, Scheduler, StepPlan
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Static engine geometry + engine-wide sampling filters (per-request
+    temperature/eos/seed live on the Request; top-k/top-p are static because
+    they shape a lax.top_k/sort inside the jit)."""
+
+    page_size: int = 16
+    num_pages: int = 128
+    max_slots: int = 8          # concurrent requests resident on device
+    pages_per_slot: int = 16    # max context = pages_per_slot * page_size
+    token_budget: int = 32      # rows per step (decode + prefill chunks)
+    prefill_chunk: int | None = None  # ≤ token_budget; None → token_budget
+    top_k: int | None = None
+    top_p: float | None = None
+
+    def __post_init__(self):
+        assert self.page_size >= 1 and self.num_pages >= 1
+        assert self.max_slots >= 1 and self.token_budget >= 1
+        assert self.pages_per_slot >= 1
+        if self.prefill_chunk is not None:
+            assert 1 <= self.prefill_chunk <= self.token_budget
+
+
+class ServingEngine:
+    """Paged-cache continuous-batching engine for the generic decoder
+    families (TransformerConfig / MoETransformerConfig, GQA or MLA). The
+    heterogeneous python-loop engine (HetMoEConfig) is not servable here."""
+
+    def __init__(self, params, cfg, serve_cfg: ServingConfig = ServingConfig()):
+        from automodel_tpu.models.moe_lm.het_moe import HetMoEConfig
+
+        if isinstance(cfg, HetMoEConfig):
+            raise NotImplementedError(
+                "ServingEngine drives the layer-scan decoders; the het "
+                "engine's per-layer python loop needs its own step function"
+            )
+        self.cfg = cfg
+        self.serve_cfg = serve_cfg
+        self.params = cast_params(params, cfg.dtype)
+        self.is_moe = getattr(cfg, "moe", None) is not None
+        self.is_mla = cfg.attention_type == "mla"
+
+        # stacks mirror generate.py: dense decoder = one; MoE = dense prefix
+        # stack then MoE stack
+        if self.is_moe:
+            self._stacks = []
+            if cfg.first_k_dense > 0:
+                self._stacks.append(("dense_layers", _dense_mlp, cfg.first_k_dense))
+            self._stacks.append(("moe_layers", _moe_mlp, cfg.num_moe_layers))
+        else:
+            L = jax.tree.leaves(self.params["layers"])[0].shape[0]
+            self._stacks = [("layers", _dense_mlp, L)]
+
+        n_layers = sum(L for *_, L in self._stacks)
+        windows = [w or 0 for w in layer_windows(cfg, n_layers)]
+        self._stack_windows = []
+        off = 0
+        for *_, L in self._stacks:
+            self._stack_windows.append(
+                jnp.asarray(windows[off : off + L], jnp.int32)
+            )
+            off += L
+        self._any_window = any(windows)
+        self._has_sinks = any(
+            "sinks" in self.params.get(k, {}) for k, *_ in self._stacks
+        )
+        # the Pallas kernel covers the windowless/sinkless hot path; traced
+        # per-layer windows and sinks take the XLA reference (static choice —
+        # one compiled step either way)
+        self._attn_impl = (
+            "xla" if (self._any_window or self._has_sinks) else "auto"
+        )
+        self._inv_freq = rope_frequencies(
+            cfg.rope_dim, cfg.rope_theta, cfg.rope_scaling
+        )
+        if cfg.rope_local_theta is not None:
+            inv_local = rope_frequencies(cfg.rope_dim, cfg.rope_local_theta, None)
+            self._freq_for_win = lambda win: jnp.where(
+                win > 0, inv_local, self._inv_freq
+            )
+        else:
+            self._freq_for_win = lambda win: self._inv_freq
+
+        self.pool = init_pool(
+            cfg, [L for *_, L in self._stacks],
+            serve_cfg.num_pages, serve_cfg.page_size,
+        )
+        self._step = jax.jit(self._step_impl, donate_argnums=(1,))
+        self.steps_run = 0
+
+    # -- device step --------------------------------------------------------
+    def _attn(self, h, lp, win, pool_k, pool_v, b):
+        """One attention sub-block over the paged pool; returns
+        (post-residual h, written pool_k, pool_v). h is (1, T, H)."""
+        cfg = self.cfg
+        window = win if self._any_window else None
+        freq = self._freq_for_win(win)
+        positions = jnp.maximum(b["pos"], 0)[None]  # (1, T); pads clamped
+        x = rms_norm(h, lp["input_norm"]["scale"], cfg.rms_norm_eps,
+                     cfg.zero_centered_norm)
+        if self.is_mla:
+            n = cfg.num_heads
+            dn, dr = cfg.mla_qk_nope_head_dim, cfg.mla_qk_rope_head_dim
+            dv = cfg.mla_v_head_dim
+            # one shared implementation of the absorbed projections
+            # (inference/generate.py) — the paged part is just where the
+            # two cached quantities land and how attention reads them back
+            q_abs, q_rope, c_kv, k_rope, w_uv = mla_absorbed_inputs(
+                x, lp, cfg, positions, freq
+            )
+            pool_k = pool_k.at[b["page"], b["off"]].set(
+                c_kv[0].astype(pool_k.dtype)
+            )
+            pool_v = pool_v.at[b["page"], b["off"]].set(
+                k_rope[0].astype(pool_v.dtype)
+            )
+            scale = (
+                cfg.attn_scale if cfg.attn_scale is not None
+                else (dn + dr) ** -0.5
+            )
+            out_lat = ragged_paged_mla_attention(
+                q_abs[0], q_rope[0], pool_k, pool_v,
+                b["pt_tok"], b["pos"],
+                scale=scale, window=window, impl=self._attn_impl,
+            )
+            attn = jnp.einsum("tnr,rnd->tnd", out_lat, w_uv)
+            attn = attn.reshape(1, -1, n * dv)
+            h = h + _mm(attn, lp["o_proj"]["kernel"], cfg.linear_precision)
+            return h, pool_k, pool_v
+        # GQA
+        q, k, v = project_qkv(x, lp, cfg, positions, freq)
+        pool_k = pool_k.at[b["page"], b["off"]].set(k[0].astype(pool_k.dtype))
+        pool_v = pool_v.at[b["page"], b["off"]].set(v[0].astype(pool_v.dtype))
+        scale = (
+            cfg.attn_scale if cfg.attn_scale is not None
+            else cfg.resolved_head_dim ** -0.5
+        )
+        attn = ragged_paged_attention(
+            q[0], pool_k, pool_v, b["pt_tok"], b["pos"],
+            scale=scale, window=window,
+            soft_cap=cfg.attn_soft_cap, sinks=lp.get("sinks"),
+            impl=self._attn_impl,
+        )
+        T = attn.shape[0]
+        attn = attn.reshape(1, T, cfg.num_heads * attn.shape[-1])
+        attn_out = _dense(attn, lp["o_proj"])
+        if cfg.use_post_norms:
+            attn_out = rms_norm(
+                attn_out, lp["post_attn_out_norm"]["scale"],
+                cfg.rms_norm_eps, cfg.zero_centered_norm,
+            )
+        return h + attn_out, pool_k, pool_v
+
+    def _step_impl(self, params, pool, b):
+        cfg, sc = self.cfg, self.serve_cfg
+        # per-token page-table rows: pads index slot 0's table but their
+        # position is -1, so they attend to nothing
+        b = dict(b)
+        b["pt_tok"] = b["page_tables"][jnp.maximum(b["slot"], 0)]
+        h = _embed(params, cfg, b["tok"][None])  # (1, T, H)
+
+        new_pool = []
+        for (pkey, mlp_fn, L), (p0, p1), wins in zip(
+            self._stacks, pool, self._stack_windows
+        ):
+            def one_layer(carry, xs, mlp_fn=mlp_fn):
+                (h,) = carry
+                lp, c0, c1, win = xs
+                h, c0, c1 = self._attn(h, lp, win, c0, c1, b)
+                h = mlp_fn(h, lp, cfg)
+                return (h,), (c0, c1)
+
+            (h,), (p0, p1) = jax.lax.scan(
+                one_layer, (h,), (params[pkey], p0, p1, wins)
+            )
+            new_pool.append((p0, p1))
+
+        h = rms_norm(h, params["final_norm"]["scale"], cfg.rms_norm_eps,
+                     cfg.zero_centered_norm)
+        # sample rows: each slot's last scheduled token (or a junk row when
+        # sample_tok < 0 — the host ignores those slots)
+        idx = jnp.clip(b["sample_tok"], 0, h.shape[1] - 1)
+        h_s = h[0, idx]                            # (S, H)
+        logits = unembed(params, cfg, h_s[None])[0]  # (S, V) fp32
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        temp = jnp.maximum(b["temp"], 1e-6)[:, None]
+        filtered = filter_logits(logits / temp, sc.top_k, sc.top_p)
+        # key = fold_in(key(seed), position-of-the-new-token): per-request
+        # deterministic, independent of batching, preemption-stable
+        next_pos = jnp.maximum(b["pos"], 0)[idx] + 1
+        keys = jax.vmap(
+            lambda s, p: jax.random.fold_in(jax.random.key(s), p)
+        )(b["seed"], next_pos)
+        sampled = jax.vmap(
+            lambda k, l: jax.random.categorical(k, l)
+        )(keys, filtered).astype(jnp.int32)
+        tokens = jnp.where(b["temp"] > 0.0, sampled, greedy)
+        logprobs = jax.nn.log_softmax(logits, axis=-1)
+        lp_tok = jnp.take_along_axis(logprobs, tokens[:, None], axis=-1)[:, 0]
+        return new_pool, tokens, lp_tok
+
+    # -- host API -----------------------------------------------------------
+    def step_cache_size(self) -> int:
+        """Compiled-signature count of the step jit (must stay 1 for a
+        serving run — the fixed-shape contract)."""
+        return self._step._cache_size()
+
+    def run_step(self, plan: StepPlan):
+        """Upload one StepPlan, run the jitted step, return sampled tokens
+        (S,) + their logprobs as numpy."""
+        batch = {
+            "tok": jnp.asarray(plan.tok),
+            "slot": jnp.asarray(plan.slot),
+            "pos": jnp.asarray(plan.pos),
+            "page": jnp.asarray(plan.page),
+            "off": jnp.asarray(plan.off),
+            "page_tables": jnp.asarray(plan.page_tables),
+            "sample_tok": jnp.asarray(plan.sample_tok),
+            "temp": jnp.asarray(plan.temp),
+            "seed": jnp.asarray(plan.seed),
+        }
+        self.pool, tokens, lps = self._step(self.params, self.pool, batch)
+        self.steps_run += 1
+        return np.asarray(tokens), np.asarray(lps)
+
+    def make_scheduler(self) -> Scheduler:
+        sc = self.serve_cfg
+        return Scheduler(
+            num_pages=sc.num_pages, page_size=sc.page_size,
+            max_slots=sc.max_slots, pages_per_slot=sc.pages_per_slot,
+            token_budget=sc.token_budget, prefill_chunk=sc.prefill_chunk,
+        )
+
+    def defrag(self, scheduler: Scheduler) -> bool:
+        """Compact live pages to a dense pool prefix (kv_pages.defrag_plan);
+        returns whether a compaction ran."""
+        plan = scheduler.alloc.defrag_plan()
+        if plan is None:
+            return False
+        src, _n_live = plan
+        self.pool = apply_defrag(self.pool, src)
+        return True
+
+    def serve_batch(
+        self,
+        requests: list[Request],
+        *,
+        metric_logger=None,
+        max_steps: int | None = None,
+        log_every: int = 0,
+    ) -> dict:
+        """Offline continuous-batching run: drive steps until every request
+        finished. Returns {"outputs": [generated ids per request, submission
+        order], "requests": finished Request objects, "stats": counters}.
+        """
+        sched = self.make_scheduler()
+        for r in requests:
+            sched.submit(r)
+        budget = max_steps if max_steps is not None else 10_000_000
+        t_start = time.perf_counter()
+        decode_s = 0.0
+        n_sampled = 0
+        n_tokens_fed = 0
+        n_steps = 0  # this call only (self.steps_run is engine-lifetime)
+        step_idx = 0
+        while sched.has_work and step_idx < budget:
+            plan = sched.schedule(step_idx)
+            if plan is None:
+                if not any(r.arrival > step_idx for r in sched.waiting):
+                    # no step could be packed and no future arrival can
+                    # change that: whether the blocker is an inadmissible
+                    # queue head or a RUNNING request that filled the pool
+                    # with no preemptible victim, the offline loop can never
+                    # make progress — fail loudly instead of spinning
+                    blocked = (
+                        sched.waiting[0] if sched.waiting
+                        else next(iter(sched.running.values()), None)
+                    )
+                    raise RuntimeError(
+                        "serving stalled: request "
+                        f"rid={getattr(blocked, 'rid', '?')} needs more pages "
+                        f"than the pool can ever free ({sched.alloc.num_free} "
+                        f"free of {sched.alloc.num_pages}, "
+                        f"{len(sched.running)} running, "
+                        f"{len(sched.waiting)} waiting)"
+                    )
+                # nothing runnable yet (future arrivals): the offline loop
+                # just advances; an online server would sleep
+                step_idx += 1
+                continue
+            t0 = time.perf_counter()
+            tokens, _lps = self.run_step(plan)
+            dt = time.perf_counter() - t0
+            sched.update(plan, tokens, step_idx)
+            n_steps += 1
+            n_tokens_fed += plan.n_tokens
+            if plan.n_samples:
+                decode_s += dt
+                n_sampled += plan.n_samples
+            if metric_logger is not None and log_every and (
+                self.steps_run % log_every == 0
+            ):
+                metric_logger.log({
+                    "step": self.steps_run,
+                    "serving_step_ms": round(dt * 1e3, 3),
+                    "tokens_fed": plan.n_tokens,
+                    "tokens_sampled": plan.n_samples,
+                    "running": len(sched.running),
+                    "waiting": len(sched.waiting),
+                    "free_pages": sched.alloc.num_free,
+                })
+            step_idx += 1
+        elapsed = time.perf_counter() - t_start
+        assert not sched.has_work or max_steps is not None, "serve stalled"
+        by_rid = sorted(sched.finished, key=lambda r: r.rid)
+        stats = {
+            "steps": n_steps,
+            "requests": len(by_rid),
+            "new_tokens": n_sampled,
+            "tokens_fed": n_tokens_fed,
+            "elapsed_s": round(elapsed, 4),
+            "decode_tokens_per_sec": round(n_sampled / max(decode_s, 1e-9), 2),
+            "ms_per_token": round(1e3 * decode_s / max(n_sampled, 1), 4),
+            "preemptions": sched.n_preemptions,
+            "compiled_signatures": self.step_cache_size(),
+        }
+        if metric_logger is not None:
+            metric_logger.log({"step": self.steps_run, **{
+                f"serve_{k}": v for k, v in stats.items()
+            }})
+        return {
+            "outputs": [list(r.generated) for r in by_rid],
+            "requests": by_rid,
+            "stats": stats,
+        }

@@ -1,0 +1,147 @@
+"""Paged KV cache: a global page pool + per-request page tables.
+
+The serving analog of `inference/generate.py`'s dense per-request cache
+(whose per-layer entry SHAPES it reuses — (Hkv, D) K/V rows for GQA, (r,)
+latent + (dr,) rope rows for MLA), re-laid-out vLLM/RPA-style
+(arXiv:2604.15464): the sequence dimension is cut into fixed-size pages
+living in one global pool shared by every request, and each request holds a
+PAGE TABLE — the dense-prefix list of pool pages backing its sequence.
+Token at position p of a request lives at `(table[p // page_size],
+p % page_size)`. Admission, growth, and preemption then become integer
+page accounting on the host (`PageAllocator`), while the device arrays keep
+ONE fixed shape for the whole serving run — the engine step never reshapes
+or recompiles as requests join and leave.
+
+Device-side layouts (L = layers of a stack, N = `num_pages`, ps =
+`page_size`; allocated as N+1 pages — page index N is the TRASH page that
+pad token rows write into and padded page-table entries point at, keeping
+every gather/scatter in bounds without branching):
+
+- GQA:  k/v  (L, N+1, ps, Hkv, D)
+- MLA:  c    (L, N+1, ps, r),  kr (L, N+1, ps, dr)   (absorbed decode —
+  r+dr cached floats per token instead of n*(dn+dr+dv))
+
+The allocator is deliberately host-side pure-python: page churn is a few
+integer ops per request per step, nothing a device roundtrip could beat.
+`defrag()` exists for pool COMPACTION (paged allocation never fragments in
+the "can't allocate despite free space" sense — any free page serves any
+request — but long-lived mixed workloads scatter live pages across the
+pool; compaction moves them to a dense prefix so the tail can be released
+or checkpointed cheaply). It returns a gather plan `apply_defrag` executes
+on the device arrays in one indexed copy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+def pages_for(num_tokens: int, page_size: int) -> int:
+    """Pages needed to hold `num_tokens` sequence positions."""
+    return -(-num_tokens // page_size)
+
+
+@dataclasses.dataclass
+class PageAllocator:
+    """Free-list page accounting + per-slot dense-prefix page tables."""
+
+    num_pages: int
+    page_size: int
+
+    def __post_init__(self):
+        # LIFO free list: recently freed (still-warm) pages are reused first
+        self._free: list[int] = list(range(self.num_pages - 1, -1, -1))
+        self._tables: dict[int, list[int]] = {}
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def table(self, slot: int) -> list[int]:
+        return self._tables.get(slot, [])
+
+    def ensure(self, slot: int, num_tokens: int) -> bool:
+        """Grow `slot`'s table to cover `num_tokens` positions. Returns False
+        (allocating nothing) when the pool cannot cover the growth — the
+        scheduler then preempts or stalls."""
+        table = self._tables.setdefault(slot, [])
+        need = pages_for(num_tokens, self.page_size) - len(table)
+        if need <= 0:
+            return True
+        if need > len(self._free):
+            return False
+        table.extend(self._free.pop() for _ in range(need))
+        return True
+
+    def free_slot(self, slot: int) -> None:
+        for p in self._tables.pop(slot, []):
+            self._free.append(p)
+
+    def defrag_plan(self):
+        """Compact live pages to a dense prefix. Rewrites the host tables in
+        place and returns (src, n_live): `src` (num_pages,) int32 where
+        new page i must be copied from old page src[i] (identity past
+        n_live) — feed to `apply_defrag`. Returns None when already compact.
+        """
+        live = sorted(p for t in self._tables.values() for p in t)
+        if live == list(range(len(live))):
+            return None
+        mapping = {old: new for new, old in enumerate(live)}
+        for table in self._tables.values():
+            table[:] = [mapping[p] for p in table]
+        src = list(range(self.num_pages))
+        for old, new in mapping.items():
+            src[new] = old
+        self._free = list(range(self.num_pages - 1, len(live) - 1, -1))
+        return jnp.asarray(src, jnp.int32), len(live)
+
+
+@jax.jit
+def apply_defrag(pool, src: jnp.ndarray):
+    """Apply a defrag plan to a pool pytree: one gather along the page axis
+    (axis 1, after the layer axis) per array; the trash page stays put."""
+    full = jnp.concatenate(
+        [src, jnp.asarray([pool_trash_index(pool)], jnp.int32)]
+    )
+    return jax.tree.map(lambda a: a[:, full], pool)
+
+
+def pool_trash_index(pool) -> int:
+    """The trash page index = num_pages (pages axis is num_pages + 1)."""
+    return jax.tree.leaves(pool)[0].shape[1] - 1
+
+
+def init_gqa_pool(cfg, num_layers: int, num_pages: int, page_size: int):
+    """(k, v) pool arrays for one GQA stack (dtype/shapes from cfg — the
+    cache-entry shapes of inference/generate.py's `_cache_shapes`)."""
+    D = cfg.resolved_head_dim
+    shape = (num_layers, num_pages + 1, page_size, cfg.num_kv_heads, D)
+    return (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+
+
+def init_mla_pool(cfg, num_layers: int, num_pages: int, page_size: int):
+    """(c, kr) pool arrays for one MLA stack (absorbed latent cache)."""
+    return (
+        jnp.zeros(
+            (num_layers, num_pages + 1, page_size, cfg.mla_kv_lora_rank),
+            cfg.dtype,
+        ),
+        jnp.zeros(
+            (num_layers, num_pages + 1, page_size, cfg.mla_qk_rope_head_dim),
+            cfg.dtype,
+        ),
+    )
+
+
+def init_pool(cfg, stack_layers: list[int], num_pages: int, page_size: int):
+    """Per-stack pool tuples for a decoder (dense decoders have one stack;
+    MoE decoders a dense prefix + MoE stack — mirrors generate.py)."""
+    init = init_mla_pool if cfg.attention_type == "mla" else init_gqa_pool
+    return [init(cfg, L, num_pages, page_size) for L in stack_layers]
+
+
+def pool_bytes(pool) -> int:
+    return sum(a.size * a.dtype.itemsize for a in jax.tree.leaves(pool))

@@ -1,0 +1,273 @@
+"""Continuous-batching request scheduler (host side of the serving engine).
+
+The engine-loop half of the throughput story (arXiv:2605.25645: the win
+over batch-synchronous generate comes from the loop, not just the kernel).
+Every engine step the scheduler packs ONE fixed-shape token batch — the
+`token_budget` rows the jitted step consumes — from whatever work exists:
+
+- admission by free pages: a waiting request is admitted only when a slot
+  is free AND the pool can hold its whole known sequence plus one decode
+  page of slack (so a fresh admit never immediately preempts itself);
+- decode first: every running request with exactly one pending token (its
+  last sampled one) gets a row — decode latency is the SLO currency;
+- chunked prefill rides the leftover budget: prompt tokens are fed in
+  chunks of at most `prefill_chunk`, interleaved with other requests'
+  decode steps instead of head-of-line blocking them;
+- preempt-and-requeue on pool exhaustion: when a growing request needs a
+  page and none is free, the YOUNGEST running request is preempted
+  recompute-style (vLLM's recompute policy): its pages are freed and it
+  re-queues at the queue head with `known = prompt + generated so far`, so
+  its re-prefill reproduces the exact cache state. Greedy decoding is
+  bit-reproducible across preemption; sampled decoding is too, because the
+  engine derives each token's key as fold_in(request seed, position).
+
+The unifying invariant: a request is just a `known` token list and a `fed`
+counter (tokens whose KV is written). Prefill, decode, and post-preemption
+re-prefill are all "feed known[fed:fed+c]"; a step that feeds the LAST
+known token samples the next one from its logits. No phase flags.
+
+The scheduler owns request/page state only; it never touches device
+memory — it emits a `StepPlan` of numpy arrays the engine uploads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+
+import numpy as np
+
+from automodel_tpu.serving.kv_pages import PageAllocator, pages_for
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. `temperature <= 0` → greedy; sampling keys
+    derive from `seed` (per token position, preemption-stable)."""
+
+    prompt: list
+    max_new_tokens: int = 64
+    temperature: float = 0.0
+    eos_token_id: int | None = None
+    seed: int = 0
+    arrival: int = 0       # earliest engine step at which it may be admitted
+    rid: int = -1          # set by the scheduler (submission order)
+
+    # runtime state (scheduler-owned)
+    generated: list = dataclasses.field(default_factory=list)
+    fed: int = 0           # tokens of `known` whose KV is written
+    preemptions: int = 0
+    admitted_at: int = -1
+    finished_at: int = -1
+    finish_reason: str | None = None
+
+    @property
+    def known(self) -> list:
+        return self.prompt + self.generated
+
+    @property
+    def done(self) -> bool:
+        return self.finish_reason is not None
+
+
+@dataclasses.dataclass
+class StepPlan:
+    """One fixed-shape engine-step input batch (numpy; engine uploads)."""
+
+    tok: np.ndarray          # (T,) int32 token ids (0 on pad rows)
+    slot: np.ndarray         # (T,) int32 owning slot, -1 pad
+    pos: np.ndarray          # (T,) int32 sequence position, -1 pad
+    page: np.ndarray         # (T,) int32 destination page (trash for pads)
+    off: np.ndarray          # (T,) int32 destination in-page offset
+    page_tables: np.ndarray  # (S, P) int32, padded entries → trash page
+    sample_tok: np.ndarray   # (S,) int32 row to sample from, -1 = no sample
+    temp: np.ndarray         # (S,) float32 per-slot temperature
+    seed: np.ndarray         # (S,) int32 per-slot base seed
+    scheduled: list = dataclasses.field(default_factory=list)
+    # scheduled: [(slot, n_tokens, samples: bool)] — host bookkeeping
+
+    @property
+    def n_tokens(self) -> int:
+        return sum(c for _, c, _ in self.scheduled)
+
+    @property
+    def n_samples(self) -> int:
+        return sum(1 for *_, s in self.scheduled if s)
+
+
+class Scheduler:
+    """Continuous-batching scheduler over `max_slots` engine slots."""
+
+    def __init__(
+        self,
+        *,
+        num_pages: int,
+        page_size: int,
+        max_slots: int,
+        pages_per_slot: int,
+        token_budget: int,
+        prefill_chunk: int | None = None,
+    ):
+        self.alloc = PageAllocator(num_pages, page_size)
+        self.page_size = page_size
+        self.max_slots = max_slots
+        self.pages_per_slot = pages_per_slot
+        self.token_budget = token_budget
+        self.prefill_chunk = prefill_chunk or token_budget
+        self.trash_page = num_pages  # pool arrays carry num_pages + 1 pages
+        self.waiting: deque[Request] = deque()
+        self.running: dict[int, Request] = {}   # slot → request
+        self._admit_order: list[int] = []       # slots, oldest admit first
+        self.finished: list[Request] = []
+        self._next_rid = 0
+        self.n_preemptions = 0
+
+    # -- request lifecycle --------------------------------------------------
+    def submit(self, req: Request) -> None:
+        # hard errors, not asserts: these guard user input and must survive
+        # python -O (a request that slips through can stall the serve loop)
+        if len(req.prompt) < 1:
+            raise ValueError("empty prompt")
+        total = len(req.prompt) + req.max_new_tokens
+        max_tokens = self.pages_per_slot * self.page_size
+        if total > max_tokens:
+            raise ValueError(
+                f"request needs {total} positions > pages_per_slot*page_size"
+                f" = {max_tokens}"
+            )
+        if pages_for(total, self.page_size) > self.alloc.num_pages:
+            raise ValueError(
+                f"request needs {pages_for(total, self.page_size)} pages but "
+                f"the whole pool holds {self.alloc.num_pages} — it could "
+                "never finish even alone"
+            )
+        if req.rid < 0:
+            req.rid = self._next_rid
+        self._next_rid = max(self._next_rid, req.rid + 1)
+        self.waiting.append(req)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    def _admit(self, step_idx: int) -> None:
+        while self.waiting and len(self.running) < self.max_slots:
+            req = self.waiting[0]
+            if req.arrival > step_idx:
+                break
+            # admission by free pages: whole known sequence + 1 decode page
+            need = pages_for(len(req.known) + 1, self.page_size)
+            if need > self.alloc.num_free:
+                break
+            self.waiting.popleft()
+            slot = next(
+                s for s in range(self.max_slots) if s not in self.running
+            )
+            self.running[slot] = req
+            self._admit_order.append(slot)
+            if req.admitted_at < 0:
+                req.admitted_at = step_idx
+        # FIFO admission: if the head doesn't fit, nothing behind it jumps
+        # the queue (no starvation of long prompts)
+
+    def _preempt_youngest(self, protected) -> bool:
+        """Free the youngest running request whose slot is not `protected`
+        (the requester and every slot with rows already planned this step —
+        their pages must not be recycled mid-step); requeue it at the queue
+        head, recompute-style. Returns False if no victim."""
+        for slot in reversed(self._admit_order):
+            if slot in protected:
+                continue
+            victim = self.running.pop(slot)
+            self._admit_order.remove(slot)
+            self.alloc.free_slot(slot)
+            victim.fed = 0
+            victim.preemptions += 1
+            self.n_preemptions += 1
+            self.waiting.appendleft(victim)
+            return True
+        return False
+
+    # -- step planning ------------------------------------------------------
+    def schedule(self, step_idx: int) -> StepPlan | None:
+        """Build the next step's token batch, or None when nothing runs this
+        step (queue empty or all arrivals in the future)."""
+        self._admit(step_idx)
+        T, S, P = self.token_budget, self.max_slots, self.pages_per_slot
+        plan = StepPlan(
+            tok=np.zeros(T, np.int32),
+            slot=np.full(T, -1, np.int32),
+            pos=np.full(T, -1, np.int32),
+            page=np.full(T, self.trash_page, np.int32),
+            off=np.zeros(T, np.int32),
+            page_tables=np.full((S, P), self.trash_page, np.int32),
+            sample_tok=np.full(S, -1, np.int32),
+            temp=np.zeros(S, np.float32),
+            seed=np.zeros(S, np.int32),
+        )
+        row = 0
+        planned = set()
+        # decode rows first (pending == 1), then prefill chunks; within each
+        # class oldest admit first
+        order = [s for s in self._admit_order]
+        decode = [s for s in order if len(self.running[s].known) - self.running[s].fed == 1]
+        prefill = [s for s in order if s not in decode]
+        for slot in decode + prefill:
+            req = self.running.get(slot)
+            if req is None or row >= T:
+                continue
+            pending = len(req.known) - req.fed
+            c = min(pending, T - row, self.prefill_chunk)
+            if c <= 0:
+                continue
+            if not self.alloc.ensure(slot, req.fed + c):
+                # pool exhausted: preempt-and-requeue until it fits (or stall
+                # this slot for the step if no preemptible victim is left)
+                while not self.alloc.ensure(slot, req.fed + c):
+                    if not self._preempt_youngest(planned | {slot}):
+                        c = 0
+                        break
+                if c == 0:
+                    continue
+            planned.add(slot)
+            table = self.alloc.table(slot)
+            for j in range(c):
+                p = req.fed + j
+                plan.tok[row + j] = req.known[p]
+                plan.slot[row + j] = slot
+                plan.pos[row + j] = p
+                plan.page[row + j] = table[p // self.page_size]
+                plan.off[row + j] = p % self.page_size
+            samples = req.fed + c == len(req.known)
+            if samples:
+                plan.sample_tok[slot] = row + c - 1
+            plan.temp[slot] = req.temperature
+            plan.seed[slot] = req.seed
+            plan.scheduled.append((slot, c, samples))
+            row += c
+        for slot, req in self.running.items():
+            t = self.alloc.table(slot)
+            plan.page_tables[slot, : len(t)] = t
+        if not plan.scheduled:
+            return None
+        return plan
+
+    def update(self, plan: StepPlan, sampled: np.ndarray, step_idx: int) -> None:
+        """Absorb one engine step's sampled tokens; finish/free requests."""
+        for slot, c, samples in plan.scheduled:
+            req = self.running[slot]
+            req.fed += c
+            if not samples:
+                continue
+            tok = int(sampled[slot])
+            req.generated.append(tok)
+            if req.eos_token_id is not None and tok == req.eos_token_id:
+                req.finish_reason = "eos"
+            elif len(req.generated) >= req.max_new_tokens:
+                req.finish_reason = "length"
+            if req.done:
+                req.finished_at = step_idx
+                self.finished.append(req)
+                del self.running[slot]
+                self._admit_order.remove(slot)
+                self.alloc.free_slot(slot)

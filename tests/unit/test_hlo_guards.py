@@ -56,8 +56,11 @@ def _collective_counts(compiled) -> dict:
     """Count collective instructions in optimized HLO. Scan bodies compile
     once, so counts reflect program structure, not trip counts."""
     txt = compiled.as_text()
+    # (?<![\w-]) keeps "all-to-all(" from also matching inside
+    # "ragged-all-to-all(" — \b holds after a hyphen
     return {
-        c: len(re.findall(rf"\b{c}(?:-start)?\(", txt)) for c in COLLECTIVES
+        c: len(re.findall(rf"(?<![\w-]){c}(?:-start)?\(", txt))
+        for c in COLLECTIVES
     }
 
 
@@ -149,6 +152,45 @@ def test_hlo_guard_ep_moe_forward():
         budget={"all-gather": 14, "all-reduce": 2, "collective-permute": 0,
                 "all-to-all": 3, "ragged-all-to-all": 0},
         floors={"all-to-all": 1},
+    )
+
+
+def test_hlo_guard_paged_decode_step():
+    """The serving engine's jitted step: per-layer paged-pool reads must
+    stay GATHERS (page-table indexed; a regression to per-request dense
+    caches would spike dynamic-slice / blow the gather count), pool writes
+    stay O(stacks) in-place updates, and a single-process step must emit NO
+    collectives. Counts are per compiled program structure (the layer scan
+    compiles once), pinned exactly like the budgets above."""
+    from automodel_tpu.serving.engine import ServingConfig, ServingEngine
+
+    cfg = dataclasses.replace(DENSE, pipeline_microbatches=1)
+    params = decoder.init(cfg, jax.random.key(0))
+    eng = ServingEngine(params, cfg, ServingConfig(
+        page_size=4, num_pages=16, max_slots=2, pages_per_slot=4,
+        token_budget=8,
+    ))
+    T, S, P = 8, 2, 4
+    batch = {k: jnp.zeros(T, jnp.int32) for k in ("tok", "slot", "pos", "page", "off")}
+    batch.update(
+        page_tables=jnp.zeros((S, P), jnp.int32),
+        sample_tok=jnp.zeros(S, jnp.int32),
+        temp=jnp.zeros(S, jnp.float32),
+        seed=jnp.zeros(S, jnp.int32),
+    )
+    compiled = eng._step.lower(eng.params, eng.pool, batch).compile()
+    txt = compiled.as_text()
+    ops = ("gather", "dynamic-slice", "dynamic-update-slice") + COLLECTIVES
+    counts = {
+        c: len(re.findall(rf"= (?:[\w\[\],<>:{{}} ]+ )?{c}\(", txt))
+        for c in ops
+    }
+    _check(
+        counts,
+        budget={"gather": 7, "dynamic-slice": 19, "dynamic-update-slice": 4,
+                "all-gather": 0, "all-reduce": 0, "collective-permute": 0,
+                "all-to-all": 0, "ragged-all-to-all": 0},
+        floors={"gather": 2},  # ≥ the paged k/v page gathers
     )
 
 

@@ -1,0 +1,99 @@
+"""Paged KV pool: allocator accounting, page tables, defrag compaction."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from automodel_tpu.serving.kv_pages import (
+    PageAllocator,
+    apply_defrag,
+    init_pool,
+    pages_for,
+    pool_trash_index,
+)
+
+
+def test_pages_for():
+    assert pages_for(1, 4) == 1
+    assert pages_for(4, 4) == 1
+    assert pages_for(5, 4) == 2
+    assert pages_for(0, 4) == 0
+
+
+def test_alloc_grow_free_accounting():
+    a = PageAllocator(num_pages=6, page_size=4)
+    assert a.num_free == 6
+    assert a.ensure(0, 5)            # 2 pages
+    assert a.ensure(1, 9)            # 3 pages
+    assert a.num_free == 1
+    assert len(a.table(0)) == 2 and len(a.table(1)) == 3
+    # growth within the covered range allocates nothing
+    assert a.ensure(0, 8) and len(a.table(0)) == 2
+    # dense-prefix tables: pages are appended, never reordered
+    t0 = list(a.table(0))
+    assert a.ensure(0, 12) and a.table(0)[:2] == t0
+    assert a.num_free == 0
+    # exhausted: refuse WITHOUT partial allocation
+    assert not a.ensure(1, 16)
+    assert len(a.table(1)) == 3 and a.num_free == 0
+    a.free_slot(0)
+    assert a.num_free == 3 and a.table(0) == []
+    # no double-free surprises: every page accounted exactly once
+    a.free_slot(1)
+    assert sorted(a._free) == list(range(6))
+
+
+def test_defrag_compacts_live_pages():
+    a = PageAllocator(num_pages=8, page_size=2)
+    a.ensure(0, 4)   # 2 pages
+    a.ensure(1, 4)   # 2 pages
+    a.ensure(2, 2)   # 1 page
+    a.free_slot(1)   # holes in the middle
+    live_before = {s: list(a.table(s)) for s in (0, 2)}
+    plan = a.defrag_plan()
+    assert plan is not None
+    src, n_live = plan
+    assert n_live == 3
+    # tables now a dense prefix, contents preserved through the mapping
+    used = sorted(p for s in (0, 2) for p in a.table(s))
+    assert used == [0, 1, 2]
+    assert a.num_free == 5
+    # device-side: new page i holds old page src[i]
+    pool = (jnp.arange(2 * 9 * 2 * 1 * 1, dtype=jnp.float32).reshape(2, 9, 2, 1, 1),)
+    moved = apply_defrag(pool, src)[0]
+    for slot in (0, 2):
+        for old, new in zip(live_before[slot], a.table(slot)):
+            np.testing.assert_array_equal(
+                np.asarray(moved[:, new]), np.asarray(pool[0][:, old])
+            )
+    # trash page (index num_pages) stays put
+    np.testing.assert_array_equal(np.asarray(moved[:, 8]), np.asarray(pool[0][:, 8]))
+
+
+def test_defrag_noop_when_compact():
+    a = PageAllocator(num_pages=4, page_size=2)
+    a.ensure(0, 4)
+    assert a.defrag_plan() is None
+
+
+def test_init_pool_shapes():
+    from automodel_tpu.models.llm.decoder import TransformerConfig
+
+    cfg = TransformerConfig(
+        vocab_size=8, hidden_size=16, intermediate_size=16, num_layers=2,
+        num_heads=4, num_kv_heads=2, dtype=jnp.float32, remat_policy="none",
+    )
+    pool = init_pool(cfg, [2], num_pages=6, page_size=4)
+    (k, v), = pool
+    D = cfg.resolved_head_dim
+    assert k.shape == (2, 7, 4, 2, D) and v.shape == k.shape  # N+1 pages
+    assert pool_trash_index(pool) == 6
+
+    import dataclasses
+
+    mla = dataclasses.replace(
+        cfg, attention_type="mla", mla_kv_lora_rank=8, mla_q_lora_rank=0,
+        mla_qk_nope_head_dim=4, mla_qk_rope_head_dim=4, mla_v_head_dim=4,
+    )
+    (c, kr), = init_pool(mla, [2], num_pages=6, page_size=4)
+    assert c.shape == (2, 7, 4, 8) and kr.shape == (2, 7, 4, 4)
