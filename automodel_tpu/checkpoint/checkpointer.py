@@ -19,6 +19,9 @@ from typing import Any, Optional
 import jax
 import orbax.checkpoint as ocp
 
+from automodel_tpu.resilience.faults import fault_hit
+from automodel_tpu.resilience.retry import RetryPolicy, retry_call
+
 logger = logging.getLogger(__name__)
 
 
@@ -70,6 +73,27 @@ class Checkpointer:
             best_mode=config.best_mode if config.best_metric else "min",
         )
         self._mgr = ocp.CheckpointManager(root, options=options)
+        # retry wiring (resilience layer): None → every op is a single
+        # attempt. Injected faults (fault_hit) fire INSIDE the attempt body
+        # so a retried save really re-runs the failure point.
+        self.retry_policy: Optional[RetryPolicy] = None
+        self._on_retry = None
+
+    def set_retry(self, policy: Optional[RetryPolicy], on_attempt=None) -> None:
+        """Wrap save/restore/wait in retry-with-backoff (resilience/retry.py);
+        `on_attempt(point, attempt, exc, delay_s)` observes every failure."""
+        self.retry_policy = policy
+        self._on_retry = on_attempt
+
+    def _attempt(self, point: str, fn):
+        # FileNotFoundError is deterministic (a missing/partial checkpoint
+        # does not appear on retry) and callers' fallbacks match on the
+        # type — auto-resume's `except FileNotFoundError → fresh start`
+        # must keep working with retry enabled
+        return retry_call(
+            fn, policy=self.retry_policy, point=point,
+            on_attempt=self._on_retry, no_retry=(FileNotFoundError,),
+        )
 
     # -- save ------------------------------------------------------------
     def save(self, step: int, state: Any, extra: dict | None = None,
@@ -84,9 +108,14 @@ class Checkpointer:
         args = {"state": ocp.args.StandardSave(state)}
         if extra:
             args["extra"] = ocp.args.JsonSave(extra)
-        saved = self._mgr.save(
-            step, args=ocp.args.Composite(**args), metrics=metrics, force=force
-        )
+
+        def attempt():
+            fault_hit("checkpoint_write", step=step)
+            return self._mgr.save(
+                step, args=ocp.args.Composite(**args), metrics=metrics, force=force
+            )
+
+        saved = self._attempt("checkpoint_write", attempt)
         if saved:
             logger.info("saved checkpoint at step %d", step)
         return bool(saved)
@@ -118,14 +147,27 @@ class Checkpointer:
         args = {"state": ocp.args.StandardRestore(abstract_state)}
         if with_extra:
             args["extra"] = ocp.args.JsonRestore()
-        out = self._mgr.restore(step, args=ocp.args.Composite(**args))
+
+        def attempt():
+            fault_hit("checkpoint_restore", step=step)
+            return self._mgr.restore(step, args=ocp.args.Composite(**args))
+
+        out = self._attempt("checkpoint_restore", attempt)
         if with_extra:
             return out["state"], (out.get("extra") or {})
         return out["state"]
 
     # -- lifecycle ---------------------------------------------------------
     def wait(self) -> None:
-        """Block until async saves land (reference: maybe_wait_for_staging)."""
+        """Block until async saves land (reference: maybe_wait_for_staging).
+
+        Deliberately NOT retried: an async save whose background write
+        failed re-raises here, but calling wait_until_finished again would
+        not re-run the write — the failed operation is already consumed, so
+        a "retry" would convert a missing checkpoint into silent success.
+        The failure must surface loudly; the caller's save cadence (or the
+        emergency path's committed=False report) is the recovery story."""
+        fault_hit("checkpoint_wait")
         self._mgr.wait_until_finished()
 
     def close(self) -> None:

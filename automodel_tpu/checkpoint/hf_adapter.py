@@ -714,13 +714,28 @@ def save_hf_checkpoint(
     out_dir: str,
     hf_config: dict | None = None,
     max_shard_bytes: int = 4 << 30,
+    retry_policy=None,
+    on_retry=None,
 ) -> None:
     """Write sharded `model-XXXXX-of-YYYYY.safetensors` + index + config.json
     (the consolidated-HF-export analog, reference: checkpointing.py
-    consolidate_safetensors_files_on_every_rank)."""
+    consolidate_safetensors_files_on_every_rank).
+
+    Crash-consistent: everything is staged into a sibling `<out_dir>.staging-
+    <pid>` directory and PUBLISHED with one atomic rename at the end — a
+    crash mid-export can never leave a loadable-looking but truncated
+    `out_dir` (a partial safetensors set without its index parses as a
+    complete smaller model). `retry_policy` (resilience/retry.py) retries
+    transient per-shard write failures; the `hf_export_write` /
+    `hf_export_commit` fault points make both paths chaos-testable.
+    """
+    import shutil
+
     from safetensors.numpy import save_file
 
     from automodel_tpu.checkpoint.checkpointer import is_remote_path
+    from automodel_tpu.resilience.faults import fault_hit
+    from automodel_tpu.resilience.retry import retry_call
 
     if is_remote_path(out_dir):
         # os.makedirs would silently create a LOCAL './gs:/…' tree and the
@@ -731,7 +746,29 @@ def save_hf_checkpoint(
             "remote checkpoint_dir) — export to a local directory via "
             "save_consolidated_hf(out_dir=...) and sync it to the bucket"
         )
-    os.makedirs(out_dir, exist_ok=True)
+    import glob as _glob
+
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        # single-writer publish: the staged-rename protocol (and the stale-
+        # staging sweep below) assumes ONE exporter per out_dir; to_hf
+        # consumers hand in host numpy tensors, so rank 0 alone writes the
+        # consolidated artifact (the MetricLogger rank-0 convention)
+        return
+    out_dir = os.path.abspath(out_dir).rstrip(os.sep)
+    old_dir = f"{out_dir}.old"
+    # recovery from a previous interrupted publish: a crash between the two
+    # swap renames leaves the old COMPLETE export under `.old` and no
+    # out_dir — restore it before staging the new one (self-healing; a
+    # reader in between sees a missing dir, never a truncated one)
+    if os.path.isdir(old_dir):
+        if not os.path.isdir(out_dir):
+            os.rename(old_dir, out_dir)
+        else:
+            shutil.rmtree(old_dir, ignore_errors=True)
+    for stale in _glob.glob(f"{out_dir}.staging-*"):
+        shutil.rmtree(stale, ignore_errors=True)
+    stage_dir = f"{out_dir}.staging-{os.getpid()}"
+    os.makedirs(stage_dir)
     # Stream: flush each shard to a temp-named file as soon as it fills so
     # host memory peaks at ONE shard, then rename once the count is known.
     tmp_files: list[str] = []
@@ -744,39 +781,78 @@ def save_hf_checkpoint(
         nonlocal shard, size
         if not shard:
             return
-        tmp = os.path.join(out_dir, f"__tmp_shard_{len(tmp_files):05d}")
-        save_file(shard, tmp)
+        tmp = os.path.join(stage_dir, f"__tmp_shard_{len(tmp_files):05d}")
+
+        def write():
+            fault_hit("hf_export_write")
+            save_file(shard, tmp)
+
+        retry_call(
+            write, policy=retry_policy, point="hf_export_write",
+            on_attempt=on_retry,
+        )
         tmp_files.append(tmp)
         shard_keys.append(list(shard))
         shard = {}
         size = 0
 
-    for name, tensor in named_tensors:
-        nbytes = tensor.nbytes
-        if size + nbytes > max_shard_bytes and shard:
-            flush()
-        shard[name] = np.ascontiguousarray(tensor)
-        size += nbytes
-        total += nbytes
-    flush()
+    try:
+        for name, tensor in named_tensors:
+            nbytes = tensor.nbytes
+            if size + nbytes > max_shard_bytes and shard:
+                flush()
+            shard[name] = np.ascontiguousarray(tensor)
+            size += nbytes
+            total += nbytes
+        flush()
 
-    n = len(tmp_files)
-    weight_map = {}
-    for idx, (tmp, keys) in enumerate(zip(tmp_files, shard_keys), 1):
-        fname = (
-            "model.safetensors" if n == 1
-            else f"model-{idx:05d}-of-{n:05d}.safetensors"
-        )
-        os.replace(tmp, os.path.join(out_dir, fname))
-        for k in keys:
-            weight_map[k] = fname
-    if n > 1:
-        index = {"metadata": {"total_size": int(total)}, "weight_map": weight_map}
-        with open(os.path.join(out_dir, "model.safetensors.index.json"), "w") as f:
-            json.dump(index, f, indent=2)
-    if hf_config is not None:
-        with open(os.path.join(out_dir, "config.json"), "w") as f:
-            json.dump(hf_config, f, indent=2)
+        n = len(tmp_files)
+        weight_map = {}
+        for idx, (tmp, keys) in enumerate(zip(tmp_files, shard_keys), 1):
+            fname = (
+                "model.safetensors" if n == 1
+                else f"model-{idx:05d}-of-{n:05d}.safetensors"
+            )
+            os.replace(tmp, os.path.join(stage_dir, fname))
+            for k in keys:
+                weight_map[k] = fname
+        if n > 1:
+            index = {"metadata": {"total_size": int(total)}, "weight_map": weight_map}
+            with open(os.path.join(stage_dir, "model.safetensors.index.json"), "w") as f:
+                json.dump(index, f, indent=2)
+        if hf_config is not None:
+            with open(os.path.join(stage_dir, "config.json"), "w") as f:
+                json.dump(hf_config, f, indent=2)
+
+        # -- atomic publish -----------------------------------------------
+        fault_hit("hf_export_commit")
+        if os.path.isdir(out_dir):
+            # replacing a previous export: move it aside first so a crash
+            # between the two renames leaves the old COMPLETE export under
+            # `.old` (restored by the recovery path above on the next
+            # export) — never a truncated mix at out_dir
+            os.rename(out_dir, old_dir)
+            fault_hit("hf_export_swap")
+            os.rename(stage_dir, out_dir)
+            # sidecar files next to the previous export (tokenizer.json,
+            # generation_config.json, …) survive the replace; model shards
+            # and the index always come from the NEW export only
+            for name in os.listdir(old_dir):
+                if name.endswith(".safetensors") or name == "model.safetensors.index.json":
+                    continue
+                dst = os.path.join(out_dir, name)
+                if not os.path.exists(dst):
+                    os.rename(os.path.join(old_dir, name), dst)
+            shutil.rmtree(old_dir, ignore_errors=True)
+        else:
+            os.rename(stage_dir, out_dir)
+    except Exception:
+        # ordinary failures clean their staging tree; an injected/real CRASH
+        # (BaseException) leaves it — which is fine: `.staging-*` is not a
+        # loadable checkpoint directory (and the next export sweeps it), the
+        # invariant holds either way
+        shutil.rmtree(stage_dir, ignore_errors=True)
+        raise
 
 
 def _dequant_fp8_block(
@@ -841,12 +917,20 @@ def _read_fp8_slice(path: str, name: str, header: tuple | None = None) -> np.nda
 
 
 class HFCheckpointReader:
-    """Lazy per-tensor reader over a local HF checkpoint directory."""
+    """Lazy per-tensor reader over a local HF checkpoint directory.
 
-    def __init__(self, ckpt_dir: str):
+    `retry_policy` (resilience/retry.py) retries transient tensor-read
+    failures with backoff — checkpoint dirs on network mounts (GCS FUSE,
+    NFS) fail transiently under load, and a 70B streamed load should not
+    die on one flaky read. The `remote_io` fault point fires inside each
+    attempt so the retry path is chaos-testable."""
+
+    def __init__(self, ckpt_dir: str, retry_policy=None, on_retry=None):
         from safetensors import safe_open
 
         self._dir = ckpt_dir
+        self.retry_policy = retry_policy
+        self.on_retry = on_retry
         self._handles: dict[str, Any] = {}
         self._header_cache: dict[str, tuple] = {}
         self._fp8_block_cache: tuple | None = None
@@ -873,11 +957,26 @@ class HFCheckpointReader:
     def __call__(self, name: str) -> np.ndarray:
         if name not in self._weight_map:
             raise KeyError(name)
-        t = self._read_raw(name)
-        scale_name = f"{name}_scale_inv"
-        if scale_name in self._weight_map:
-            t = _dequant_fp8_block(t, self._read_raw(scale_name), self._fp8_block())
-        return t
+
+        def attempt():
+            from automodel_tpu.resilience.faults import fault_hit
+
+            fault_hit("remote_io")
+            t = self._read_raw(name)
+            scale_name = f"{name}_scale_inv"
+            if scale_name in self._weight_map:
+                t = _dequant_fp8_block(
+                    t, self._read_raw(scale_name), self._fp8_block()
+                )
+            return t
+
+        from automodel_tpu.resilience.retry import retry_call
+
+        # KeyError is a MISSING tensor, not a transient — never retried
+        return retry_call(
+            attempt, policy=self.retry_policy, point="remote_io",
+            on_attempt=self.on_retry, retry_on=(OSError, RuntimeError),
+        )
 
     def _fp8_block(self) -> tuple:
         """Block size of fp8-quantized checkpoints, from config.json's

@@ -48,12 +48,22 @@ class LauncherConfig:
     tpu_chips_per_host: int = 4
     image: str = "python:3.12"
     workdir: str = "/workspace"
+    # bounded pod-recreation budget: TPU spot/preemptible nodes get
+    # reclaimed routinely, and `backoffLimit: 0` turned every preemption
+    # into a dead job even though the recipe auto-resumes from its
+    # emergency checkpoint; a small bounded budget restarts those while a
+    # crash-looping job still fails fast
+    backoff_limit: int = 3
 
     def __post_init__(self):
         if self.backend not in ("slurm", "gke"):
             raise ValueError(f"launcher.backend must be slurm|gke, got {self.backend}")
         if self.nodes < 1:
             raise ValueError(f"launcher.nodes must be >= 1, got {self.nodes}")
+        if self.backoff_limit < 0:
+            raise ValueError(
+                f"launcher.backoff_limit must be >= 0, got {self.backoff_limit}"
+            )
 
 
 def _train_command(config_path: str, extra: str) -> str:
@@ -125,7 +135,7 @@ def render_gke_jobset(cfg: LauncherConfig, config_path: str) -> str:
                 "parallelism": cfg.nodes,
                 "completions": cfg.nodes,
                 "completionMode": "Indexed",
-                "backoffLimit": 0,
+                "backoffLimit": cfg.backoff_limit,
                 "template": {"spec": {
                     "restartPolicy": "Never",
                     "nodeSelector": {

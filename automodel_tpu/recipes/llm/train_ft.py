@@ -130,6 +130,10 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         self.mesh_ctx = self.typed.mesh.build()
         logger.info("mesh: %s", self.mesh_ctx.sizes)
 
+        # resilience wiring comes FIRST: pretrained-weight reads in
+        # _build_model already run under the remote-IO retry + fault points
+        self._setup_resilience()
+
         self._build_model()
         self._build_optimizer()
         self._build_data()
@@ -139,10 +143,18 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             save_every_steps=self.step_scheduler.config.ckpt_every_steps,
         )
         self.checkpointer = ckpt_cfg.build() if ckpt_cfg.enabled else None
+        if self.checkpointer is not None and self._retry_policy is not None:
+            self.checkpointer.set_retry(
+                self._retry_policy, on_attempt=self._on_retry_attempt
+            )
 
         run_dir = cfg.get("run_dir", ".")
         self.metric_logger = MetricLogger(os.path.join(run_dir, "training.jsonl"))
         self.val_logger = MetricLogger(os.path.join(run_dir, "validation.jsonl"))
+        # retries that happened before the logger existed (pretrained-weight
+        # reads during _build_model) surface on the first records too
+        for name, n in self._retry_counts.items():
+            self.metric_logger.set_counter(name, n)
 
         from automodel_tpu.loggers.trackers import build_trackers
 
@@ -161,13 +173,22 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         )
 
         restore_from = cfg.get("checkpoint.restore_from", None)
+        t_restore = time.perf_counter()
+        resumed = False
         if restore_from:
             self.restore_from(restore_from, step=cfg.get("checkpoint.restore_step"))
+            resumed = True
         elif cfg.get("auto_resume", True):
             try:
-                self.load_checkpoint()
+                resumed = self.load_checkpoint()
             except FileNotFoundError:
                 pass
+        if resumed:
+            # time-to-resume: the goodput cost of coming back from a
+            # preemption (restore only — model build/compile is the same
+            # either way); surfaced on the first step's record and in the
+            # bench `resilience` headline
+            self._time_to_resume_s = round(time.perf_counter() - t_restore, 3)
 
         from automodel_tpu.training.utils import GCController
 
@@ -176,6 +197,116 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             enabled=bool(cfg.get("gc_control", False)),
         )
         self.step_scheduler.install_sigterm_handler()
+
+    # ------------------------------------------------------------------
+    def _setup_resilience(self) -> None:
+        """Wire the fault-tolerance layer (automodel_tpu/resilience/):
+        config-armed fault injection, retry-with-backoff around checkpoint +
+        HF-adapter I/O, the rollback manager, and the nonfinite fail-fast
+        counters. Runs BEFORE model build / checkpointer / loggers exist, so
+        pretrained reads are protected too; the checkpointer wires itself in
+        setup() once built. See docs/RESILIENCE.md."""
+        from automodel_tpu.resilience import install_injector
+
+        res_cfg = self.typed.resilience
+        self.resilience_cfg = res_cfg
+        self.fault_injector = install_injector(res_cfg.build_injector())
+        if self.fault_injector.armed:
+            logger.warning(
+                "fault injection armed: %s",
+                [dataclasses.asdict(s) for s in self.fault_injector.specs],
+            )
+        self._retry_policy = res_cfg.retry_policy(seed=jax.process_index())
+        self._retry_counts: dict = {}
+        self.rollback = res_cfg.build_rollback()
+        self._nonfinite_streak = 0
+        self._first_nonfinite_step: Optional[int] = None
+        self._time_to_resume_s: Optional[float] = None
+        self._preempt_finished = False
+
+    def _on_retry_attempt(self, point, attempt, exc, delay_s) -> None:
+        """Every retried I/O attempt is counted through MetricLogger (once
+        it exists — model-load retries are buffered and mirrored in), so
+        the retry pressure a run survived is visible in training.jsonl."""
+        name = f"retry_{point}"
+        self._retry_counts[name] = self._retry_counts.get(name, 0) + 1
+        ml = getattr(self, "metric_logger", None)
+        if ml is not None:
+            ml.set_counter(name, self._retry_counts[name])
+
+    def _check_nonfinite_cap(self, step: int, nonfinite: bool) -> None:
+        """Fail fast on a diverged run: without this cap,
+        skip_nonfinite_updates would silently skip EVERY remaining step of
+        a NaN'd run to completion (the `skipped_nonfinite` metric was
+        ignored). With rollback enabled, recovery fires first; this cap is
+        the backstop."""
+        if not nonfinite:
+            self._nonfinite_streak = 0
+            self._first_nonfinite_step = None
+            return
+        self._nonfinite_streak += 1
+        if self._first_nonfinite_step is None:
+            self._first_nonfinite_step = step
+        cap = int(self.resilience_cfg.max_consecutive_nonfinite or 0)
+        if self.resilience_cfg.enabled and cap and self._nonfinite_streak >= cap:
+            from automodel_tpu.resilience import ResilienceError
+
+            raise ResilienceError(
+                f"{self._nonfinite_streak} consecutive non-finite step(s); "
+                f"first bad step: {self._first_nonfinite_step}. The run has "
+                "diverged — failing fast instead of skipping every update "
+                "to completion (raise resilience.max_consecutive_nonfinite "
+                "or enable rollback snapshots to auto-recover)"
+            )
+
+    def _maybe_rollback(self, step: int, loss: float, nonfinite: bool) -> bool:
+        """NaN/spike detection + bounded rollback. Returns True when the
+        step's outcome was discarded and the loop should move on."""
+        if self.rollback is None:
+            return False
+        reason = self.rollback.observe(step, loss, nonfinite)
+        if reason is None:
+            return False
+        snap_step, state = self.rollback.rollback(step, reason)
+        self.train_state = state
+        self._nonfinite_streak = 0
+        self._first_nonfinite_step = None
+        # goodput counters come from the manager's stats — one source of
+        # truth, mirrored into the logger so they ride every record
+        self.metric_logger.set_counter("rollbacks", self.rollback.stats.rollbacks)
+        self.metric_logger.set_counter("wasted_steps", self.rollback.stats.wasted_steps)
+        self.metric_logger.log({
+            "step": step, "event": "rollback", "reason": reason,
+            "restored_step": snap_step,
+        })
+        return True
+
+    def _emergency_checkpoint(self, step: int) -> None:
+        """SIGTERM → forced save + grace-deadline wait for the async commit
+        (preemption model: the process dies when the grace window closes)."""
+        from automodel_tpu.resilience import wait_with_deadline
+
+        t0 = time.perf_counter()
+        saved = self.save_checkpoint(step, force=True)
+        committed = True
+        if self.checkpointer is not None:
+            grace = self.step_scheduler.grace_remaining(
+                float(self.resilience_cfg.sigterm_grace_s)
+            )
+            committed = wait_with_deadline(self.checkpointer, grace)
+        seconds = round(time.perf_counter() - t0, 3)
+        self.metric_logger.log({
+            "step": step, "event": "emergency_checkpoint",
+            "saved": bool(saved), "committed": bool(committed),
+            "seconds": seconds,
+        })
+        if not committed:
+            logger.error(
+                "emergency checkpoint at step %d NOT committed within the "
+                "grace window — resume will fall back to step %s",
+                step,
+                self.checkpointer.latest_step() if self.checkpointer else None,
+            )
 
     # ------------------------------------------------------------------
     def _build_model(self) -> None:
@@ -241,7 +372,10 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
 
         pretrained = mcfg.get("pretrained_path", None)
         if pretrained:
-            self._hf_reader = HFCheckpointReader(pretrained)
+            self._hf_reader = HFCheckpointReader(
+                pretrained, retry_policy=self._retry_policy,
+                on_retry=self._on_retry_attempt,
+            )
             hf_config = self._hf_reader.hf_config()
         else:
             self._hf_reader = None
@@ -653,15 +787,45 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
 
     def _run_train_validation_loop(self) -> None:
         t_last = time.perf_counter()
+        first_record = True
+        if self.rollback is not None:
+            # step-0 snapshot: a NaN on the very first steps is recoverable
+            self.rollback.snapshot(self.step_scheduler.step, self.train_state)
         for microbatches in self.step_scheduler:
+            step = self.step_scheduler.step
+            # chaos hooks — no-ops unless armed via `resilience.faults`
+            if self.fault_injector.check("sigterm", step=step) is not None:
+                self.step_scheduler.sigterm_received = True
+            if self.fault_injector.check("nan_grads", step=step) is not None:
+                # poison the params: this step's gradients (and every later
+                # step's, absent recovery) are non-finite — the scenario
+                # skip_nonfinite_updates alone can never recover from
+                self.train_state = self.train_state._replace(
+                    params=jax.tree.map(
+                        lambda p: (p * jnp.nan).astype(p.dtype),
+                        self.train_state.params,
+                    )
+                )
             batch_np = stack_microbatches(microbatches)
             batch = self._make_global(batch_np)
             self.train_state, metrics = self._train_step(
                 self.train_state, batch, self.rng.next_key(), *self._step_extra()
             )
-            step = self.step_scheduler.step
             self.profiler.step(step)
             self.gc.step(step)
+
+            loss_val = float(metrics["loss"])
+            nonfinite = (
+                not np.isfinite(loss_val)
+                or float(metrics.get("skipped_nonfinite", 0.0)) > 0
+            )
+            if self._maybe_rollback(step, loss_val, nonfinite):
+                t_last = time.perf_counter()
+                if self.step_scheduler.sigterm_received:
+                    self._finish_preempted(step)
+                    break
+                continue
+            self._check_nonfinite_cap(step, nonfinite)
 
             if self.is_moe and self.model_cfg.moe.gate_bias_update_speed > 0:
                 self._update_gate_bias(metrics["tokens_per_expert"])
@@ -689,22 +853,38 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             for k, v in metrics.items():
                 if k not in record and k != "tokens_per_expert" and getattr(v, "ndim", 0) == 0:
                     record[k] = float(v)
+            if first_record and self._time_to_resume_s is not None:
+                record["time_to_resume_s"] = self._time_to_resume_s
+            first_record = False
             self.metric_logger.log(record)
             for t in self.trackers:
                 t.log({k: v for k, v in record.items() if k not in ("step", "ts")}, step=step)
 
+            if self.rollback is not None and not nonfinite and self.rollback.due(step):
+                self.rollback.snapshot(step, self.train_state)
             if self.step_scheduler.is_val_step and self.val_dataloader is not None:
                 self._run_validation(step)
-            if (self.step_scheduler.is_ckpt_step or self.step_scheduler.sigterm_received):
-                self.save_checkpoint(step, force=self.step_scheduler.sigterm_received)
             if self.step_scheduler.sigterm_received:
-                logger.info("SIGTERM received — checkpointed and exiting")
-                # mark external trackers KILLED (reference: mlflow_utils.py)
-                for t in self.trackers:
-                    t.finish(status="KILLED")
-                self.trackers = []
+                self._finish_preempted(step)
                 break
+            if self.step_scheduler.is_ckpt_step:
+                self.save_checkpoint(step)
 
+        if self.step_scheduler.sigterm_received:
+            if not self._preempt_finished:
+                # the signal landed AFTER the last in-loop check (e.g.
+                # during the final step or its cadenced save) — run the
+                # emergency path now so the grace window is still honored
+                self._finish_preempted(self.step_scheduler.step)
+            # preempted: the emergency path saved and waited under the
+            # grace deadline — no further UNBOUNDED finalization (a
+            # re-save/wait/consolidated-export here would block past the
+            # grace window on exactly the commit the deadline gave up on)
+            self.profiler.close()
+            self.gc.close()
+            self.metric_logger.close()
+            self.val_logger.close()
+            return
         if self.checkpointer is not None:
             self.save_checkpoint(self.step_scheduler.step, force=True)
             self.checkpointer.wait()
@@ -716,6 +896,16 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             t.finish()
         self.metric_logger.close()
         self.val_logger.close()
+
+    def _finish_preempted(self, step: int) -> None:
+        """SIGTERM path: emergency checkpoint, mark external trackers KILLED
+        (reference: mlflow_utils.py), stop iterating."""
+        self._preempt_finished = True
+        self._emergency_checkpoint(step)
+        logger.info("SIGTERM received — checkpointed and exiting")
+        for t in self.trackers:
+            t.finish(status="KILLED")
+        self.trackers = []
 
     # ------------------------------------------------------------------
     def _update_gate_bias(self, tokens_per_expert) -> None:
@@ -810,7 +1000,11 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             )
         else:
             params = jax.device_get(self.train_state.params)
-        save_hf_checkpoint(adapter.to_hf(params), out_dir, hf_config=self._hf_config)
+        save_hf_checkpoint(
+            adapter.to_hf(params), out_dir, hf_config=self._hf_config,
+            retry_policy=getattr(self, "_retry_policy", None),
+            on_retry=getattr(self, "_on_retry_attempt", None),
+        )
         logger.info("consolidated HF checkpoint written to %s", out_dir)
         return out_dir
 

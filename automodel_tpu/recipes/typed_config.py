@@ -103,6 +103,12 @@ class RecipeConfig:
         return self._section("qat", QATConfig)
 
     @property
+    def resilience(self):
+        from automodel_tpu.resilience.config import ResilienceConfig
+
+        return self._section("resilience", ResilienceConfig)
+
+    @property
     def profiling(self):
         from automodel_tpu.utils.profiling import ProfilingConfig
 

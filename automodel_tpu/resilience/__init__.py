@@ -1,0 +1,58 @@
+"""Fault-tolerance layer: fault injection, retry, rollback, preemption.
+
+Composes the pieces the trainer already had (SIGTERM flag in
+training/step_scheduler.py, skip_nonfinite_updates in
+training/train_step.py, orbax async saves in checkpoint/checkpointer.py,
+resume plumbing in recipes/llm/train_ft.py) into survivable runs:
+
+- faults.py:     deterministic fault-injection harness (chaos tests on CPU)
+- retry.py:      exponential backoff + jitter around remote I/O
+- rollback.py:   host-offloaded snapshots + NaN/spike detect + bounded
+                 rollback
+- preemption.py: emergency-checkpoint grace-deadline wait
+- config.py:     the typed `resilience:` recipe section
+
+See docs/RESILIENCE.md for the failure model and the goodput metrics.
+"""
+
+from automodel_tpu.resilience.config import ResilienceConfig
+from automodel_tpu.resilience.faults import (
+    FaultCrash,
+    FaultError,
+    FaultInjector,
+    FaultSpec,
+    fault_hit,
+    get_injector,
+    injected,
+    install_injector,
+)
+from automodel_tpu.resilience.preemption import wait_with_deadline
+from automodel_tpu.resilience.retry import (
+    RetryBudgetExhausted,
+    RetryPolicy,
+    retry_call,
+)
+from automodel_tpu.resilience.rollback import (
+    ResilienceError,
+    RollbackManager,
+    RollbackStats,
+)
+
+__all__ = [
+    "FaultCrash",
+    "FaultError",
+    "FaultInjector",
+    "FaultSpec",
+    "ResilienceConfig",
+    "ResilienceError",
+    "RetryBudgetExhausted",
+    "RetryPolicy",
+    "RollbackManager",
+    "RollbackStats",
+    "fault_hit",
+    "get_injector",
+    "injected",
+    "install_injector",
+    "retry_call",
+    "wait_with_deadline",
+]

@@ -1,0 +1,144 @@
+"""In-trainer auto-recovery: host-offloaded rollback snapshots.
+
+A NaN streak or loss spike detected mid-run rolls the optimizer state back
+to the last known-good snapshot instead of (a) dying or (b) silently
+skipping every remaining update (`skip_nonfinite_updates` alone does the
+latter for a truly diverged run). The snapshot lives on HOST memory
+(`jax.device_get`), so it costs no device HBM and survives a device-side
+NaN wavefront; shardings are remembered so the restore is a plain
+`device_put` back into the original layout.
+
+Recovery semantics (see docs/RESILIENCE.md):
+
+- the data stream and the step counter keep moving FORWARD: the batches
+  consumed between the snapshot and the bad step are the "offending data
+  window" and are deterministically skipped (they were already drawn from
+  the dataloader, whose position is not rewound);
+- the model/optimizer state (including the optimizer's own step counter,
+  hence the LR schedule) rewinds to the snapshot — the discarded updates
+  never happened;
+- restarts are BOUNDED: exceeding ``max_rollbacks`` raises
+  :class:`ResilienceError` naming the first bad step, replacing unbounded
+  silent skipping with a loud failure.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from collections import deque
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class ResilienceError(RuntimeError):
+    """Unrecoverable divergence / recovery budget exhausted."""
+
+
+def host_snapshot(state: Any) -> tuple:
+    """(host numpy tree, shardings tree) of a device pytree."""
+    shardings = jax.tree.map(
+        lambda x: getattr(x, "sharding", None) if hasattr(x, "shape") else None,
+        state,
+    )
+    return jax.device_get(state), shardings
+
+
+def device_restore(host_state: Any, shardings: Any) -> Any:
+    return jax.tree.map(
+        lambda v, s: jax.device_put(v, s) if s is not None else v,
+        host_state, shardings,
+    )
+
+
+@dataclasses.dataclass
+class RollbackStats:
+    rollbacks: int = 0
+    wasted_steps: int = 0
+    snapshots: int = 0
+
+
+class RollbackManager:
+    """Snapshot-every-K + NaN/spike detector + bounded rollback."""
+
+    def __init__(
+        self,
+        *,
+        every_steps: int,
+        max_rollbacks: int = 3,
+        loss_spike_factor: Optional[float] = None,
+        spike_window: int = 32,
+        min_spike_history: int = 5,
+    ):
+        if every_steps <= 0:
+            raise ValueError(f"every_steps must be > 0, got {every_steps}")
+        self.every_steps = int(every_steps)
+        self.max_rollbacks = int(max_rollbacks)
+        self.loss_spike_factor = loss_spike_factor
+        self.min_spike_history = int(min_spike_history)
+        self._recent: deque = deque(maxlen=int(spike_window))
+        self._snap: Optional[tuple] = None  # (step, host_tree, shardings)
+        self.stats = RollbackStats()
+        self.first_bad_step: Optional[int] = None
+
+    # -- snapshots ---------------------------------------------------------
+    @property
+    def snapshot_step(self) -> Optional[int]:
+        return self._snap[0] if self._snap is not None else None
+
+    def due(self, step: int) -> bool:
+        return self._snap is None or step % self.every_steps == 0
+
+    def snapshot(self, step: int, state: Any) -> None:
+        host, shardings = host_snapshot(state)
+        self._snap = (int(step), host, shardings)
+        self.stats.snapshots += 1
+
+    # -- detection ---------------------------------------------------------
+    def observe(self, step: int, loss: float, nonfinite: bool) -> Optional[str]:
+        """Feed one step's outcome; return a rollback reason or None."""
+        if nonfinite or not np.isfinite(loss):
+            if self.first_bad_step is None:
+                self.first_bad_step = int(step)
+            return "nonfinite"
+        if (
+            self.loss_spike_factor is not None
+            and len(self._recent) >= self.min_spike_history
+            and loss > self.loss_spike_factor * float(np.median(self._recent))
+        ):
+            if self.first_bad_step is None:
+                self.first_bad_step = int(step)
+            return "loss_spike"
+        self._recent.append(float(loss))
+        return None
+
+    # -- recovery ----------------------------------------------------------
+    def rollback(self, step: int, reason: str) -> tuple:
+        """Restore the snapshot; returns (snapshot_step, restored_state).
+        Raises ResilienceError when the restart budget is exhausted."""
+        if self._snap is None:
+            raise ResilienceError(
+                f"rollback requested at step {step} ({reason}) but no "
+                "snapshot was ever taken"
+            )
+        self.stats.rollbacks += 1
+        if self.stats.rollbacks > self.max_rollbacks:
+            raise ResilienceError(
+                f"rollback budget exhausted: {self.stats.rollbacks - 1} "
+                f"rollback(s) already spent, still {reason} at step {step} "
+                f"(first bad step: {self.first_bad_step}); the run is "
+                "diverged beyond auto-recovery"
+            )
+        snap_step, host, shardings = self._snap
+        self.stats.wasted_steps += max(0, int(step) - snap_step)
+        logger.warning(
+            "rolling back: %s at step %d → restoring snapshot from step %d "
+            "(%d update(s) discarded; data window is skipped, the stream "
+            "continues forward)",
+            reason, step, snap_step, step - snap_step,
+        )
+        return snap_step, device_restore(host, shardings)

@@ -335,7 +335,23 @@ class ServingEngine:
         while sched.has_work and step_idx < budget:
             plan = sched.schedule(step_idx)
             if plan is None:
-                if not any(r.arrival > step_idx for r in sched.waiting):
+                if not sched.has_work:
+                    # deadline expiry inside schedule() drained the last
+                    # request(s) — nothing left to run
+                    break
+                arrivals = [
+                    r.arrival for r in sched.waiting if r.arrival > step_idx
+                ]
+                nd = sched.next_deadline
+                if nd is not None and nd > step_idx:
+                    # a pending deadline will evict the blocker and free its
+                    # pages — jump ahead (offline loop; an online server
+                    # would keep serving other traffic), but never PAST a
+                    # servable arrival: skipping it would wrongly expire a
+                    # request that was never given its window to run
+                    step_idx = min([nd] + arrivals)
+                    continue
+                if not arrivals:
                     # no step could be packed and no future arrival can
                     # change that: whether the blocker is an inadmissible
                     # queue head or a RUNNING request that filled the pool
@@ -391,6 +407,7 @@ class ServingEngine:
             "decode_tokens_per_sec": round(n_sampled / max(decode_s, 1e-9), 2),
             "ms_per_token": round(1e3 * decode_s / max(n_sampled, 1), 4),
             "preemptions": sched.n_preemptions,
+            "timed_out": sched.n_timed_out,
             "compiled_signatures": self.step_cache_size(),
         }
         if metric_logger is not None:

@@ -51,6 +51,10 @@ class Request:
     eos_token_id: int | None = None
     seed: int = 0
     arrival: int = 0       # earliest engine step at which it may be admitted
+    # graceful degradation under overload: a request not finished by engine
+    # step `deadline` is EVICTED (pages freed, finish_reason "timed_out")
+    # instead of occupying pool pages forever; None → no deadline
+    deadline: int | None = None
     rid: int = -1          # set by the scheduler (submission order)
 
     # runtime state (scheduler-owned)
@@ -121,6 +125,7 @@ class Scheduler:
         self.finished: list[Request] = []
         self._next_rid = 0
         self.n_preemptions = 0
+        self.n_timed_out = 0
 
     # -- request lifecycle --------------------------------------------------
     def submit(self, req: Request) -> None:
@@ -188,10 +193,49 @@ class Scheduler:
             return True
         return False
 
+    def _expire_deadlines(self, step_idx: int) -> None:
+        """Evict requests whose deadline has passed — running requests free
+        their slot and pages (relieving pool pressure under overload),
+        waiting ones just leave the queue. Runs BETWEEN engine steps (at the
+        top of schedule()), so no mid-step plan ever references recycled
+        pages. The partial generation stays on the Request."""
+        for slot, req in list(self.running.items()):
+            if req.deadline is not None and step_idx >= req.deadline:
+                req.finish_reason = "timed_out"
+                req.finished_at = step_idx
+                self.finished.append(req)
+                del self.running[slot]
+                self._admit_order.remove(slot)
+                self.alloc.free_slot(slot)
+                self.n_timed_out += 1
+        expired = [
+            r for r in self.waiting
+            if r.deadline is not None and step_idx >= r.deadline
+        ]
+        for req in expired:
+            self.waiting.remove(req)
+            req.finish_reason = "timed_out"
+            req.finished_at = step_idx
+            self.finished.append(req)
+            self.n_timed_out += 1
+
+    @property
+    def next_deadline(self) -> int | None:
+        """Earliest pending deadline across running+waiting (None if none) —
+        lets the serve loop distinguish 'stalled forever' from 'stalled
+        until an eviction frees pages'."""
+        ds = [
+            r.deadline
+            for r in list(self.running.values()) + list(self.waiting)
+            if r.deadline is not None
+        ]
+        return min(ds) if ds else None
+
     # -- step planning ------------------------------------------------------
     def schedule(self, step_idx: int) -> StepPlan | None:
         """Build the next step's token batch, or None when nothing runs this
         step (queue empty or all arrivals in the future)."""
+        self._expire_deadlines(step_idx)
         self._admit(step_idx)
         T, S, P = self.token_budget, self.max_slots, self.pages_per_slot
         plan = StepPlan(

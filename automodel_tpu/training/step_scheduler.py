@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import signal
+import time
 from typing import Any, Iterator, Optional
 
 
@@ -33,6 +34,7 @@ class StepScheduler:
         self.step = 0
         self.epoch = 0
         self.sigterm_received = False
+        self.sigterm_time: Optional[float] = None  # time.monotonic() at signal
 
     # -- iteration ---------------------------------------------------------
     def __iter__(self) -> Iterator[list]:
@@ -73,8 +75,19 @@ class StepScheduler:
     def install_sigterm_handler(self) -> None:
         def handler(signum, frame):
             self.sigterm_received = True
+            # stamp the ARRIVAL: the emergency-checkpoint grace deadline
+            # counts from when the orchestrator sent the signal (k8s/SLURM
+            # semantics), not from when the current step finished
+            if self.sigterm_time is None:
+                self.sigterm_time = time.monotonic()
 
         signal.signal(signal.SIGTERM, handler)
+
+    def grace_remaining(self, grace_s: float) -> float:
+        """Seconds left of a `grace_s` window that opened at the SIGTERM."""
+        if self.sigterm_time is None:
+            return grace_s
+        return max(0.0, grace_s - (time.monotonic() - self.sigterm_time))
 
     # -- checkpointable state ------------------------------------------------
     def state_dict(self) -> dict:

@@ -72,6 +72,13 @@ _SLOW_SMOKES = {
     "examples/vlm_kd/llava_kd_smoke.yaml",           # test_recipe_matrix KD
     "examples/llm_finetune/mimo_v2_flash_smoke.yaml",  # test_model_tail + pin
     "examples/llm_finetune/gemma4_moe_smoke.yaml",   # test_model_tail + pin
+    "examples/vlm_finetune/qwen3_vl_moe_mock_smoke.yaml",  # test_qwen3_vl
+    "examples/vlm_finetune/kimi_vl_mock_smoke.yaml",  # test_kimi_vl
+    "examples/diffusion/dit_flow_smoke.yaml",        # test_diffusion_pipeline
+    "examples/llm_finetune/deepseek_v32_smoke.yaml",  # test_dsa recipe tests
+    # same tiny-llama train as tiny_llama_mock_smoke + the resilience knobs,
+    # which tier-1 already exercises end-to-end in test_resilience.py
+    "examples/llm_finetune/tiny_llama_resilient_smoke.yaml",
 }
 
 _SMOKES = [

@@ -1,0 +1,157 @@
+"""Kill-and-resume: SIGTERM a REAL subprocess trainer mid-run, restart it
+with auto_resume, and assert the concatenated loss curve is step-for-step
+identical to an uninterrupted run.
+
+This pins the end-to-end resume claims (training/rng.py key-stream counter,
+step_scheduler/dataloader positions through the checkpoint extra side-car,
+the SIGTERM → emergency-checkpoint path) that the in-process tests can only
+check piecewise: the resumed process rebuilds everything from disk.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+import yaml
+
+pytestmark = pytest.mark.recipe
+
+STEPS = 16
+
+
+def _cfg(workdir: str) -> dict:
+    return {
+        "seed": 13,
+        "run_dir": os.path.join(workdir, "run"),
+        "auto_resume": True,
+        "model": {
+            "hf_config": {
+                "architectures": ["LlamaForCausalLM"],
+                "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+                "num_hidden_layers": 2, "num_attention_heads": 4,
+                "num_key_value_heads": 2,
+            },
+            "dtype": "float32",
+            "remat_policy": "none",
+        },
+        "distributed": {"dp_shard": -1},
+        "dataset": {
+            "_target_": "automodel_tpu.datasets.mock.MockDatasetConfig",
+            "num_samples": 1024, "seq_len": 128, "vocab_size": 128,
+        },
+        "dataloader": {"microbatch_size": 8, "grad_acc_steps": 2},
+        "optimizer": {"name": "adamw", "lr": 1e-3, "weight_decay": 0.0},
+        "lr_scheduler": {"warmup_steps": 2, "decay_steps": STEPS, "style": "cosine"},
+        "step_scheduler": {
+            "max_steps": STEPS, "ckpt_every_steps": 1000, "num_epochs": 4,
+        },
+        "checkpoint": {
+            "enabled": True,
+            "checkpoint_dir": os.path.join(workdir, "ckpt"),
+            "async_save": True,
+        },
+        "resilience": {"sigterm_grace_s": 120.0},
+        "loss": {"chunk_size": 128},
+    }
+
+
+def _launch(cfg: dict, workdir: str, name: str):
+    path = os.path.join(workdir, f"{name}.yaml")
+    with open(path, "w") as f:
+        yaml.safe_dump(cfg, f)
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    log = open(os.path.join(workdir, f"{name}.log"), "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "automodel_tpu", path],
+        env=env, stdout=log, stderr=subprocess.STDOUT,
+    )
+    return proc, log
+
+
+def _records(run_dir: str) -> list:
+    path = os.path.join(run_dir, "training.jsonl")
+    if not os.path.exists(path):
+        return []
+    return [json.loads(l) for l in open(path) if l.strip()]
+
+
+def _losses(recs) -> dict:
+    return {r["step"]: r["loss"] for r in recs if "loss" in r and "step" in r}
+
+
+def _tail(workdir, name):
+    return open(os.path.join(workdir, f"{name}.log")).read()[-2000:]
+
+
+def test_sigterm_kill_and_resume_reproduces_uninterrupted_curve(tmp_path):
+    work = str(tmp_path)
+
+    # the uninterrupted golden runs CONCURRENTLY in its own directories (it
+    # shares nothing with the preempted pair); joined before the comparison
+    gwork = os.path.join(work, "golden")
+    os.makedirs(gwork)
+    gcfg = _cfg(gwork)
+    p3, log3 = _launch(gcfg, gwork, "golden")
+
+    # 1) the run that gets preempted: wait for a few real steps, SIGTERM it
+    cfg = _cfg(work)
+    p1, log1 = _launch(cfg, work, "interrupted")
+    run_dir = cfg["run_dir"]
+    deadline = time.monotonic() + 420
+    try:
+        while time.monotonic() < deadline and p1.poll() is None:
+            if len(_losses(_records(run_dir))) >= 3:
+                break
+            time.sleep(0.02)
+        assert p1.poll() is None, (
+            f"trainer finished before it could be killed:\n{_tail(work, 'interrupted')}"
+        )
+        p1.send_signal(signal.SIGTERM)
+        p1.wait(timeout=300)
+    finally:
+        log1.close()
+    assert p1.returncode == 0, (
+        f"SIGTERM'd trainer exited rc={p1.returncode}:\n{_tail(work, 'interrupted')}"
+    )
+    recs1 = _records(run_dir)
+    killed_at = max(_losses(recs1))
+    assert 0 < killed_at < STEPS, f"run was not interrupted mid-run: {killed_at}"
+    ev = [r for r in recs1 if r.get("event") == "emergency_checkpoint"]
+    assert ev and ev[0]["committed"], "emergency checkpoint did not commit"
+
+    # 2) fresh process, same config: auto_resume from the emergency ckpt
+    p2, log2 = _launch(cfg, work, "resumed")
+    try:
+        p2.wait(timeout=420)
+    finally:
+        log2.close()
+    assert p2.returncode == 0, f"resumed trainer failed:\n{_tail(work, 'resumed')}"
+    merged = _losses(_records(run_dir))  # same jsonl, appended
+    assert sorted(merged) == list(range(1, STEPS + 1)), sorted(merged)
+    resumed_recs = [
+        r for r in _records(run_dir) if r.get("step") == killed_at + 1 and "loss" in r
+    ]
+    assert any("time_to_resume_s" in r for r in resumed_recs)
+
+    # 3) join the uninterrupted golden
+    try:
+        p3.wait(timeout=420)
+    finally:
+        log3.close()
+    assert p3.returncode == 0, f"golden trainer failed:\n{_tail(gwork, 'golden')}"
+    golden = _losses(_records(gcfg["run_dir"]))
+    assert sorted(golden) == list(range(1, STEPS + 1))
+
+    # the concatenated curve must be step-for-step identical: same data
+    # order (dataloader position), same per-step rng keys (counter), same
+    # optimizer state (orbax round-trip) ⇒ same floats on the same machine
+    a = np.array([merged[s] for s in range(1, STEPS + 1)])
+    b = np.array([golden[s] for s in range(1, STEPS + 1)])
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)

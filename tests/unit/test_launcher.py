@@ -50,11 +50,23 @@ def test_gke_jobset_is_valid_yaml_with_tpu_resources():
     c = pod["containers"][0]
     assert c["resources"]["limits"]["google.com/tpu"] == 4
     assert "python -m automodel_tpu cfg.yaml" in c["args"][0]
+    # preempted pods must be restartable: backoffLimit 0 turned every TPU
+    # spot reclaim into a dead job even though the recipe auto-resumes from
+    # its emergency checkpoint — the default is a small bounded budget
+    assert job["backoffLimit"] == 3
+    doc2 = yaml.safe_load(
+        render_gke_jobset(
+            LauncherConfig(backend="gke", backoff_limit=7), "cfg.yaml"
+        )
+    )
+    assert doc2["spec"]["replicatedJobs"][0]["template"]["spec"]["backoffLimit"] == 7
 
 
 def test_launcher_rejects_bad_backend():
     with pytest.raises(ValueError, match="slurm|gke"):
         LauncherConfig(backend="torchrun")
+    with pytest.raises(ValueError, match="backoff_limit"):
+        LauncherConfig(backend="gke", backoff_limit=-1)
 
 
 def test_cli_launch_writes_spec(tmp_path):

@@ -1,0 +1,563 @@
+"""Chaos suite for the fault-tolerance layer (automodel_tpu/resilience/).
+
+Deterministic fault injection drives the failure scenarios in tier-1 on
+CPU: transient checkpoint-write faults are retried and the run completes;
+retry-budget exhaustion fails loudly; an injected NaN streak triggers
+rollback + data-window skip and the run converges next to the clean curve;
+a diverged run without rollback fails fast instead of silently skipping
+every update; crash-before-commit never leaves a restore-able partial
+checkpoint or HF export.
+"""
+
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu.resilience import (
+    FaultCrash,
+    FaultError,
+    FaultInjector,
+    FaultSpec,
+    ResilienceError,
+    RetryBudgetExhausted,
+    RetryPolicy,
+    RollbackManager,
+    injected,
+    retry_call,
+    wait_with_deadline,
+)
+
+
+# ---------------------------------------------------------------------------
+# unit: fault injector
+# ---------------------------------------------------------------------------
+def test_fault_injector_step_call_times_gating():
+    inj = FaultInjector([
+        {"point": "a", "step": 3, "times": 2},
+        {"point": "b", "call": 2},
+    ])
+    # step-gated: fires only when the caller reports the armed step
+    assert inj.check("a", step=1) is None
+    assert inj.check("a", step=3) is not None
+    assert inj.check("a", step=3) is not None  # times=2
+    assert inj.check("a", step=3) is None      # disarmed
+    # call-gated: fires from the 2nd hit, once
+    assert inj.check("b") is None
+    assert inj.check("b") is not None
+    assert inj.check("b") is None
+    assert inj.fired["a"] == 2 and inj.fired["b"] == 1
+
+
+def test_fault_modes_and_context_manager():
+    with injected(FaultSpec(point="p", mode="error")):
+        from automodel_tpu.resilience import fault_hit
+
+        with pytest.raises(FaultError):
+            fault_hit("p")
+        assert fault_hit("p") is False  # times=1, disarmed
+    with injected({"point": "p", "mode": "crash"}):
+        from automodel_tpu.resilience import fault_hit
+
+        with pytest.raises(FaultCrash):
+            fault_hit("p")
+    # context exited → default disarmed injector, probe is a no-op
+    from automodel_tpu.resilience import fault_hit
+
+    assert fault_hit("p") is False
+
+
+def test_fault_spec_rejects_unknown_mode():
+    with pytest.raises(ValueError, match="mode"):
+        FaultSpec(point="x", mode="explode")
+
+
+def test_resilience_disabled_disarms_everything():
+    """enabled:false turns the WHOLE layer off — faults included (a chaos
+    YAML toggled off for a comparison run must not keep firing with no
+    retry left to absorb it)."""
+    from automodel_tpu.resilience import ResilienceConfig
+
+    cfg = ResilienceConfig(
+        enabled=False, snapshot_every_steps=4,
+        faults=[{"point": "checkpoint_write"}],
+    )
+    assert not cfg.build_injector().armed
+    assert cfg.retry_policy() is None
+    assert cfg.build_rollback() is None
+
+
+# ---------------------------------------------------------------------------
+# unit: retry
+# ---------------------------------------------------------------------------
+def test_retry_succeeds_after_transients_and_counts_attempts():
+    calls = {"n": 0}
+    seen = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    out = retry_call(
+        flaky, policy=RetryPolicy(max_attempts=3, base_delay_s=0.0),
+        point="t", on_attempt=lambda p, a, e, d: seen.append((p, a)),
+    )
+    assert out == "ok" and calls["n"] == 3
+    assert seen == [("t", 1), ("t", 2)]  # every failed attempt observed
+
+
+def test_retry_budget_exhaustion_fails_loudly():
+    def always():
+        raise OSError("down")
+
+    with pytest.raises(RetryBudgetExhausted, match="2 attempt"):
+        retry_call(
+            always, policy=RetryPolicy(max_attempts=2, base_delay_s=0.0),
+            point="t",
+        )
+
+
+def test_retry_never_swallows_a_crash():
+    def crash():
+        raise FaultCrash("dead")
+
+    with pytest.raises(FaultCrash):
+        retry_call(crash, policy=RetryPolicy(max_attempts=5, base_delay_s=0.0))
+
+
+def test_retry_backoff_deterministic_and_bounded():
+    p = RetryPolicy(max_attempts=5, base_delay_s=0.1, max_delay_s=0.3, jitter=0.5, seed=7)
+    d1 = [p.delay(a, p.rng_for("x")) for a in (1, 2, 3, 4)]
+    d2 = [p.delay(a, p.rng_for("x")) for a in (1, 2, 3, 4)]
+    assert d1 == d2  # deterministic replay per (seed, point)
+    assert all(d <= 0.3 * 1.5 + 1e-9 for d in d1)  # capped + jitter bound
+    rng = p.rng_for("x")
+    delays = [p.delay(a, rng) for a in (1, 2, 3)]
+    assert delays[0] >= 0.1 and delays[1] >= delays[0] / 2  # growing base
+
+
+# ---------------------------------------------------------------------------
+# unit: rollback manager
+# ---------------------------------------------------------------------------
+def _tiny_state():
+    return {"w": jnp.arange(4.0), "m": jnp.ones((2, 2))}
+
+
+def test_rollback_restores_snapshot_and_counts_waste():
+    rb = RollbackManager(every_steps=2, max_rollbacks=2)
+    state = _tiny_state()
+    rb.snapshot(4, state)
+    corrupted = jax.tree.map(lambda x: x * jnp.nan, state)
+    assert rb.observe(7, float("nan"), nonfinite=True) == "nonfinite"
+    snap_step, restored = rb.rollback(7, "nonfinite")
+    assert snap_step == 4
+    for a, b in zip(jax.tree.leaves(restored), jax.tree.leaves(state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    del corrupted
+    assert rb.stats.wasted_steps == 3 and rb.stats.rollbacks == 1
+    assert rb.first_bad_step == 7
+
+
+def test_rollback_budget_exhaustion_raises():
+    rb = RollbackManager(every_steps=1, max_rollbacks=1)
+    rb.snapshot(1, _tiny_state())
+    rb.rollback(2, "nonfinite")
+    with pytest.raises(ResilienceError, match="budget exhausted"):
+        rb.rollback(3, "nonfinite")
+
+
+def test_rollback_spike_detection():
+    rb = RollbackManager(every_steps=1, max_rollbacks=1, loss_spike_factor=3.0)
+    for s, l in enumerate((1.0, 1.1, 0.9, 1.0, 1.05), start=1):
+        assert rb.observe(s, l, nonfinite=False) is None
+    assert rb.observe(6, 30.0, nonfinite=False) == "loss_spike"
+    assert rb.observe(6, 1.2, nonfinite=False) is None  # normal loss passes
+
+
+def test_wait_with_deadline():
+    import time as _time
+
+    class Slow:
+        def wait(self):
+            _time.sleep(5.0)
+
+    class Fast:
+        def wait(self):
+            pass
+
+    assert wait_with_deadline(Fast(), 1.0) is True
+    assert wait_with_deadline(Slow(), 0.05) is False
+    # an ALREADY-EXPIRED grace window (spent inside a long step) must probe
+    # and return False promptly — never block unbounded on a stuck commit
+    t0 = _time.monotonic()
+    assert wait_with_deadline(Slow(), 0.0) is False
+    assert _time.monotonic() - t0 < 2.0
+    # …but an instantly-committing save must still report True (the probe
+    # has a small floor window so it cannot race the wait thread's startup)
+    assert wait_with_deadline(Fast(), 0.0) is True
+    assert wait_with_deadline(Fast(), None) is True  # None = no deadline
+
+
+# ---------------------------------------------------------------------------
+# chaos: checkpoint write/restore under faults
+# ---------------------------------------------------------------------------
+def _ckpt(tmp_path, **kw):
+    from automodel_tpu.checkpoint import CheckpointingConfig
+
+    return CheckpointingConfig(
+        checkpoint_dir=str(tmp_path / "ckpt"), async_save=False, **kw
+    ).build()
+
+
+def test_checkpoint_save_retries_transient_fault(tmp_path):
+    from automodel_tpu.checkpoint import abstract_state_like
+
+    ckpt = _ckpt(tmp_path)
+    attempts = []
+    ckpt.set_retry(
+        RetryPolicy(max_attempts=3, base_delay_s=0.0),
+        on_attempt=lambda p, a, e, d: attempts.append((p, a)),
+    )
+    state = {"w": jnp.arange(8.0)}
+    with injected({"point": "checkpoint_write", "call": 1, "times": 2}):
+        assert ckpt.save(1, state, force=True)
+    assert attempts == [("checkpoint_write", 1), ("checkpoint_write", 2)]
+    restored = ckpt.restore(abstract_state_like(state))
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.arange(8.0))
+    ckpt.close()
+
+
+def test_restore_file_not_found_is_never_retried(tmp_path):
+    """FileNotFoundError is deterministic; with retry enabled it must still
+    surface AS FileNotFoundError (auto-resume's fresh-start fallback in
+    train_ft matches on the type) instead of being burned through the
+    budget and re-raised as RetryBudgetExhausted."""
+    ckpt = _ckpt(tmp_path)
+    attempts = []
+    ckpt.set_retry(
+        RetryPolicy(max_attempts=3, base_delay_s=0.0),
+        on_attempt=lambda p, a, e, d: attempts.append(a),
+    )
+    with pytest.raises(FileNotFoundError):
+        ckpt.restore({"w": jnp.zeros(2)})  # empty dir: no checkpoint at all
+
+    def damaged_restore(*a, **k):
+        raise FileNotFoundError("damaged step dir")
+
+    ckpt._mgr.restore = damaged_restore
+    with pytest.raises(FileNotFoundError, match="damaged"):
+        ckpt.restore({"w": jnp.zeros(2)}, step=7)
+    assert attempts == []  # zero retried attempts for either path
+    ckpt.close()
+
+
+def test_checkpoint_save_exhaustion_fails_loudly(tmp_path):
+    ckpt = _ckpt(tmp_path)
+    ckpt.set_retry(RetryPolicy(max_attempts=2, base_delay_s=0.0))
+    with injected({"point": "checkpoint_write", "call": 1, "times": 5}):
+        with pytest.raises(RetryBudgetExhausted, match="checkpoint_write"):
+            ckpt.save(1, {"w": jnp.zeros(2)}, force=True)
+    ckpt.close()
+
+
+def test_crash_before_commit_leaves_no_partial_checkpoint(tmp_path):
+    """A crash at the write point must never surface a partial step to
+    latest_step()/restore — resume falls back to the last COMPLETE step."""
+    from automodel_tpu.checkpoint import abstract_state_like
+
+    ckpt = _ckpt(tmp_path)
+    state = {"w": jnp.arange(4.0)}
+    assert ckpt.save(1, state, force=True)
+    ckpt.wait()
+    with injected({"point": "checkpoint_write", "mode": "crash"}):
+        with pytest.raises(FaultCrash):
+            ckpt.save(2, {"w": jnp.full((4,), 9.0)}, force=True)
+    ckpt.close()
+    # a fresh process (new manager) sees only the complete step
+    ckpt2 = _ckpt(tmp_path)
+    assert ckpt2.latest_step() == 1
+    restored = ckpt2.restore(abstract_state_like(state))
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.arange(4.0))
+    ckpt2.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos: HF export crash consistency + remote-IO retry
+# ---------------------------------------------------------------------------
+def _dense_params_and_adapter():
+    from automodel_tpu.checkpoint import DenseDecoderAdapter
+    from automodel_tpu.models.llm import decoder
+    from automodel_tpu.models.llm.decoder import TransformerConfig
+
+    cfg = TransformerConfig(
+        vocab_size=64, hidden_size=16, intermediate_size=32, num_layers=2,
+        num_heads=2, num_kv_heads=2, dtype=jnp.float32, remat_policy="none",
+    )
+    return decoder.init(cfg, jax.random.key(0)), DenseDecoderAdapter(cfg)
+
+
+def test_hf_export_crash_before_commit_never_truncates(tmp_path):
+    from automodel_tpu.checkpoint import HFCheckpointReader, save_hf_checkpoint
+
+    params, adapter = _dense_params_and_adapter()
+    out = tmp_path / "hf"
+    # crash on a FRESH export: the target directory must not exist at all
+    # (a truncated safetensors set parses as a complete smaller model)
+    with injected({"point": "hf_export_commit", "mode": "crash"}):
+        with pytest.raises(FaultCrash):
+            save_hf_checkpoint(adapter.to_hf(params), str(out), hf_config={"a": 1})
+    assert not out.exists()
+
+    # successful export, then crash while REPLACING it: old export intact
+    save_hf_checkpoint(adapter.to_hf(params), str(out), hf_config={"a": 1})
+    before = sorted(os.listdir(out))
+    with injected({"point": "hf_export_commit", "mode": "crash"}):
+        with pytest.raises(FaultCrash):
+            save_hf_checkpoint(adapter.to_hf(params), str(out), hf_config={"a": 2})
+    assert sorted(os.listdir(out)) == before
+    reader = HFCheckpointReader(str(out))
+    restored = adapter.from_hf(reader)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_hf_export_swap_crash_recovery_and_sidecar_preservation(tmp_path):
+    """Crash BETWEEN the two publish renames: out_dir is momentarily gone
+    but the old complete export sits under `.old` and the next export
+    self-heals (restores, then replaces). Sidecar files a user staged next
+    to the export (tokenizer.json) survive a replace; stale model shards
+    never do."""
+    from automodel_tpu.checkpoint import HFCheckpointReader, save_hf_checkpoint
+
+    params, adapter = _dense_params_and_adapter()
+    out = tmp_path / "hf"
+    save_hf_checkpoint(adapter.to_hf(params), str(out), hf_config={"v": 1})
+    (out / "tokenizer.json").write_text('{"tok": true}')
+
+    with injected({"point": "hf_export_swap", "mode": "crash"}):
+        with pytest.raises(FaultCrash):
+            save_hf_checkpoint(adapter.to_hf(params), str(out), hf_config={"v": 2})
+    assert not out.exists() and (tmp_path / "hf.old").is_dir()
+
+    # next export recovers the stranded state and publishes cleanly
+    save_hf_checkpoint(adapter.to_hf(params), str(out), hf_config={"v": 3})
+    assert not (tmp_path / "hf.old").exists()
+    assert not list(tmp_path.glob("hf.staging-*"))
+    assert json.load(open(out / "config.json")) == {"v": 3}
+    assert (out / "tokenizer.json").read_text() == '{"tok": true}'
+    restored = adapter.from_hf(HFCheckpointReader(str(out)))
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_hf_export_transient_write_fault_retried(tmp_path):
+    from automodel_tpu.checkpoint import HFCheckpointReader, save_hf_checkpoint
+
+    params, adapter = _dense_params_and_adapter()
+    out = tmp_path / "hf"
+    attempts = []
+    with injected({"point": "hf_export_write", "call": 1, "times": 1}):
+        save_hf_checkpoint(
+            adapter.to_hf(params), str(out),
+            retry_policy=RetryPolicy(max_attempts=3, base_delay_s=0.0),
+            on_retry=lambda p, a, e, d: attempts.append(a),
+        )
+    assert attempts == [1]
+    restored = adapter.from_hf(HFCheckpointReader(str(out)))
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_reader_remote_io_retry_and_exhaustion(tmp_path):
+    from automodel_tpu.checkpoint import HFCheckpointReader, save_hf_checkpoint
+
+    params, adapter = _dense_params_and_adapter()
+    save_hf_checkpoint(adapter.to_hf(params), str(tmp_path / "hf"))
+    reader = HFCheckpointReader(
+        str(tmp_path / "hf"),
+        retry_policy=RetryPolicy(max_attempts=3, base_delay_s=0.0),
+    )
+    with injected({"point": "remote_io", "call": 1, "times": 2}):
+        t = reader("model.embed_tokens.weight")  # two faults, three attempts
+    assert t.shape == (64, 16)
+    with injected({"point": "remote_io", "call": 1, "times": 10}):
+        with pytest.raises(RetryBudgetExhausted, match="remote_io"):
+            reader("model.norm.weight")
+
+
+# ---------------------------------------------------------------------------
+# chaos: end-to-end trainer recovery (recipe tier)
+# ---------------------------------------------------------------------------
+pytest_recipe = pytest.mark.recipe
+
+
+def _smoke_cfg(tmp_path, **over):
+    from automodel_tpu.config import ConfigNode
+
+    cfg = {
+        "seed": 7,
+        "run_dir": str(tmp_path),
+        "auto_resume": True,
+        "model": {
+            "hf_config": {
+                "architectures": ["LlamaForCausalLM"],
+                "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+                "num_hidden_layers": 2, "num_attention_heads": 4,
+                "num_key_value_heads": 2,
+            },
+            "dtype": "float32",
+            "remat_policy": "none",
+        },
+        "distributed": {"dp_shard": -1},
+        "dataset": {
+            "_target_": "automodel_tpu.datasets.mock.MockDatasetConfig",
+            "num_samples": 256, "seq_len": 32, "vocab_size": 128,
+        },
+        "dataloader": {"microbatch_size": 8, "grad_acc_steps": 1},
+        "optimizer": {"name": "adamw", "lr": 1e-3, "weight_decay": 0.0},
+        "lr_scheduler": {"warmup_steps": 1, "decay_steps": 16, "style": "cosine"},
+        "step_scheduler": {"max_steps": 10, "ckpt_every_steps": 5, "num_epochs": 2},
+        "checkpoint": {
+            "enabled": True,
+            "checkpoint_dir": str(tmp_path / "ckpt"),
+            "async_save": False,
+        },
+        "loss": {"chunk_size": 32},
+    }
+    node = ConfigNode(cfg)
+    for k, v in over.items():
+        node.set(k, v)
+    return node
+
+
+def _run(cfg):
+    from automodel_tpu.cli.app import resolve_recipe_class
+
+    recipe = resolve_recipe_class(cfg)(cfg)
+    recipe.setup()
+    recipe.run_train_validation_loop()
+    recs = [
+        json.loads(l)
+        for l in open(os.path.join(cfg.get("run_dir"), "training.jsonl"))
+        if l.strip()
+    ]
+    return recipe, recs
+
+
+@pytest_recipe
+def test_nan_streak_rolls_back_and_converges(tmp_path):
+    """Injected NaN params at step 6: the detector rolls back to the step-4
+    snapshot, the offending window is skipped, and the run converges into
+    the clean curve's final-loss window — vs today's alternative of either
+    dying or silently skipping steps 6..10."""
+    _, clean = _run(_smoke_cfg(tmp_path / "clean", **{"step_scheduler.max_steps": 8}))
+    recipe, recs = _run(_smoke_cfg(
+        tmp_path / "chaos",
+        **{
+            "step_scheduler.max_steps": 8,
+            "skip_nonfinite_updates": True,
+            "resilience": {
+                "snapshot_every_steps": 2,
+                "max_rollbacks": 2,
+                "faults": [{"point": "nan_grads", "step": 6}],
+            },
+        },
+    ))
+    events = [r for r in recs if r.get("event") == "rollback"]
+    assert len(events) == 1 and events[0]["reason"] == "nonfinite"
+    assert events[0]["step"] == 6 and events[0]["restored_step"] in (4, 6 - 2)
+    assert recipe.rollback.stats.rollbacks == 1
+    assert recipe.rollback.stats.wasted_steps >= 1
+    steps = [r for r in recs if "loss" in r]
+    assert steps[-1]["step"] == 8
+    # every post-recovery loss is finite and the run lands in the clean
+    # curve's final-loss window (one batch was skipped → not identical)
+    post = [r["loss"] for r in steps if r["step"] > 6]
+    assert post and all(np.isfinite(l) for l in post)
+    clean_final = [r["loss"] for r in clean if "loss" in r][-1]
+    assert abs(steps[-1]["loss"] - clean_final) < 0.25 * abs(clean_final) + 0.1
+    # goodput counters rode the records
+    assert steps[-1]["rollbacks"] == 1 and steps[-1]["wasted_steps"] >= 1
+
+
+@pytest_recipe
+def test_diverged_run_fails_fast_without_rollback(tmp_path):
+    """The satellite bugfix: skip_nonfinite_updates alone used to skip every
+    step of a diverged run to completion; now the streak cap fails loudly,
+    naming the first bad step."""
+    cfg = _smoke_cfg(
+        tmp_path,
+        **{
+            "skip_nonfinite_updates": True,
+            "resilience": {
+                "max_consecutive_nonfinite": 3,
+                # persistent poison: every step from 3 on is non-finite
+                "faults": [{"point": "nan_grads", "step": 3}],
+            },
+        },
+    )
+    from automodel_tpu.cli.app import resolve_recipe_class
+
+    recipe = resolve_recipe_class(cfg)(cfg)
+    recipe.setup()
+    with pytest.raises(ResilienceError, match="first bad step: 3"):
+        recipe.run_train_validation_loop()
+
+
+@pytest_recipe
+def test_recipe_checkpoint_write_fault_retried_and_counted(tmp_path):
+    """A transient checkpoint-write fault mid-run is absorbed by the retry
+    layer; the attempt count flows through MetricLogger into the JSONL."""
+    recipe, recs = _run(_smoke_cfg(
+        tmp_path,
+        **{
+            "step_scheduler.max_steps": 6,
+            "step_scheduler.ckpt_every_steps": 3,
+            "resilience": {
+                "retry_attempts": 3,
+                "retry_base_delay_s": 0.0,
+                "faults": [{"point": "checkpoint_write", "call": 1, "times": 2}],
+            },
+        },
+    ))
+    steps = [r for r in recs if "loss" in r]
+    assert steps[-1]["step"] == 6
+    assert max(r.get("retry_checkpoint_write", 0) for r in recs) == 2
+    assert sorted(
+        int(d) for d in os.listdir(recipe.cfg.get("checkpoint.checkpoint_dir"))
+        if d.isdigit()
+    ) == [3, 6]
+
+
+@pytest_recipe
+@pytest.mark.slow  # the subprocess kill-and-resume test (test_kill_resume.py,
+# tier-1) pins the REAL-signal version of this path end-to-end; this variant
+# adds the flag-injected simulation for debugging without processes
+def test_recipe_sigterm_fault_emergency_checkpoint_and_resume(tmp_path):
+    """Injected SIGTERM at step 3 → emergency checkpoint (grace-deadline
+    wait) → a fresh recipe auto-resumes and reports time_to_resume_s."""
+    cfg = _smoke_cfg(
+        tmp_path,
+        **{
+            "checkpoint.async_save": True,
+            "resilience": {"faults": [{"point": "sigterm", "step": 3}]},
+        },
+    )
+    _, recs = _run(cfg)
+    steps = [r["step"] for r in recs if "loss" in r]
+    assert steps[-1] == 3
+    ev = [r for r in recs if r.get("event") == "emergency_checkpoint"]
+    assert ev and ev[0]["committed"] and ev[0]["step"] == 3
+
+    cfg2 = _smoke_cfg(tmp_path, **{"checkpoint.async_save": True})
+    recipe2, recs2 = _run(cfg2)
+    steps2 = [r for r in recs2 if "loss" in r]
+    assert steps2[-1]["step"] == 10
+    resumed_first = next(r for r in steps2 if r["step"] == 4)
+    assert resumed_first["time_to_resume_s"] > 0

@@ -232,6 +232,106 @@ def test_defrag_preserves_decode():
         assert [int(t) for t in np.asarray(ref)[0, len(p):]] == req.generated
 
 
+_OVERLOAD = dict(
+    page_size=2, num_pages=8, max_slots=2, pages_per_slot=8,
+    token_budget=8, prefill_chunk=4,
+)
+
+
+def _overload_stream(deadline):
+    """A pool-hogging request (grows toward the WHOLE pool) + a smaller
+    late joiner: together they oversubscribe the pool, so the stream only
+    progresses by preempt-and-requeue churn until one of them leaves."""
+    hog_prompt, blocked_prompt = _ragged_prompts([8, 6], seed0=90)
+    return (
+        Request(prompt=list(hog_prompt), max_new_tokens=8, deadline=deadline),
+        Request(prompt=list(blocked_prompt), max_new_tokens=3, arrival=1),
+        blocked_prompt,
+    )
+
+
+def test_deadline_evicts_pool_hog_from_stalled_stream():
+    """Graceful degradation under overload: the hog's deadline evicts it
+    mid-generation (pages freed, reported `timed_out`) instead of occupying
+    pool pages for the rest of its decode; the co-resident request then
+    runs without further churn and its output keeps exact greedy parity."""
+    params = decoder.init(CFG, jax.random.key(0))
+    engine = ServingEngine(params, CFG, ServingConfig(**_OVERLOAD))
+    hog, blocked, blocked_prompt = _overload_stream(deadline=6)
+    res = engine.serve_batch([hog, blocked])
+    stats = res["stats"]
+    assert stats["timed_out"] == 1 and stats["requests"] == 2
+    a, b = res["requests"]
+    assert a.finish_reason == "timed_out" and a.finished_at == 6
+    assert 0 < len(a.generated) < 8  # partial generation survives eviction
+    # the surviving request matches the batch-synchronous path exactly
+    ref = generate(
+        params, CFG, jnp.asarray([blocked_prompt], jnp.int32), jax.random.key(0),
+        GenerateConfig(max_new_tokens=3),
+    )
+    assert b.finish_reason == "length"
+    assert [int(t) for t in np.asarray(ref)[0, len(blocked_prompt):]] == b.generated
+    assert res["stats"]["compiled_signatures"] == 1
+    # eviction relieved the overload: strictly fewer engine steps than the
+    # churning no-deadline run of the same stream (see companion test)
+    assert b.finished_at <= 11
+
+
+def test_no_deadline_same_stream_churns_but_completes():
+    """The same overload stream WITHOUT a deadline completes only through
+    preempt-and-requeue churn (the victim re-prefills from scratch), and
+    the smaller request finishes AFTER the hog despite needing 3 tokens —
+    the latency cliff the per-request deadline bounds."""
+    params = decoder.init(CFG, jax.random.key(0))
+    engine = ServingEngine(params, CFG, ServingConfig(**_OVERLOAD))
+    hog, blocked, _ = _overload_stream(deadline=None)
+    res = engine.serve_batch([hog, blocked])
+    assert res["stats"]["timed_out"] == 0
+    a, b = res["requests"]
+    assert a.finish_reason == "length" and len(a.generated) == 8
+    assert b.preemptions >= 1            # pool churn, recompute-style
+    assert b.finished_at > a.finished_at  # 3-token request served LAST
+
+
+def test_deadline_fast_forward_never_skips_a_future_arrival():
+    """The serve loop's idle fast-forward to the next deadline must not
+    jump PAST a future arrival — the request would be expired without ever
+    getting its window to run."""
+    params = decoder.init(CFG, jax.random.key(0))
+    engine = ServingEngine(params, CFG, ServingConfig(
+        page_size=4, num_pages=16, max_slots=2, pages_per_slot=4,
+        token_budget=8,
+    ))
+    (prompt,) = _ragged_prompts([5], seed0=95)
+    res = engine.serve_batch([
+        Request(prompt=list(prompt), max_new_tokens=3, arrival=5, deadline=100),
+    ])
+    (req,) = res["requests"]
+    assert req.finish_reason == "length" and len(req.generated) == 3
+    assert res["stats"]["timed_out"] == 0
+
+
+def test_deadline_expires_waiting_request_without_pages():
+    """A request whose deadline passes while it is still QUEUED leaves with
+    zero generated tokens and never touches the pool."""
+    from automodel_tpu.serving.scheduler import Scheduler
+
+    sched = Scheduler(
+        num_pages=8, page_size=2, max_slots=1, pages_per_slot=8,
+        token_budget=8,
+    )
+    sched.submit(Request(prompt=[1, 2, 3, 4, 5, 6], max_new_tokens=6))
+    sched.submit(Request(prompt=[7, 8], max_new_tokens=2, deadline=2))
+    free0 = sched.alloc.num_free
+    plan = sched.schedule(0)  # only the first request admits (max_slots=1)
+    assert plan is not None and len(sched.running) == 1
+    sched.schedule(3)  # past the waiter's deadline
+    timed_out = [r for r in sched.finished if r.finish_reason == "timed_out"]
+    assert len(timed_out) == 1 and timed_out[0].generated == []
+    assert sched.n_timed_out == 1
+    assert sched.alloc.num_free < free0  # only the running request holds pages
+
+
 def test_het_engine_rejected():
     from automodel_tpu.serving.engine import ServingEngine as SE
 
