@@ -26,6 +26,12 @@ YAML:
       eos_token_id: null
       arrival_stride: 2               # admit 1 request per N engine steps
       max_prompt_len: null
+      admission_policy: fifo          # fifo | prefix-hit (needs the cache)
+      prefix_cache:                   # typed: PrefixCacheConfig
+        enabled: false
+        max_pages: null               # cap on cached pages (null → pool)
+        eviction: lru                 # lru | fifo
+        share_partial: true           # COW-adopt a mid-page divergence
     max_requests: 64
 """
 
@@ -112,6 +118,8 @@ class ServeRecipe(TrainFinetuneRecipeForNextTokenPrediction):
             ),
             top_k=(int(get("top_k", 0)) or None),
             top_p=(float(get("top_p", 0.0)) or None),
+            prefix_cache=self.typed.serving_prefix_cache,
+            admission_policy=str(get("admission_policy", "fifo")),
         )
         params = self.train_state.params
         if self.peft_cfg is not None:

@@ -132,6 +132,19 @@ class RecipeConfig:
         return self._cache[key]
 
     @property
+    def serving_prefix_cache(self):
+        """`serving.prefix_cache` section → PrefixCacheConfig (defaults to
+        disabled when the section is absent)."""
+        from automodel_tpu.serving.prefix_cache import PrefixCacheConfig
+
+        key = ("serving.prefix_cache", "PrefixCacheConfig")
+        if key not in self._cache:
+            node = self.raw.get("serving")
+            sub = node.get("prefix_cache") if node is not None else None
+            self._cache[key] = dataclass_from_node(PrefixCacheConfig, sub)
+        return self._cache[key]
+
+    @property
     def packing(self) -> Optional[Any]:
         node = self.raw.get("packing")
         if node is None:

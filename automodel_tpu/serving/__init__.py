@@ -1,17 +1,28 @@
 """Continuous-batching serving engine over a paged KV cache.
 
-- kv_pages.py:  global page pool + per-request page tables (GQA + MLA)
-- scheduler.py: admission / chunked-prefill / preemption scheduling
-- engine.py:    the jitted fixed-shape step + serve_batch() host loop
+- kv_pages.py:     global refcounted page pool + per-request page tables
+                   (GQA + MLA layouts, copy-on-write sharing)
+- prefix_cache.py: radix tree over known tokens at page granularity —
+                   cross-request prefix sharing + LRU reclaim
+- scheduler.py:    admission / chunked-prefill / preemption scheduling
+- engine.py:       the jitted fixed-shape step + serve_batch() host loop
 - ops/paged_attention.py holds the ragged paged-attention op it runs on.
 """
 
 from automodel_tpu.serving.engine import Request, ServingConfig, ServingEngine
 from automodel_tpu.serving.kv_pages import PageAllocator, pages_for
+from automodel_tpu.serving.prefix_cache import (
+    PrefixCache,
+    PrefixCacheConfig,
+    PrefixMatch,
+)
 from automodel_tpu.serving.scheduler import Scheduler, StepPlan
 
 __all__ = [
     "PageAllocator",
+    "PrefixCache",
+    "PrefixCacheConfig",
+    "PrefixMatch",
     "Request",
     "Scheduler",
     "ServingConfig",

@@ -63,6 +63,7 @@ from automodel_tpu.ops.norms import rms_norm
 from automodel_tpu.ops.quant import matmul as _mm
 from automodel_tpu.ops.rope import rope_frequencies
 from automodel_tpu.serving.kv_pages import apply_defrag, init_pool
+from automodel_tpu.serving.prefix_cache import PrefixCacheConfig
 from automodel_tpu.serving.scheduler import Request, Scheduler, StepPlan
 
 
@@ -80,6 +81,10 @@ class ServingConfig:
     prefill_chunk: int | None = None  # ≤ token_budget; None → token_budget
     top_k: int | None = None
     top_p: float | None = None
+    # prefix sharing (serving/prefix_cache.py): refcounted COW pages + a
+    # radix tree over known tokens; None/disabled → PR-2 behavior exactly
+    prefix_cache: PrefixCacheConfig | None = None
+    admission_policy: str = "fifo"  # "fifo" | "prefix-hit"
 
     def __post_init__(self):
         assert self.page_size >= 1 and self.num_pages >= 1
@@ -87,6 +92,9 @@ class ServingConfig:
         assert self.pages_per_slot >= 1
         if self.prefill_chunk is not None:
             assert 1 <= self.prefill_chunk <= self.token_budget
+        assert self.admission_policy in ("fifo", "prefix-hit")
+        if self.admission_policy == "prefix-hit":
+            assert self.prefix_cache is not None and self.prefix_cache.enabled
 
 
 class ServingEngine:
@@ -225,6 +233,12 @@ class ServingEngine:
         # position is -1, so they attend to nothing
         b = dict(b)
         b["pt_tok"] = b["page_tables"][jnp.maximum(b["slot"], 0)]
+        # copy-on-write splits first (≤ 1 per slot; idle entries copy the
+        # trash page onto itself): a slot about to append into a page some
+        # other table or the radix tree still reads gets a private copy
+        pool = jax.tree.map(
+            lambda a: a.at[:, b["cow_dst"]].set(a[:, b["cow_src"]]), pool
+        )
         h = _embed(params, cfg, b["tok"][None])  # (1, T, H)
 
         new_pool = []
@@ -287,6 +301,8 @@ class ServingEngine:
             "sample_tok": jnp.asarray(plan.sample_tok),
             "temp": jnp.asarray(plan.temp),
             "seed": jnp.asarray(plan.seed),
+            "cow_src": jnp.asarray(plan.cow_src),
+            "cow_dst": jnp.asarray(plan.cow_dst),
         }
         self.pool, tokens, lps = self._step(self.params, self.pool, batch)
         self.steps_run += 1
@@ -298,6 +314,8 @@ class ServingEngine:
             num_pages=sc.num_pages, page_size=sc.page_size,
             max_slots=sc.max_slots, pages_per_slot=sc.pages_per_slot,
             token_budget=sc.token_budget, prefill_chunk=sc.prefill_chunk,
+            prefix_cache=sc.prefix_cache,
+            admission_policy=sc.admission_policy,
         )
 
     def defrag(self, scheduler: Scheduler) -> bool:
@@ -410,6 +428,14 @@ class ServingEngine:
             "timed_out": sched.n_timed_out,
             "compiled_signatures": self.step_cache_size(),
         }
+        if sched.prefix is not None:
+            stats.update({
+                "prefix_hits": sched.n_prefix_hits,
+                "prefill_skipped_tokens": sched.prefill_skipped,
+                "cow_copies": sched.n_cow,
+                "prefix_cached_pages": sched.prefix.cached_pages,
+                "prefix_evicted_pages": sched.prefix.n_evicted,
+            })
         if metric_logger is not None:
             metric_logger.log({"step": self.steps_run, **{
                 f"serve_{k}": v for k, v in stats.items()

@@ -29,6 +29,16 @@ request — but long-lived mixed workloads scatter live pages across the
 pool; compaction moves them to a dense prefix so the tail can be released
 or checkpointed cheaply). It returns a gather plan `apply_defrag` executes
 on the device arrays in one indexed copy.
+
+Pages are REFCOUNTED (prefix sharing, serving/prefix_cache.py): the same
+pool page may appear in many slots' dense-prefix tables (a shared system
+prompt's KV is stored once) and be pinned by the radix tree over known
+tokens. `adopt` maps existing pages into a fresh slot's table, `free_slot`
+only returns a page to the free list when its last reference drops, and
+`cow` gives a slot a private copy-on-write replacement before it appends
+into a page someone else can still read. `defrag_plan` moves a shared page
+ONCE and patches every referencing table (plus any registered remap
+listener — the radix tree keeps its node→page map current this way).
 """
 
 from __future__ import annotations
@@ -55,6 +65,11 @@ class PageAllocator:
         # LIFO free list: recently freed (still-warm) pages are reused first
         self._free: list[int] = list(range(self.num_pages - 1, -1, -1))
         self._tables: dict[int, list[int]] = {}
+        # page → reference count; absent == 0 == on the free list. A page is
+        # referenced once per table that lists it plus once if the prefix
+        # cache's radix tree pins it (incref/decref).
+        self._refs: dict[int, int] = {}
+        self._remap_listeners: list = []
 
     @property
     def num_free(self) -> int:
@@ -63,39 +78,108 @@ class PageAllocator:
     def table(self, slot: int) -> list[int]:
         return self._tables.get(slot, [])
 
-    def ensure(self, slot: int, num_tokens: int) -> bool:
+    def refcount(self, page: int) -> int:
+        return self._refs.get(page, 0)
+
+    def incref(self, page: int) -> None:
+        """Take an extra reference on an allocated page (radix-tree pin or
+        cross-slot sharing)."""
+        if page not in self._refs:
+            raise ValueError(f"incref of free page {page}")
+        self._refs[page] += 1
+
+    def decref(self, page: int) -> None:
+        """Drop one reference; the last drop returns the page to the free
+        list (LIFO, so the still-warm page is reused first)."""
+        r = self._refs[page] - 1
+        if r == 0:
+            del self._refs[page]
+            self._free.append(page)
+        else:
+            self._refs[page] = r
+
+    def _alloc_page(self) -> int:
+        p = self._free.pop()
+        self._refs[p] = 1
+        return p
+
+    def ensure(self, slot: int, num_tokens: int, reclaim=None) -> bool:
         """Grow `slot`'s table to cover `num_tokens` positions. Returns False
         (allocating nothing) when the pool cannot cover the growth — the
-        scheduler then preempts or stalls."""
+        scheduler then preempts or stalls. `reclaim(n)`, when given, is asked
+        to free up to n more pages ONLY once the free list is short — cached
+        prefix pages are reclaimed strictly behind truly-free pages."""
         table = self._tables.setdefault(slot, [])
         need = pages_for(num_tokens, self.page_size) - len(table)
         if need <= 0:
             return True
+        if need > len(self._free) and reclaim is not None:
+            reclaim(need - len(self._free))
         if need > len(self._free):
             return False
-        table.extend(self._free.pop() for _ in range(need))
+        table.extend(self._alloc_page() for _ in range(need))
         return True
+
+    def adopt(self, slot: int, pages: list[int]) -> None:
+        """Map already-allocated (shared) pages into the dense prefix of a
+        fresh slot's table, taking a reference on each — the admission path
+        of a radix-tree prefix hit."""
+        table = self._tables.setdefault(slot, [])
+        if table:
+            raise ValueError(f"adopt into non-empty table of slot {slot}")
+        for p in pages:
+            self.incref(p)
+        table.extend(pages)
+
+    def cow(self, slot: int, index: int):
+        """Copy-on-write: repoint `slot`'s table entry `index` (a page some
+        other table or the radix tree still references) at a fresh page and
+        drop the shared reference. Returns (src, dst) for the one-page device
+        copy the engine step executes, or None when the page was exclusive
+        (write in place). Needs one free page — the caller reclaims/preempts
+        first."""
+        table = self._tables[slot]
+        old = table[index]
+        if self._refs[old] <= 1:
+            return None
+        if not self._free:
+            raise RuntimeError("cow needs a free page; reclaim/preempt first")
+        new = self._alloc_page()
+        table[index] = new
+        self.decref(old)
+        return old, new
 
     def free_slot(self, slot: int) -> None:
         for p in self._tables.pop(slot, []):
-            self._free.append(p)
+            self.decref(p)
+
+    def register_remap_listener(self, fn) -> None:
+        """`fn(mapping: dict[old_page, new_page])` is called whenever defrag
+        renumbers pages, so holders of page ids outside the slot tables (the
+        radix tree) stay consistent."""
+        self._remap_listeners.append(fn)
 
     def defrag_plan(self):
         """Compact live pages to a dense prefix. Rewrites the host tables in
         place and returns (src, n_live): `src` (num_pages,) int32 where
         new page i must be copied from old page src[i] (identity past
         n_live) — feed to `apply_defrag`. Returns None when already compact.
-        """
-        live = sorted(p for t in self._tables.values() for p in t)
+        A multiply-referenced page is moved ONCE (one mapping entry, one
+        device copy) and every table listing it is patched; remap listeners
+        fire so the radix tree follows."""
+        live = sorted(self._refs)  # every page any table or the tree holds
         if live == list(range(len(live))):
             return None
         mapping = {old: new for new, old in enumerate(live)}
         for table in self._tables.values():
             table[:] = [mapping[p] for p in table]
+        self._refs = {mapping[p]: r for p, r in self._refs.items()}
         src = list(range(self.num_pages))
         for old, new in mapping.items():
             src[new] = old
         self._free = list(range(self.num_pages - 1, len(live) - 1, -1))
+        for fn in self._remap_listeners:
+            fn(mapping)
         return jnp.asarray(src, jnp.int32), len(live)
 
 

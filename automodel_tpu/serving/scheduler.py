@@ -26,6 +26,22 @@ counter (tokens whose KV is written). Prefill, decode, and post-preemption
 re-prefill are all "feed known[fed:fed+c]"; a step that feeds the LAST
 known token samples the next one from its logits. No phase flags.
 
+Prefix sharing (serving/prefix_cache.py, opt-in): admission walks a radix
+tree over known tokens at page granularity; matched pages are adopted
+straight into the new slot's table and `fed` starts past them, so prefill
+begins at the divergence point (a full hit's first step is already a
+decode row). Running requests donate each newly COMPLETED full page, so
+even concurrent requests share; finished/preempted/expired ones donate on
+release. A slot about to append into a still-shared page gets a
+copy-on-write replacement (`StepPlan.cow_src/cow_dst` carries the one-page
+device copy). Cached-but-unreferenced pages are reclaimed (LRU) strictly
+behind the free list, so admission-by-free-pages and preempt-and-requeue
+keep working. The radix match also enables the first non-FIFO admission
+policy, `admission_policy="prefix-hit"`: when the pool is too tight for
+the queue head, prefer the arrived waiter with the highest hit ratio —
+it adds decode load with the least prefill work, protecting decode
+latency (the SLO currency) while the pool is contended.
+
 The scheduler owns request/page state only; it never touches device
 memory — it emits a `StepPlan` of numpy arrays the engine uploads.
 """
@@ -38,6 +54,11 @@ from collections import deque
 import numpy as np
 
 from automodel_tpu.serving.kv_pages import PageAllocator, pages_for
+from automodel_tpu.serving.prefix_cache import (
+    PrefixCache,
+    PrefixCacheConfig,
+    PrefixMatch,
+)
 
 
 @dataclasses.dataclass
@@ -64,6 +85,8 @@ class Request:
     admitted_at: int = -1
     finished_at: int = -1
     finish_reason: str | None = None
+    prefix_hit_tokens: int = 0  # prefill tokens skipped via the radix cache
+    donated_pages: int = 0      # full pages already offered to the tree
 
     @property
     def known(self) -> list:
@@ -87,6 +110,9 @@ class StepPlan:
     sample_tok: np.ndarray   # (S,) int32 row to sample from, -1 = no sample
     temp: np.ndarray         # (S,) float32 per-slot temperature
     seed: np.ndarray         # (S,) int32 per-slot base seed
+    # copy-on-write page copies (≤ 1 per slot per step; trash→trash = no-op)
+    cow_src: np.ndarray = None  # (S,) int32 source page
+    cow_dst: np.ndarray = None  # (S,) int32 destination page
     scheduled: list = dataclasses.field(default_factory=list)
     # scheduled: [(slot, n_tokens, samples: bool)] — host bookkeeping
 
@@ -111,6 +137,8 @@ class Scheduler:
         pages_per_slot: int,
         token_budget: int,
         prefill_chunk: int | None = None,
+        prefix_cache: PrefixCacheConfig | None = None,
+        admission_policy: str = "fifo",
     ):
         self.alloc = PageAllocator(num_pages, page_size)
         self.page_size = page_size
@@ -119,6 +147,18 @@ class Scheduler:
         self.token_budget = token_budget
         self.prefill_chunk = prefill_chunk or token_budget
         self.trash_page = num_pages  # pool arrays carry num_pages + 1 pages
+        if admission_policy not in ("fifo", "prefix-hit"):
+            raise ValueError(f"unknown admission_policy {admission_policy!r}")
+        if admission_policy == "prefix-hit" and not (
+            prefix_cache and prefix_cache.enabled
+        ):
+            raise ValueError("admission_policy='prefix-hit' needs the prefix cache")
+        self.admission_policy = admission_policy
+        self.prefix: PrefixCache | None = (
+            PrefixCache(self.alloc, page_size, prefix_cache)
+            if prefix_cache is not None and prefix_cache.enabled
+            else None
+        )
         self.waiting: deque[Request] = deque()
         self.running: dict[int, Request] = {}   # slot → request
         self._admit_order: list[int] = []       # slots, oldest admit first
@@ -126,6 +166,9 @@ class Scheduler:
         self._next_rid = 0
         self.n_preemptions = 0
         self.n_timed_out = 0
+        self.n_cow = 0
+        self.n_prefix_hits = 0        # admissions that adopted cached pages
+        self.prefill_skipped = 0      # prompt tokens never re-prefilled
 
     # -- request lifecycle --------------------------------------------------
     def submit(self, req: Request) -> None:
@@ -155,16 +198,29 @@ class Scheduler:
     def has_work(self) -> bool:
         return bool(self.waiting or self.running)
 
+    def _match(self, req: Request) -> PrefixMatch:
+        if self.prefix is None:
+            return PrefixMatch(pages=[], fed=0, matched_tokens=0,
+                               cow_pending=False)
+        return self.prefix.lookup(req.known)
+
+    def _need(self, req: Request, match: PrefixMatch) -> int:
+        """Pages a fresh admit must still find: whole known sequence + 1
+        decode page of slack, minus adopted pages, plus one for the pending
+        copy-on-write split when the first write lands in a shared page."""
+        return (
+            pages_for(len(req.known) + 1, self.page_size)
+            - len(match.pages)
+            + (1 if match.cow_pending else 0)
+        )
+
     def _admit(self, step_idx: int) -> None:
         while self.waiting and len(self.running) < self.max_slots:
-            req = self.waiting[0]
-            if req.arrival > step_idx:
+            picked = self._pick_admission(step_idx)
+            if picked is None:
                 break
-            # admission by free pages: whole known sequence + 1 decode page
-            need = pages_for(len(req.known) + 1, self.page_size)
-            if need > self.alloc.num_free:
-                break
-            self.waiting.popleft()
+            i, req, match = picked
+            del self.waiting[i]
             slot = next(
                 s for s in range(self.max_slots) if s not in self.running
             )
@@ -172,26 +228,142 @@ class Scheduler:
             self._admit_order.append(slot)
             if req.admitted_at < 0:
                 req.admitted_at = step_idx
-        # FIFO admission: if the head doesn't fit, nothing behind it jumps
-        # the queue (no starvation of long prompts)
+            if match.pages:
+                # radix hit: the matched prefix's pages go straight into the
+                # slot's table and `fed` advances past them — prefill starts
+                # at the divergence point (full hit → first step is decode)
+                self.alloc.adopt(slot, match.pages)
+                req.fed = match.fed
+                req.prefix_hit_tokens += match.fed
+                req.donated_pages = (
+                    len(match.pages) - (1 if match.cow_pending else 0)
+                )
+                self.prefill_skipped += match.fed
+                self.n_prefix_hits += 1
+        # FIFO admission (default): if the head doesn't fit, nothing behind
+        # it jumps the queue (no starvation of long prompts). Under
+        # "prefix-hit", a tight pool admits the best-hit-ratio waiter
+        # instead — the head stays at the front and still goes first the
+        # moment it fits.
+
+    def _admissible(self, req: Request, avail: int) -> PrefixMatch | None:
+        """The match to admit `req` with, or None if it cannot fit. Adopting
+        a tree-only page PINS it — it stops counting as reclaimable — so the
+        radix hit only stands when `need` fits what would remain available
+        after adoption. When the warm admit does not fit but a cold one
+        would (the tree itself is hogging the pool), fall back to a cold
+        admission: the un-adopted cached pages stay evictable and the
+        pressure ladder reclaims them during prefill."""
+        match = self._match(req)
+        if match.pages:
+            pinned = sum(
+                1 for p in match.pages if self.alloc.refcount(p) == 1
+            )
+            if self._need(req, match) + pinned <= avail:
+                return match
+            match = PrefixMatch(pages=[], fed=0, matched_tokens=0,
+                                cow_pending=False)
+        if self._need(req, match) <= avail:
+            return match
+        return None
+
+    def _pick_admission(self, step_idx: int):
+        """Choose the next waiter: queue index, request, radix match.
+        FIFO fast path: the head, whenever it fits. The prefix-hit scan
+        below only runs while the pool is too tight for the head, so its
+        per-waiter radix walks stay off the uncontended hot path."""
+        avail = self.alloc.num_free + (
+            self.prefix.reclaimable() if self.prefix else 0
+        )
+        head = self.waiting[0]
+        if head.arrival <= step_idx:
+            match = self._admissible(head, avail)
+            if match is not None:
+                return 0, head, match
+        if self.admission_policy == "fifo":
+            return None
+        # pool too tight for the head (or head not arrived): prefer the
+        # arrived waiter with the highest hit ratio among those that fit
+        best = None
+        for i, req in enumerate(self.waiting):
+            if req.arrival > step_idx:
+                continue
+            match = self._admissible(req, avail)
+            if match is None:
+                continue
+            ratio = match.fed / max(len(req.known), 1)
+            key = (ratio, -i)  # tie → submission order
+            if best is None or key > best[0]:
+                best = (key, i, req, match)
+        return best[1:] if best is not None else None
+
+    def _donate(self, slot: int) -> None:
+        """Offer a slot's newly completed full pages to the radix tree (the
+        tree takes its own allocator reference, so the pages survive the
+        slot). Runs after every feed and on release — content below `fed`
+        is immutable, so a donated page can never change under the tree."""
+        if self.prefix is None:
+            return
+        req = self.running[slot]
+        full = req.fed // self.page_size
+        if full <= req.donated_pages:
+            return
+        self.prefix.insert(
+            req.known[: full * self.page_size],
+            self.alloc.table(slot)[:full],
+        )
+        req.donated_pages = full
+
+    def _release_slot(self, slot: int, donate: bool = True) -> Request:
+        """Remove a running request from its slot: donate its full pages to
+        the prefix tree (completion, preemption, and deadline eviction all
+        seed future hits), then drop the slot's references — shared pages
+        live on, exclusive ones return to the free list."""
+        if donate:
+            self._donate(slot)
+        req = self.running.pop(slot)
+        self._admit_order.remove(slot)
+        self.alloc.free_slot(slot)
+        return req
 
     def _preempt_youngest(self, protected) -> bool:
         """Free the youngest running request whose slot is not `protected`
         (the requester and every slot with rows already planned this step —
         their pages must not be recycled mid-step); requeue it at the queue
-        head, recompute-style. Returns False if no victim."""
+        head, recompute-style. Returns False if no victim. With the prefix
+        cache on, the victim's full pages were donated — its requeued
+        "re-prefill" is mostly a radix hit that re-adopts its own pages."""
         for slot in reversed(self._admit_order):
             if slot in protected:
                 continue
-            victim = self.running.pop(slot)
-            self._admit_order.remove(slot)
-            self.alloc.free_slot(slot)
+            victim = self._release_slot(slot)
             victim.fed = 0
+            victim.donated_pages = 0
             victim.preemptions += 1
             self.n_preemptions += 1
             self.waiting.appendleft(victim)
             return True
         return False
+
+    def _reclaim(self, n: int) -> int:
+        """Allocator reclaim hook: cached pages, strictly behind free ones."""
+        return self.prefix.reclaim(n) if self.prefix is not None else 0
+
+    def _ensure(self, slot: int, num_tokens: int, protected) -> bool:
+        """ensure() + the pool-pressure ladder: free list first, then evict
+        cached-but-unreferenced prefix pages (LRU), then preempt-and-requeue
+        the youngest unprotected request. False → stall this slot a step."""
+        while not self.alloc.ensure(slot, num_tokens, reclaim=self._reclaim):
+            if not self._preempt_youngest(protected):
+                return False
+        return True
+
+    def _free_page_for_cow(self, protected) -> bool:
+        """One free page for a copy-on-write split, same pressure ladder."""
+        while not (self.alloc.num_free >= 1 or self._reclaim(1) >= 1):
+            if not self._preempt_youngest(protected):
+                return False
+        return True
 
     def _expire_deadlines(self, step_idx: int) -> None:
         """Evict requests whose deadline has passed — running requests free
@@ -204,9 +376,7 @@ class Scheduler:
                 req.finish_reason = "timed_out"
                 req.finished_at = step_idx
                 self.finished.append(req)
-                del self.running[slot]
-                self._admit_order.remove(slot)
-                self.alloc.free_slot(slot)
+                self._release_slot(slot)
                 self.n_timed_out += 1
         expired = [
             r for r in self.waiting
@@ -248,6 +418,8 @@ class Scheduler:
             sample_tok=np.full(S, -1, np.int32),
             temp=np.zeros(S, np.float32),
             seed=np.zeros(S, np.int32),
+            cow_src=np.full(S, self.trash_page, np.int32),
+            cow_dst=np.full(S, self.trash_page, np.int32),
         )
         row = 0
         planned = set()
@@ -264,15 +436,21 @@ class Scheduler:
             c = min(pending, T - row, self.prefill_chunk)
             if c <= 0:
                 continue
-            if not self.alloc.ensure(slot, req.fed + c):
-                # pool exhausted: preempt-and-requeue until it fits (or stall
-                # this slot for the step if no preemptible victim is left)
-                while not self.alloc.ensure(slot, req.fed + c):
-                    if not self._preempt_youngest(planned | {slot}):
-                        c = 0
-                        break
-                if c == 0:
+            # pool exhausted → the pressure ladder (reclaim cached pages,
+            # then preempt-and-requeue); stall this slot a step if dry
+            if not self._ensure(slot, req.fed + c, planned | {slot}):
+                continue
+            # copy-on-write on divergence: the first write of this chunk
+            # lands in a page another table or the radix tree still reads —
+            # give the slot a private copy (one-page device copy in-plan)
+            first_page = req.fed // self.page_size
+            if self.alloc.refcount(self.alloc.table(slot)[first_page]) > 1:
+                if not self._free_page_for_cow(planned | {slot}):
                     continue
+                pair = self.alloc.cow(slot, first_page)
+                if pair is not None:  # the ladder may have dropped the share
+                    plan.cow_src[slot], plan.cow_dst[slot] = pair
+                    self.n_cow += 1
             planned.add(slot)
             table = self.alloc.table(slot)
             for j in range(c):
@@ -301,6 +479,9 @@ class Scheduler:
         for slot, c, samples in plan.scheduled:
             req = self.running[slot]
             req.fed += c
+            # donate every newly completed full page while still running, so
+            # CONCURRENT requests with the same prefix share immediately
+            self._donate(slot)
             if not samples:
                 continue
             tok = int(sampled[slot])
@@ -312,6 +493,4 @@ class Scheduler:
             if req.done:
                 req.finished_at = step_idx
                 self.finished.append(req)
-                del self.running[slot]
-                self._admit_order.remove(slot)
-                self.alloc.free_slot(slot)
+                self._release_slot(slot)

@@ -153,8 +153,8 @@ def main() -> None:
     ap.add_argument(
         "--no-headline", action="store_true",
         help="emit only the llama-MFU metric (skip the flash-vs-XLA, MoE "
-        "dropless, long-context CP, and serving-decode probes that ride "
-        "the same window)",
+        "dropless, long-context CP, serving-decode, prefix-cache, and "
+        "resilience probes that ride the same window)",
     )
     args = ap.parse_args()
 
@@ -589,6 +589,101 @@ def _headline_decode(accel: bool) -> dict:
     }
 
 
+def _headline_prefix(accel: bool) -> dict:
+    """Prefix cache: prefill tokens skipped (hit ratio) + sustained decode
+    tokens/s on a shared-system-prompt agent-loop workload — K agents each
+    re-sending their whole growing history every round (the traffic shape
+    the radix tree exists for) — against the cache-DISABLED engine on the
+    identical stream. Rides the same probe window as the other headlines."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from automodel_tpu.models.llm import decoder
+    from automodel_tpu.models.llm.decoder import TransformerConfig
+    from automodel_tpu.serving import (
+        PrefixCacheConfig,
+        Request,
+        ServingConfig,
+        ServingEngine,
+    )
+
+    if accel:
+        cfg = TransformerConfig(
+            vocab_size=32768, hidden_size=1024, intermediate_size=4096,
+            num_layers=8, num_heads=16, num_kv_heads=8,
+            rope_theta=500000.0, dtype=jnp.bfloat16, remat_policy="none",
+            attn_impl="auto",
+        )
+        geo = dict(page_size=16, num_pages=4096, max_slots=16,
+                   pages_per_slot=128, token_budget=64, prefill_chunk=48)
+        sys_len, turn_len, agents, rounds, max_new = 256, 32, 4, 4, 32
+        arrival_stride = 40
+    else:
+        cfg = TransformerConfig(
+            vocab_size=512, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=4, num_kv_heads=2,
+            dtype=jnp.float32, remat_policy="none", attn_impl="xla",
+        )
+        geo = dict(page_size=4, num_pages=256, max_slots=4,
+                   pages_per_slot=32, token_budget=16, prefill_chunk=8)
+        sys_len, turn_len, agents, rounds, max_new = 24, 6, 3, 4, 8
+        arrival_stride = 12
+    params = decoder.init(cfg, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    system = [int(t) for t in rng.integers(1, cfg.vocab_size, (sys_len,))]
+
+    # agent loops: every round re-sends system + the whole history so far;
+    # rounds are staggered so earlier rounds complete (and donate) first
+    reqs = []
+    for a in range(agents):
+        hist = list(system)
+        for r in range(rounds):
+            hist = hist + [
+                int(t) for t in rng.integers(1, cfg.vocab_size, (turn_len,))
+            ]
+            reqs.append(Request(
+                prompt=list(hist), max_new_tokens=max_new,
+                arrival=r * arrival_stride + a,
+            ))
+    total_prompt = sum(len(r.prompt) for r in reqs)
+
+    def run(prefix_cfg):
+        engine = ServingEngine(params, cfg, ServingConfig(
+            **geo, prefix_cache=prefix_cfg,
+        ))
+        # warmup compiles the single step signature outside the timed window
+        engine.serve_batch([Request(prompt=[1, 2, 3], max_new_tokens=2)])
+        return engine.serve_batch([
+            Request(prompt=list(r.prompt), max_new_tokens=r.max_new_tokens,
+                    arrival=r.arrival)
+            for r in reqs
+        ])["stats"]
+
+    cold = run(None)
+    warm = run(PrefixCacheConfig(enabled=True))
+    assert warm["compiled_signatures"] == 1, warm
+    skipped = warm["prefill_skipped_tokens"]
+    return {
+        "prefill_skipped_tokens": skipped,
+        "prefill_hit_ratio": round(skipped / max(total_prompt, 1), 4),
+        "tokens_per_sec": warm["decode_tokens_per_sec"],
+        "tokens_per_sec_nocache": cold["decode_tokens_per_sec"],
+        "elapsed_s": warm["elapsed_s"],
+        "elapsed_s_nocache": cold["elapsed_s"],
+        "tokens_fed": warm["tokens_fed"],
+        "tokens_fed_nocache": cold["tokens_fed"],
+        "cow_copies": warm["cow_copies"],
+        "prefix_hits": warm["prefix_hits"],
+        "config": {
+            "agents": agents, "rounds": rounds, "system_len": sys_len,
+            "turn_len": turn_len, "max_new_tokens": max_new,
+            "requests": len(reqs), "total_prompt_tokens": total_prompt,
+            **geo,
+        },
+    }
+
+
 def _headline_resilience(accel: bool) -> dict:
     """Goodput under one injected preemption: a tiny train run is
     SIGTERM'd (via the deterministic fault injector) at mid-run, emergency-
@@ -682,6 +777,7 @@ def _run_headline(accel: bool) -> dict:
         ("moe_dropless_step", _headline_moe),
         ("cp_long_context_step", _headline_cp),
         ("decode", _headline_decode),
+        ("prefix", _headline_prefix),
         ("resilience", _headline_resilience),
     ):
         try:

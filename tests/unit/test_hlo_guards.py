@@ -160,8 +160,12 @@ def test_hlo_guard_paged_decode_step():
     stay GATHERS (page-table indexed; a regression to per-request dense
     caches would spike dynamic-slice / blow the gather count), pool writes
     stay O(stacks) in-place updates, and a single-process step must emit NO
-    collectives. Counts are per compiled program structure (the layer scan
-    compiles once), pinned exactly like the budgets above."""
+    collectives. The prefix-hit path rides the SAME program — cross-request
+    page sharing is pure page-table indirection — plus the fixed-shape
+    copy-on-write block (cow_src/cow_dst, one bounded page copy per slot),
+    whose cost is pinned into the budgets below. Counts are per compiled
+    program structure (the layer scan compiles once), pinned exactly like
+    the budgets above."""
     from automodel_tpu.serving.engine import ServingConfig, ServingEngine
 
     cfg = dataclasses.replace(DENSE, pipeline_microbatches=1)
@@ -177,6 +181,8 @@ def test_hlo_guard_paged_decode_step():
         sample_tok=jnp.zeros(S, jnp.int32),
         temp=jnp.zeros(S, jnp.float32),
         seed=jnp.zeros(S, jnp.int32),
+        cow_src=jnp.zeros(S, jnp.int32),
+        cow_dst=jnp.zeros(S, jnp.int32),
     )
     compiled = eng._step.lower(eng.params, eng.pool, batch).compile()
     txt = compiled.as_text()
@@ -185,9 +191,12 @@ def test_hlo_guard_paged_decode_step():
         c: len(re.findall(rf"= (?:[\w\[\],<>:{{}} ]+ )?{c}\(", txt))
         for c in ops
     }
+    # re-pinned for the COW block: +2 gathers (read cow_src pages of k and
+    # v), +8 slice/update pairs scattering them to cow_dst — still O(pool
+    # leaves), independent of traffic, and collective-free
     _check(
         counts,
-        budget={"gather": 7, "dynamic-slice": 19, "dynamic-update-slice": 4,
+        budget={"gather": 9, "dynamic-slice": 27, "dynamic-update-slice": 6,
                 "all-gather": 0, "all-reduce": 0, "collective-permute": 0,
                 "all-to-all": 0, "ragged-all-to-all": 0},
         floors={"gather": 2},  # ≥ the paged k/v page gathers

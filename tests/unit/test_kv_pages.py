@@ -70,6 +70,79 @@ def test_defrag_compacts_live_pages():
     np.testing.assert_array_equal(np.asarray(moved[:, 8]), np.asarray(pool[0][:, 8]))
 
 
+def test_refcount_share_and_free():
+    """A page adopted into a second table frees only when the LAST
+    reference drops; incref/decref pin pages without any table."""
+    a = PageAllocator(num_pages=4, page_size=2)
+    a.ensure(0, 4)                      # slot 0: 2 pages
+    shared = list(a.table(0))
+    a.adopt(1, shared)                  # slot 1 maps the same pages
+    assert a.table(1) == shared
+    assert all(a.refcount(p) == 2 for p in shared)
+    a.free_slot(0)
+    assert a.num_free == 2              # nothing freed: slot 1 still reads
+    assert all(a.refcount(p) == 1 for p in shared)
+    a.incref(shared[0])                 # radix-tree style pin
+    a.free_slot(1)
+    assert a.num_free == 3 and a.refcount(shared[0]) == 1
+    a.decref(shared[0])
+    assert a.num_free == 4
+
+
+def test_cow_splits_shared_page():
+    a = PageAllocator(num_pages=4, page_size=2)
+    a.ensure(0, 4)
+    a.adopt(1, list(a.table(0)))
+    old = a.table(1)[1]
+    pair = a.cow(1, 1)
+    assert pair is not None and pair[0] == old
+    src, dst = pair
+    assert a.table(1)[1] == dst and a.table(0)[1] == old
+    assert a.refcount(old) == 1 and a.refcount(dst) == 1
+    # exclusive page → write in place, no copy
+    assert a.cow(1, 1) is None
+
+
+def test_ensure_reclaims_behind_free_list():
+    """The reclaim hook is consulted only once the free list is short."""
+    calls = []
+    a = PageAllocator(num_pages=3, page_size=2)
+
+    def reclaim(n):
+        calls.append(n)
+        return 0
+
+    assert a.ensure(0, 4, reclaim=reclaim)   # 2 pages, free list suffices
+    assert calls == []
+    assert not a.ensure(0, 8, reclaim=reclaim)  # needs 2 more, 1 free
+    assert calls == [1]
+
+
+def test_defrag_moves_shared_page_once_and_patches_every_table():
+    """A multiply-referenced page gets ONE mapping entry (one device copy)
+    while every referencing table — and any remap listener, i.e. the radix
+    tree — sees the new index."""
+    a = PageAllocator(num_pages=8, page_size=2)
+    a.ensure(0, 4)                      # slot 0: pages 0, 1
+    a.ensure(2, 4)                      # slot 2: pages 2, 3
+    a.adopt(1, list(a.table(0)))        # slot 1 shares 0, 1
+    a.ensure(1, 6)                      # + one private page (4)
+    a.free_slot(2)                      # holes at 2, 3
+    seen = []
+    a.register_remap_listener(seen.append)
+    plan = a.defrag_plan()
+    assert plan is not None
+    src, n_live = plan
+    assert n_live == 3                  # 2 shared (once each) + 1 private
+    assert a.table(0) == a.table(1)[:2]  # sharing survives the move
+    assert sorted({p for t in (a.table(0), a.table(1)) for p in t}) == [0, 1, 2]
+    (mapping,) = seen
+    assert sorted(mapping.values()) == [0, 1, 2]
+    # shared pages keep their refcounts under the new numbering
+    assert all(a.refcount(p) == 2 for p in a.table(0))
+    assert a.num_free == 5
+
+
 def test_defrag_noop_when_compact():
     a = PageAllocator(num_pages=4, page_size=2)
     a.ensure(0, 4)
