@@ -1,0 +1,44 @@
+"""Static analysis: JAX hazard lint + compiled-program (HLO) baselines.
+
+Two prongs, one CI gate (``python -m automodel_tpu.analysis``):
+
+- :mod:`automodel_tpu.analysis.lint` — AST rules over the whole package for
+  JAX/TPU hazards (host sync inside jitted code, nondeterminism in compiled
+  paths, recompile hazards, missing donation, ``FaultCrash``-swallowing
+  exception handlers), with inline suppressions and a justified allowlist.
+- :mod:`automodel_tpu.analysis.hlo` — parse ``compiled.as_text()`` into a
+  structured report (collectives by kind and replica-group shape, gather /
+  dynamic-slice / DUS counts, bf16→f32 upcasts, host callbacks, donation
+  table, peak memory) and diff it against checked-in JSON baselines for the
+  five jitted entry points in :mod:`automodel_tpu.analysis.entrypoints`.
+
+See docs/ANALYSIS.md for the rule catalog and the baseline-update workflow.
+"""
+
+from automodel_tpu.analysis.hlo import (
+    HLOReport,
+    analyze_compiled,
+    compare_report,
+    load_baseline,
+    save_baseline,
+)
+from automodel_tpu.analysis.lint import (
+    Finding,
+    apply_allowlist,
+    lint_package,
+    lint_source,
+    load_allowlist,
+)
+
+__all__ = [
+    "Finding",
+    "HLOReport",
+    "analyze_compiled",
+    "apply_allowlist",
+    "compare_report",
+    "lint_package",
+    "lint_source",
+    "load_allowlist",
+    "load_baseline",
+    "save_baseline",
+]

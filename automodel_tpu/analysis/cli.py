@@ -1,0 +1,200 @@
+"""``python -m automodel_tpu.analysis`` — the static-analysis CI gate.
+
+Runs both prongs and exits non-zero on any unacknowledged finding:
+
+1. the AST hazard lint over the whole package, filtered through the
+   justified allowlist (``analysis/allowlist.txt``; stale entries fail —
+   the list only shrinks without review);
+2. the compiled-program baseline ratchet: compile the five jitted entry
+   points on an 8-device virtual CPU mesh, analyze each into an HLOReport,
+   and diff against the checked-in JSON baselines.
+
+``--update-baselines`` regenerates the JSONs (the ONE command replacing
+hand-editing counts in five tests); ``--lint-only`` / ``--hlo-only``
+split the prongs (the lint prong is pure AST work and needs no devices).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+EXIT_OK = 0
+EXIT_FINDINGS = 1
+EXIT_CONFIG = 2  # broken allowlist / unknown entry: the gate itself is sick
+
+
+def _package_paths():
+    import automodel_tpu
+
+    pkg_dir = os.path.dirname(os.path.abspath(automodel_tpu.__file__))
+    return pkg_dir, os.path.dirname(pkg_dir)
+
+
+def run_lint(allowlist_path: str, out=sys.stdout) -> int:
+    from automodel_tpu.analysis.lint import (
+        AllowlistError,
+        apply_allowlist,
+        lint_package,
+        load_allowlist,
+    )
+
+    pkg_dir, repo_root = _package_paths()
+    findings = lint_package(pkg_dir, repo_root)
+    try:
+        allowlist = load_allowlist(allowlist_path)
+    except AllowlistError as e:
+        print(f"lint: {e}", file=out)
+        return EXIT_CONFIG
+    kept, suppressed, stale = apply_allowlist(findings, allowlist)
+    for f in kept:
+        print(f"lint: {f.render()}", file=out)
+        print(f"lint:   allowlist key: {f.key}", file=out)
+    for key in stale:
+        print(
+            f"lint: stale allowlist entry (no finding matches): {key}",
+            file=out,
+        )
+    print(
+        f"lint: {len(kept)} finding(s), {len(suppressed)} allowlisted, "
+        f"{len(stale)} stale allowlist entr(ies)",
+        file=out,
+    )
+    return EXIT_FINDINGS if kept or stale else EXIT_OK
+
+
+def _ensure_devices() -> None:
+    """The HLO prong needs the 8-device virtual CPU mesh (same platform
+    the tier-1 tests pin). Under pytest the conftest already installed it;
+    standalone, install it before any backend touch."""
+    from automodel_tpu.utils.hostplatform import force_cpu_devices
+
+    try:
+        force_cpu_devices(8)
+    except RuntimeError:
+        import jax
+
+        if jax.default_backend() != "cpu" or jax.device_count() < 8:
+            raise
+
+
+def run_hlo(
+    baselines_dir: str,
+    entries: list[str],
+    *,
+    update: bool = False,
+    mem_rtol: float = 0.02,
+    out=sys.stdout,
+) -> int:
+    _ensure_devices()
+
+    import jax
+
+    from automodel_tpu.analysis.entrypoints import (
+        ENTRY_POINTS,
+        build_report,
+        check_invariants,
+    )
+    from automodel_tpu.analysis.hlo import (
+        compare_report,
+        load_baseline,
+        save_baseline,
+    )
+
+    unknown = [e for e in entries if e not in ENTRY_POINTS]
+    if unknown:
+        print(
+            f"hlo: unknown entry point(s) {unknown}; "
+            f"known: {sorted(ENTRY_POINTS)}", file=out,
+        )
+        return EXIT_CONFIG
+
+    rc = EXIT_OK
+    for name in entries:
+        report = build_report(name)
+        # structural invariants hold regardless of any baseline, and a
+        # baseline that violates them is refused — --update-baselines
+        # cannot launder a degenerate program past the gate
+        violations = check_invariants(report)
+        for v in violations:
+            print(f"hlo: {v}", file=out)
+        if update:
+            if violations:
+                print(
+                    f"hlo: {name}: REFUSING to write a baseline that "
+                    "violates structural invariants", file=out,
+                )
+                rc = EXIT_FINDINGS
+                continue
+            path = save_baseline(
+                report, baselines_dir, meta={"jax": jax.__version__}
+            )
+            print(f"hlo: {name}: baseline written to {path}", file=out)
+            continue
+        if violations:
+            rc = EXIT_FINDINGS
+        baseline = load_baseline(baselines_dir, name)
+        if baseline is None:
+            print(
+                f"hlo: {name}: NO baseline in {baselines_dir} — run "
+                "`python -m automodel_tpu.analysis --update-baselines`",
+                file=out,
+            )
+            rc = EXIT_FINDINGS
+            continue
+        drifts = compare_report(report, baseline, mem_rtol=mem_rtol)
+        for d in drifts:
+            print(f"hlo: {d}", file=out)
+        status = "drifted" if drifts else "matches baseline"
+        print(f"hlo: {name}: {status}", file=out)
+        if drifts:
+            rc = EXIT_FINDINGS
+    return rc
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m automodel_tpu.analysis",
+        description="JAX hazard lint + compiled-program baseline gate",
+    )
+    parser.add_argument("--lint-only", action="store_true")
+    parser.add_argument("--hlo-only", action="store_true")
+    parser.add_argument(
+        "--update-baselines", action="store_true",
+        help="recompile the entry points and rewrite the JSON baselines",
+    )
+    parser.add_argument(
+        "--entries", default=None,
+        help="comma-separated subset of entry points (default: all)",
+    )
+    parser.add_argument("--allowlist", default=None)
+    parser.add_argument("--baselines-dir", default=None)
+    parser.add_argument("--mem-rtol", type=float, default=0.02)
+    args = parser.parse_args(argv)
+    if args.lint_only and args.hlo_only:
+        parser.error("--lint-only and --hlo-only are mutually exclusive")
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    allowlist = args.allowlist or os.path.join(here, "allowlist.txt")
+    baselines = args.baselines_dir or os.path.join(here, "baselines")
+
+    rc = EXIT_OK
+    if not args.hlo_only:
+        rc = max(rc, run_lint(allowlist))
+    if not args.lint_only:
+        from automodel_tpu.analysis.entrypoints import ENTRY_POINTS
+
+        entries = (
+            [e.strip() for e in args.entries.split(",") if e.strip()]
+            if args.entries else sorted(ENTRY_POINTS)
+        )
+        rc = max(rc, run_hlo(
+            baselines, entries,
+            update=args.update_baselines, mem_rtol=args.mem_rtol,
+        ))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

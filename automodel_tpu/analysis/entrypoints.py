@@ -1,0 +1,277 @@
+"""The five jitted entry points whose compiled structure is baselined.
+
+One builder per headline program — the same tiny-shape, virtual-CPU-mesh
+setups the old hand-rolled guards in tests/unit/test_hlo_guards.py used
+(``jit(...).lower().compile()`` on a CPU mesh emits the same logical
+collectives GSPMD/shard_map would emit for TPU):
+
+- ``fsdp_grad``         — dp_shard=8 dense decoder grad
+- ``ring_cp_forward``   — cp=2 ring-attention forward
+- ``ep_moe_forward``    — ep=4 dropless-MoE forward
+- ``paged_serve_step``  — the serving engine's single-chip jitted step
+- ``pp_ep_1f1b_grad``   — the flagship PP×EP explicit 1F1B grad
+
+Each builder returns ``(compiled, mesh_axes)``; callers feed both to
+:func:`automodel_tpu.analysis.hlo.analyze_compiled`. Requires an 8-device
+(virtual CPU) platform — ``force_cpu_devices(8)`` before any backend
+touch, exactly like tests/conftest.py.
+
+Every future jitted entry point (sharded serve step, speculative-decode
+verify step, quantized serve step) earns its structural guard by adding a
+builder here and running ``--update-baselines`` once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+def _configs():
+    import jax.numpy as jnp
+
+    from automodel_tpu.models.llm.decoder import TransformerConfig
+    from automodel_tpu.models.moe_lm.decoder import MoETransformerConfig
+    from automodel_tpu.moe import MoEConfig
+
+    dense = TransformerConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=48, num_layers=2,
+        num_heads=4, num_kv_heads=2, dtype=jnp.float32, remat_policy="none",
+        pipeline_microbatches=2,
+    )
+    moe = MoETransformerConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=48, num_layers=2,
+        num_heads=4, num_kv_heads=2, first_k_dense=0,
+        moe=MoEConfig(
+            n_routed_experts=4, n_shared_experts=1, experts_per_token=2,
+            moe_intermediate_size=16, shared_expert_intermediate_size=16,
+            aux_loss_coeff=0.01, dispatcher="dropless",
+        ),
+        dtype=jnp.float32, remat_policy="none", pipeline_microbatches=2,
+    )
+    return dense, moe
+
+
+def _sharded(cfg, mod, ctx):
+    import jax
+
+    from automodel_tpu.parallel import logical_to_shardings
+
+    params = mod.init(cfg, jax.random.key(0))
+    sh = logical_to_shardings(
+        mod.param_specs(cfg), ctx,
+        shapes=jax.tree.map(lambda p: p.shape, params),
+    )
+    return jax.device_put(params, sh)
+
+
+def _ids(ctx, B=8, S=16, seq_axis=None):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.device_put(
+        jnp.zeros((B, S), jnp.int32), ctx.sharding("batch", seq_axis)
+    )
+
+
+def fsdp_grad():
+    """dp_shard=8 dense decoder grad: per-layer-scan param all-gathers +
+    grad all-reduces; pure FSDP must stay permute/A2A-free."""
+    import jax
+
+    from automodel_tpu.distributed import MeshConfig
+    from automodel_tpu.loss import fused_linear_cross_entropy
+    from automodel_tpu.models.llm import decoder
+
+    dense, _ = _configs()
+    ctx = MeshConfig(dp_shard=8).build()
+    p = _sharded(dense, decoder, ctx)
+    ids, lab = _ids(ctx), _ids(ctx)
+
+    def loss(p, i, l):
+        h = decoder.forward(p, dense, i, mesh_ctx=ctx, return_hidden=True)
+        ce, _ = fused_linear_cross_entropy(
+            h, p["lm_head"]["kernel"], l, chunk_size=64
+        )
+        return ce
+
+    compiled = jax.jit(jax.grad(loss)).lower(p, ids, lab).compile()
+    return compiled, dict(ctx.sizes)
+
+
+def ring_cp_forward():
+    """cp=2 ring attention forward: the KV ring must stay collective-
+    permutes (one hop per cp peer per scanned attention), never an A2A."""
+    import jax
+
+    from automodel_tpu.distributed import MeshConfig
+    from automodel_tpu.models.llm import decoder
+
+    dense, _ = _configs()
+    ctx = MeshConfig(cp=2, dp_shard=4).build()
+    p = _sharded(dense, decoder, ctx)
+    ids = _ids(ctx, B=4, seq_axis="cp")
+    compiled = (
+        jax.jit(lambda p, i: decoder.forward(p, dense, i, mesh_ctx=ctx))
+        .lower(p, ids).compile()
+    )
+    return compiled, dict(ctx.sizes)
+
+
+def ep_moe_forward():
+    """ep=4 dropless MoE forward: the manual EP dispatch is a bounded
+    number of all-to-alls; a re-gather of expert weights would spike
+    all-gather."""
+    import jax
+
+    from automodel_tpu.distributed import MeshConfig
+    from automodel_tpu.models.moe_lm import decoder as moe_decoder
+
+    _, moe = _configs()
+    ctx = MeshConfig(ep=4, dp_shard=2).build()
+    p = _sharded(moe, moe_decoder, ctx)
+    ids = _ids(ctx)
+    compiled = (
+        jax.jit(lambda p, i: moe_decoder.forward(p, moe, i, mesh_ctx=ctx))
+        .lower(p, ids).compile()
+    )
+    return compiled, dict(ctx.sizes)
+
+
+def paged_serve_step():
+    """The serving engine's jitted step: paged-pool reads stay gathers,
+    pool writes stay O(stacks) in-place updates, zero collectives on a
+    single-process engine, and the pool donation must survive (the
+    aliasing table is part of the baseline). The prefix-hit path rides the
+    SAME program — COW is the bounded copy block pinned here."""
+    import jax
+    import jax.numpy as jnp
+
+    from automodel_tpu.models.llm import decoder
+    from automodel_tpu.serving.engine import ServingConfig, ServingEngine
+
+    dense, _ = _configs()
+    cfg = dataclasses.replace(dense, pipeline_microbatches=1)
+    params = decoder.init(cfg, jax.random.key(0))
+    eng = ServingEngine(params, cfg, ServingConfig(
+        page_size=4, num_pages=16, max_slots=2, pages_per_slot=4,
+        token_budget=8,
+    ))
+    T, S, P = 8, 2, 4
+    batch = {k: jnp.zeros(T, jnp.int32) for k in ("tok", "slot", "pos", "page", "off")}
+    batch.update(
+        page_tables=jnp.zeros((S, P), jnp.int32),
+        sample_tok=jnp.zeros(S, jnp.int32),
+        temp=jnp.zeros(S, jnp.float32),
+        seed=jnp.zeros(S, jnp.int32),
+        cow_src=jnp.zeros(S, jnp.int32),
+        cow_dst=jnp.zeros(S, jnp.int32),
+    )
+    compiled = eng._step.lower(eng.params, eng.pool, batch).compile()
+    return compiled, None
+
+
+def pp_ep_1f1b_grad():
+    """The flagship PP×EP program: explicit 1F1B grad with the expert A2A
+    inside each stage's step. The ppermute ring (fwd + bwd streams) and
+    the per-stage A2As are the pinned structure; expert weights must NOT
+    be re-gathered per microbatch."""
+    import jax
+
+    from automodel_tpu.distributed import MeshConfig
+    from automodel_tpu.models.llm import decoder
+    from automodel_tpu.models.moe_lm import decoder as moe_decoder
+
+    _, moe = _configs()
+    cfg = dataclasses.replace(moe, pipeline_schedule="1f1b")
+    ctx = MeshConfig(pp=2, ep=2, dp_shard=2).build()
+    p = _sharded(cfg, moe_decoder, ctx)
+    batch = {"input_ids": _ids(ctx), "labels": _ids(ctx)}
+    grad_fn = decoder.make_pp_1f1b_loss_and_grad(cfg, ctx, chunk_size=64)
+    compiled = jax.jit(grad_fn).lower(p, batch, jax.random.key(0)).compile()
+    return compiled, dict(ctx.sizes)
+
+
+ENTRY_POINTS = {
+    "fsdp_grad": fsdp_grad,
+    "ring_cp_forward": ring_cp_forward,
+    "ep_moe_forward": ep_moe_forward,
+    "paged_serve_step": paged_serve_step,
+    "pp_ep_1f1b_grad": pp_ep_1f1b_grad,
+}
+
+# Structural invariants — what each program must BE, independent of any
+# baseline: `floors` are collectives that must exist (a degenerate lowering
+# that drops the ring or the EP dispatch must not pass just because a
+# freshly re-pinned baseline agrees), `zeros` must not exist, `op_floors`
+# are data-movement ops that must exist (the serve step's paged k/v page
+# gathers). The CLI gate checks these on every run AND refuses to write a
+# baseline that violates them — `--update-baselines` cannot launder a lost
+# collective. Keys must cover ENTRY_POINTS exactly (asserted below).
+STRUCTURAL_INVARIANTS = {
+    "fsdp_grad": {
+        "floors": {"all-gather": 1, "all-reduce": 1},
+        "zeros": ("collective-permute", "all-to-all", "ragged-all-to-all"),
+        "op_floors": {},
+    },
+    "ring_cp_forward": {
+        "floors": {"collective-permute": 1},
+        "zeros": ("all-to-all", "ragged-all-to-all"),
+        "op_floors": {},
+    },
+    "ep_moe_forward": {
+        "floors": {"all-to-all": 1},
+        "zeros": ("collective-permute", "ragged-all-to-all"),
+        "op_floors": {},
+    },
+    "paged_serve_step": {
+        "floors": {},
+        "zeros": (
+            "all-gather", "all-reduce", "reduce-scatter",
+            "collective-permute", "all-to-all", "ragged-all-to-all",
+        ),
+        "op_floors": {"gather": 2},  # >= the paged k/v page gathers
+    },
+    "pp_ep_1f1b_grad": {
+        "floors": {"collective-permute": 2, "all-to-all": 2},
+        "zeros": ("ragged-all-to-all",),
+        "op_floors": {},
+    },
+}
+assert set(STRUCTURAL_INVARIANTS) == set(ENTRY_POINTS)
+
+
+def check_invariants(report) -> list[str]:
+    """Violations of `report.entry`'s structural invariants (empty = ok)."""
+    inv = STRUCTURAL_INVARIANTS.get(report.entry)
+    if inv is None:
+        return []
+    out = []
+    for kind, lo in inv["floors"].items():
+        if report.collectives[kind] < lo:
+            out.append(
+                f"{report.entry}: {kind} = {report.collectives[kind]} < "
+                f"floor {lo} — the program lost a collective it needs "
+                f"(degenerate lowering? full counts: {report.collectives})"
+            )
+    for kind in inv["zeros"]:
+        if report.collectives[kind] != 0:
+            out.append(
+                f"{report.entry}: {kind} = {report.collectives[kind]} "
+                f"must be 0 (full counts: {report.collectives})"
+            )
+    for op, lo in inv["op_floors"].items():
+        if report.ops[op] < lo:
+            out.append(
+                f"{report.entry}: {op} = {report.ops[op]} < floor {lo} — "
+                f"the paged access structure degenerated (full ops: "
+                f"{report.ops})"
+            )
+    return out
+
+
+def build_report(name: str):
+    """Compile entry point `name` and analyze it into an HLOReport."""
+    from automodel_tpu.analysis.hlo import analyze_compiled
+
+    compiled, mesh_axes = ENTRY_POINTS[name]()
+    return analyze_compiled(compiled, entry=name, mesh_axes=mesh_axes)

@@ -1,0 +1,730 @@
+"""AST lint for JAX/TPU hazards over the whole package.
+
+The "parallelism is pure configuration" contract only holds if the code
+that reaches a compiled program keeps device work on device, deterministic,
+and donation-friendly — and if the host-side resilience layer never
+swallows the exceptions it is built around. These are properties a human
+reviewer checks by pattern-matching; this module checks them mechanically.
+
+Rule catalog (docs/ANALYSIS.md has the long form):
+
+- **AM101 host-sync-in-jit** — ``.item()``, ``jax.device_get`` /
+  ``jax.block_until_ready``, ``np.asarray``/``np.array``, or a
+  ``float()``/``int()``/``bool()`` cast of a function parameter, inside a
+  function reachable from a jitted entry point. Each forces a device→host
+  round trip (or a trace error) in what must stay a fully compiled path.
+- **AM102 nondeterminism-in-jit** — ``time.time()``-family clocks, stdlib
+  ``random.*``, or ``np.random.*`` reachable from a jitted body. Compiled
+  programs must derive randomness from ``jax.random`` keys (replayable,
+  batching-invariant) and never read wall clocks while tracing.
+- **AM103 recompile-hazard** — a jit-wrapped function with a ``bool``- or
+  ``str``-defaulted parameter that is not declared static: flag-like
+  Python scalars in a jitted signature either retrace per value (when used
+  in Python control flow) or silently become traced values; they should be
+  ``static_argnames`` or baked into the closure.
+- **AM104 missing-donate** — a step-shaped jit (function named ``*step*``
+  or whose first parameter is ``state``/``pool``/``carry``) without
+  ``donate_argnums``/``donate_argnames``: the step threads large state, and
+  without donation XLA must double-buffer it.
+- **AM105 crash-swallow** — a bare ``except:`` (or ``except
+  BaseException``) that does not re-raise anywhere, or an ``except
+  Exception`` that does not re-raise around retry-wrapped I/O
+  (``retry_call`` / ``fault_hit`` / checkpoint save-restore-wait surfaces).
+  ``FaultCrash`` is a ``BaseException`` precisely so blanket handlers let
+  it propagate; a bare except defeats that, and an ``except Exception``
+  around the retry layer masks ``RetryBudgetExhausted``/``FaultError``
+  escalation the resilience tests rely on.
+
+Reachability is a package-wide over-approximation: from every jit root
+(decorated ``@jax.jit``/``@partial(jax.jit, ...)``, wrapped
+``jax.jit(fn)``, or any function a jit factory defines), any *reference*
+to a package function — called, or passed as a callback into
+``lax.scan``/``shard_map``/``vmap`` — marks it reachable. Heuristic by
+design: precision comes from the suppression syntax (``# lint-ok: AM101
+reason`` on the offending or preceding line) and the checked-in allowlist
+(``analysis/allowlist.txt``), where every entry carries a one-line
+justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from collections import deque
+
+RULES = {
+    "AM101": "host-sync-in-jit: device→host round trip inside jit-reachable code",
+    "AM102": "nondeterminism-in-jit: wall clock / non-jax RNG in a compiled path",
+    "AM103": "recompile-hazard: non-static bool/str-defaulted param on a jitted function",
+    "AM104": "missing-donate: step-shaped jit threads large state without donation",
+    "AM105": "crash-swallow: except block that can swallow FaultCrash / retry failures",
+}
+
+# AM101 tokens
+_HOST_SYNC_JAX = {"device_get", "block_until_ready"}
+_HOST_SYNC_NP = {"asarray", "array", "copy"}
+_HOST_CASTS = {"float", "int", "bool"}
+# params that are static-by-convention in this codebase (hashable config
+# dataclasses closed over or declared static at every jit site) — casting
+# an attribute of these is trace-time arithmetic, not a host sync
+_CONVENTIONAL_STATIC = {"cfg", "config", "self", "cls"}
+# casting something derived only from .shape/.ndim/... is static metadata
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype"}
+# AM102 tokens
+_CLOCK_ATTRS = {"time", "perf_counter", "monotonic", "time_ns", "process_time"}
+# AM104 heuristics
+_STEP_NAME = re.compile(r"(^|_)step|step($|_)")
+_STEP_FIRST_PARAMS = {"state", "train_state", "pool", "carry", "opt_state"}
+# AM105 retry surfaces: function names, and method names gated on the
+# receiver looking like a checkpoint/retry object
+_RETRY_FUNCS = {"retry_call", "fault_hit", "save_hf_checkpoint"}
+_RETRY_METHODS = {"save", "restore", "wait"}
+_RETRY_RECV = re.compile(r"checkpoint|ckpt|reader|retry", re.IGNORECASE)
+
+_SUPPRESS = re.compile(r"#\s*lint-ok:\s*([A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding with a span-precise location and a stable key."""
+
+    rule: str
+    path: str          # repo-relative
+    line: int
+    col: int
+    end_line: int
+    end_col: int
+    qualname: str      # enclosing function/class scope ("<module>" at top)
+    token: str         # short hazard symbol ("item", "time.time", a param name…)
+    message: str
+
+    @property
+    def key(self) -> str:
+        """Allowlist key: stable under line churn within a function."""
+        return f"{self.rule} {self.path}::{self.qualname}::{self.token}"
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col + 1}: {self.rule} "
+            f"{self.message}"
+        )
+
+
+# -- module model -------------------------------------------------------------
+
+
+class _Module:
+    """One parsed source file + its symbol/import tables."""
+
+    def __init__(self, name: str, relpath: str, source: str):
+        self.name = name            # dotted module name
+        self.relpath = relpath
+        self.is_pkg = relpath.endswith("__init__.py")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        self.defs: dict[str, ast.AST] = {}          # top-level functions
+        self.classes: dict[str, dict[str, ast.AST]] = {}
+        self.import_mod: dict[str, str] = {}        # alias -> dotted module
+        self.import_sym: dict[str, tuple[str, str]] = {}  # alias -> (mod, name)
+        self.functions: list[ast.AST] = []          # every def, annotated
+        self._index()
+
+    def _index(self) -> None:
+        for node in self.tree.body:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                self._index_import(node)
+        self._annotate(self.tree, qual="", cls=None, parent_fn=None)
+        for node in self.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                methods = {}
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        methods[sub.name] = sub
+                self.classes[node.name] = methods
+
+    def _index_import(self, node) -> None:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                alias = a.asname or a.name.split(".")[0]
+                self.import_mod[alias] = a.name if a.asname else a.name.split(".")[0]
+        else:  # ImportFrom
+            if node.level:
+                # relative: level 1 is the containing package — which IS
+                # this module's name for a package __init__, but its parent
+                # for a regular module; each further level strips one more
+                parts = self.name.split(".")
+                drop = node.level - (1 if self.is_pkg else 0)
+                pkg = ".".join(parts[: max(0, len(parts) - drop)])
+                base = f"{pkg}.{node.module}" if node.module else pkg
+            else:
+                base = node.module or ""
+            for a in node.names:
+                self.import_sym[a.asname or a.name] = (base, a.name)
+
+    def _annotate(self, node, qual: str, cls: str | None, parent_fn) -> None:
+        """Attach _qualname/_params/_class/_nested to every function def."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{qual}.{child.name}" if qual else child.name
+                child._qualname = q
+                child._class = cls
+                child._module = self
+                child._parent_fn = parent_fn
+                a = child.args
+                child._params = {
+                    p.arg
+                    for p in (
+                        a.posonlyargs + a.args + a.kwonlyargs
+                        + ([a.vararg] if a.vararg else [])
+                        + ([a.kwarg] if a.kwarg else [])
+                    )
+                }
+                child._nested = {}
+                if parent_fn is not None:
+                    parent_fn._nested[child.name] = child
+                self.functions.append(child)
+                self._annotate(child, q, cls, child)
+            elif isinstance(child, ast.ClassDef):
+                q = f"{qual}.{child.name}" if qual else child.name
+                self._annotate(child, q, child.name, parent_fn)
+            elif isinstance(child, ast.Lambda):
+                child._qualname = f"{qual}.<lambda>" if qual else "<lambda>"
+                child._class = cls
+                child._module = self
+                child._parent_fn = parent_fn
+                a = child.args
+                child._params = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+                child._nested = {}
+                self.functions.append(child)
+                self._annotate(child, child._qualname, cls, child)
+            else:
+                self._annotate(child, qual, cls, parent_fn)
+
+    def alias_for(self, dotted: str) -> set[str]:
+        """Local aliases under which module `dotted` is importable."""
+        return {a for a, m in self.import_mod.items() if m == dotted}
+
+
+@dataclasses.dataclass
+class _JitSite:
+    node: ast.AST                  # the jit call / decorator (span anchor)
+    func: ast.AST | None           # resolved wrapped function, if any
+    module: _Module
+    scope: str                     # qualname of the enclosing scope
+    static_names: frozenset
+    static_nums: tuple
+    has_donate: bool
+
+
+# -- the linter ---------------------------------------------------------------
+
+
+class Linter:
+    """Package-wide hazard lint. Parse once, resolve cross-module."""
+
+    def __init__(self, modules: list[_Module]):
+        self.modules = {m.name: m for m in modules}
+        self.findings: list[Finding] = []
+
+    # -- symbol resolution ---------------------------------------------------
+    def _resolve_symbol(self, mod: _Module, name: str, _depth=0):
+        """Resolve `name` in `mod`'s top scope to a function def or a
+        _Module (for `from pkg import submodule`)."""
+        if name in mod.defs:
+            return mod.defs[name]
+        if name in mod.import_sym and _depth < 4:
+            src, orig = mod.import_sym[name]
+            sub = self.modules.get(f"{src}.{orig}")
+            if sub is not None:
+                return sub
+            srcmod = self.modules.get(src)
+            if srcmod is not None:
+                return self._resolve_symbol(srcmod, orig, _depth + 1)
+        if name in mod.import_mod:
+            return self.modules.get(mod.import_mod[name])
+        return None
+
+    def _resolve_ref(self, mod: _Module, scope, expr):
+        """Resolve a Name/Attribute reference to a package function def."""
+        if isinstance(expr, ast.Name):
+            fn = scope
+            while fn is not None:
+                nested = getattr(fn, "_nested", {})
+                if expr.id in nested:
+                    return nested[expr.id]
+                fn = getattr(fn, "_parent_fn", None)
+            got = self._resolve_symbol(mod, expr.id)
+            return got if not isinstance(got, _Module) else None
+        if isinstance(expr, ast.Attribute):
+            v = expr.value
+            if isinstance(v, ast.Name):
+                if v.id == "self" and scope is not None:
+                    cls = getattr(scope, "_class", None)
+                    if cls and cls in mod.classes:
+                        return mod.classes[cls].get(expr.attr)
+                    return None
+                got = self._resolve_symbol(mod, v.id)
+                if isinstance(got, _Module):
+                    return got.defs.get(expr.attr)
+        return None
+
+    # -- jit detection -------------------------------------------------------
+    def _is_jit_name(self, mod: _Module, expr) -> bool:
+        if isinstance(expr, ast.Attribute) and expr.attr in ("jit", "pjit"):
+            v = expr.value
+            return isinstance(v, ast.Name) and mod.import_mod.get(v.id) == "jax"
+        if isinstance(expr, ast.Name):
+            return mod.import_sym.get(expr.id, ("", ""))[1] in ("jit", "pjit")
+        return False
+
+    def _is_partial(self, mod: _Module, expr) -> bool:
+        if isinstance(expr, ast.Name):
+            return mod.import_sym.get(expr.id, ("", ""))[1] == "partial"
+        if isinstance(expr, ast.Attribute) and expr.attr == "partial":
+            v = expr.value
+            return isinstance(v, ast.Name) and mod.import_mod.get(v.id) == "functools"
+        return False
+
+    @staticmethod
+    def _jit_kwargs(call: ast.Call):
+        static_names: set[str] = set()
+        static_nums: tuple = ()
+        donate = False
+        for kw in call.keywords:
+            if kw.arg in ("donate_argnums", "donate_argnames"):
+                donate = True
+            elif kw.arg == "static_argnames":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                        static_names.add(n.value)
+            elif kw.arg == "static_argnums":
+                nums = [
+                    n.value for n in ast.walk(kw.value)
+                    if isinstance(n, ast.Constant) and isinstance(n.value, int)
+                ]
+                static_nums = tuple(nums)
+        return frozenset(static_names), static_nums, donate
+
+    def _collect_jit_sites(self) -> list[_JitSite]:
+        sites: list[_JitSite] = []
+        for mod in self.modules.values():
+            # decorated defs
+            for fn in mod.functions:
+                for dec in getattr(fn, "decorator_list", []):
+                    site = self._jit_decorator_site(mod, fn, dec)
+                    if site is not None:
+                        sites.append(site)
+            # jax.jit(...) call expressions
+            for scope, node in _walk_with_scope(mod.tree):
+                if isinstance(node, ast.Call) and self._is_jit_name(mod, node.func):
+                    sites.append(self._jit_call_site(mod, scope, node))
+        return sites
+
+    def _jit_decorator_site(self, mod, fn, dec):
+        if self._is_jit_name(mod, dec):
+            return _JitSite(dec, fn, mod, fn._qualname, frozenset(), (), False)
+        if isinstance(dec, ast.Call):
+            if self._is_jit_name(mod, dec.func):
+                names, nums, donate = self._jit_kwargs(dec)
+                return _JitSite(dec, fn, mod, fn._qualname, names, nums, donate)
+            if self._is_partial(mod, dec.func) and dec.args and self._is_jit_name(
+                mod, dec.args[0]
+            ):
+                names, nums, donate = self._jit_kwargs(dec)
+                return _JitSite(dec, fn, mod, fn._qualname, names, nums, donate)
+        return None
+
+    def _jit_call_site(self, mod, scope, call: ast.Call) -> _JitSite:
+        names, nums, donate = self._jit_kwargs(call)
+        func = None
+        if call.args:
+            arg = call.args[0]
+            if isinstance(arg, (ast.Name, ast.Attribute)):
+                func = self._resolve_ref(mod, scope, arg)
+            elif isinstance(arg, ast.Lambda):
+                func = arg
+            elif isinstance(arg, ast.Call):
+                # jit(factory(...)): the factory's nested defs are the real
+                # jitted bodies — root the factory itself, reachability
+                # walks into everything it defines or references
+                func = self._resolve_ref(mod, scope, arg.func)
+        qual = getattr(scope, "_qualname", "<module>") if scope else "<module>"
+        return _JitSite(call, func, mod, qual, names, nums, donate)
+
+    # -- reachability --------------------------------------------------------
+    def _reachable(self, roots) -> set:
+        seen: set[int] = set()
+        out = []
+        queue = deque(roots)
+        while queue:
+            fn = queue.popleft()
+            if fn is None or id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            out.append(fn)
+            mod = getattr(fn, "_module", None)
+            if mod is None:
+                continue
+            for node in _own_nodes(fn):
+                ref = None
+                if isinstance(node, (ast.Name, ast.Attribute)):
+                    ref = self._resolve_ref(mod, fn, node)
+                elif isinstance(node, (ast.FunctionDef, ast.Lambda)):
+                    ref = node  # nested def: conservatively reachable
+                if ref is not None and getattr(ref, "_module", None) is not None:
+                    queue.append(ref)
+        return seen
+
+    # -- rules ---------------------------------------------------------------
+    def run(self) -> list[Finding]:
+        sites = self._collect_jit_sites()
+        roots = [s.func for s in sites if s.func is not None]
+        reach_ids = self._reachable(roots)
+        static_params: dict[int, set] = {}
+        for s in sites:
+            if s.func is not None:
+                static_params.setdefault(id(s.func), set()).update(s.static_names)
+        for mod in self.modules.values():
+            for fn in mod.functions:
+                if id(fn) in reach_ids:
+                    self._scan_jit_body(mod, fn, static_params.get(id(fn), set()))
+        for s in sites:
+            self._check_jit_signature(s)
+        for mod in self.modules.values():
+            self._scan_excepts(mod)
+        self.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        return self.findings
+
+    def _emit(self, rule, mod: _Module, node, qual, token, msg) -> None:
+        line = getattr(node, "lineno", 1)
+        if self._suppressed(mod, line, rule):
+            return
+        self.findings.append(Finding(
+            rule=rule, path=mod.relpath, line=line,
+            col=getattr(node, "col_offset", 0),
+            end_line=getattr(node, "end_lineno", line),
+            end_col=getattr(node, "end_col_offset", 0),
+            qualname=qual, token=token, message=msg,
+        ))
+
+    def _suppressed(self, mod: _Module, line: int, rule: str) -> bool:
+        for ln in (line, line - 1):
+            if 1 <= ln <= len(mod.lines):
+                m = _SUPPRESS.search(mod.lines[ln - 1])
+                if m and rule in {r.strip() for r in m.group(1).split(",")}:
+                    return True
+        return False
+
+    # AM101 + AM102: hazards inside one jit-reachable function body
+    def _scan_jit_body(self, mod: _Module, fn, static_names: set) -> None:
+        qual = fn._qualname
+        params = fn._params - _CONVENTIONAL_STATIC - static_names
+        for node in _own_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                self._scan_attr_call(mod, fn, qual, node, f)
+            elif isinstance(f, ast.Name) and f.id in _HOST_CASTS and node.args:
+                traced = _traced_names(node.args[0]) & params
+                if traced:
+                    self._emit(
+                        "AM101", mod, node, qual, f.id,
+                        f"`{f.id}()` of traced parameter "
+                        f"{sorted(traced)[0]!r} inside "
+                        f"jit-reachable `{qual}` forces a host sync (or a "
+                        "ConcretizationTypeError under trace)",
+                    )
+
+    def _scan_attr_call(self, mod, fn, qual, node, f: ast.Attribute) -> None:
+        v = f.value
+        vmod = mod.import_mod.get(v.id) if isinstance(v, ast.Name) else None
+        if f.attr == "item" and not node.args:
+            self._emit(
+                "AM101", mod, node, qual, "item",
+                f"`.item()` inside jit-reachable `{qual}` is a device→host "
+                "round trip; keep the value on device or move the read out "
+                "of the compiled path",
+            )
+        elif vmod == "jax" and f.attr in _HOST_SYNC_JAX:
+            self._emit(
+                "AM101", mod, node, qual, f"jax.{f.attr}",
+                f"`jax.{f.attr}` inside jit-reachable `{qual}` blocks on "
+                "device→host transfer",
+            )
+        elif vmod == "numpy" and f.attr in _HOST_SYNC_NP:
+            self._emit(
+                "AM101", mod, node, qual, f"np.{f.attr}",
+                f"`{v.id}.{f.attr}` inside jit-reachable `{qual}` pulls the "
+                "array to host memory; use jnp on device",
+            )
+        elif vmod == "time" and f.attr in _CLOCK_ATTRS:
+            self._emit(
+                "AM102", mod, node, qual, f"time.{f.attr}",
+                f"`time.{f.attr}()` inside jit-reachable `{qual}`: the clock "
+                "is read once at trace time and baked into the program",
+            )
+        elif vmod == "random":
+            self._emit(
+                "AM102", mod, node, qual, f"random.{f.attr}",
+                f"stdlib `random.{f.attr}` inside jit-reachable `{qual}` is "
+                "trace-time nondeterminism; derive from jax.random keys",
+            )
+        elif (
+            isinstance(v, ast.Attribute)
+            and v.attr == "random"
+            and isinstance(v.value, ast.Name)
+            and mod.import_mod.get(v.value.id) == "numpy"
+        ):
+            self._emit(
+                "AM102", mod, node, qual, f"np.random.{f.attr}",
+                f"`np.random.{f.attr}` inside jit-reachable `{qual}` is "
+                "host RNG baked in at trace time; use jax.random",
+            )
+
+    # AM103 + AM104: jitted signature checks
+    def _check_jit_signature(self, s: _JitSite) -> None:
+        fn = s.func
+        if fn is None or isinstance(fn, ast.Lambda):
+            return
+        a = fn.args
+        pos = a.posonlyargs + a.args
+        static = set(s.static_names)
+        for i in s.static_nums:
+            if 0 <= i < len(pos):
+                static.add(pos[i].arg)
+        defaults = list(a.defaults)
+        defaulted = list(zip(pos[len(pos) - len(defaults):], defaults))
+        # kw-only flags (`*, training=True`) are the most common way such
+        # flags are written — kw_defaults aligns 1:1 with kwonlyargs
+        defaulted += [
+            (p, d) for p, d in zip(a.kwonlyargs, a.kw_defaults) if d is not None
+        ]
+        for p, d in defaulted:
+            if p.arg in static or p.arg in ("self", "cls"):
+                continue
+            if isinstance(d, ast.Constant) and isinstance(d.value, (bool, str)):
+                self._emit(
+                    "AM103", s.module, p, fn._qualname, p.arg,
+                    f"param `{p.arg}` of jitted `{fn._qualname}` defaults to "
+                    f"a Python {type(d.value).__name__} but is not in "
+                    "static_argnames — a flag-like scalar in a jitted "
+                    "signature retraces per value (or silently traces); "
+                    "declare it static or bake it into the closure",
+                )
+        first = next((p.arg for p in pos if p.arg not in ("self", "cls")), "")
+        step_shaped = bool(_STEP_NAME.search(fn.name)) or first in _STEP_FIRST_PARAMS
+        if step_shaped and not s.has_donate:
+            self._emit(
+                "AM104", s.module, s.node, s.scope, fn.name,
+                f"step-shaped jit of `{fn._qualname}` (first arg "
+                f"{first!r}) without donate_argnums/donate_argnames: the "
+                "threaded state double-buffers on device",
+            )
+
+    # AM105: except blocks that can swallow FaultCrash / retry escalation
+    def _scan_excepts(self, mod: _Module) -> None:
+        for scope, node in _walk_with_scope(mod.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            qual = getattr(scope, "_qualname", "<module>") if scope else "<module>"
+            touches_retry = self._touches_retry(node.body)
+            for h in node.handlers:
+                if any(isinstance(n, ast.Raise) for n in ast.walk(h)):
+                    continue  # re-raises (or converts): not a swallow
+                kind = self._handler_kind(h)
+                if kind == "bare":
+                    self._emit(
+                        "AM105", mod, h, qual, "bare-except",
+                        f"bare `except:` in `{qual}` catches BaseException — "
+                        "it swallows FaultCrash (and KeyboardInterrupt); "
+                        "catch Exception or re-raise",
+                    )
+                elif kind == "base":
+                    self._emit(
+                        "AM105", mod, h, qual, "except-BaseException",
+                        f"`except BaseException` in `{qual}` swallows "
+                        "FaultCrash; catch Exception or re-raise",
+                    )
+                elif kind == "exception" and touches_retry:
+                    self._emit(
+                        "AM105", mod, h, qual, "except-Exception",
+                        f"`except Exception` around retry-wrapped I/O in "
+                        f"`{qual}` masks RetryBudgetExhausted/FaultError "
+                        "escalation; narrow the except or re-raise",
+                    )
+
+    @staticmethod
+    def _handler_kind(h: ast.ExceptHandler) -> str | None:
+        if h.type is None:
+            return "bare"
+        names = {
+            n.id for n in ast.walk(h.type) if isinstance(n, ast.Name)
+        }
+        if "BaseException" in names:
+            return "base"
+        if "Exception" in names:
+            return "exception"
+        return None
+
+    @staticmethod
+    def _touches_retry(body) -> bool:
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if isinstance(f, ast.Name) and f.id in _RETRY_FUNCS:
+                    return True
+                if isinstance(f, ast.Attribute):
+                    if f.attr in _RETRY_FUNCS:
+                        return True
+                    if f.attr in _RETRY_METHODS:
+                        recv = f.value
+                        txt = ""
+                        if isinstance(recv, ast.Name):
+                            txt = recv.id
+                        elif isinstance(recv, ast.Attribute):
+                            txt = recv.attr
+                        if _RETRY_RECV.search(txt):
+                            return True
+        return False
+
+
+# -- AST walking helpers ------------------------------------------------------
+
+
+def _traced_names(expr) -> set[str]:
+    """Names in `expr` whose value could be traced data: excludes names
+    that only appear under static-metadata attributes (x.shape, x.ndim…)."""
+    exempt: set[int] = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            for sub in ast.walk(node.value):
+                exempt.add(id(sub))
+    return {
+        n.id
+        for n in ast.walk(expr)
+        if isinstance(n, ast.Name) and id(n) not in exempt
+    }
+
+
+def _own_nodes(fn):
+    """All nodes of `fn`'s body excluding nested function/lambda bodies
+    (those are separate reachable units)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _walk_with_scope(tree):
+    """Yield (enclosing function or None, node) over a module tree."""
+    stack = [(None, tree)]
+    while stack:
+        scope, node = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                child._parent_fn = scope
+                yield scope, child
+                stack.append((child, child))
+            else:
+                yield scope, child
+                stack.append((scope, child))
+
+
+# -- public API ---------------------------------------------------------------
+
+
+def _module_name(root: str, relpath: str) -> str:
+    dotted = relpath[:-3].replace(os.sep, ".")
+    if dotted.endswith(".__init__"):
+        dotted = dotted[: -len(".__init__")]
+    return dotted
+
+
+def lint_paths(py_files: list[tuple[str, str]]) -> list[Finding]:
+    """Lint a list of (relpath, source) pairs as one resolution universe."""
+    modules = []
+    for relpath, source in py_files:
+        try:
+            modules.append(_Module(_module_name("", relpath), relpath, source))
+        except SyntaxError as e:
+            raise SyntaxError(f"{relpath}: {e}") from e
+    return Linter(modules).run()
+
+
+def lint_package(package_dir: str, repo_root: str | None = None) -> list[Finding]:
+    """Lint every .py file under `package_dir` (paths repo-relative)."""
+    repo_root = repo_root or os.path.dirname(os.path.abspath(package_dir))
+    files = []
+    for dirpath, dirnames, filenames in os.walk(package_dir):
+        dirnames.sort()
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, name)
+            rel = os.path.relpath(full, repo_root)
+            with open(full, encoding="utf-8") as f:
+                files.append((rel, f.read()))
+    return lint_paths(files)
+
+
+def lint_source(source: str, relpath: str = "<snippet>.py") -> list[Finding]:
+    """Lint a single source string (rule-fixture tests)."""
+    return lint_paths([(relpath, source)])
+
+
+# -- allowlist ----------------------------------------------------------------
+
+
+class AllowlistError(ValueError):
+    """Malformed allowlist: entry without a justification, or unparseable."""
+
+
+def load_allowlist(path: str) -> dict[str, str]:
+    """Parse `allowlist.txt`: one `<RULE> <path>::<scope>::<token>  # why`
+    entry per line. Every entry MUST carry a justification comment."""
+    entries: dict[str, str] = {}
+    if not os.path.exists(path):
+        return entries
+    with open(path, encoding="utf-8") as f:
+        for i, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            key, sep, why = line.partition("#")
+            key, why = key.strip(), why.strip()
+            if not sep or not why:
+                raise AllowlistError(
+                    f"{path}:{i}: allowlist entry {key!r} has no "
+                    "justification — append `# <one-line reason>`"
+                )
+            if not re.match(r"^[A-Z]{2}\d{3} \S+::\S*::\S+$", key):
+                raise AllowlistError(
+                    f"{path}:{i}: malformed allowlist key {key!r} "
+                    "(want `<RULE> <path>::<scope>::<token>`)"
+                )
+            entries[key] = why
+    return entries
+
+
+def apply_allowlist(findings, allowlist: dict[str, str]):
+    """Split findings into (kept, suppressed) and report stale entries."""
+    kept, suppressed = [], []
+    used = set()
+    for f in findings:
+        if f.key in allowlist:
+            suppressed.append(f)
+            used.add(f.key)
+        else:
+            kept.append(f)
+    stale = sorted(set(allowlist) - used)
+    return kept, suppressed, stale

@@ -224,6 +224,18 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         self._time_to_resume_s: Optional[float] = None
         self._preempt_finished = False
 
+    def _invoke_train_step(self, batch):
+        """Run the jitted train step; with `resilience.transfer_guard` the
+        invocation runs under jax.transfer_guard("disallow") — the batch
+        device_put above and the metric reads below stay OUTSIDE the guard,
+        so the ONLY thing it can trip on is an unintended device↔host
+        transfer introduced into the step path itself."""
+        args = (self.train_state, batch, self.rng.next_key(), *self._step_extra())
+        if self.resilience_cfg.transfer_guard:
+            with jax.transfer_guard("disallow"):
+                return self._train_step(*args)
+        return self._train_step(*args)
+
     def _on_retry_attempt(self, point, attempt, exc, delay_s) -> None:
         """Every retried I/O attempt is counted through MetricLogger (once
         it exists — model-load retries are buffered and mirrored in), so
@@ -808,9 +820,7 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                 )
             batch_np = stack_microbatches(microbatches)
             batch = self._make_global(batch_np)
-            self.train_state, metrics = self._train_step(
-                self.train_state, batch, self.rng.next_key(), *self._step_extra()
-            )
+            self.train_state, metrics = self._invoke_train_step(batch)
             self.profiler.step(step)
             self.gc.step(step)
 

@@ -50,6 +50,10 @@ class ResilienceConfig:
     retry_jitter: float = 0.25
     # preemption
     sigterm_grace_s: float = 30.0
+    # debug tripwire: run the jitted train step under
+    # jax.transfer_guard("disallow") so an unintended device↔host transfer
+    # inside the step fails loudly (the dryrun stages turn this on)
+    transfer_guard: bool = False
     # chaos testing
     faults: Any = dataclasses.field(default_factory=list)
 

@@ -85,6 +85,11 @@ class ServingConfig:
     # radix tree over known tokens; None/disabled → PR-2 behavior exactly
     prefix_cache: PrefixCacheConfig | None = None
     admission_policy: str = "fifo"  # "fifo" | "prefix-hit"
+    # debug tripwire: run the jitted step under jax.transfer_guard
+    # ("disallow") so an unintended device↔host transfer inside the step
+    # raises instead of silently serializing the serve loop (the dryrun
+    # stages turn this on; see docs/ANALYSIS.md)
+    guard_transfers: bool = False
 
     def __post_init__(self):
         assert self.page_size >= 1 and self.num_pages >= 1
@@ -304,7 +309,14 @@ class ServingEngine:
             "cow_src": jnp.asarray(plan.cow_src),
             "cow_dst": jnp.asarray(plan.cow_dst),
         }
-        self.pool, tokens, lps = self._step(self.params, self.pool, batch)
+        # the StepPlan upload above is the ONE sanctioned host→device copy
+        # per step; with guard_transfers the step invocation itself runs
+        # under transfer_guard("disallow") so any other transfer raises
+        if self.serve_cfg.guard_transfers:
+            with jax.transfer_guard("disallow"):
+                self.pool, tokens, lps = self._step(self.params, self.pool, batch)
+        else:
+            self.pool, tokens, lps = self._step(self.params, self.pool, batch)
         self.steps_run += 1
         return np.asarray(tokens), np.asarray(lps)
 

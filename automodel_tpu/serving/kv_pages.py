@@ -44,6 +44,7 @@ listener — the radix tree keeps its node→page map current this way).
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -183,10 +184,13 @@ class PageAllocator:
         return jnp.asarray(src, jnp.int32), len(live)
 
 
-@jax.jit
+@functools.partial(jax.jit, donate_argnums=(0,))
 def apply_defrag(pool, src: jnp.ndarray):
     """Apply a defrag plan to a pool pytree: one gather along the page axis
-    (axis 1, after the layer axis) per array; the trash page stays put."""
+    (axis 1, after the layer axis) per array; the trash page stays put.
+    The old pool is donated — callers rebind (`pool = apply_defrag(pool,
+    src)`), and XLA may reuse the donated buffers instead of double-
+    buffering the whole KV pool during compaction."""
     full = jnp.concatenate(
         [src, jnp.asarray([pool_trash_index(pool)], jnp.int32)]
     )

@@ -1,0 +1,463 @@
+"""The static-analysis gate: lint rule fixtures, allowlist semantics,
+HLO report parsing, the baseline ratchet, and the CI entry point.
+
+Each lint rule gets a fixture snippet with a KNOWN violation asserting
+rule ID + line span + suppression behavior — the "deliberately introduced
+violation of each kind fails it" half of the acceptance criteria. The
+baseline half is a synthetic-drift test (mutate one count, the ratchet
+fires) — the real five entry points are compared in test_hlo_guards.py.
+Finally, the gate itself runs in-process on the package: the lint prong
+must exit 0 (clean modulo the justified allowlist)."""
+
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu.analysis.hlo import HLOReport, analyze_compiled, compare_report
+from automodel_tpu.analysis.lint import (
+    AllowlistError,
+    apply_allowlist,
+    lint_source,
+    load_allowlist,
+)
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# -- rule fixtures: one known violation per rule ------------------------------
+
+
+def test_am101_item_in_jit_body():
+    src = textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def fwd(x):
+            y = x * 2
+            return y.item()
+    """)
+    fs = lint_source(src)
+    assert _rules(fs) == ["AM101"]
+    assert fs[0].token == "item"
+    assert fs[0].line == 7  # the `return y.item()` line (1-based, after \\n)
+    assert fs[0].qualname == "fwd"
+
+
+def test_am101_np_asarray_reachable_through_helper():
+    """Reachability crosses plain calls: the hazard sits in a helper the
+    jitted body calls, not in the jit root itself."""
+    src = textwrap.dedent("""
+        import jax
+        import numpy as np
+
+        def helper(x):
+            return np.asarray(x)
+
+        @jax.jit
+        def fwd(x):
+            return helper(x) + 1
+    """)
+    fs = lint_source(src)
+    assert _rules(fs) == ["AM101"]
+    assert fs[0].token == "np.asarray"
+    assert fs[0].qualname == "helper"
+
+
+def test_am101_float_cast_of_param():
+    src = textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def fwd(x):
+            return float(x) + 1.0
+    """)
+    fs = lint_source(src)
+    assert _rules(fs) == ["AM101"] and fs[0].token == "float"
+
+
+def test_am101_shape_and_static_config_casts_are_clean():
+    """float(x.shape[-1]) is static metadata; int(cfg.k) follows the
+    static-config convention; params declared static_argnames are exempt."""
+    src = textwrap.dedent("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("n",))
+        def fwd(x, cfg, n):
+            scale = float(x.shape[-1]) ** -0.5
+            k = int(cfg.top_k) + int(n)
+            return x * scale + k
+    """)
+    assert lint_source(src) == []
+
+
+def test_am102_clock_and_rng_in_jit():
+    src = textwrap.dedent("""
+        import jax
+        import random
+        import time
+        import numpy as np
+
+        @jax.jit
+        def fwd(x):
+            t = time.time()
+            r = random.random()
+            z = np.random.uniform()
+            return x + t + r + z
+    """)
+    fs = lint_source(src)
+    assert _rules(fs) == ["AM102", "AM102", "AM102"]
+    assert {f.token for f in fs} == {"time.time", "random.random", "np.random.uniform"}
+    # span precision: each finding anchors to its own call line
+    assert [f.line for f in fs] == [9, 10, 11]
+
+
+def test_am103_bool_flag_not_static():
+    src = textwrap.dedent("""
+        import jax
+
+        def run(x, training=True):
+            return x
+
+        f = jax.jit(run)
+    """)
+    fs = lint_source(src)
+    assert _rules(fs) == ["AM103"]
+    assert fs[0].token == "training"
+    assert fs[0].line == 4  # the parameter's own span, not the jit site
+
+
+def test_am103_static_argnames_clean():
+    src = textwrap.dedent("""
+        import jax
+
+        def run(x, training=True):
+            return x
+
+        f = jax.jit(run, static_argnames=("training",))
+    """)
+    assert lint_source(src) == []
+
+
+def test_am104_step_jit_without_donate():
+    src = textwrap.dedent("""
+        import jax
+
+        def train_step(state, batch):
+            return state
+
+        f = jax.jit(train_step)
+    """)
+    fs = lint_source(src)
+    assert _rules(fs) == ["AM104"]
+    assert fs[0].line == 7  # anchored at the jit call site
+    g = src.replace("jax.jit(train_step)", "jax.jit(train_step, donate_argnums=0)")
+    assert lint_source(g) == []
+
+
+def test_am105_bare_except_and_retry_mask():
+    src = textwrap.dedent("""
+        from automodel_tpu.resilience.retry import retry_call
+
+        def load(path):
+            try:
+                return retry_call(open, path, policy=None)
+            except Exception:
+                return None
+
+        def poll():
+            try:
+                return 1
+            except:
+                pass
+    """)
+    fs = lint_source(src)
+    assert _rules(fs) == ["AM105", "AM105"]
+    assert fs[0].token == "except-Exception" and fs[0].qualname == "load"
+    assert fs[1].token == "bare-except" and fs[1].qualname == "poll"
+
+
+def test_am105_reraise_is_clean():
+    src = textwrap.dedent("""
+        from automodel_tpu.resilience.retry import retry_call
+
+        def load(path):
+            try:
+                return retry_call(open, path, policy=None)
+            except Exception:
+                cleanup = True
+                raise
+    """)
+    assert lint_source(src) == []
+
+
+def test_am105_plain_except_exception_without_retry_is_clean():
+    """`except Exception` away from the retry surfaces is ordinary
+    defensive code (FaultCrash passes through it by construction)."""
+    src = textwrap.dedent("""
+        def parse(s):
+            try:
+                return int(s)
+            except Exception:
+                return None
+    """)
+    assert lint_source(src) == []
+
+
+# -- suppression + allowlist --------------------------------------------------
+
+
+def test_inline_suppression_same_and_previous_line():
+    src = textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def fwd(x):
+            a = x.item()  # lint-ok: AM101 scalar readout is the api contract
+            # lint-ok: AM101 second one too
+            b = x.item()
+            return a + b
+    """)
+    assert lint_source(src) == []
+
+
+def test_inline_suppression_wrong_rule_still_fires():
+    src = textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def fwd(x):
+            return x.item()  # lint-ok: AM102 wrong rule id
+    """)
+    assert _rules(lint_source(src)) == ["AM101"]
+
+
+def test_allowlist_requires_justification(tmp_path):
+    p = tmp_path / "allow.txt"
+    p.write_text("AM101 pkg/mod.py::fwd::item\n")
+    with pytest.raises(AllowlistError):
+        load_allowlist(str(p))
+    p.write_text("AM101 pkg/mod.py::fwd::item  # device readout by design\n")
+    assert load_allowlist(str(p)) == {
+        "AM101 pkg/mod.py::fwd::item": "device readout by design"
+    }
+
+
+def test_allowlist_split_and_stale(tmp_path):
+    src = textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def fwd(x):
+            return x.item()
+    """)
+    fs = lint_source(src, relpath="pkg/mod.py")
+    key = fs[0].key
+    allow = {key: "why", "AM102 pkg/gone.py::f::time.time": "stale"}
+    kept, suppressed, stale = apply_allowlist(fs, allow)
+    assert kept == [] and [f.key for f in suppressed] == [key]
+    assert stale == ["AM102 pkg/gone.py::f::time.time"]
+
+
+# -- HLO report parsing -------------------------------------------------------
+
+
+_SYNTHETIC_HLO = """\
+HloModule jit_step, is_scheduled=true, input_output_alias={ {0}: (1, {}, may-alias), {1}: (2, {0}, must-alias) }, entry_computation_layout={(f32[8]{0})->f32[8]{0}}
+
+ENTRY %main (p0: f32[8]) -> f32[8] {
+  %p0 = f32[8]{0} parameter(0)
+  %ag = f32[16]{0} all-gather(f32[8]{0} %p0), replica_groups={{0,1},{2,3}}, dimensions={0}
+  %agt = f32[16]{0} all-gather(f32[8]{0} %p0), replica_groups=[2,4]<=[4,2]T(1,0), dimensions={0}
+  %a2a = (f32[8]{0}, f32[8]{0}) all-to-all(f32[8]{0} %p0, f32[8]{0} %p0), replica_groups=[2,4]<=[8]
+  %raa = f32[8]{0} ragged-all-to-all(f32[8]{0} %p0), replica_groups={{0,1,2,3}}
+  %g = f32[4]{0} gather(f32[8]{0} %p0, s32[4,1]{0} %p0), offset_dims={}
+  %ds = f32[2]{0} dynamic-slice(f32[8]{0} %p0, s32[] %p0), dynamic_slice_sizes={2}
+  %dus = f32[8]{0} dynamic-update-slice(f32[8]{0} %p0, f32[2]{0} %ds, s32[] %p0)
+  %cv = f32[8]{0} convert(bf16[8]{0} %p0)
+  %down = bf16[8]{0} convert(f32[8]{0} %p0)
+  %cb = f32[8]{0} custom-call(f32[8]{0} %p0), custom_call_target="xla_python_cpu_callback"
+  %tk = f32[8]{0} custom-call(f32[8]{0} %p0), custom_call_target="TopK"
+}
+"""
+
+
+class _FakeCompiled:
+    def __init__(self, txt):
+        self._txt = txt
+
+    def as_text(self):
+        return self._txt
+
+    def memory_analysis(self):
+        raise AttributeError("no memory stats on this backend")
+
+
+def test_analyze_synthetic_hlo():
+    r = analyze_compiled(
+        _FakeCompiled(_SYNTHETIC_HLO), entry="synthetic",
+        mesh_axes={"dp_shard": 2, "tp": 4},
+    )
+    assert r.collectives == {
+        "all-gather": 2, "all-reduce": 0, "reduce-scatter": 0,
+        "collective-permute": 0, "all-to-all": 1, "ragged-all-to-all": 1,
+    }
+    # group signatures normalized + axis-annotated; the tuple-typed A2A and
+    # both iota-v2 replica_groups forms (flat source and multi-dim source
+    # with a transpose suffix) parse to n-groups-of-m shapes
+    assert r.collective_groups == {
+        "all-gather": {"2x2 (axis~dp_shard)": 1, "2x4 (axis~tp)": 1},
+        "all-to-all": {"2x4 (axis~tp)": 1},
+        "ragged-all-to-all": {"1x4 (axis~tp)": 1},
+    }
+    # "gather" does not double-count "all-gather"; "dynamic-slice" does not
+    # double-count "dynamic-update-slice"
+    assert r.ops == {"gather": 1, "dynamic-slice": 1, "dynamic-update-slice": 1}
+    assert r.convert_upcasts == 1  # bf16->f32 only; the downcast is not one
+    assert r.custom_calls == {"xla_python_cpu_callback": 1, "TopK": 1}
+    assert r.host_callbacks == 1
+    assert r.donation == [
+        "output{0} <- param 1{} (may-alias)",
+        "output{1} <- param 2{0} (must-alias)",
+    ]
+    assert r.memory == {}  # backend without stats: section omitted, no crash
+
+
+def test_baseline_ratchet_fires_both_directions():
+    base = analyze_compiled(_FakeCompiled(_SYNTHETIC_HLO), entry="synthetic")
+    up = analyze_compiled(
+        _FakeCompiled(_SYNTHETIC_HLO.replace(
+            "%ag =", "%ag2 = f32[16]{0} all-gather(f32[8]{0} %p0), replica_groups={{0,1},{2,3}}\n  %ag =",
+        )),
+        entry="synthetic",
+    )
+    drifts = compare_report(up, base)
+    assert drifts and any("all-gather" in d for d in drifts)
+    down = analyze_compiled(
+        _FakeCompiled(_SYNTHETIC_HLO.replace("all-to-all(", "nop(")),
+        entry="synthetic",
+    )
+    assert compare_report(down, base)  # an "optimization" drifts too
+    assert compare_report(base, base) == []
+
+
+def test_structural_invariants_catch_degenerate_program():
+    """check_invariants holds regardless of any baseline: a ring-CP
+    program that lost its permutes (or a serve step that grew a
+    collective / lost its paged gathers) violates, so --update-baselines
+    refuses to pin it."""
+    from automodel_tpu.analysis.entrypoints import check_invariants
+
+    zeroed = {k: 0 for k in (
+        "all-gather", "all-reduce", "reduce-scatter",
+        "collective-permute", "all-to-all", "ragged-all-to-all",
+    )}
+
+    def rep(entry, coll=(), ops=()):
+        return HLOReport(
+            entry=entry, collectives={**zeroed, **dict(coll)},
+            collective_groups={}, ops={"gather": 0, "dynamic-slice": 0,
+                                       "dynamic-update-slice": 0, **dict(ops)},
+            convert_upcasts=0, custom_calls={}, host_callbacks=0,
+            donation=[], memory={},
+        )
+
+    assert check_invariants(rep("ring_cp_forward"))           # lost the ring
+    assert check_invariants(rep(
+        "paged_serve_step", coll=[("all-reduce", 1)], ops=[("gather", 9)]
+    ))                                                        # grew a collective
+    assert check_invariants(rep("paged_serve_step"))          # lost the gathers
+    assert check_invariants(rep(
+        "paged_serve_step", ops=[("gather", 9)]
+    )) == []                                                  # healthy shape
+    assert check_invariants(rep("unknown_entry")) == []       # no table: no-op
+
+
+def test_memory_rtol():
+    a = HLOReport(
+        entry="m", collectives={}, collective_groups={}, ops={},
+        convert_upcasts=0, custom_calls={}, host_callbacks=0, donation=[],
+        memory={"peak_bytes": 1000},
+    )
+    b = HLOReport(
+        entry="m", collectives={}, collective_groups={}, ops={},
+        convert_upcasts=0, custom_calls={}, host_callbacks=0, donation=[],
+        memory={"peak_bytes": 1015},
+    )
+    assert compare_report(b, a, mem_rtol=0.02) == []
+    assert compare_report(b, a, mem_rtol=0.01)
+
+
+# -- the real HLO pipeline end-to-end on a tiny program -----------------------
+
+
+def test_analyze_real_compiled_program():
+    """Donation + upcast + memory fields against a real compiled object
+    (the five production entry points are covered in test_hlo_guards)."""
+
+    def f(x, y):
+        return (x.astype(jnp.bfloat16) @ y.astype(jnp.bfloat16)).astype(
+            jnp.float32
+        ) + x
+
+    x = jnp.ones((8, 8), jnp.float32)
+    c = jax.jit(f, donate_argnums=(0,)).lower(x, x).compile()
+    r = analyze_compiled(c, entry="tiny")
+    assert r.donation == ["output{} <- param 0{} (may-alias)"]
+    assert r.convert_upcasts >= 1
+    assert r.memory["argument_bytes"] == 512
+    assert r.memory["peak_bytes"] > 0
+    assert all(v == 0 for v in r.collectives.values())
+
+
+# -- transfer-guard tripwire (the dryrun stages run the full engines) ---------
+
+
+def test_transfer_guard_semantics():
+    """The contract the guarded serve/train steps rely on: the sanctioned
+    jnp.asarray upload stays legal, while an in-step device→host read or
+    an implicit mixed-operand transfer raises."""
+    with jax.transfer_guard("disallow"):
+        jnp.asarray(np.ones(3))  # plan upload: allowed
+    with pytest.raises(Exception, match="[Dd]isallow"):
+        with jax.transfer_guard("disallow"):
+            float(jnp.ones(()))  # host readout: trips
+    with pytest.raises(Exception, match="[Dd]isallow"):
+        with jax.transfer_guard("disallow"):
+            jnp.ones(3) + np.ones(3)  # implicit operand transfer: trips
+
+
+# -- the gate itself, on the package ------------------------------------------
+
+
+def test_gate_lint_prong_clean_on_package():
+    """`python -m automodel_tpu.analysis --lint-only` (in-process): the
+    package lints clean modulo the justified allowlist; the HLO prong's
+    baseline comparisons run in test_hlo_guards against the same library.
+    """
+    from automodel_tpu.analysis.cli import main
+
+    assert main(["--lint-only"]) == 0
+
+
+def test_gate_package_lint_has_no_unjustified_allowlist(tmp_path):
+    """A finding NOT in the allowlist fails the gate (fixture package on
+    disk, run through the same run_lint entry the CLI uses)."""
+    import os
+
+    from automodel_tpu.analysis.lint import lint_package
+
+    pkg = tmp_path / "pkg"
+    os.makedirs(pkg)
+    (pkg / "bad.py").write_text(
+        "import jax\n\n@jax.jit\ndef fwd(x):\n    return x.item()\n"
+    )
+    fs = lint_package(str(pkg), str(tmp_path))
+    assert _rules(fs) == ["AM101"]
+    kept, _, _ = apply_allowlist(fs, {})
+    assert kept  # unacknowledged -> the gate exits non-zero on these

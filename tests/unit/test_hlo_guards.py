@@ -1,224 +1,77 @@
-"""HLO collective-count guards: CPU-verifiable perf regression fences.
+"""HLO baseline guards: CPU-verifiable perf regression fences.
 
-The TPU tunnel has produced zero on-accelerator evidence in five rounds, so
-these guards pin the COMPILED collective structure of the headline parallel
-programs instead: `jit(...).lower().compile()` on a virtual CPU mesh emits
-the same logical collectives GSPMD/shard_map would emit for TPU, and a
-change that, say, re-gathers expert weights per microbatch or breaks the
-manual-A2A EP dispatch shows up as a count jump here — failing tier-1 with
-no accelerator in the loop.
+The TPU tunnel has produced zero on-accelerator evidence, so these guards
+pin the COMPILED structure of the headline parallel programs instead:
+`jit(...).lower().compile()` on a virtual CPU mesh emits the same logical
+collectives GSPMD/shard_map would emit for TPU, and a change that, say,
+re-gathers expert weights per microbatch or breaks the manual-A2A EP
+dispatch shows up as baseline drift here — failing tier-1 with no
+accelerator in the loop.
 
-Budgets are pinned to the measured counts of the current lowering (exact,
-not fuzzed): a regression that doubles a collective fails loudly; an
-optimization that LOWERS a count should consciously re-pin the budget.
-Floors assert the collectives that must exist (the ring ppermute, the EP
-all-to-all) so the guard also catches silently-degenerate programs."""
+This file used to hand-count `compiled.as_text()` ops with five copies of
+a regex; it is now a thin shell over `automodel_tpu.analysis`: one builder
+per jitted entry point (analysis/entrypoints.py), one structured report
+per compiled program (analysis/hlo.py), and one checked-in JSON baseline
+per entry (analysis/baselines/*.json). The ratchet is two-sided: a
+regression that GROWS a collective fails, and an optimization that LOWERS
+a count also fails until the baseline is consciously re-pinned with
 
-import dataclasses
-import re
+    python -m automodel_tpu.analysis --update-baselines
 
-import jax
-import jax.numpy as jnp
+which replaces hand-editing counts in five tests. The same comparisons run
+in CI via `python -m automodel_tpu.analysis`; keeping them as individual
+tier-1 tests too gives per-entry failure granularity and rides the
+existing pytest budget."""
+
+import os
+
 import pytest
 
-from automodel_tpu.distributed import MeshConfig
-from automodel_tpu.loss import fused_linear_cross_entropy
-from automodel_tpu.models.llm import decoder
-from automodel_tpu.models.llm.decoder import TransformerConfig
-from automodel_tpu.models.moe_lm import decoder as moe_decoder
-from automodel_tpu.models.moe_lm.decoder import MoETransformerConfig
-from automodel_tpu.moe import MoEConfig
-from automodel_tpu.parallel import logical_to_shardings
-
-COLLECTIVES = (
-    "all-gather", "all-reduce", "reduce-scatter",
-    "collective-permute", "all-to-all", "ragged-all-to-all",
+import automodel_tpu.analysis
+from automodel_tpu.analysis import compare_report, load_baseline
+from automodel_tpu.analysis.entrypoints import (
+    ENTRY_POINTS,
+    STRUCTURAL_INVARIANTS,
+    build_report,
+    check_invariants,
 )
 
-DENSE = TransformerConfig(
-    vocab_size=64, hidden_size=32, intermediate_size=48, num_layers=2,
-    num_heads=4, num_kv_heads=2, dtype=jnp.float32, remat_policy="none",
-    pipeline_microbatches=2,
-)
-MOE = MoETransformerConfig(
-    vocab_size=64, hidden_size=32, intermediate_size=48, num_layers=2,
-    num_heads=4, num_kv_heads=2, first_k_dense=0,
-    moe=MoEConfig(
-        n_routed_experts=4, n_shared_experts=1, experts_per_token=2,
-        moe_intermediate_size=16, shared_expert_intermediate_size=16,
-        aux_loss_coeff=0.01, dispatcher="dropless",
-    ),
-    dtype=jnp.float32, remat_policy="none", pipeline_microbatches=2,
+# the SAME directory `python -m automodel_tpu.analysis` gates
+BASELINES = os.path.join(
+    os.path.dirname(os.path.abspath(automodel_tpu.analysis.__file__)),
+    "baselines",
 )
 
 
-def _collective_counts(compiled) -> dict:
-    """Count collective instructions in optimized HLO. Scan bodies compile
-    once, so counts reflect program structure, not trip counts."""
-    txt = compiled.as_text()
-    # (?<![\w-]) keeps "all-to-all(" from also matching inside
-    # "ragged-all-to-all(" — \b holds after a hyphen
-    return {
-        c: len(re.findall(rf"(?<![\w-]){c}(?:-start)?\(", txt))
-        for c in COLLECTIVES
-    }
-
-
-def _sharded(cfg, mod, ctx):
-    params = mod.init(cfg, jax.random.key(0))
-    sh = logical_to_shardings(
-        mod.param_specs(cfg), ctx,
-        shapes=jax.tree.map(lambda p: p.shape, params),
+@pytest.mark.parametrize("entry", sorted(ENTRY_POINTS))
+def test_hlo_baseline(entry):
+    report = build_report(entry)
+    baseline = load_baseline(BASELINES, entry)
+    assert baseline is not None, (
+        f"no baseline for {entry!r} in {BASELINES} — run "
+        "`python -m automodel_tpu.analysis --update-baselines`"
     )
-    return jax.device_put(params, sh)
-
-
-def _ids(ctx, B=8, S=16, seq_axis=None):
-    return jax.device_put(
-        jnp.zeros((B, S), jnp.int32), ctx.sharding("batch", seq_axis)
+    drifts = compare_report(report, baseline)
+    assert not drifts, (
+        "compiled program drifted from its baseline; if intentional, "
+        "re-pin with `python -m automodel_tpu.analysis --update-baselines` "
+        "and justify in the PR:\n" + "\n".join(drifts)
     )
+    # structural invariants (floors / zero-ceilings / op floors) live next
+    # to the entry-point registry so the CLI gate enforces the SAME tables
+    # — and --update-baselines refuses to pin a program that violates them
+    assert check_invariants(report) == []
+    assert entry in STRUCTURAL_INVARIANTS  # registry/invariants stay in sync
 
 
-def _check(counts: dict, budget: dict, floors: dict = ()):
-    for c, limit in budget.items():
-        assert counts[c] <= limit, (
-            f"{c}: {counts[c]} > pinned budget {limit} — the compiled "
-            f"program grew collectives (full counts: {counts}); if this is "
-            "an intentional lowering change, re-pin the budget"
-        )
-    for c, lo in dict(floors).items():
-        assert counts[c] >= lo, (
-            f"{c}: {counts[c]} < floor {lo} — the program lost a collective "
-            f"it needs (degenerate lowering? full counts: {counts})"
-        )
-
-
-def test_hlo_guard_fsdp_grad():
-    """dp_shard=8 dense decoder grad: per-layer-scan param all-gathers +
-    grad all-reduces; no permutes / A2As may appear in pure FSDP."""
-    ctx = MeshConfig(dp_shard=8).build()
-    p = _sharded(DENSE, decoder, ctx)
-    ids, lab = _ids(ctx), _ids(ctx)
-
-    def loss(p, i, l):
-        h = decoder.forward(p, DENSE, i, mesh_ctx=ctx, return_hidden=True)
-        ce, _ = fused_linear_cross_entropy(
-            h, p["lm_head"]["kernel"], l, chunk_size=64
-        )
-        return ce
-
-    counts = _collective_counts(
-        jax.jit(jax.grad(loss)).lower(p, ids, lab).compile()
-    )
-    _check(
-        counts,
-        budget={"all-gather": 18, "all-reduce": 12, "collective-permute": 0,
-                "all-to-all": 0, "ragged-all-to-all": 0},
-        floors={"all-gather": 1, "all-reduce": 1},
-    )
-
-
-def test_hlo_guard_ring_cp_forward():
-    """cp=2 ring attention: the KV ring is collective-permutes (one hop per
-    cp peer per scanned attention call), never an A2A."""
-    ctx = MeshConfig(cp=2, dp_shard=4).build()
-    p = _sharded(DENSE, decoder, ctx)
-    ids = _ids(ctx, B=4, seq_axis="cp")
-    counts = _collective_counts(
-        jax.jit(lambda p, i: decoder.forward(p, DENSE, i, mesh_ctx=ctx))
-        .lower(p, ids).compile()
-    )
-    _check(
-        counts,
-        budget={"all-gather": 9, "all-reduce": 0, "collective-permute": 4,
-                "all-to-all": 0, "ragged-all-to-all": 0},
-        floors={"collective-permute": 1},
-    )
-
-
-def test_hlo_guard_ep_moe_forward():
-    """ep=4 dropless MoE forward: the manual EP dispatch is a bounded
-    number of (dense-bucket, on CPU) all-to-alls — token sort + send +
-    return combine; a re-gather of expert weights would spike all-gather."""
-    ctx = MeshConfig(ep=4, dp_shard=2).build()
-    p = _sharded(MOE, moe_decoder, ctx)
-    ids = _ids(ctx)
-    counts = _collective_counts(
-        jax.jit(lambda p, i: moe_decoder.forward(p, MOE, i, mesh_ctx=ctx))
-        .lower(p, ids).compile()
-    )
-    _check(
-        counts,
-        budget={"all-gather": 14, "all-reduce": 2, "collective-permute": 0,
-                "all-to-all": 3, "ragged-all-to-all": 0},
-        floors={"all-to-all": 1},
-    )
-
-
-def test_hlo_guard_paged_decode_step():
-    """The serving engine's jitted step: per-layer paged-pool reads must
-    stay GATHERS (page-table indexed; a regression to per-request dense
-    caches would spike dynamic-slice / blow the gather count), pool writes
-    stay O(stacks) in-place updates, and a single-process step must emit NO
-    collectives. The prefix-hit path rides the SAME program — cross-request
-    page sharing is pure page-table indirection — plus the fixed-shape
-    copy-on-write block (cow_src/cow_dst, one bounded page copy per slot),
-    whose cost is pinned into the budgets below. Counts are per compiled
-    program structure (the layer scan compiles once), pinned exactly like
-    the budgets above."""
-    from automodel_tpu.serving.engine import ServingConfig, ServingEngine
-
-    cfg = dataclasses.replace(DENSE, pipeline_microbatches=1)
-    params = decoder.init(cfg, jax.random.key(0))
-    eng = ServingEngine(params, cfg, ServingConfig(
-        page_size=4, num_pages=16, max_slots=2, pages_per_slot=4,
-        token_budget=8,
-    ))
-    T, S, P = 8, 2, 4
-    batch = {k: jnp.zeros(T, jnp.int32) for k in ("tok", "slot", "pos", "page", "off")}
-    batch.update(
-        page_tables=jnp.zeros((S, P), jnp.int32),
-        sample_tok=jnp.zeros(S, jnp.int32),
-        temp=jnp.zeros(S, jnp.float32),
-        seed=jnp.zeros(S, jnp.int32),
-        cow_src=jnp.zeros(S, jnp.int32),
-        cow_dst=jnp.zeros(S, jnp.int32),
-    )
-    compiled = eng._step.lower(eng.params, eng.pool, batch).compile()
-    txt = compiled.as_text()
-    ops = ("gather", "dynamic-slice", "dynamic-update-slice") + COLLECTIVES
-    counts = {
-        c: len(re.findall(rf"= (?:[\w\[\],<>:{{}} ]+ )?{c}\(", txt))
-        for c in ops
-    }
-    # re-pinned for the COW block: +2 gathers (read cow_src pages of k and
-    # v), +8 slice/update pairs scattering them to cow_dst — still O(pool
-    # leaves), independent of traffic, and collective-free
-    _check(
-        counts,
-        budget={"gather": 9, "dynamic-slice": 27, "dynamic-update-slice": 6,
-                "all-gather": 0, "all-reduce": 0, "collective-permute": 0,
-                "all-to-all": 0, "ragged-all-to-all": 0},
-        floors={"gather": 2},  # ≥ the paged k/v page gathers
-    )
-
-
-def test_hlo_guard_pp_ep_1f1b_grad():
-    """The flagship PP×EP program: explicit 1F1B grad with the expert A2A
-    inside each stage's step. The ppermute ring (fwd + bwd streams) and the
-    per-stage A2As (fwd, recompute, dgrad) are the pinned structure; expert
-    weights must NOT be re-gathered per microbatch (all-gather budget)."""
-    cfg = dataclasses.replace(MOE, pipeline_schedule="1f1b")
-    ctx = MeshConfig(pp=2, ep=2, dp_shard=2).build()
-    p = _sharded(cfg, moe_decoder, ctx)
-    batch = {"input_ids": _ids(ctx), "labels": _ids(ctx)}
-    grad_fn = decoder.make_pp_1f1b_loss_and_grad(cfg, ctx, chunk_size=64)
-    counts = _collective_counts(
-        jax.jit(grad_fn).lower(p, batch, jax.random.key(0)).compile()
-    )
-    _check(
-        counts,
-        budget={"all-gather": 13, "all-reduce": 24, "collective-permute": 6,
-                "all-to-all": 11, "ragged-all-to-all": 0},
-        floors={"collective-permute": 2, "all-to-all": 2},
+def test_paged_serve_step_donation_pinned():
+    """The serve step's pool donation is part of the compiled contract:
+    losing it silently doubles pool memory. The aliasing table in the
+    baseline must stay non-empty (belt to the baseline's suspenders —
+    this asserts the INVARIANT, not a count that drifts)."""
+    baseline = load_baseline(BASELINES, "paged_serve_step")
+    assert baseline is not None
+    assert baseline.donation, (
+        "paged_serve_step baseline has an empty input_output_alias table — "
+        "the pool donation was lost"
     )

@@ -58,16 +58,18 @@ def test_defrag_compacts_live_pages():
     used = sorted(p for s in (0, 2) for p in a.table(s))
     assert used == [0, 1, 2]
     assert a.num_free == 5
-    # device-side: new page i holds old page src[i]
+    # device-side: new page i holds old page src[i] (apply_defrag donates
+    # the pool, so compare against a host snapshot taken before the call)
     pool = (jnp.arange(2 * 9 * 2 * 1 * 1, dtype=jnp.float32).reshape(2, 9, 2, 1, 1),)
+    before = np.asarray(pool[0])
     moved = apply_defrag(pool, src)[0]
     for slot in (0, 2):
         for old, new in zip(live_before[slot], a.table(slot)):
             np.testing.assert_array_equal(
-                np.asarray(moved[:, new]), np.asarray(pool[0][:, old])
+                np.asarray(moved[:, new]), before[:, old]
             )
     # trash page (index num_pages) stays put
-    np.testing.assert_array_equal(np.asarray(moved[:, 8]), np.asarray(pool[0][:, 8]))
+    np.testing.assert_array_equal(np.asarray(moved[:, 8]), before[:, 8])
 
 
 def test_refcount_share_and_free():
