@@ -9,6 +9,7 @@ collectives GSPMD/shard_map would emit for TPU):
 - ``ring_cp_forward``   — cp=2 ring-attention forward
 - ``ep_moe_forward``    — ep=4 dropless-MoE forward
 - ``paged_serve_step``  — the serving engine's single-chip jitted step
+- ``spec_serve_step``   — the same step with speculative draft-then-verify
 - ``pp_ep_1f1b_grad``   — the flagship PP×EP explicit 1F1B grad
 
 Each builder returns ``(compiled, mesh_axes)``; callers feed both to
@@ -170,6 +171,45 @@ def paged_serve_step():
     return compiled, None
 
 
+def spec_serve_step():
+    """The serving step with speculative decoding enabled: the verify
+    block adds row gathers + the (S, K+1)-row unembed/acceptance tail on
+    top of the paged_serve_step program. Must stay collective-free with
+    the pool donation intact, and the paged k/v page gathers must survive
+    — a lowering that drops the verify-row gather would silently verify
+    nothing."""
+    import jax
+    import jax.numpy as jnp
+
+    from automodel_tpu.models.llm import decoder
+    from automodel_tpu.serving.engine import ServingConfig, ServingEngine
+    from automodel_tpu.speculative.serve_draft import SpeculativeConfig
+
+    dense, _ = _configs()
+    cfg = dataclasses.replace(dense, pipeline_microbatches=1)
+    params = decoder.init(cfg, jax.random.key(0))
+    K = 3
+    eng = ServingEngine(params, cfg, ServingConfig(
+        page_size=4, num_pages=16, max_slots=2, pages_per_slot=4,
+        token_budget=8,
+        speculative=SpeculativeConfig(enabled=True, draft_len=K),
+    ))
+    T, S, P = 8, 2, 4
+    batch = {k: jnp.zeros(T, jnp.int32) for k in ("tok", "slot", "pos", "page", "off")}
+    batch.update(
+        page_tables=jnp.zeros((S, P), jnp.int32),
+        sample_tok=jnp.zeros(S, jnp.int32),
+        temp=jnp.zeros(S, jnp.float32),
+        seed=jnp.zeros(S, jnp.int32),
+        cow_src=jnp.zeros(S, jnp.int32),
+        cow_dst=jnp.zeros(S, jnp.int32),
+        verify_rows=jnp.zeros((S, K + 1), jnp.int32),
+        spec_len=jnp.zeros(S, jnp.int32),
+    )
+    compiled = eng._step.lower(eng.params, eng.pool, batch).compile()
+    return compiled, None
+
+
 def pp_ep_1f1b_grad():
     """The flagship PP×EP program: explicit 1F1B grad with the expert A2A
     inside each stage's step. The ppermute ring (fwd + bwd streams) and
@@ -196,6 +236,7 @@ ENTRY_POINTS = {
     "ring_cp_forward": ring_cp_forward,
     "ep_moe_forward": ep_moe_forward,
     "paged_serve_step": paged_serve_step,
+    "spec_serve_step": spec_serve_step,
     "pp_ep_1f1b_grad": pp_ep_1f1b_grad,
 }
 
@@ -230,6 +271,16 @@ STRUCTURAL_INVARIANTS = {
             "collective-permute", "all-to-all", "ragged-all-to-all",
         ),
         "op_floors": {"gather": 2},  # >= the paged k/v page gathers
+    },
+    "spec_serve_step": {
+        "floors": {},
+        "zeros": (
+            "all-gather", "all-reduce", "reduce-scatter",
+            "collective-permute", "all-to-all", "ragged-all-to-all",
+        ),
+        # paged k/v page gathers PLUS the (S, K+1) verify-row gather —
+        # a program below this floor stopped verifying drafted blocks
+        "op_floors": {"gather": 3},
     },
     "pp_ep_1f1b_grad": {
         "floors": {"collective-permute": 2, "all-to-all": 2},

@@ -36,6 +36,17 @@ order), so the position bound alone masks both the causal future *and*
 unallocated page-table padding (which must still hold a VALID page index —
 the pool's trash page — to keep gathers in bounds). positions[t] < 0 marks a
 pad row: fully masked, output 0.
+
+Multi-query-per-slot scoring rows: nothing ties a request to one row per
+call — chunked prefill feeds whole chunks, and speculative decoding's
+draft-then-verify (serving/engine.py) feeds a slot's pending token plus K
+provisional drafts at positions p..p+K in the SAME batch. Because the
+engine scatters each row's K/V into the pool BEFORE this op gathers (per
+layer), a draft row at position p+j attends to the drafts before it
+through the ordinary position bound — verifying a whole block costs one
+call, the same bandwidth the pages cost anyway. The kernel contract is
+unchanged: rows are independent given (page table row, position), so a
+verify block is just more ragged rows.
 """
 
 from __future__ import annotations

@@ -32,6 +32,13 @@ YAML:
         max_pages: null               # cap on cached pages (null → pool)
         eviction: lru                 # lru | fifo
         share_partial: true           # COW-adopt a mid-page divergence
+      speculative:                    # typed: SpeculativeConfig
+        enabled: false
+        draft_source: ngram           # ngram only from YAML (eagle/dflash
+        draft_len: 4                  #   need drafter params — API-only)
+        acceptance: greedy            # greedy | sampled
+        ngram_max: 3
+        ngram_min: 1
     max_requests: 64
 """
 
@@ -119,6 +126,7 @@ class ServeRecipe(TrainFinetuneRecipeForNextTokenPrediction):
             top_k=(int(get("top_k", 0)) or None),
             top_p=(float(get("top_p", 0.0)) or None),
             prefix_cache=self.typed.serving_prefix_cache,
+            speculative=self.typed.serving_speculative,
             admission_policy=str(get("admission_policy", "fifo")),
         )
         params = self.train_state.params

@@ -145,6 +145,19 @@ class RecipeConfig:
         return self._cache[key]
 
     @property
+    def serving_speculative(self):
+        """`serving.speculative` section → SpeculativeConfig (defaults to
+        disabled when the section is absent)."""
+        from automodel_tpu.speculative.serve_draft import SpeculativeConfig
+
+        key = ("serving.speculative", "SpeculativeConfig")
+        if key not in self._cache:
+            node = self.raw.get("serving")
+            sub = node.get("speculative") if node is not None else None
+            self._cache[key] = dataclass_from_node(SpeculativeConfig, sub)
+        return self._cache[key]
+
+    @property
     def packing(self) -> Optional[Any]:
         node = self.raw.get("packing")
         if node is None:

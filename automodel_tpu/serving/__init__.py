@@ -17,8 +17,19 @@ from automodel_tpu.serving.prefix_cache import (
     PrefixMatch,
 )
 from automodel_tpu.serving.scheduler import Scheduler, StepPlan
+from automodel_tpu.speculative.serve_draft import (
+    DFlashDraftSource,
+    DraftSource,
+    EagleDraftSource,
+    NgramDraftSource,
+    SpeculativeConfig,
+)
 
 __all__ = [
+    "DFlashDraftSource",
+    "DraftSource",
+    "EagleDraftSource",
+    "NgramDraftSource",
     "PageAllocator",
     "PrefixCache",
     "PrefixCacheConfig",
@@ -27,6 +38,7 @@ __all__ = [
     "Scheduler",
     "ServingConfig",
     "ServingEngine",
+    "SpeculativeConfig",
     "StepPlan",
     "pages_for",
 ]

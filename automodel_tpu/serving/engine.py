@@ -26,6 +26,18 @@ top-k/top-p (static, engine-wide) filtered categorical with the key derived
 as fold_in(key(slot seed), position) — deterministic per request and stable
 across preempt-and-requeue recompute.
 
+Speculative decoding (ServingConfig.speculative, opt-in): the scheduler
+appends up to K drafted rows behind each decode slot's pending token
+(speculative/serve_draft.py sources them), the SAME ragged paged-attention
+step scores the whole block, and an in-jit verify tail
+(speculative/acceptance.py — greedy: longest matching prefix; sampled:
+distribution-preserving one-hot rejection) returns the committed-candidate
+block plus the accepted length. The spec step is its own single compiled
+signature — fixed (S, K+1) verify rows, idle slots carry empty blocks —
+and the plain program is byte-identical to the speculation-disabled
+engine's (both pinned by analysis baselines paged_serve_step /
+spec_serve_step).
+
 `serve_batch()` is the offline API (recipes/llm/serve.py wires it to the
 CLI): submit a list of requests with arrival times, drive steps until
 drained, return per-request outputs + throughput/latency counters (logged
@@ -65,6 +77,14 @@ from automodel_tpu.ops.rope import rope_frequencies
 from automodel_tpu.serving.kv_pages import apply_defrag, init_pool
 from automodel_tpu.serving.prefix_cache import PrefixCacheConfig
 from automodel_tpu.serving.scheduler import Request, Scheduler, StepPlan
+from automodel_tpu.speculative.acceptance import (
+    greedy_accept_length,
+    onehot_speculative_verify,
+)
+from automodel_tpu.speculative.serve_draft import (
+    SpeculativeConfig,
+    build_draft_source,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -84,6 +104,10 @@ class ServingConfig:
     # prefix sharing (serving/prefix_cache.py): refcounted COW pages + a
     # radix tree over known tokens; None/disabled → PR-2 behavior exactly
     prefix_cache: PrefixCacheConfig | None = None
+    # speculative decoding (speculative/serve_draft.py): per-slot
+    # draft-then-verify inside the one jitted step; None/disabled → the
+    # plain one-token-per-slot decode program exactly
+    speculative: SpeculativeConfig | None = None
     admission_policy: str = "fifo"  # "fifo" | "prefix-hit"
     # debug tripwire: run the jitted step under jax.transfer_guard
     # ("disallow") so an unintended device↔host transfer inside the step
@@ -100,6 +124,11 @@ class ServingConfig:
         assert self.admission_policy in ("fifo", "prefix-hit")
         if self.admission_policy == "prefix-hit":
             assert self.prefix_cache is not None and self.prefix_cache.enabled
+        if self.speculative is not None and self.speculative.enabled:
+            # at least one full verify block must fit a step
+            assert self.token_budget >= self.speculative.draft_len + 1, (
+                "token_budget must cover draft_len + 1 verify rows"
+            )
 
 
 class ServingEngine:
@@ -107,7 +136,13 @@ class ServingEngine:
     families (TransformerConfig / MoETransformerConfig, GQA or MLA). The
     heterogeneous python-loop engine (HetMoEConfig) is not servable here."""
 
-    def __init__(self, params, cfg, serve_cfg: ServingConfig = ServingConfig()):
+    def __init__(
+        self,
+        params,
+        cfg,
+        serve_cfg: ServingConfig = ServingConfig(),
+        draft_source=None,
+    ):
         from automodel_tpu.models.moe_lm.het_moe import HetMoEConfig
 
         if isinstance(cfg, HetMoEConfig):
@@ -166,6 +201,19 @@ class ServingEngine:
             cfg, [L for *_, L in self._stacks],
             serve_cfg.num_pages, serve_cfg.page_size,
         )
+        # speculative decoding: a STATIC trace-time choice — the spec and
+        # plain engines each compile exactly one step program (the plain
+        # program is byte-identical to the non-speculative engine's, so
+        # the paged_serve_step HLO baseline is untouched)
+        spec = serve_cfg.speculative
+        self._spec = spec if (spec is not None and spec.enabled) else None
+        self._draft_source = None
+        if self._spec is not None:
+            self._draft_source = draft_source or build_draft_source(
+                self._spec,
+                max_context=serve_cfg.pages_per_slot * serve_cfg.page_size,
+            )
+        self._needs_hidden = getattr(self._draft_source, "needs_hidden", "none")
         self._step = jax.jit(self._step_impl, donate_argnums=(1,))
         self.steps_run = 0
 
@@ -264,28 +312,107 @@ class ServingEngine:
 
         h = rms_norm(h, params["final_norm"]["scale"], cfg.rms_norm_eps,
                      cfg.zero_centered_norm)
+        if self._spec is not None:
+            return self._spec_verify_tail(params, new_pool, h, b)
         # sample rows: each slot's last scheduled token (or a junk row when
         # sample_tok < 0 — the host ignores those slots)
         idx = jnp.clip(b["sample_tok"], 0, h.shape[1] - 1)
         h_s = h[0, idx]                            # (S, H)
         logits = unembed(params, cfg, h_s[None])[0]  # (S, V) fp32
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-
-        temp = jnp.maximum(b["temp"], 1e-6)[:, None]
-        filtered = filter_logits(logits / temp, sc.top_k, sc.top_p)
-        # key = fold_in(key(seed), position-of-the-new-token): per-request
-        # deterministic, independent of batching, preemption-stable
         next_pos = jnp.maximum(b["pos"], 0)[idx] + 1
-        keys = jax.vmap(
-            lambda s, p: jax.random.fold_in(jax.random.key(s), p)
-        )(b["seed"], next_pos)
-        sampled = jax.vmap(
-            lambda k, l: jax.random.categorical(k, l)
-        )(keys, filtered).astype(jnp.int32)
+        sampled = self._sample_rows(logits, b["temp"], b["seed"], next_pos)
         tokens = jnp.where(b["temp"] > 0.0, sampled, greedy)
         logprobs = jax.nn.log_softmax(logits, axis=-1)
         lp_tok = jnp.take_along_axis(logprobs, tokens[:, None], axis=-1)[:, 0]
         return new_pool, tokens, lp_tok
+
+    def _sample_rows(self, logits, temp, seed, next_pos):
+        """Per-slot filtered categorical over one logits row each — the ONE
+        sampling recipe (temperature clamp → static top-k/p filter → key =
+        fold_in(key(seed), position-of-the-new-token): per-request
+        deterministic, independent of batching, preemption-stable). Shared
+        by the plain tail and the spec tail's greedy-acceptance branch;
+        the spec-on == spec-off contract for sampled slots rests on this
+        being a single implementation."""
+        sc = self.serve_cfg
+        filtered = filter_logits(
+            logits / jnp.maximum(temp, 1e-6)[:, None], sc.top_k, sc.top_p
+        )
+        keys = jax.vmap(
+            lambda s, p: jax.random.fold_in(jax.random.key(s), p)
+        )(seed, next_pos)
+        return jax.vmap(
+            lambda k, l: jax.random.categorical(k, l)
+        )(keys, filtered).astype(jnp.int32)
+
+    def _spec_verify_tail(self, params, new_pool, h, b):
+        """Draft-then-verify sampling tail (speculation enabled): score
+        every slot's verify block — the row feeding its pending token plus
+        the rows feeding its K drafts — and keep the longest valid prefix
+        via the shared acceptance rule (speculative/acceptance.py). A slot
+        with spec_len == 0 (prefill, or a decode slot whose block shrank
+        away) reduces exactly to the plain one-row tail: its verify rows
+        all alias the sample row and acceptance is always 0, so tokens[:1]
+        is the plain greedy/sampled token."""
+        cfg, sc = self.cfg, self.serve_cfg
+        K = self._spec.draft_len
+        T = h.shape[1]
+        vr = jnp.clip(b["verify_rows"], 0, T - 1)              # (S, K+1)
+        h_sel = h[0, vr]                                       # (S, K+1, H)
+        S = h_sel.shape[0]
+        logits = unembed(params, cfg, h_sel.reshape(1, S * (K + 1), -1))
+        logits = logits[0].reshape(S, K + 1, -1)               # fp32
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        draft = b["tok"][vr[:, 1:]]                            # (S, K)
+        valid = jnp.arange(K)[None, :] < b["spec_len"][:, None]
+        a_greedy = greedy_accept_length(draft, greedy[:, :K], valid)
+
+        base = jnp.maximum(b["pos"], 0)[vr[:, 0]] + 1          # (S,)
+        use_sample = b["temp"] > 0.0
+        if self._spec.acceptance == "sampled":
+            # distribution-preserving one-hot verification over the SAME
+            # filtered per-slot distribution the plain tail samples from,
+            # with key[j] = fold_in(request seed, absolute position) —
+            # batching-invariant and preemption-stable, and identical to
+            # the plain tail when the block is empty
+            temp = jnp.maximum(b["temp"], 1e-6)[:, None, None]
+            filtered = filter_logits(logits / temp, sc.top_k, sc.top_p)
+            keys = jax.vmap(
+                lambda s, p0: jax.vmap(
+                    lambda j: jax.random.fold_in(jax.random.key(s), p0 + j)
+                )(jnp.arange(K + 1))
+            )(b["seed"], base)
+            a_samp, tok_samp = jax.vmap(onehot_speculative_verify)(
+                draft, filtered, keys, valid
+            )
+            accept = jnp.where(use_sample, a_samp, a_greedy).astype(jnp.int32)
+            # greedy committed tokens ARE the verifier's own argmax rows
+            # (an accepted draft equals the argmax of the row before it)
+            tokens = jnp.where(use_sample[:, None], tok_samp, greedy)
+        else:
+            # acceptance == "greedy" (static): only temperature<=0 slots
+            # draft, so sampled slots need exactly the plain one-row tail
+            # (_sample_rows, the shared implementation) — the block
+            # machinery is argmax-only, keeping the default program lean
+            sampled0 = self._sample_rows(
+                logits[:, 0], b["temp"], b["seed"], base
+            )
+            accept = jnp.where(use_sample, 0, a_greedy).astype(jnp.int32)
+            tokens = greedy.at[:, 0].set(
+                jnp.where(use_sample, sampled0, greedy[:, 0])
+            )
+        logprobs = jax.nn.log_softmax(logits, axis=-1)
+        lp_tok = jnp.take_along_axis(logprobs, tokens[..., None], -1)[..., 0]
+        out = [new_pool, tokens, lp_tok, accept]
+        if self._needs_hidden == "frontier":
+            # the hidden that produced the bonus token (row `accept`)
+            out.append(jnp.take_along_axis(
+                h_sel, jnp.clip(accept, 0, K)[:, None, None], axis=1
+            )[:, 0])
+        elif self._needs_hidden == "rows":
+            out.append(h[0])
+        return tuple(out)
 
     # -- host API -----------------------------------------------------------
     def step_cache_size(self) -> int:
@@ -294,8 +421,10 @@ class ServingEngine:
         return self._step._cache_size()
 
     def run_step(self, plan: StepPlan):
-        """Upload one StepPlan, run the jitted step, return sampled tokens
-        (S,) + their logprobs as numpy."""
+        """Upload one StepPlan, run the jitted step, return numpy outputs:
+        (tokens (S,), logprobs (S,)) plainly, or — with speculation — the
+        committed-candidate block (tokens (S, K+1), logprobs (S, K+1),
+        accept (S,)[, hidden feedback for the draft source])."""
         batch = {
             "tok": jnp.asarray(plan.tok),
             "slot": jnp.asarray(plan.slot),
@@ -309,16 +438,20 @@ class ServingEngine:
             "cow_src": jnp.asarray(plan.cow_src),
             "cow_dst": jnp.asarray(plan.cow_dst),
         }
+        if self._spec is not None:
+            batch["verify_rows"] = jnp.asarray(plan.verify_rows)
+            batch["spec_len"] = jnp.asarray(plan.spec_len)
         # the StepPlan upload above is the ONE sanctioned host→device copy
         # per step; with guard_transfers the step invocation itself runs
         # under transfer_guard("disallow") so any other transfer raises
         if self.serve_cfg.guard_transfers:
             with jax.transfer_guard("disallow"):
-                self.pool, tokens, lps = self._step(self.params, self.pool, batch)
+                out = self._step(self.params, self.pool, batch)
         else:
-            self.pool, tokens, lps = self._step(self.params, self.pool, batch)
+            out = self._step(self.params, self.pool, batch)
+        self.pool = out[0]
         self.steps_run += 1
-        return np.asarray(tokens), np.asarray(lps)
+        return tuple(np.asarray(x) for x in out[1:])
 
     def make_scheduler(self) -> Scheduler:
         sc = self.serve_cfg
@@ -328,6 +461,7 @@ class ServingEngine:
             token_budget=sc.token_budget, prefill_chunk=sc.prefill_chunk,
             prefix_cache=sc.prefix_cache,
             admission_policy=sc.admission_policy,
+            spec=self._spec, draft_source=self._draft_source,
         )
 
     def defrag(self, scheduler: Scheduler) -> bool:
@@ -404,26 +538,43 @@ class ServingEngine:
                 step_idx += 1
                 continue
             t0 = time.perf_counter()
-            tokens, _lps = self.run_step(plan)
+            out = self.run_step(plan)
             dt = time.perf_counter() - t0
-            sched.update(plan, tokens, step_idx)
+            if self._spec is not None:
+                tokens, _lps, accept, *hid = out
+                fh = hid[0] if self._needs_hidden == "frontier" else None
+                rh = hid[0] if self._needs_hidden == "rows" else None
+                n_new = sched.update(
+                    plan, tokens, step_idx, accept=accept,
+                    frontier_hidden=fh, row_hidden=rh,
+                )
+            else:
+                tokens, _lps = out
+                n_new = sched.update(plan, tokens, step_idx)
             n_steps += 1
             n_tokens_fed += plan.n_tokens
             if plan.n_samples:
                 decode_s += dt
-                n_sampled += plan.n_samples
+                n_sampled += n_new
             if metric_logger is not None and log_every and (
                 self.steps_run % log_every == 0
             ):
-                metric_logger.log({
+                rec = {
                     "step": self.steps_run,
                     "serving_step_ms": round(dt * 1e3, 3),
                     "tokens_fed": plan.n_tokens,
-                    "tokens_sampled": plan.n_samples,
+                    "tokens_sampled": n_new,
                     "running": len(sched.running),
                     "waiting": len(sched.waiting),
                     "free_pages": sched.alloc.num_free,
-                })
+                }
+                if self._spec is not None:
+                    rec.update(
+                        drafted_tokens=sched.n_drafted,
+                        accepted_tokens=sched.n_accepted,
+                        rolled_back_tokens=sched.n_drafted - sched.n_accepted,
+                    )
+                metric_logger.log(rec)
             step_idx += 1
         elapsed = time.perf_counter() - t_start
         assert not sched.has_work or max_steps is not None, "serve stalled"
@@ -447,6 +598,23 @@ class ServingEngine:
                 "cow_copies": sched.n_cow,
                 "prefix_cached_pages": sched.prefix.cached_pages,
                 "prefix_evicted_pages": sched.prefix.n_evicted,
+            })
+        if self._spec is not None:
+            stats.update({
+                "drafted_tokens": sched.n_drafted,
+                "accepted_tokens": sched.n_accepted,
+                "rolled_back_tokens": sched.n_drafted - sched.n_accepted,
+                "spec_steps": sched.n_spec_steps,
+                "acceptance_rate": round(
+                    sched.n_accepted / max(sched.n_drafted, 1), 4
+                ),
+                # committed tokens per drafted verify step (accepted + the
+                # bonus) — the "tokens per jitted step" headline; > 1 means
+                # speculation is beating one-token-per-step decode
+                "mean_accepted_len": round(
+                    (sched.n_accepted + sched.n_spec_steps)
+                    / max(sched.n_spec_steps, 1), 4
+                ),
             })
         if metric_logger is not None:
             metric_logger.log({"step": self.steps_run, **{

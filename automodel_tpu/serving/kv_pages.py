@@ -150,6 +150,20 @@ class PageAllocator:
         self.decref(old)
         return old, new
 
+    def truncate(self, slot: int, n_pages: int) -> int:
+        """Shrink `slot`'s table to its first `n_pages` entries, dropping
+        one reference per removed page (an exclusively-held page returns
+        to the free list; a shared one lives on for its other holders).
+        The speculative-decode rollback: provisional pages a rejected
+        draft suffix spilled into are released between steps. Returns the
+        number of entries dropped."""
+        table = self._tables.get(slot, [])
+        dropped = table[n_pages:]
+        del table[n_pages:]
+        for p in dropped:
+            self.decref(p)
+        return len(dropped)
+
     def free_slot(self, slot: int) -> None:
         for p in self._tables.pop(slot, []):
             self.decref(p)

@@ -42,6 +42,24 @@ the queue head, prefer the arrived waiter with the highest hit ratio —
 it adds decode load with the least prefill work, protecting decode
 latency (the SLO currency) while the pool is contended.
 
+Speculative decoding (speculative/serve_draft.py, opt-in): a decode-class
+slot (one pending token) additionally asks its draft source for up to K
+provisional tokens and feeds them as extra rows of the SAME chunk —
+positions fed+1..fed+K, appended into spare pages the slot allocates
+opportunistically. The jitted step scores the whole block in one ragged
+paged-attention pass and verifies it in-jit (acceptance.py); `update`
+absorbs the accepted prefix (+1 bonus/corrected token), rolls `fed` back
+past the rejected suffix, and truncates the page table's provisional
+tail (`PageAllocator.truncate`) — rollback is integer bookkeeping, the
+payoff of the no-phase-flags request model (rejected KV rows sit beyond
+`fed` and are overwritten when those positions are legitimately fed).
+Provisional pages are OPPORTUNISTIC: they are allocated with reclaim but
+never preemption (a draft block shrinks — possibly to zero, degrading to
+plain decode — before any running request is evicted for it), they never
+count in admission (`_need` stays known+1), and they are released every
+step, so deadline eviction, preempt-and-requeue, and prefix-cache
+donation only ever see committed pages.
+
 The scheduler owns request/page state only; it never touches device
 memory — it emits a `StepPlan` of numpy arrays the engine uploads.
 """
@@ -113,12 +131,22 @@ class StepPlan:
     # copy-on-write page copies (≤ 1 per slot per step; trash→trash = no-op)
     cow_src: np.ndarray = None  # (S,) int32 source page
     cow_dst: np.ndarray = None  # (S,) int32 destination page
+    # speculative decoding (None unless the engine runs with it enabled):
+    # verify_rows[s, j] = row feeding the j-th token of slot s's verify
+    # block (row 0 = the pending known token, rows 1..k its drafts; padded
+    # entries repeat the last valid row), spec_len[s] = drafted tokens
+    verify_rows: np.ndarray = None  # (S, K+1) int32
+    spec_len: np.ndarray = None     # (S,) int32
     scheduled: list = dataclasses.field(default_factory=list)
     # scheduled: [(slot, n_tokens, samples: bool)] — host bookkeeping
+    # (a slot's drafted rows are NOT in n_tokens; see spec_len)
 
     @property
     def n_tokens(self) -> int:
-        return sum(c for _, c, _ in self.scheduled)
+        fed = sum(c for _, c, _ in self.scheduled)
+        if self.spec_len is not None:
+            fed += int(self.spec_len.sum())
+        return fed
 
     @property
     def n_samples(self) -> int:
@@ -139,6 +167,8 @@ class Scheduler:
         prefill_chunk: int | None = None,
         prefix_cache: PrefixCacheConfig | None = None,
         admission_policy: str = "fifo",
+        spec=None,               # SpeculativeConfig (enabled) or None
+        draft_source=None,       # speculative.serve_draft.DraftSource
     ):
         self.alloc = PageAllocator(num_pages, page_size)
         self.page_size = page_size
@@ -159,6 +189,10 @@ class Scheduler:
             if prefix_cache is not None and prefix_cache.enabled
             else None
         )
+        self.spec = spec if (spec is not None and spec.enabled) else None
+        self.draft_source = draft_source if self.spec is not None else None
+        if self.spec is not None and self.draft_source is None:
+            raise ValueError("speculative scheduling needs a draft source")
         self.waiting: deque[Request] = deque()
         self.running: dict[int, Request] = {}   # slot → request
         self._admit_order: list[int] = []       # slots, oldest admit first
@@ -169,6 +203,10 @@ class Scheduler:
         self.n_cow = 0
         self.n_prefix_hits = 0        # admissions that adopted cached pages
         self.prefill_skipped = 0      # prompt tokens never re-prefilled
+        # speculative-decoding counters
+        self.n_drafted = 0            # provisional tokens fed for scoring
+        self.n_accepted = 0           # drafts the verifier kept
+        self.n_spec_steps = 0         # verify blocks with >= 1 draft
 
     # -- request lifecycle --------------------------------------------------
     def submit(self, req: Request) -> None:
@@ -324,6 +362,8 @@ class Scheduler:
         req = self.running.pop(slot)
         self._admit_order.remove(slot)
         self.alloc.free_slot(slot)
+        if self.draft_source is not None:
+            self.draft_source.release(req)
         return req
 
     def _preempt_youngest(self, protected) -> bool:
@@ -421,6 +461,9 @@ class Scheduler:
             cow_src=np.full(S, self.trash_page, np.int32),
             cow_dst=np.full(S, self.trash_page, np.int32),
         )
+        if self.spec is not None:
+            plan.verify_rows = np.zeros((S, self.spec.draft_len + 1), np.int32)
+            plan.spec_len = np.zeros(S, np.int32)
         row = 0
         planned = set()
         # decode rows first (pending == 1), then prefill chunks; within each
@@ -428,8 +471,15 @@ class Scheduler:
         order = [s for s in self._admit_order]
         decode = [s for s in order if len(self.running[s].known) - self.running[s].fed == 1]
         prefill = [s for s in order if s not in decode]
+        # decode rows not yet handed out: an earlier slot's draft block may
+        # never eat a later decode slot's ONE guaranteed row (stable order
+        # would starve the same slot every step)
+        decode_left = len(decode)
         for slot in decode + prefill:
             req = self.running.get(slot)
+            is_decode = decode_left > 0  # decode slots run first
+            if is_decode:
+                decode_left -= 1
             if req is None or row >= T:
                 continue
             pending = len(req.known) - req.fed
@@ -452,21 +502,51 @@ class Scheduler:
                     plan.cow_src[slot], plan.cow_dst[slot] = pair
                     self.n_cow += 1
             planned.add(slot)
+            samples = req.fed + c == len(req.known)
+            # speculative block: a sampling (decode-class) slot extends its
+            # chunk with up to K drafted rows. Pages for the drafts come
+            # from the free list / prefix-cache reclaim only — NEVER
+            # preemption — and the block shrinks to what fits, so
+            # speculation degrades to plain decode under pool pressure
+            # instead of evicting anyone.
+            drafts: list = []
+            if samples and self.spec is not None and (
+                req.temperature <= 0.0 or self.spec.acceptance == "sampled"
+            ):
+                k_cap = min(
+                    self.spec.draft_len,
+                    # leave one row for every decode slot still waiting
+                    T - row - c - decode_left,
+                    req.max_new_tokens - len(req.generated) - 1,
+                    self.pages_per_slot * self.page_size - (req.fed + c),
+                )
+                if k_cap > 0:
+                    drafts = list(self.draft_source.draft(req, k_cap))[:k_cap]
+                while drafts and not self.alloc.ensure(
+                    slot, req.fed + c + len(drafts), reclaim=self._reclaim
+                ):
+                    drafts.pop()
+            k = len(drafts)
             table = self.alloc.table(slot)
-            for j in range(c):
+            for j in range(c + k):
                 p = req.fed + j
-                plan.tok[row + j] = req.known[p]
+                plan.tok[row + j] = req.known[p] if j < c else drafts[j - c]
                 plan.slot[row + j] = slot
                 plan.pos[row + j] = p
                 plan.page[row + j] = table[p // self.page_size]
                 plan.off[row + j] = p % self.page_size
-            samples = req.fed + c == len(req.known)
             if samples:
                 plan.sample_tok[slot] = row + c - 1
+            if self.spec is not None and samples:
+                plan.verify_rows[slot] = np.minimum(
+                    row + c - 1 + np.arange(self.spec.draft_len + 1),
+                    row + c - 1 + k,
+                )
+                plan.spec_len[slot] = k
             plan.temp[slot] = req.temperature
             plan.seed[slot] = req.seed
             plan.scheduled.append((slot, c, samples))
-            row += c
+            row += c + k
         for slot, req in self.running.items():
             t = self.alloc.table(slot)
             plan.page_tables[slot, : len(t)] = t
@@ -474,23 +554,87 @@ class Scheduler:
             return None
         return plan
 
-    def update(self, plan: StepPlan, sampled: np.ndarray, step_idx: int) -> None:
-        """Absorb one engine step's sampled tokens; finish/free requests."""
+    def update(
+        self,
+        plan: StepPlan,
+        sampled: np.ndarray,
+        step_idx: int,
+        accept: np.ndarray | None = None,
+        frontier_hidden=None,
+        row_hidden=None,
+    ) -> int:
+        """Absorb one engine step's sampled tokens; finish/free requests.
+
+        Speculative steps (plan.spec_len set) pass `accept` (S,) from the
+        in-jit verifier and `sampled` as the (S, K+1) committed-candidate
+        block: the accepted prefix + bonus token is absorbed, `fed` rolls
+        back past the rejected suffix, and the page table's provisional
+        tail is truncated. Returns the number of tokens committed this
+        step (== number of sampling slots when speculation is off)."""
+        sampled = np.asarray(sampled)
+        committed_total = 0
         for slot, c, samples in plan.scheduled:
             req = self.running[slot]
-            req.fed += c
-            # donate every newly completed full page while still running, so
-            # CONCURRENT requests with the same prefix share immediately
-            self._donate(slot)
-            if not samples:
-                continue
-            tok = int(sampled[slot])
-            req.generated.append(tok)
-            if req.eos_token_id is not None and tok == req.eos_token_id:
-                req.finish_reason = "eos"
-            elif len(req.generated) >= req.max_new_tokens:
-                req.finish_reason = "length"
+            k = int(plan.spec_len[slot]) if plan.spec_len is not None else 0
+            a = max(0, min(int(accept[slot]), k)) if k > 0 else 0
+            if samples:
+                block = sampled[slot]
+                candidates = (
+                    [int(t) for t in block[: a + 1]]
+                    if block.ndim else [int(block)]
+                )
+            else:
+                candidates = []
+            n_commit = 0
+            for tok in candidates:
+                req.generated.append(tok)
+                n_commit += 1
+                committed_total += 1
+                if req.eos_token_id is not None and tok == req.eos_token_id:
+                    req.finish_reason = "eos"
+                elif len(req.generated) >= req.max_new_tokens:
+                    req.finish_reason = "length"
+                if req.done:
+                    break
+            # KV is written for the fed chunk plus the accepted drafts that
+            # were actually COMMITTED — an EOS/length cut inside the block
+            # discards the tail, whose KV rows roll back with the rejected
+            # suffix (keeps fed <= len(known) always, and the acceptance
+            # stats honest); the bonus/corrected token is known-but-not-fed
+            # (pending == 1, the plain decode invariant)
+            a = min(a, n_commit)
+            req.fed += c + a
+            if k > 0:
+                self.n_drafted += k
+                self.n_accepted += a
+                self.n_spec_steps += 1
+            if self.draft_source is not None and not req.done:
+                if frontier_hidden is not None and samples:
+                    # the newest committed token + the hidden that produced
+                    # it (position == req.fed: the pending token's position)
+                    self.draft_source.observe(
+                        req, req.known[-1], frontier_hidden[slot], req.fed
+                    )
+                if row_hidden is not None:
+                    # every row this slot fed whose KV survived the rollback
+                    # (positions < fed) — prefill chunks included, so block
+                    # drafters see the whole committed context
+                    rows = np.nonzero(plan.slot == slot)[0]
+                    rows = rows[plan.pos[rows] < req.fed]
+                    self.draft_source.observe_rows(
+                        req,
+                        [int(p) for p in plan.pos[rows]],
+                        row_hidden[rows],
+                    )
             if req.done:
                 req.finished_at = step_idx
                 self.finished.append(req)
                 self._release_slot(slot)
+                continue
+            # donate every newly completed full page while still running, so
+            # CONCURRENT requests with the same prefix share immediately
+            self._donate(slot)
+            if k > 0:
+                # roll back the rejected suffix's provisional pages
+                self.alloc.truncate(slot, pages_for(req.fed, self.page_size))
+        return committed_total

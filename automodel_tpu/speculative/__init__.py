@@ -1,3 +1,7 @@
+from automodel_tpu.speculative.acceptance import (  # noqa: F401
+    greedy_accept_length,
+    onehot_speculative_verify,
+)
 from automodel_tpu.speculative.eagle3 import (  # noqa: F401
     Eagle3Config,
     build_vocab_mapping,
@@ -6,4 +10,11 @@ from automodel_tpu.speculative.eagle3 import (  # noqa: F401
     init_drafter,
     drafter_param_specs,
     simulated_accept_length,
+)
+from automodel_tpu.speculative.serve_draft import (  # noqa: F401
+    DFlashDraftSource,
+    DraftSource,
+    EagleDraftSource,
+    NgramDraftSource,
+    SpeculativeConfig,
 )

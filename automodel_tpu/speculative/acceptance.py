@@ -1,21 +1,32 @@
-"""Offline acceptance-length estimation for EAGLE-1/2 drafters.
+"""Draft acceptance: the ONE verification rule every speculative path uses.
 
-The analog of the reference's acceptance benchmarking harness (reference:
-nemo_automodel/components/speculative/bench_common.py + bench_vllm/
-bench_sglang — there, a serving engine measures accepted tokens per round;
-here the target is emulated greedily offline, which is exact for greedy
-speculative decoding and needs no server).
+Two consumers share these functions (one implementation, property-tested):
 
-Estimator: teacher-forced multi-step draft over a target GREEDY PATH.
-Round starting at position t (the standard EAGLE chain draft):
+- the offline eval loops (`decode_eval.dflash_decode`, `eagle1_acceptance`
+  below) that measure accepted tokens per round over a corpus, and
+- the serving engine's in-jit draft-then-verify tail
+  (`serving/engine.py`): per decode slot the target scores the whole
+  drafted block in one ragged paged-attention step and the acceptance
+  rule keeps the longest valid prefix.
 
-    step 1: drafter sees (token_{t+1}, H_t) → predicts token_{t+2}
-    step k: feeds its OWN predicted hidden/token from step k-1
+`greedy_accept_length` is the lossless greedy rule — accepted tokens are
+exactly the target's own greedy continuation, so the committed stream is
+token-for-token identical to decoding without speculation.
 
-A step-k hit means the drafter's k-th token equals the path token; the
-expected accepted tokens per round is 1 + Σ_k (prefix-hit rate through k)
-(reference: eagle/core.py:218 `simulated_accept_length`; same estimator the
-EAGLE-3 trainer logs during training, applied post-hoc over a corpus).
+`onehot_speculative_verify` is the sampled rule for DETERMINISTIC draft
+proposals (ngram lookup, chain-argmax EAGLE, DFlash block argmax — every
+serve-facing draft source emits point-mass proposals): accept draft d with
+probability p(d) under the target distribution, and on rejection sample
+from p restricted to tokens != d (Leviathan-style rejection sampling with
+a one-hot proposal q = δ_d, for which the residual max(p - q, 0)
+renormalizes to exactly p|≠d). The marginal law of every committed token
+equals the target distribution — speculation changes throughput, never
+the distribution (property-tested on a toy vocab in tier-1).
+
+The file also keeps the offline EAGLE-1/2 acceptance-length estimator
+(the analog of the reference's bench_common.py harness): teacher-forced
+multi-step draft over a target greedy path, expected accepted tokens per
+round = 1 + Σ_k (prefix-hit rate through k).
 """
 
 from __future__ import annotations
@@ -25,6 +36,81 @@ import jax.numpy as jnp
 
 from automodel_tpu.speculative.eagle1 import Eagle1Config, drafter_forward
 from automodel_tpu.speculative.eagle3 import _shift_left, simulated_accept_length
+
+
+def greedy_accept_length(draft, target_greedy, valid=None):
+    """Longest accepted draft prefix under greedy verification.
+
+    `draft[..., j]` is the proposed token for some position and
+    `target_greedy[..., j]` the verifier's argmax for that SAME position;
+    a draft token is accepted iff it matches and every earlier draft in
+    the block was accepted — i.e. the longest matching prefix. `valid`
+    (same shape, bool) masks rows beyond the drafted block: an invalid
+    row never accepts, so a block of k < K drafts can ride fixed-(K)
+    arrays. Returns int32 accepted counts over the last axis.
+    """
+    match = jnp.asarray(draft) == jnp.asarray(target_greedy)
+    if valid is not None:
+        match = jnp.logical_and(match, valid)
+    return jnp.cumprod(match.astype(jnp.int32), axis=-1).sum(axis=-1)
+
+
+def onehot_speculative_verify(draft, logits, keys, valid):
+    """Distribution-preserving verification of a deterministic draft.
+
+    One slot's block (callers vmap over slots):
+
+    - draft  (K,)      proposed token for positions 0..K-1 of the block
+    - logits (K+1, V)  target logits; row j is the distribution position
+                       j's token must be drawn from (already filtered /
+                       temperature-scaled by the caller — row K scores
+                       the bonus position after a fully accepted block)
+    - keys   (K+1,)    PRNG keys, one per position (the serving engine
+                       derives key[j] = fold_in(request seed, absolute
+                       position), so the decision is batching- and
+                       preemption-invariant)
+    - valid  (K,) bool rows beyond the actual drafted block auto-reject
+
+    Returns (accept_len, tokens (K+1,)): tokens[:accept_len] are the
+    accepted drafts and tokens[accept_len] the bonus/corrected token
+    (entries past that are unspecified). Acceptance of draft d at row j
+    uses u < p_j(d); the first rejected row resamples from p_j excluding
+    d; a fully accepted block samples the bonus row K with its plain key
+    — identical to non-speculative sampling when the block is empty.
+    """
+    K = draft.shape[0]
+    p = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    p_draft = jnp.take_along_axis(p[:K], draft[:, None], axis=-1)[:, 0]
+    u = jax.vmap(
+        lambda k: jax.random.uniform(jax.random.fold_in(k, 1))
+    )(keys[:K])
+    ok = jnp.logical_and(u < p_draft, valid)
+    a = jnp.cumprod(ok.astype(jnp.int32)).sum()
+
+    # candidate outcome per row, selected by where the process lands:
+    # rejection at row j → sample from p_j with the draft token removed
+    neg = jnp.finfo(jnp.float32).min
+    resid_logits = logits[:K].astype(jnp.float32) + jnp.where(
+        jax.nn.one_hot(draft, logits.shape[-1], dtype=jnp.float32) > 0,
+        neg, 0.0,
+    )
+    resampled = jax.vmap(
+        lambda k, l: jax.random.categorical(jax.random.fold_in(k, 2), l)
+    )(keys[:K], resid_logits).astype(jnp.int32)
+    # full acceptance → plain sample at the bonus row with its OWN key
+    plain = jax.vmap(
+        lambda k, l: jax.random.categorical(k, l)
+    )(keys, logits.astype(jnp.float32)).astype(jnp.int32)
+
+    n_valid = jnp.sum(valid.astype(jnp.int32))
+    all_accepted = a >= n_valid
+    frontier = jnp.clip(a, 0, K - 1)
+    bonus = jnp.where(
+        all_accepted, plain[jnp.clip(a, 0, K)], resampled[frontier]
+    )
+    idx = jnp.arange(K + 1)
+    tokens = jnp.where(idx < a, jnp.concatenate([draft, draft[-1:]]), bonus)
+    return a, tokens
 
 
 def eagle1_acceptance(

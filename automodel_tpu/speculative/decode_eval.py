@@ -23,6 +23,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from automodel_tpu.speculative.acceptance import greedy_accept_length
 from automodel_tpu.speculative.dflash import (
     DFlashConfig,
     dflash_mask,
@@ -123,8 +124,9 @@ def dflash_decode(
             jax.lax.dynamic_slice(logits, (0, start, 0), (1, bs, logits.shape[-1])),
             axis=-1,
         )[0].astype(jnp.int32)
-        match = (draft == posterior[: bs - 1]).astype(jnp.int32)
-        a = int(jnp.cumprod(match).sum())  # accepted draft tokens
+        # the ONE acceptance rule (speculative/acceptance.py), shared with
+        # the serving engine's in-jit verify tail
+        a = int(greedy_accept_length(draft, posterior[: bs - 1]))
         # commit the accepted prefix + the bonus token from the verifier
         buf = buf.at[0, start + a + 1].set(posterior[a])
         accepted.append(a)

@@ -1,0 +1,331 @@
+"""Serve-facing draft sources for speculative decoding in the batcher.
+
+The pluggable "draft" half of the serving engine's per-slot
+draft-then-verify (serving/engine.py + serving/scheduler.py): at schedule
+time each decode slot asks its draft source for up to K provisional
+tokens, the scheduler appends them into spare pages of the slot's
+dense-prefix page table, and the ONE jitted target step scores the whole
+block through the ragged paged-attention op. Verification
+(speculative/acceptance.py) keeps the longest valid prefix — so a draft
+source can be arbitrarily wrong and the committed stream still equals
+non-speculative decoding exactly; quality only moves throughput.
+
+Every source emits DETERMINISTIC proposals (no sampling of its own), so
+committed GREEDY streams are token-exact vs the plain engine no matter
+what — verification guarantees that. For SAMPLED slots the accept/reject
+keys derive from (seed, position), so the stream is a deterministic
+function of (seed, known tokens, drafts): with the stateless ngram
+source that also makes sampled streams batching-invariant and
+preemption-stable (a requeued request re-drafts identically). The
+eagle/dflash sources carry per-request observation state that release()
+drops on preemption, so a preempted sampled request may commit a
+DIFFERENT (still distribution-correct) continuation than an
+uninterrupted run — quality state is rebuilt, correctness never depends
+on it.
+
+Three sources, all host-driven (drafting happens between engine steps;
+the eagle/dflash forwards are their own small jitted programs with fixed
+shapes — they compile once per serving run, pinned alongside the step's
+cache-miss counter):
+
+- `NgramDraftSource` — prompt-lookup (vLLM's ngram speculator): find the
+  most recent earlier occurrence of the last n known tokens and propose
+  what followed it. Free (no model), and strong exactly on the traffic
+  the prefix cache targets — agent loops and template-heavy streams that
+  repeat themselves.
+- `EagleDraftSource` — EAGLE-style chain draft reusing
+  `speculative/eagle1.py`: the engine returns the target's final-norm
+  hidden at the accept frontier each step; the drafter conditions on a
+  sliding window of recent (token, hidden) pairs and feeds its OWN
+  predicted hidden forward K times (eagle1_acceptance's round, live).
+- `DFlashDraftSource` — block draft reusing `speculative/dflash.py`: the
+  engine returns per-row hiddens, the source keeps them per position,
+  and one drafter forward proposes the whole block anchored at the
+  request frontier (decode_eval._draft_block, paged).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from automodel_tpu.speculative.dflash import DFlashConfig
+from automodel_tpu.speculative.eagle1 import Eagle1Config
+
+
+@dataclasses.dataclass(frozen=True)
+class SpeculativeConfig:
+    """Typed `serving.speculative` section (recipes/typed_config.py).
+
+    `draft_len` (K) is STATIC engine geometry — the step carries fixed
+    (S, K+1) verify rows, idle slots draft zero tokens into rows that
+    alias the trash page — so changing it recompiles, while requests
+    joining/leaving/preempting never do. `acceptance` gates WHICH slots
+    draft: "greedy" drafts only temperature<=0 slots (committed tokens
+    provably equal the plain greedy stream); "sampled" also drafts
+    sampled slots through the distribution-preserving one-hot rule
+    (acceptance.onehot_speculative_verify)."""
+
+    enabled: bool = False
+    draft_source: str = "ngram"   # ngram | eagle | dflash
+    draft_len: int = 4
+    acceptance: str = "greedy"    # greedy | sampled
+    # ngram source: longest/shortest suffix match attempted (prompt lookup)
+    ngram_max: int = 3
+    ngram_min: int = 1
+    # ngram source: only the most recent `ngram_window` known tokens are
+    # searched, bounding the per-step host scan to O(window) — long
+    # generations would otherwise pay a quadratic rescan on the critical
+    # path between jitted steps (recent matches also predict better)
+    ngram_window: int = 1024
+
+    def __post_init__(self):
+        if self.draft_source not in ("ngram", "eagle", "dflash"):
+            raise ValueError(f"unknown draft_source {self.draft_source!r}")
+        if self.acceptance not in ("greedy", "sampled"):
+            raise ValueError(f"unknown acceptance {self.acceptance!r}")
+        if self.draft_len < 1:
+            raise ValueError("draft_len must be >= 1")
+        if not (1 <= self.ngram_min <= self.ngram_max):
+            raise ValueError("need 1 <= ngram_min <= ngram_max")
+        if self.ngram_window < self.ngram_max + 1:
+            raise ValueError("ngram_window must exceed ngram_max")
+
+
+class DraftSource:
+    """Protocol for serve-facing draft sources.
+
+    `needs_hidden` tells the engine what to return from the jitted step
+    (a STATIC choice — part of the one compiled signature):
+    "none" | "frontier" (final-norm hidden at the accept frontier, (S,H))
+    | "rows" (final-norm hidden of every scheduled row, (T,H))."""
+
+    needs_hidden = "none"
+
+    def draft(self, req, k: int) -> list:
+        """Up to `k` proposed continuation tokens for `req.known` (may
+        return fewer/none — the scheduler shrinks the block)."""
+        raise NotImplementedError
+
+    def observe(self, req, token: int, hidden, position: int) -> None:
+        """Engine feedback after a step: the newest committed `token` at
+        `position` plus the target hidden that produced it."""
+
+    def observe_rows(self, req, positions: list, hiddens) -> None:
+        """Engine feedback: final-norm hiddens of this step's committed
+        rows (positions < req.fed only — rolled-back drafts excluded)."""
+
+    def release(self, req) -> None:
+        """Slot released (finish / preemption / deadline eviction) —
+        drop any per-request state."""
+
+
+class NgramDraftSource(DraftSource):
+    """Prompt-lookup drafting: propose the continuation of the most
+    recent earlier occurrence of the request's current n-token suffix,
+    longest n first. Pure host-side token matching."""
+
+    def __init__(self, cfg: SpeculativeConfig):
+        self.cfg = cfg
+
+    def draft(self, req, k: int) -> list:
+        # bounded scan: only the trailing ngram_window tokens are searched,
+        # so the per-step host cost stays O(window) however long the
+        # generation runs (drafts are read from the full sequence)
+        known = req.known
+        base = max(0, len(known) - self.cfg.ngram_window)
+        tail = known[base:]
+        for n in range(self.cfg.ngram_max, self.cfg.ngram_min - 1, -1):
+            if len(tail) <= n:
+                continue
+            suffix = tuple(tail[-n:])
+            # most recent earlier occurrence wins (recency ~ relevance)
+            for j in range(len(tail) - n - 1, -1, -1):
+                if tuple(tail[j : j + n]) == suffix:
+                    out = known[base + j + n : base + j + n + k]
+                    if out:
+                        return list(out)
+                    break
+        return []
+
+
+class EagleDraftSource(DraftSource):
+    """EAGLE-1/2 chain draft over a sliding window of (token, hidden)
+    pairs the engine observed at recent accept frontiers. One jitted
+    K-step scan with fixed (window, H) shapes — compiles once."""
+
+    needs_hidden = "frontier"
+
+    def __init__(
+        self,
+        draft_params: dict,
+        eagle_cfg: Eagle1Config,
+        lm_head_kernel,
+        draft_len: int,
+        window: int = 16,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        from automodel_tpu.speculative.eagle1 import drafter_forward
+
+        self.window = window
+        self.draft_len = draft_len
+        self._params_ref = draft_params
+        self._state: dict = {}  # rid -> (ids (W,), hids (W,H), poss (W,))
+        W, K = window, draft_len
+        head = jnp.asarray(lm_head_kernel, jnp.float32)
+
+        def chain(params, ids, hids, poss):
+            def step(carry, _):
+                ids, hids, poss = carry
+                seg = (poss >= 0).astype(jnp.int32)[None]
+                pred = drafter_forward(
+                    params, eagle_cfg, ids[None], hids[None],
+                    positions=jnp.maximum(poss, 0)[None], segment_ids=seg,
+                )
+                h_last = pred[0, -1]
+                tok = jnp.argmax(h_last.astype(jnp.float32) @ head).astype(
+                    jnp.int32
+                )
+                ids = jnp.concatenate([ids[1:], tok[None]])
+                hids = jnp.concatenate([hids[1:], h_last[None]])
+                poss = jnp.concatenate([poss[1:], poss[-1:] + 1])
+                return (ids, hids, poss), tok
+
+            _, toks = jax.lax.scan(step, (ids, hids, poss), None, length=K)
+            return toks
+
+        self._chain = jax.jit(chain)
+        self._H = eagle_cfg.hidden_size
+
+    def observe(self, req, token, hidden, position):
+        W = self.window
+        ids, hids, poss = self._state.get(req.rid) or (
+            np.zeros(W, np.int32),
+            np.zeros((W, self._H), np.float32),
+            np.full(W, -1, np.int32),
+        )
+        ids = np.concatenate([ids[1:], [np.int32(token)]])
+        hids = np.concatenate([hids[1:], np.asarray(hidden, np.float32)[None]])
+        poss = np.concatenate([poss[1:], [np.int32(position)]])
+        self._state[req.rid] = (ids, hids, poss)
+
+    def draft(self, req, k: int) -> list:
+        state = self._state.get(req.rid)
+        if state is None:
+            return []
+        ids, hids, poss = state
+        # the chain only makes sense from the CURRENT frontier: the newest
+        # observed pair must be the request's last known token
+        if int(poss[-1]) != len(req.known) - 1 or int(ids[-1]) != req.known[-1]:
+            return []
+        toks = self._chain(self._params_ref, ids, hids, poss)
+        return [int(t) for t in np.asarray(toks)[:k]]
+
+    def release(self, req):
+        self._state.pop(req.rid, None)
+
+
+class DFlashDraftSource(DraftSource):
+    """DFlash block draft anchored at the request frontier. The source
+    keeps the target's final-norm hidden per committed position (the
+    engine returns every scheduled row's hidden) and one drafter forward
+    proposes block_size-1 tokens in parallel. Serve-facing restriction:
+    the drafter's context must be the single final-layer tap
+    (num_target_layers_used == 1, target_hidden_size == the decoder's
+    hidden size) — multi-tap contexts would need the serve step to
+    surface mid-stack hiddens."""
+
+    needs_hidden = "rows"
+
+    def __init__(
+        self,
+        draft_params: dict,
+        dcfg: DFlashConfig,
+        embed_table,
+        lm_head_kernel,
+        max_context: int,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        from automodel_tpu.speculative.dflash import (
+            dflash_mask,
+            drafter_forward,
+        )
+
+        if dcfg.num_target_layers_used != 1:
+            raise ValueError(
+                "DFlashDraftSource serves single-tap drafters only "
+                f"(num_target_layers_used={dcfg.num_target_layers_used})"
+            )
+        self.dcfg = dcfg
+        self.max_context = max_context
+        self._params = draft_params
+        self._ctx: dict = {}  # rid -> (C, Ht) hidden buffer
+        C, bs = max_context, dcfg.block_size
+        embed = jnp.asarray(embed_table)
+        head = jnp.asarray(lm_head_kernel)
+
+        def block(params, ctx, anchor_tok, anchor_pos):
+            noise_ids = jnp.full((1, bs), dcfg.mask_token_id, jnp.int32)
+            noise_ids = noise_ids.at[0, 0].set(anchor_tok)
+            noise_emb = jnp.take(embed, noise_ids, axis=0)
+            positions = jnp.arange(C, dtype=jnp.int32)[None]
+            draft_pos = (anchor_pos + jnp.arange(bs, dtype=jnp.int32))[None]
+            anchors = jnp.full((1, 1), anchor_pos, jnp.int32)
+            keep = jnp.ones((1, 1), bool)
+            mask = dflash_mask(anchors, keep, C, bs, dcfg.causal_blocks)
+            hidden = drafter_forward(
+                params, dcfg, noise_emb, ctx[None], positions, draft_pos, mask
+            )
+            logits = jnp.einsum(
+                "bqh,hv->bqv", hidden, head.astype(hidden.dtype),
+                preferred_element_type=jnp.float32,
+            )
+            return jnp.argmax(logits[0, 1:], axis=-1).astype(jnp.int32)
+
+        self._block = jax.jit(block)
+
+    def observe_rows(self, req, positions, hiddens):
+        buf = self._ctx.get(req.rid)
+        if buf is None:
+            buf = np.zeros(
+                (self.max_context, self.dcfg.resolved_target_hidden),
+                np.float32,
+            )
+            self._ctx[req.rid] = buf
+        for pos, h in zip(positions, hiddens):
+            if 0 <= pos < self.max_context:
+                buf[pos] = np.asarray(h, np.float32)
+
+    def draft(self, req, k: int) -> list:
+        buf = self._ctx.get(req.rid)
+        anchor = len(req.known) - 1
+        # hiddens must cover every context position the mask exposes
+        # (0..anchor-1 == 0..fed-1 for a decode-class slot)
+        if buf is None or req.fed < anchor or anchor >= self.max_context:
+            return []
+        toks = self._block(
+            self._params, buf, np.int32(req.known[anchor]), np.int32(anchor)
+        )
+        return [int(t) for t in np.asarray(toks)[:k]]
+
+    def release(self, req):
+        self._ctx.pop(req.rid, None)
+
+
+def build_draft_source(spec: SpeculativeConfig, *, max_context: int):
+    """Config-name → draft source. Only "ngram" is constructible from
+    config alone; eagle/dflash need drafter params — pass an instance to
+    `ServingEngine(draft_source=...)` instead."""
+    if spec.draft_source == "ngram":
+        return NgramDraftSource(spec)
+    raise ValueError(
+        f"draft_source={spec.draft_source!r} needs drafter params: build "
+        "an EagleDraftSource/DFlashDraftSource and pass it to "
+        "ServingEngine(draft_source=...)"
+    )

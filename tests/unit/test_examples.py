@@ -109,6 +109,12 @@ def test_example_smoke_trains(path, tmp_path, monkeypatch):
         cfg.set("step_scheduler.max_steps", min(
             int(cfg.get("step_scheduler.max_steps", 2)), 2
         ))
+    # redirect the checkpoint dir too: a YAML's absolute /tmp path outlives
+    # the test, and a stale checkpoint from an earlier (longer) run makes
+    # auto_resume skip straight past the clamped step budget — the smoke
+    # then "passes" zero steps or fails with no train records
+    if cfg.get("checkpoint") is not None and cfg.get("checkpoint.checkpoint_dir"):
+        cfg.set("checkpoint.checkpoint_dir", str(tmp_path / "ckpt"))
     r = resolve_recipe_class(cfg)(cfg)
     r.setup()
     r.run_train_validation_loop()

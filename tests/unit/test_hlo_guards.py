@@ -64,14 +64,16 @@ def test_hlo_baseline(entry):
     assert entry in STRUCTURAL_INVARIANTS  # registry/invariants stay in sync
 
 
-def test_paged_serve_step_donation_pinned():
+@pytest.mark.parametrize("entry", ["paged_serve_step", "spec_serve_step"])
+def test_serve_step_donation_pinned(entry):
     """The serve step's pool donation is part of the compiled contract:
-    losing it silently doubles pool memory. The aliasing table in the
-    baseline must stay non-empty (belt to the baseline's suspenders —
+    losing it silently doubles pool memory — in BOTH the plain and the
+    speculative draft-then-verify step programs. The aliasing table in
+    the baseline must stay non-empty (belt to the baseline's suspenders —
     this asserts the INVARIANT, not a count that drifts)."""
-    baseline = load_baseline(BASELINES, "paged_serve_step")
+    baseline = load_baseline(BASELINES, entry)
     assert baseline is not None
     assert baseline.donation, (
-        "paged_serve_step baseline has an empty input_output_alias table — "
+        f"{entry} baseline has an empty input_output_alias table — "
         "the pool donation was lost"
     )

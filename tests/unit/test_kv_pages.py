@@ -145,6 +145,24 @@ def test_defrag_moves_shared_page_once_and_patches_every_table():
     assert a.num_free == 5
 
 
+def test_truncate_drops_provisional_tail():
+    """Speculative rollback: truncate() releases exclusively-held tail
+    pages to the free list, but a SHARED tail page survives for its other
+    holder (only the truncating slot's reference drops)."""
+    a = PageAllocator(num_pages=8, page_size=2)
+    a.ensure(0, 8)                 # 4 pages
+    assert a.truncate(0, 2) == 2   # drop 2 exclusive provisional pages
+    assert len(a.table(0)) == 2 and a.num_free == 6
+    # shared tail: slot 1 adopts slot 0's pages, then truncates them away
+    a.adopt(1, list(a.table(0)))
+    assert a.truncate(1, 0) == 2
+    assert a.num_free == 6         # slot 0 still references both pages
+    assert all(a.refcount(p) == 1 for p in a.table(0))
+    assert a.truncate(0, 2) == 0   # no-op at or below the target length
+    a.free_slot(0)
+    assert a.num_free == 8
+
+
 def test_defrag_noop_when_compact():
     a = PageAllocator(num_pages=4, page_size=2)
     a.ensure(0, 4)
