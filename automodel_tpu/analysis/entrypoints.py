@@ -5,20 +5,21 @@ setups the old hand-rolled guards in tests/unit/test_hlo_guards.py used
 (``jit(...).lower().compile()`` on a CPU mesh emits the same logical
 collectives GSPMD/shard_map would emit for TPU):
 
-- ``fsdp_grad``         — dp_shard=8 dense decoder grad
-- ``ring_cp_forward``   — cp=2 ring-attention forward
-- ``ep_moe_forward``    — ep=4 dropless-MoE forward
-- ``paged_serve_step``  — the serving engine's single-chip jitted step
-- ``spec_serve_step``   — the same step with speculative draft-then-verify
-- ``pp_ep_1f1b_grad``   — the flagship PP×EP explicit 1F1B grad
+- ``fsdp_grad``          — dp_shard=8 dense decoder grad
+- ``ring_cp_forward``    — cp=2 ring-attention forward
+- ``ep_moe_forward``     — ep=4 dropless-MoE forward
+- ``paged_serve_step``   — the serving engine's single-chip jitted step
+- ``spec_serve_step``    — the same step with speculative draft-then-verify
+- ``sharded_serve_step`` — the tp=2 mesh-sharded serving step
+- ``pp_ep_1f1b_grad``    — the flagship PP×EP explicit 1F1B grad
 
 Each builder returns ``(compiled, mesh_axes)``; callers feed both to
 :func:`automodel_tpu.analysis.hlo.analyze_compiled`. Requires an 8-device
 (virtual CPU) platform — ``force_cpu_devices(8)`` before any backend
 touch, exactly like tests/conftest.py.
 
-Every future jitted entry point (sharded serve step, speculative-decode
-verify step, quantized serve step) earns its structural guard by adding a
+Every future jitted entry point (quantized serve step, multimodal serve
+step, multi-host frontend step) earns its structural guard by adding a
 builder here and running ``--update-baselines`` once.
 """
 
@@ -210,6 +211,52 @@ def spec_serve_step():
     return compiled, None
 
 
+def sharded_serve_step():
+    """The TP-sharded serving step (tp=2 mesh slice): the paged pool
+    partitions KV heads over tp (pages stay global), attention and the
+    page gathers are rank-local, and the only collectives are the
+    per-layer partial-sum reductions of the row-parallel projections plus
+    the logits gather feeding the replicated sampling tail — the sampling
+    tail itself (filters, fold_in keys, categorical) must stay
+    collective-free, and the pool donation must survive sharding. The
+    per-layer all-gather/reduce-scatter budget is the baseline's pinned
+    collective table (two-sided ratchet)."""
+    import jax
+    import jax.numpy as jnp
+
+    from automodel_tpu.distributed import MeshConfig
+    from automodel_tpu.models.llm import decoder
+    from automodel_tpu.serving.engine import ServingConfig, ServingEngine
+
+    dense, _ = _configs()
+    cfg = dataclasses.replace(dense, pipeline_microbatches=1)
+    ctx = MeshConfig(tp=2, dp_shard=1).build(jax.devices()[:2])
+    params = decoder.init(cfg, jax.random.key(0))
+    eng = ServingEngine(params, cfg, ServingConfig(
+        page_size=4, num_pages=16, max_slots=2, pages_per_slot=4,
+        token_budget=8,
+    ), mesh_ctx=ctx)
+    T, S, P = 8, 2, 4
+    rep = ctx.replicated()
+    batch = {
+        k: jax.device_put(jnp.zeros(T, jnp.int32), rep)
+        for k in ("tok", "slot", "pos", "page", "off")
+    }
+    batch.update({
+        k: jax.device_put(v, rep)
+        for k, v in dict(
+            page_tables=jnp.zeros((S, P), jnp.int32),
+            sample_tok=jnp.zeros(S, jnp.int32),
+            temp=jnp.zeros(S, jnp.float32),
+            seed=jnp.zeros(S, jnp.int32),
+            cow_src=jnp.zeros(S, jnp.int32),
+            cow_dst=jnp.zeros(S, jnp.int32),
+        ).items()
+    })
+    compiled = eng._step.lower(eng.params, eng.pool, batch).compile()
+    return compiled, dict(ctx.sizes)
+
+
 def pp_ep_1f1b_grad():
     """The flagship PP×EP program: explicit 1F1B grad with the expert A2A
     inside each stage's step. The ppermute ring (fwd + bwd streams) and
@@ -237,6 +284,7 @@ ENTRY_POINTS = {
     "ep_moe_forward": ep_moe_forward,
     "paged_serve_step": paged_serve_step,
     "spec_serve_step": spec_serve_step,
+    "sharded_serve_step": sharded_serve_step,
     "pp_ep_1f1b_grad": pp_ep_1f1b_grad,
 }
 
@@ -286,6 +334,17 @@ STRUCTURAL_INVARIANTS = {
         "floors": {"collective-permute": 2, "all-to-all": 2},
         "zeros": ("ragged-all-to-all",),
         "op_floors": {},
+    },
+    "sharded_serve_step": {
+        # tp partial-sum reductions must exist (o_proj/down_proj are
+        # row-parallel — a program with zero all-reduces silently stopped
+        # sharding the matmuls); permutes/A2As have no business in a
+        # tp-only decode step, so any appearance is drift the two-sided
+        # baseline alone could launder by re-pinning
+        "floors": {"all-reduce": 1},
+        "zeros": ("collective-permute", "all-to-all", "ragged-all-to-all"),
+        # the paged k/v page gathers survive sharding (rank-local)
+        "op_floors": {"gather": 2},
     },
 }
 assert set(STRUCTURAL_INVARIANTS) == set(ENTRY_POINTS)

@@ -140,6 +140,66 @@ def ragged_paged_mla_attention_xla(
     return jnp.einsum("tnc,tcr->tnr", p.astype(c.dtype), c)
 
 
+def _tp_size(mesh_ctx) -> int:
+    return 1 if mesh_ctx is None else mesh_ctx.sizes["tp"]
+
+
+def _annotate_tp(x, mesh_ctx, dim: int):
+    """Pin `x`'s axis `dim` to tp — the ONE sharding annotation of the
+    reference path (no-op without a mesh: the single-chip program stays
+    byte-identical). For GQA `dim` is the head axis (every rank owns
+    whole KV heads of every page, so gather + softmax + weighted sum are
+    rank-local); for MLA it is the latent-rank axis (heads share one
+    latent, so score/value contractions over r reduce cross-rank)."""
+    if _tp_size(mesh_ctx) == 1:
+        return x
+    axes = [None] * x.ndim
+    axes[dim] = "tp"
+    return jax.lax.with_sharding_constraint(x, mesh_ctx.sharding(*axes))
+
+
+def _pallas_gqa_shard_map(mesh_ctx):
+    """shard_map wrapper for the Pallas GQA kernel under tp>1: each rank
+    runs the SAME kernel on its local head slice — q/k/v/out shard the
+    head dim, page tables and positions replicate, and the grid/BlockSpec
+    machinery (scalar-prefetch page indexing, online softmax) is untouched
+    because GQA groups never cross a KV-head boundary."""
+    from jax.sharding import PartitionSpec as P
+
+    from automodel_tpu.ops.pallas.ragged_paged_attention import (
+        paged_attention_kernel,
+    )
+
+    def wrapped(q, k_pages, v_pages, page_tables, positions, *,
+                scale, soft_cap, window, sinks):
+        tp = mesh_ctx.sizes["tp"]
+        if q.shape[1] % tp or k_pages.shape[2] % tp:
+            raise NotImplementedError(
+                f"heads ({q.shape[1]}/{k_pages.shape[2]}) not divisible by "
+                f"tp={tp} — falling back to the XLA reference"
+            )
+        heads = P(None, "tp", None)
+        pages = P(None, None, "tp", None)
+        args = (q, k_pages, v_pages, page_tables, positions)
+        in_specs = (heads, pages, pages, P(None, None), P(None))
+        if sinks is not None:
+            args += (sinks,)
+            in_specs += (P("tp"),)
+
+        def body(q, k, v, pt, pos, *s):
+            return paged_attention_kernel(
+                q, k, v, pt, pos, scale=scale, soft_cap=soft_cap,
+                window=window, sinks=s[0] if s else None,
+            )
+
+        return jax.shard_map(
+            body, mesh=mesh_ctx.mesh, in_specs=in_specs, out_specs=heads,
+            check_vma=False,
+        )(*args)
+
+    return wrapped
+
+
 def ragged_paged_attention(
     q, k_pages, v_pages, page_tables, positions,
     *,
@@ -148,20 +208,29 @@ def ragged_paged_attention(
     soft_cap: float | None = None,
     sinks=None,
     impl: str = "auto",
+    mesh_ctx=None,
 ):
     """GQA entry. impl: "xla" | "pallas" | "auto" (pallas on TPU, with a
     shape/feature-based fallback to the reference — the flash dispatch
-    pattern of ops/attention.py)."""
+    pattern of ops/attention.py). With a `mesh_ctx` (tp>1) the reference
+    path carries head-sharding annotations and the Pallas kernel runs
+    inside a shard_map over the tp axis (rank-local head slices)."""
     scale = scale if scale is not None else float(q.shape[-1]) ** -0.5
     resolved = impl
     if impl == "auto":
         resolved = "pallas" if jax.default_backend() == "tpu" else "xla"
     if resolved == "pallas":
-        from automodel_tpu.ops.pallas.ragged_paged_attention import (
-            paged_attention_kernel,
-        )
-
         try:
+            if _tp_size(mesh_ctx) > 1:
+                return _pallas_gqa_shard_map(mesh_ctx)(
+                    q, k_pages, v_pages, page_tables, positions,
+                    scale=scale, soft_cap=soft_cap, window=window,
+                    sinks=sinks,
+                )
+            from automodel_tpu.ops.pallas.ragged_paged_attention import (
+                paged_attention_kernel,
+            )
+
             return paged_attention_kernel(
                 q, k_pages, v_pages, page_tables, positions,
                 scale=scale, soft_cap=soft_cap, window=window, sinks=sinks,
@@ -169,10 +238,14 @@ def ragged_paged_attention(
         except NotImplementedError:
             resolved = "xla"
     if resolved == "xla":
-        return ragged_paged_attention_xla(
+        q = _annotate_tp(q, mesh_ctx, 1)              # head axis
+        k_pages = _annotate_tp(k_pages, mesh_ctx, 2)
+        v_pages = _annotate_tp(v_pages, mesh_ctx, 2)
+        out = ragged_paged_attention_xla(
             q, k_pages, v_pages, page_tables, positions,
             scale=scale, window=window, soft_cap=soft_cap, sinks=sinks,
         )
+        return _annotate_tp(out, mesh_ctx, 1)
     raise ValueError(f"Unknown paged attention impl '{impl}'")
 
 
@@ -182,18 +255,28 @@ def ragged_paged_mla_attention(
     scale: float,
     window=None,
     impl: str = "auto",
+    mesh_ctx=None,
 ):
     """MLA (absorbed latent-cache) entry; same dispatch contract as the GQA
-    one. Returns latent-space outputs (T, n, r)."""
+    one. Returns latent-space outputs (T, n, r). Under tp>1 the latent rank
+    r is the sharded dim (q_abs/c_pages/out; the tiny shared rope head
+    replicates) — the score contraction reduces over r across ranks, which
+    the Pallas kernel's rank-local online softmax cannot express, so the
+    sharded MLA path always takes the annotated XLA reference."""
     resolved = impl
     if impl == "auto":
         resolved = "pallas" if jax.default_backend() == "tpu" else "xla"
     if resolved == "pallas":
-        from automodel_tpu.ops.pallas.ragged_paged_attention import (
-            paged_mla_attention_kernel,
-        )
-
         try:
+            if _tp_size(mesh_ctx) > 1:
+                raise NotImplementedError(
+                    "latent-sharded MLA paged attention needs the "
+                    "cross-rank score reduction — XLA reference only"
+                )
+            from automodel_tpu.ops.pallas.ragged_paged_attention import (
+                paged_mla_attention_kernel,
+            )
+
             return paged_mla_attention_kernel(
                 q_abs, q_rope, c_pages, kr_pages, page_tables, positions,
                 scale=scale, window=window,
@@ -201,8 +284,11 @@ def ragged_paged_mla_attention(
         except NotImplementedError:
             resolved = "xla"
     if resolved == "xla":
-        return ragged_paged_mla_attention_xla(
+        q_abs = _annotate_tp(q_abs, mesh_ctx, 2)      # latent-rank axis
+        c_pages = _annotate_tp(c_pages, mesh_ctx, 2)
+        out = ragged_paged_mla_attention_xla(
             q_abs, q_rope, c_pages, kr_pages, page_tables, positions,
             scale=scale, window=window,
         )
+        return _annotate_tp(out, mesh_ctx, 2)
     raise ValueError(f"Unknown paged attention impl '{impl}'")

@@ -58,6 +58,14 @@ DEFAULT_RULES: tuple[tuple[str, Any], ...] = (
     # (a sharding annotation, not graph surgery — see parallel/pp.py)
     ("layers", "pp"),
     ("norm", None),
+    # serving paged-KV pool (serving/kv_pages.py): pages replicate over the
+    # data tier (page IDs are GLOBAL — the host allocator/scheduler/prefix
+    # cache never know the mesh exists), while the per-page head dim shards
+    # over tp: GQA pools partition KV heads, absorbed-MLA pools partition
+    # the kv latent rank (heads share ONE latent, so the latent — the big
+    # cached quantity — is the dim that halves HBM per chip)
+    ("pages", None),
+    ("mla_latent", "tp"),
 )
 
 

@@ -13,6 +13,10 @@ YAML:
     model: {hf_config: {...} | pretrained_path: ...}
     dataset: {...}                    # rows provide the prompts
     serving:
+      mesh:                             # typed: ServeMeshConfig (pod shape)
+        replicas: 1                     # data-parallel engine replicas
+        tp: 1                           # tensor parallel per replica
+        ep: 1                           # expert parallel per replica (MoE)
       page_size: 16
       num_pages: 2048
       max_slots: 16
@@ -48,7 +52,6 @@ import json
 import logging
 import os
 
-import jax
 import numpy as np
 
 from automodel_tpu.config import parse_args_and_load_config
@@ -134,13 +137,18 @@ class ServeRecipe(TrainFinetuneRecipeForNextTokenPrediction):
             from automodel_tpu.peft.lora import merge_lora
 
             params = merge_lora(self.base_params, params, self.peft_cfg)
-        # the engine is a single-chip step this round (multi-chip serving =
-        # roadmap): pull the chassis' mesh-sharded params onto the default
-        # device so the step keeps ONE compiled signature
-        params = jax.tree.map(lambda x: np.asarray(x), params)
-        engine = ServingEngine(params, self.model_cfg, serve_cfg)
+        # the chassis' mesh-sharded params flow STRAIGHT into the sharded
+        # step (no de-shard hop through host memory — PR 2's single-chip
+        # workaround is gone): each engine replica re-device_puts them onto
+        # its own serving mesh slice. serving.mesh={replicas,tp,ep} picks
+        # the pod shape; the default 1x1x1 is the single-chip engine on a
+        # trivial mesh of the SAME code path.
+        serve_mesh = self.typed.serving_mesh
         reqs = self._requests(node, serve_cfg)
-        logger.info("serving %d requests (%s)", len(reqs), serve_cfg)
+        logger.info(
+            "serving %d requests (%s, mesh=%s)", len(reqs), serve_cfg,
+            serve_mesh,
+        )
         # serving counters get their own JSONL (training.jsonl stays a
         # train-loss trail for the golden/parity tooling)
         from automodel_tpu.loggers.metric_logger import MetricLogger
@@ -148,9 +156,21 @@ class ServeRecipe(TrainFinetuneRecipeForNextTokenPrediction):
         serve_logger = MetricLogger(
             os.path.join(cfg.get("run_dir", "."), "serving.jsonl")
         )
-        res = engine.serve_batch(
-            reqs, metric_logger=serve_logger, log_every=16,
-        )
+        if serve_mesh.replicas > 1:
+            from automodel_tpu.serving import ReplicaRouter
+
+            router = ReplicaRouter(
+                params, self.model_cfg, serve_cfg, serve_mesh
+            )
+            res = router.serve_batch(reqs, metric_logger=serve_logger)
+        else:
+            ctx = serve_mesh.build_contexts()[0]
+            engine = ServingEngine(
+                params, self.model_cfg, serve_cfg, mesh_ctx=ctx
+            )
+            res = engine.serve_batch(
+                reqs, metric_logger=serve_logger, log_every=16,
+            )
         serve_logger.close()
         tokenizer = getattr(self, "_tokenizer", None)
         out_path = os.path.join(cfg.get("run_dir", "."), "generations.jsonl")

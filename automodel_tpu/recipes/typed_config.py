@@ -158,6 +158,19 @@ class RecipeConfig:
         return self._cache[key]
 
     @property
+    def serving_mesh(self):
+        """`serving.mesh` section → ServeMeshConfig (defaults to the
+        trivial 1-chip mesh when absent)."""
+        from automodel_tpu.serving.router import ServeMeshConfig
+
+        key = ("serving.mesh", "ServeMeshConfig")
+        if key not in self._cache:
+            node = self.raw.get("serving")
+            sub = node.get("mesh") if node is not None else None
+            self._cache[key] = dataclass_from_node(ServeMeshConfig, sub)
+        return self._cache[key]
+
+    @property
     def packing(self) -> Optional[Any]:
         node = self.raw.get("packing")
         if node is None:

@@ -1,16 +1,21 @@
 """Continuous-batching serving engine over a paged KV cache.
 
 - kv_pages.py:     global refcounted page pool + per-request page tables
-                   (GQA + MLA layouts, copy-on-write sharing)
+                   (GQA + MLA layouts, copy-on-write sharing; mesh-sharded
+                   under tp — pages global, per-page head dim partitioned)
 - prefix_cache.py: radix tree over known tokens at page granularity —
                    cross-request prefix sharing + LRU reclaim
 - scheduler.py:    admission / chunked-prefill / preemption scheduling
-- engine.py:       the jitted fixed-shape step + serve_batch() host loop
+- engine.py:       the jitted fixed-shape step (single-chip or TP/EP-
+                   sharded over a mesh slice) + serve_batch() host loop
+- router.py:       data-parallel engine replicas + per-replica admission
+                   (sticky prefix affinity, least-loaded-by-free-pages)
 - ops/paged_attention.py holds the ragged paged-attention op it runs on.
 """
 
 from automodel_tpu.serving.engine import Request, ServingConfig, ServingEngine
 from automodel_tpu.serving.kv_pages import PageAllocator, pages_for
+from automodel_tpu.serving.router import ReplicaRouter, ServeMeshConfig
 from automodel_tpu.serving.prefix_cache import (
     PrefixCache,
     PrefixCacheConfig,
@@ -34,8 +39,10 @@ __all__ = [
     "PrefixCache",
     "PrefixCacheConfig",
     "PrefixMatch",
+    "ReplicaRouter",
     "Request",
     "Scheduler",
+    "ServeMeshConfig",
     "ServingConfig",
     "ServingEngine",
     "SpeculativeConfig",

@@ -38,6 +38,18 @@ and the plain program is byte-identical to the speculation-disabled
 engine's (both pinned by analysis baselines paged_serve_step /
 spec_serve_step).
 
+Pod-scale serving (mesh_ctx given): the SAME step runs TP/EP-sharded
+under GSPMD over a mesh slice — the paged pool becomes a mesh-sharded
+array (kv_pages.pool_axes: pages global, GQA KV heads / MLA latent rank
+partitioned over tp), params re-shard onto the serving plan
+(_serving_param_specs), MoE decoders dispatch experts through PR 1's EP
+shard_map inside the step, and the sampling tail runs on replicated
+logits so it stays collective-free (the sharded_serve_step analysis
+baseline pins the per-layer all-reduce budget and the pool donation).
+Page IDs are global, so the host-side scheduler/allocator/prefix-cache
+never know the mesh exists. Data parallelism is a layer above: N engine
+replicas behind serving/router.py's ReplicaRouter.
+
 `serve_batch()` is the offline API (recipes/llm/serve.py wires it to the
 CLI): submit a list of requests with arrival times, drive steps until
 drained, return per-request outputs + throughput/latency counters (logged
@@ -74,7 +86,7 @@ from automodel_tpu.ops.paged_attention import (
 from automodel_tpu.ops.norms import rms_norm
 from automodel_tpu.ops.quant import matmul as _mm
 from automodel_tpu.ops.rope import rope_frequencies
-from automodel_tpu.serving.kv_pages import apply_defrag, init_pool
+from automodel_tpu.serving.kv_pages import apply_defrag, init_pool, pool_axes
 from automodel_tpu.serving.prefix_cache import PrefixCacheConfig
 from automodel_tpu.serving.scheduler import Request, Scheduler, StepPlan
 from automodel_tpu.speculative.acceptance import (
@@ -142,6 +154,7 @@ class ServingEngine:
         cfg,
         serve_cfg: ServingConfig = ServingConfig(),
         draft_source=None,
+        mesh_ctx=None,
     ):
         from automodel_tpu.models.moe_lm.het_moe import HetMoEConfig
 
@@ -152,17 +165,45 @@ class ServingEngine:
             )
         self.cfg = cfg
         self.serve_cfg = serve_cfg
-        self.params = cast_params(params, cfg.dtype)
         self.is_moe = getattr(cfg, "moe", None) is not None
         self.is_mla = cfg.attention_type == "mla"
+        # tp/ep-sharded step (mesh_ctx set): the paged pool becomes a
+        # mesh-sharded array (kv_pages.pool_axes) and GSPMD partitions the
+        # ONE jitted step over the mesh — page IDs stay global, so the host
+        # scheduler/allocator/prefix cache are untouched. mesh_ctx=None is
+        # the PR-2 single-process program, byte-identical (pinned by the
+        # paged_serve_step / spec_serve_step analysis baselines); a trivial
+        # 1-device mesh runs the sharded code path with no-op constraints.
+        self._mesh = mesh_ctx
+        if mesh_ctx is not None:
+            self._validate_mesh(cfg, serve_cfg, mesh_ctx)
+        self.params = cast_params(params, cfg.dtype)
+        if mesh_ctx is not None:
+            from automodel_tpu.parallel.sharding import logical_to_shardings
+
+            # params may arrive with ANY placement (the recipe chassis'
+            # FSDP shardings flow straight in — no de-shard hop through
+            # host memory); device_put reshards onto the serving plan
+            self.params = jax.device_put(
+                self.params,
+                logical_to_shardings(
+                    self._serving_param_specs(), mesh_ctx,
+                    shapes=jax.tree.map(lambda p: p.shape, self.params),
+                ),
+            )
 
         # stacks mirror generate.py: dense decoder = one; MoE = dense prefix
-        # stack then MoE stack
+        # stack then MoE stack. Under an ep>1 mesh the MoE stack routes
+        # through PR 1's EP shard_map machinery (dropless dispatch + expert
+        # A2A INSIDE the step) instead of the single-shard dropless path.
         if self.is_moe:
+            moe_fn = _moe_mlp
+            if mesh_ctx is not None and mesh_ctx.sizes["ep"] > 1:
+                moe_fn = self._moe_mlp_ep
             self._stacks = []
             if cfg.first_k_dense > 0:
                 self._stacks.append(("dense_layers", _dense_mlp, cfg.first_k_dense))
-            self._stacks.append(("moe_layers", _moe_mlp, cfg.num_moe_layers))
+            self._stacks.append(("moe_layers", moe_fn, cfg.num_moe_layers))
         else:
             L = jax.tree.leaves(self.params["layers"])[0].shape[0]
             self._stacks = [("layers", _dense_mlp, L)]
@@ -200,7 +241,9 @@ class ServingEngine:
         self.pool = init_pool(
             cfg, [L for *_, L in self._stacks],
             serve_cfg.num_pages, serve_cfg.page_size,
+            mesh_ctx=self._mesh,
         )
+        self._pool_axes = pool_axes(cfg)
         # speculative decoding: a STATIC trace-time choice — the spec and
         # plain engines each compile exactly one step program (the plain
         # program is byte-identical to the non-speculative engine's, so
@@ -214,8 +257,171 @@ class ServingEngine:
                 max_context=serve_cfg.pages_per_slot * serve_cfg.page_size,
             )
         self._needs_hidden = getattr(self._draft_source, "needs_hidden", "none")
-        self._step = jax.jit(self._step_impl, donate_argnums=(1,))
+        if self._mesh is None:
+            self._step = jax.jit(self._step_impl, donate_argnums=(1,))
+        else:
+            # explicit in/out shardings: jit normalizes sharding specs on
+            # its outputs (trailing/size-1 axes dropped), so without a
+            # pinned signature the SECOND step would see a "different"
+            # pool sharding and recompile — breaking the compile-once
+            # contract the cache-miss counter tests pin per replica
+            from automodel_tpu.serving.kv_pages import pool_shardings
+
+            rep = self._mesh.replicated()
+            psh = pool_shardings(
+                cfg, [L for *_, L in self._stacks], self._mesh
+            )
+            batch_keys = [
+                "tok", "slot", "pos", "page", "off", "page_tables",
+                "sample_tok", "temp", "seed", "cow_src", "cow_dst",
+            ]
+            if self._spec is not None:
+                batch_keys += ["verify_rows", "spec_len"]
+            out_sh: list = [psh, rep, rep]
+            if self._spec is not None:
+                out_sh.append(rep)
+                if self._needs_hidden in ("frontier", "rows"):
+                    out_sh.append(rep)
+            self._step = jax.jit(
+                self._step_impl,
+                donate_argnums=(1,),
+                in_shardings=(
+                    jax.tree.map(lambda p: p.sharding, self.params),
+                    psh,
+                    {k: rep for k in batch_keys},
+                ),
+                out_shardings=tuple(out_sh),
+            )
         self.steps_run = 0
+
+    # -- mesh plumbing ------------------------------------------------------
+    @staticmethod
+    def _validate_mesh(cfg, serve_cfg, mesh_ctx) -> None:
+        """An engine's mesh shards tp (attention/MLP/pool heads) and ep
+        (expert dispatch) only — data parallelism is the ReplicaRouter tier
+        (serving/router.py), and pp/cp make no sense for one decode step."""
+        sizes = mesh_ctx.sizes
+        for ax in ("pp", "cp", "dp_replicate", "dp_shard"):
+            if sizes[ax] != 1:
+                raise ValueError(
+                    f"serving mesh must keep {ax}=1 (got {sizes[ax]}): the "
+                    "engine shards tp/ep; replicate engines behind a "
+                    "ReplicaRouter for data parallelism"
+                )
+        tp, ep = sizes["tp"], sizes["ep"]
+        if tp > 1:
+            if cfg.attention_type == "mla":
+                if cfg.mla_kv_lora_rank % tp:
+                    raise ValueError(
+                        f"mla_kv_lora_rank={cfg.mla_kv_lora_rank} not "
+                        f"divisible by tp={tp} (the latent pool shards r)"
+                    )
+            elif cfg.num_kv_heads % tp or cfg.num_heads % tp:
+                # the GQA head-divisibility constraint (docs/SERVING.md):
+                # each tp rank must own whole KV heads of every page, with
+                # their GQA query groups on the same rank
+                raise ValueError(
+                    f"num_heads={cfg.num_heads} / num_kv_heads="
+                    f"{cfg.num_kv_heads} not divisible by tp={tp}"
+                )
+            if cfg.intermediate_size % tp:
+                raise ValueError(
+                    f"intermediate_size={cfg.intermediate_size} not "
+                    f"divisible by tp={tp}"
+                )
+        if ep > 1:
+            moe = getattr(cfg, "moe", None)
+            if moe is None:
+                raise ValueError("ep>1 needs an MoE decoder")
+            if moe.n_routed_experts % ep:
+                raise ValueError(
+                    f"n_routed_experts={moe.n_routed_experts} not "
+                    f"divisible by ep={ep}"
+                )
+            if serve_cfg.token_budget % ep:
+                # the EP shard_map splits the flat token batch over ep
+                raise ValueError(
+                    f"token_budget={serve_cfg.token_budget} not divisible "
+                    f"by ep={ep}"
+                )
+
+    def _serving_param_specs(self):
+        """Model param specs adjusted for the serving TP plan. GQA keeps the
+        training plan (q/k/v/o on heads, MLP column/row — so k/v land
+        pre-sharded on the pool's KV-head cut). MLA switches the attention
+        block to LATENT-parallel: heads share one cached latent, so head
+        sharding would force every rank to read the full latent pages;
+        instead `kv_up_proj` shards its rank dim r (matching the pool) and
+        the head-sharded q/o projections replicate — scores and the
+        absorbed value product then reduce over the sharded r via two
+        all-reduces per layer, and the big cached quantity is what halves
+        per chip."""
+        if self.is_moe:
+            from automodel_tpu.models.moe_lm import decoder as mod
+        else:
+            from automodel_tpu.models.llm import decoder as mod
+        specs = mod.param_specs(self.cfg)
+        if not self.is_mla:
+            return specs
+
+        def _drop_heads(spec):
+            return tuple(None if a == "heads" else a for a in spec)
+
+        for key in ("layers", "dense_layers", "moe_layers"):
+            ld = specs.get(key)
+            if not ld:
+                continue
+            for name in ("q_proj", "q_up_proj", "o_proj"):
+                if name in ld:
+                    ld[name] = jax.tree.map(
+                        _drop_heads, ld[name],
+                        is_leaf=lambda x: isinstance(x, tuple),
+                    )
+            if "kv_up_proj" in ld:
+                ld["kv_up_proj"]["kernel"] = ("layers", "mla_latent", None)
+        return specs
+
+    def _constrain_rep(self, x):
+        """Pin an activation replicated (no-op off-mesh). Applied to the
+        post-layer hidden and the logits, so every cross-rank reduction
+        happens INSIDE the layer stack / unembed and the sampling tail
+        (filters, fold_in keys, categorical) is rank-local — zero
+        collectives after the logits all-gather, pinned by the
+        sharded_serve_step baseline."""
+        if self._mesh is None:
+            return x
+        return jax.lax.with_sharding_constraint(x, self._mesh.replicated())
+
+    def _constrain_pool(self, pool):
+        """Pin the per-stack pool arrays to their kv_pages.pool_axes layout
+        through the COW block and the layer scan (no-op off-mesh)."""
+        if self._mesh is None:
+            return pool
+        a0, a1 = self._pool_axes
+        s0, s1 = self._mesh.sharding(*a0), self._mesh.sharding(*a1)
+        return [
+            (
+                jax.lax.with_sharding_constraint(p0, s0),
+                jax.lax.with_sharding_constraint(p1, s1),
+            )
+            for p0, p1 in pool
+        ]
+
+    def _moe_mlp_ep(self, h, lp, cfg):
+        """MoE block under ep>1: PR 1's dropless EP dispatch (sort + ragged
+        GEMM + expert A2A confined to this step) via the shard_map wrapper —
+        the flat token batch shards over ep, expert weights enter sharded on
+        ep only. Routing is deterministic in the logits, so EP changes
+        where experts run, never which tokens they see."""
+        from automodel_tpu.moe.layer import moe_forward
+
+        moe_cfg = dataclasses.replace(cfg.moe, dispatcher="dropless")
+        x = rms_norm(h, lp["post_attn_norm"]["scale"], cfg.rms_norm_eps,
+                     cfg.zero_centered_norm)
+        moe_out, _aux, _stats = moe_forward(
+            lp["moe"], moe_cfg, x, mesh_ctx=self._mesh
+        )
+        return h + moe_out
 
     # -- device step --------------------------------------------------------
     def _attn(self, h, lp, win, pool_k, pool_v, b):
@@ -251,6 +457,7 @@ class ServingEngine:
                 q_abs[0], q_rope[0], pool_k, pool_v,
                 b["pt_tok"], b["pos"],
                 scale=scale, window=window, impl=self._attn_impl,
+                mesh_ctx=self._mesh,
             )
             attn = jnp.einsum("tnr,rnd->tnd", out_lat, w_uv)
             attn = attn.reshape(1, -1, n * dv)
@@ -268,7 +475,7 @@ class ServingEngine:
             q[0], pool_k, pool_v, b["pt_tok"], b["pos"],
             scale=scale, window=window,
             soft_cap=cfg.attn_soft_cap, sinks=lp.get("sinks"),
-            impl=self._attn_impl,
+            impl=self._attn_impl, mesh_ctx=self._mesh,
         )
         T = attn.shape[0]
         attn = attn.reshape(1, T, cfg.num_heads * attn.shape[-1])
@@ -292,7 +499,12 @@ class ServingEngine:
         pool = jax.tree.map(
             lambda a: a.at[:, b["cow_dst"]].set(a[:, b["cow_src"]]), pool
         )
+        # under a mesh: pool pinned to its pages-global / heads-sharded
+        # layout through the COW block and the scans; hidden replicated so
+        # every tp reduction lives inside the layer stack (no-ops off-mesh)
+        pool = self._constrain_pool(pool)
         h = _embed(params, cfg, b["tok"][None])  # (1, T, H)
+        h = self._constrain_rep(h)
 
         new_pool = []
         for (pkey, mlp_fn, L), (p0, p1), wins in zip(
@@ -303,12 +515,13 @@ class ServingEngine:
                 lp, c0, c1, win = xs
                 h, c0, c1 = self._attn(h, lp, win, c0, c1, b)
                 h = mlp_fn(h, lp, cfg)
-                return (h,), (c0, c1)
+                return (self._constrain_rep(h),), (c0, c1)
 
             (h,), (p0, p1) = jax.lax.scan(
                 one_layer, (h,), (params[pkey], p0, p1, wins)
             )
             new_pool.append((p0, p1))
+        new_pool = self._constrain_pool(new_pool)
 
         h = rms_norm(h, params["final_norm"]["scale"], cfg.rms_norm_eps,
                      cfg.zero_centered_norm)
@@ -319,6 +532,7 @@ class ServingEngine:
         idx = jnp.clip(b["sample_tok"], 0, h.shape[1] - 1)
         h_s = h[0, idx]                            # (S, H)
         logits = unembed(params, cfg, h_s[None])[0]  # (S, V) fp32
+        logits = self._constrain_rep(logits)
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         next_pos = jnp.maximum(b["pos"], 0)[idx] + 1
         sampled = self._sample_rows(logits, b["temp"], b["seed"], next_pos)
@@ -363,6 +577,7 @@ class ServingEngine:
         S = h_sel.shape[0]
         logits = unembed(params, cfg, h_sel.reshape(1, S * (K + 1), -1))
         logits = logits[0].reshape(S, K + 1, -1)               # fp32
+        logits = self._constrain_rep(logits)
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         draft = b["tok"][vr[:, 1:]]                            # (S, K)
         valid = jnp.arange(K)[None, :] < b["spec_len"][:, None]
@@ -405,13 +620,18 @@ class ServingEngine:
         logprobs = jax.nn.log_softmax(logits, axis=-1)
         lp_tok = jnp.take_along_axis(logprobs, tokens[..., None], -1)[..., 0]
         out = [new_pool, tokens, lp_tok, accept]
+        # EAGLE/DFlash hidden-state feedback is gathered PER SLOT from the
+        # sharded step's outputs: the replication constraint makes the
+        # feedback fully addressable on the host however the step is
+        # partitioned (the ngram source is sharding-oblivious — it never
+        # sees a device array, only known tokens)
         if self._needs_hidden == "frontier":
             # the hidden that produced the bonus token (row `accept`)
-            out.append(jnp.take_along_axis(
+            out.append(self._constrain_rep(jnp.take_along_axis(
                 h_sel, jnp.clip(accept, 0, K)[:, None, None], axis=1
-            )[:, 0])
+            )[:, 0]))
         elif self._needs_hidden == "rows":
-            out.append(h[0])
+            out.append(self._constrain_rep(h[0]))
         return tuple(out)
 
     # -- host API -----------------------------------------------------------
@@ -425,22 +645,29 @@ class ServingEngine:
         (tokens (S,), logprobs (S,)) plainly, or — with speculation — the
         committed-candidate block (tokens (S, K+1), logprobs (S, K+1),
         accept (S,)[, hidden feedback for the draft source])."""
+        if self._mesh is None:
+            up = jnp.asarray
+        else:
+            # plan arrays upload replicated onto the engine's mesh (the
+            # host scheduler is mesh-oblivious: page IDs are global)
+            rep = self._mesh.replicated()
+            up = lambda a: jax.device_put(np.asarray(a), rep)  # noqa: E731
         batch = {
-            "tok": jnp.asarray(plan.tok),
-            "slot": jnp.asarray(plan.slot),
-            "pos": jnp.asarray(plan.pos),
-            "page": jnp.asarray(plan.page),
-            "off": jnp.asarray(plan.off),
-            "page_tables": jnp.asarray(plan.page_tables),
-            "sample_tok": jnp.asarray(plan.sample_tok),
-            "temp": jnp.asarray(plan.temp),
-            "seed": jnp.asarray(plan.seed),
-            "cow_src": jnp.asarray(plan.cow_src),
-            "cow_dst": jnp.asarray(plan.cow_dst),
+            "tok": up(plan.tok),
+            "slot": up(plan.slot),
+            "pos": up(plan.pos),
+            "page": up(plan.page),
+            "off": up(plan.off),
+            "page_tables": up(plan.page_tables),
+            "sample_tok": up(plan.sample_tok),
+            "temp": up(plan.temp),
+            "seed": up(plan.seed),
+            "cow_src": up(plan.cow_src),
+            "cow_dst": up(plan.cow_dst),
         }
         if self._spec is not None:
-            batch["verify_rows"] = jnp.asarray(plan.verify_rows)
-            batch["spec_len"] = jnp.asarray(plan.spec_len)
+            batch["verify_rows"] = up(plan.verify_rows)
+            batch["spec_len"] = up(plan.spec_len)
         # the StepPlan upload above is the ONE sanctioned host→device copy
         # per step; with guard_transfers the step invocation itself runs
         # under transfer_guard("disallow") so any other transfer raises
@@ -452,6 +679,32 @@ class ServingEngine:
         self.pool = out[0]
         self.steps_run += 1
         return tuple(np.asarray(x) for x in out[1:])
+
+    def run_and_absorb(
+        self, sched: Scheduler, plan: StepPlan, step_idx: int,
+    ) -> tuple[int, float]:
+        """One engine step + scheduler absorption (speculative outputs
+        unpacked and fed back to the draft source). Returns (tokens
+        committed, device-step seconds) — the shared inner loop of
+        `serve_batch` and the ReplicaRouter's per-replica drive. The
+        timing covers run_step ONLY (upload + jitted step + readback),
+        not the host-side scheduler bookkeeping, so latency counters stay
+        comparable with the pre-router serve loop's."""
+        t0 = time.perf_counter()
+        out = self.run_step(plan)
+        dt = time.perf_counter() - t0
+        if self._spec is not None:
+            tokens, _lps, accept, *hid = out
+            fh = hid[0] if self._needs_hidden == "frontier" else None
+            rh = hid[0] if self._needs_hidden == "rows" else None
+            n_new = sched.update(
+                plan, tokens, step_idx, accept=accept,
+                frontier_hidden=fh, row_hidden=rh,
+            )
+        else:
+            tokens, _lps = out
+            n_new = sched.update(plan, tokens, step_idx)
+        return n_new, dt
 
     def make_scheduler(self) -> Scheduler:
         sc = self.serve_cfg
@@ -537,20 +790,7 @@ class ServingEngine:
                 # just advances; an online server would sleep
                 step_idx += 1
                 continue
-            t0 = time.perf_counter()
-            out = self.run_step(plan)
-            dt = time.perf_counter() - t0
-            if self._spec is not None:
-                tokens, _lps, accept, *hid = out
-                fh = hid[0] if self._needs_hidden == "frontier" else None
-                rh = hid[0] if self._needs_hidden == "rows" else None
-                n_new = sched.update(
-                    plan, tokens, step_idx, accept=accept,
-                    frontier_hidden=fh, row_hidden=rh,
-                )
-            else:
-                tokens, _lps = out
-                n_new = sched.update(plan, tokens, step_idx)
+            n_new, dt = self.run_and_absorb(sched, plan, step_idx)
             n_steps += 1
             n_tokens_fed += plan.n_tokens
             if plan.n_samples:

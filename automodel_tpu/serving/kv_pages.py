@@ -21,6 +21,13 @@ every gather/scatter in bounds without branching):
 - MLA:  c    (L, N+1, ps, r),  kr (L, N+1, ps, dr)   (absorbed decode —
   r+dr cached floats per token instead of n*(dn+dr+dv))
 
+Under a serving mesh (ServingEngine(mesh_ctx=...)) the pool becomes a
+MESH-SHARDED array: pages stay global/replicated while the per-page head
+dim partitions over tp (`pool_axes` — GQA KV heads, MLA kv-latent rank),
+so every integer in this file — page IDs, tables, refcounts, defrag
+plans — is mesh-oblivious and admission/COW/preemption/prefix-sharing
+compose with sharding unchanged.
+
 The allocator is deliberately host-side pure-python: page churn is a few
 integer ops per request per step, nothing a device roundtrip could beat.
 `defrag()` exists for pool COMPACTION (paged allocation never fragments in
@@ -238,11 +245,50 @@ def init_mla_pool(cfg, num_layers: int, num_pages: int, page_size: int):
     )
 
 
-def init_pool(cfg, stack_layers: list[int], num_pages: int, page_size: int):
+def pool_axes(cfg) -> tuple:
+    """Per-stack mesh-axis tuples for the two pool arrays of one stack
+    (feed each through `MeshContext.sharding(*axes)`). Page IDs stay
+    GLOBAL — layer and page axes are never sharded, so the host-side
+    allocator/scheduler/prefix-cache integer accounting composes with any
+    mesh unchanged. Only the per-page head dim is partitioned over tp:
+
+    - GQA:  k/v shard KV heads (each tp rank owns Hkv/tp heads of every
+      page — the query heads of its GQA groups live on the same rank, so
+      the paged attention gather/softmax is rank-local);
+    - MLA:  the kv latent `c` shards its rank dim r (the big cached
+      quantity; heads share one latent, so there is no head dim to cut),
+      while the tiny shared rope head `kr` (dr floats/token) replicates.
+    """
+    if cfg.attention_type == "mla":
+        return ((None, None, None, "tp"), (None, None, None, None))
+    return ((None, None, None, "tp", None), (None, None, None, "tp", None))
+
+
+def pool_shardings(cfg, stack_layers: list[int], mesh_ctx):
+    """Per-stack NamedSharding tuples matching `init_pool`'s structure."""
+    a0, a1 = pool_axes(cfg)
+    return [
+        (mesh_ctx.sharding(*a0), mesh_ctx.sharding(*a1)) for _ in stack_layers
+    ]
+
+
+def init_pool(
+    cfg, stack_layers: list[int], num_pages: int, page_size: int,
+    mesh_ctx=None,
+):
     """Per-stack pool tuples for a decoder (dense decoders have one stack;
-    MoE decoders a dense prefix + MoE stack — mirrors generate.py)."""
+    MoE decoders a dense prefix + MoE stack — mirrors generate.py). With a
+    `mesh_ctx` the arrays are placed mesh-sharded (`pool_axes`)."""
     init = init_mla_pool if cfg.attention_type == "mla" else init_gqa_pool
-    return [init(cfg, L, num_pages, page_size) for L in stack_layers]
+    pool = [init(cfg, L, num_pages, page_size) for L in stack_layers]
+    if mesh_ctx is not None:
+        pool = [
+            tuple(jax.device_put(a, s) for a, s in zip(stack, shards))
+            for stack, shards in zip(
+                pool, pool_shardings(cfg, stack_layers, mesh_ctx)
+            )
+        ]
+    return pool
 
 
 def pool_bytes(pool) -> int:

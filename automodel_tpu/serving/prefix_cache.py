@@ -165,6 +165,24 @@ class PrefixCache:
             cow_pending=bool(pages) and fed < len(pages) * ps,
         )
 
+    def peek_match_tokens(self, tokens: list) -> int:
+        """Read-only match length: how many leading tokens full cached
+        chunks cover, WITHOUT ticking any LRU clock. The ReplicaRouter's
+        affinity probe — every replica is probed per arriving request, and
+        a mutating probe would keep prefixes warm on replicas that lose
+        the routing decision, letting probe-only pages outlive genuinely
+        served ones under LRU pressure."""
+        ps = self.page_size
+        node = self.root
+        i = 0
+        while i + ps <= len(tokens):
+            child = node.children.get(tuple(tokens[i : i + ps]))
+            if child is None:
+                break
+            node = child
+            i += ps
+        return i
+
     # -- insertion -----------------------------------------------------------
     def insert(self, tokens: list, pages: list) -> int:
         """Donate `pages` (full pages backing `tokens`, page-aligned) into

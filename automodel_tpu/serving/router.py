@@ -1,0 +1,302 @@
+"""Data-parallel serving tier: N sharded engine replicas behind a router.
+
+The pod-scale layer of the serving stack (the Gemma-on-TPU serving study,
+PAPERS.md, is the comparison target): one `ServingEngine` shards its jitted
+step over tp/ep inside a mesh SLICE, and the `ReplicaRouter` replicates
+that engine across `replicas` disjoint slices — the same `llm_serve`
+recipe scales from one chip to a pod by changing `serving.mesh` in YAML:
+
+    serving:
+      mesh: {replicas: 2, tp: 2, ep: 1}     # dp2 x tp2 over 4 chips
+
+Routing is PER-REQUEST ADMISSION, decided once when a request arrives
+(requests never migrate — their KV pages live on one slice's pool):
+
+- sticky on prefix-cache affinity: each replica's scheduler is probed for
+  the longest cached prefix of the request (`Scheduler.prefix_hit_tokens`);
+  the best non-zero match wins, so agent loops and shared-system-prompt
+  traffic keep landing where their pages already are instead of diluting
+  the radix tree across replicas;
+- otherwise least-loaded-by-free-pages: the replica whose pool has the
+  most free pages (ties → fewest resident requests, then lowest index).
+  Free pages are the honest load signal — they bound both admission and
+  preemption churn, which is what actually moves tail latency.
+
+The router owns NO device state: it holds one scheduler per replica and
+drives them in lockstep engine steps (an offline analog of N independent
+serve loops; an online frontend would run one thread per replica). Every
+replica keeps its own compile-once contract — `serve_batch` reports the
+jit cache-miss counter per replica plus balance stats (requests/tokens per
+replica, per-replica p50/p95 ms per committed token).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+from automodel_tpu.serving.engine import ServingConfig, ServingEngine
+from automodel_tpu.serving.scheduler import Request
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeMeshConfig:
+    """Typed `serving.mesh` section: the pod topology of a serving run.
+
+    `replicas` data-parallel engine replicas, each over a `tp * ep`-chip
+    mesh slice (tp shards attention/MLP/pool heads, ep shards expert
+    dispatch for MoE decoders). replicas=tp=ep=1 is the single-chip
+    engine on a trivial 1x1 mesh — the SAME code path end to end."""
+
+    replicas: int = 1
+    tp: int = 1
+    ep: int = 1
+
+    def __post_init__(self):
+        if self.replicas < 1 or self.tp < 1 or self.ep < 1:
+            raise ValueError(f"mesh sizes must be >= 1: {self}")
+
+    @property
+    def chips_per_replica(self) -> int:
+        return self.tp * self.ep
+
+    @property
+    def num_chips(self) -> int:
+        return self.replicas * self.chips_per_replica
+
+    def build_contexts(self, devices=None) -> list:
+        """One MeshContext per replica over disjoint device slices."""
+        import jax
+
+        from automodel_tpu.distributed import MeshConfig
+
+        devices = list(devices if devices is not None else jax.devices())
+        if len(devices) < self.num_chips:
+            raise ValueError(
+                f"serving.mesh needs replicas*tp*ep = {self.num_chips} "
+                f"devices, have {len(devices)}"
+            )
+        per = self.chips_per_replica
+        return [
+            MeshConfig(tp=self.tp, ep=self.ep, dp_shard=1).build(
+                devices[i * per : (i + 1) * per]
+            )
+            for i in range(self.replicas)
+        ]
+
+
+class ReplicaRouter:
+    """N data-parallel `ServingEngine` replicas + per-replica admission."""
+
+    def __init__(
+        self,
+        params,
+        cfg,
+        serve_cfg: ServingConfig = ServingConfig(),
+        mesh: ServeMeshConfig = ServeMeshConfig(),
+        devices=None,
+        draft_source_factory=None,
+    ):
+        """`params` may carry any placement (chassis-sharded arrays flow
+        straight in); each replica re-shards them onto its own slice.
+        `draft_source_factory()` builds one draft source per replica for
+        the stateful EAGLE/DFlash speculation adapters (per-request state
+        must live with the replica that serves the request)."""
+        self.mesh = mesh
+        ctxs = mesh.build_contexts(devices)
+        self.engines = [
+            ServingEngine(
+                params, cfg, serve_cfg,
+                draft_source=(
+                    draft_source_factory() if draft_source_factory else None
+                ),
+                mesh_ctx=ctx,
+            )
+            for ctx in ctxs
+        ]
+
+    @property
+    def num_replicas(self) -> int:
+        return len(self.engines)
+
+    # -- admission ----------------------------------------------------------
+    def route(self, req: Request, schedulers) -> tuple[int, bool]:
+        """(replica index, sticky?) for one arriving request: best
+        prefix-cache affinity first, else most-free-pages (ties → fewest
+        resident requests, then lowest index)."""
+        best_aff, best_r = 0, None
+        for r, s in enumerate(schedulers):
+            aff = s.prefix_hit_tokens(req.prompt)
+            if aff > best_aff:
+                best_aff, best_r = aff, r
+        if best_r is not None:
+            return best_r, True
+        return max(
+            range(len(schedulers)),
+            key=lambda r: (
+                schedulers[r].alloc.num_free,
+                -(len(schedulers[r].running) + len(schedulers[r].waiting)),
+                -r,
+            ),
+        ), False
+
+    # -- offline drive ------------------------------------------------------
+    def serve_batch(
+        self,
+        requests: list[Request],
+        *,
+        metric_logger=None,
+        max_steps: int | None = None,
+    ) -> dict:
+        """Route + drive all replicas until every request finished. Returns
+        {"outputs": per-request ids (submission order), "requests", "stats"}
+        with the same top-level counters as `ServingEngine.serve_batch`
+        plus `per_replica` and router balance stats."""
+        for i, req in enumerate(requests):
+            if req.rid < 0:
+                req.rid = i  # global rids: replicas must never collide
+        scheds = [eng.make_scheduler() for eng in self.engines]
+        pending = sorted(requests, key=lambda r: (r.arrival, r.rid))
+        n = self.num_replicas
+        routed = [0] * n
+        sticky_routed = 0
+        decode_s = [0.0] * n
+        n_sampled = [0] * n
+        n_steps = [0] * n
+        tokens_fed = [0] * n
+        ms_per_tok: list[list[float]] = [[] for _ in range(n)]
+        budget = max_steps if max_steps is not None else 10_000_000
+        t_start = time.perf_counter()
+        step_idx = 0
+        while step_idx < budget and (
+            pending or any(s.has_work for s in scheds)
+        ):
+            while pending and pending[0].arrival <= step_idx:
+                req = pending.pop(0)
+                r, sticky = self.route(req, scheds)
+                scheds[r].submit(req)
+                routed[r] += 1
+                sticky_routed += int(sticky)
+            progressed = False
+            for r, (eng, sched) in enumerate(zip(self.engines, scheds)):
+                if not sched.has_work:
+                    continue
+                plan = sched.schedule(step_idx)
+                if plan is None:
+                    continue
+                n_new, dt = eng.run_and_absorb(sched, plan, step_idx)
+                progressed = True
+                n_steps[r] += 1
+                tokens_fed[r] += plan.n_tokens
+                if plan.n_samples:
+                    decode_s[r] += dt
+                    n_sampled[r] += n_new
+                    if n_new:
+                        ms_per_tok[r].append(dt * 1e3 / n_new)
+            if progressed:
+                step_idx += 1
+                continue
+            # idle step on every replica: jump to the next event (arrival
+            # or deadline eviction) instead of spinning — mirroring the
+            # single-engine loop's fast-forward, incl. never jumping PAST
+            # a servable arrival
+            arrivals = [r.arrival for r in pending if r.arrival > step_idx]
+            for s in scheds:
+                arrivals += [
+                    r.arrival for r in s.waiting if r.arrival > step_idx
+                ]
+            deadlines = [
+                s.next_deadline for s in scheds
+                if s.next_deadline is not None and s.next_deadline > step_idx
+            ]
+            if deadlines:
+                step_idx = min(deadlines + arrivals)
+                continue
+            if not arrivals:
+                if pending or any(s.has_work for s in scheds):
+                    blocked = next(
+                        (s.waiting[0] for s in scheds if s.waiting),
+                        pending[0] if pending else None,
+                    )
+                    raise RuntimeError(
+                        "routed serving stalled: request "
+                        f"rid={getattr(blocked, 'rid', '?')} cannot make "
+                        f"progress on any of {n} replicas (free pages: "
+                        f"{[s.alloc.num_free for s in scheds]})"
+                    )
+                break
+            step_idx = min(arrivals)
+        elapsed = time.perf_counter() - t_start
+        assert max_steps is not None or (
+            not pending and not any(s.has_work for s in scheds)
+        ), "routed serve stalled"
+
+        finished = [r for s in scheds for r in s.finished]
+        by_rid = sorted(finished, key=lambda r: r.rid)
+        per_replica = []
+        for r, (eng, sched) in enumerate(zip(self.engines, scheds)):
+            samples = ms_per_tok[r]
+            per_replica.append({
+                "requests": routed[r],
+                "steps": n_steps[r],
+                "new_tokens": n_sampled[r],
+                "tokens_fed": tokens_fed[r],
+                "decode_tokens_per_sec": round(
+                    n_sampled[r] / max(decode_s[r], 1e-9), 2
+                ),
+                "p50_ms_per_token": round(
+                    float(np.percentile(samples, 50)), 4
+                ) if samples else None,
+                "p95_ms_per_token": round(
+                    float(np.percentile(samples, 95)), 4
+                ) if samples else None,
+                "preemptions": sched.n_preemptions,
+                "free_pages": sched.alloc.num_free,
+                "compiled_signatures": eng.step_cache_size(),
+            })
+        stats = {
+            "replicas": n,
+            "requests": len(by_rid),
+            "new_tokens": sum(n_sampled),
+            "tokens_fed": sum(tokens_fed),
+            "steps": max(n_steps) if n_steps else 0,
+            "elapsed_s": round(elapsed, 4),
+            # pod throughput: each replica decodes on its own slice, so
+            # aggregate tokens/s is the SUM of per-replica rates (the
+            # offline loop time-slices them on one host; a pod runs them
+            # concurrently)
+            "decode_tokens_per_sec": round(sum(
+                ns / max(ds, 1e-9) for ns, ds in zip(n_sampled, decode_s)
+            ), 2),
+            "timed_out": sum(s.n_timed_out for s in scheds),
+            "preemptions": sum(s.n_preemptions for s in scheds),
+            "compiled_signatures": max(
+                pr["compiled_signatures"] for pr in per_replica
+            ),
+            "sticky_routed": sticky_routed,
+            "requests_per_replica": routed,
+            "tokens_per_replica": list(n_sampled),
+            "balance": round(
+                min(routed) / max(max(routed), 1), 4
+            ),
+            "per_replica": per_replica,
+        }
+        if any(s.prefix is not None for s in scheds):
+            stats["prefix_hits"] = sum(s.n_prefix_hits for s in scheds)
+            stats["prefill_skipped_tokens"] = sum(
+                s.prefill_skipped for s in scheds
+            )
+        if any(s.spec is not None for s in scheds):
+            stats["drafted_tokens"] = sum(s.n_drafted for s in scheds)
+            stats["accepted_tokens"] = sum(s.n_accepted for s in scheds)
+        if metric_logger is not None:
+            metric_logger.log({
+                f"route_{k}": v for k, v in stats.items() if k != "per_replica"
+            })
+        return {
+            "outputs": [list(r.generated) for r in by_rid],
+            "requests": by_rid,
+            "stats": stats,
+        }

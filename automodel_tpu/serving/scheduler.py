@@ -236,6 +236,18 @@ class Scheduler:
     def has_work(self) -> bool:
         return bool(self.waiting or self.running)
 
+    def prefix_hit_tokens(self, tokens: list) -> int:
+        """Radix-affinity probe: how many of `tokens` this scheduler's
+        prefix cache could serve from shared pages (0 without a cache).
+        The ReplicaRouter's sticky-routing signal — a request lands on the
+        replica already holding its prefix, so the data-parallel tier
+        never dilutes the cache. Strictly READ-ONLY (no LRU tick): every
+        replica is probed per request, and warming the losers' trees
+        would let probe-only pages outlive genuinely served ones."""
+        if self.prefix is None:
+            return 0
+        return self.prefix.peek_match_tokens(list(tokens))
+
     def _match(self, req: Request) -> PrefixMatch:
         if self.prefix is None:
             return PrefixMatch(pages=[], fed=0, matched_tokens=0,

@@ -23,6 +23,16 @@ DIFFERENT (still distribution-correct) continuation than an
 uninterrupted run — quality state is rebuilt, correctness never depends
 on it.
 
+Sharded serving (ServingEngine(mesh_ctx=...), docs/SERVING.md §"Sharded
+serving"): the EAGLE/DFlash hidden-state feedback is gathered PER SLOT
+from the sharded step's outputs — the engine pins the frontier/row
+hiddens replicated before they leave the jit, so the host-side observe()
+buffers below always see fully-addressable arrays no matter how the step
+is partitioned. The ngram source is SHARDING-OBLIVIOUS: it never touches
+a device array (pure token matching over `req.known`), so it works
+unchanged on any mesh and stays the only source the data-parallel
+replica tier can hand out from config alone.
+
 Three sources, all host-driven (drafting happens between engine steps;
 the eagle/dflash forwards are their own small jitted programs with fixed
 shapes — they compile once per serving run, pinned alongside the step's

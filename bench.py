@@ -151,13 +151,23 @@ def main() -> None:
         "accel: require the accelerator (fail fast if unusable); cpu: force CPU",
     )
     ap.add_argument(
+        "--serve-scale-child", default=None, metavar="MESH_JSON",
+        help="internal: run one serve_scale mesh shape in this process "
+        "(the parent forces the virtual CPU device count via env) and "
+        "print a SERVE_SCALE: JSON line",
+    )
+    ap.add_argument(
         "--no-headline", action="store_true",
         help="emit only the llama-MFU metric (skip the flash-vs-XLA, MoE "
         "dropless, long-context CP, serving-decode, prefix-cache, "
-        "speculative-decode, and resilience probes that ride the same "
-        "window)",
+        "speculative-decode, serve-scale, and resilience probes that ride "
+        "the same window)",
     )
     args = ap.parse_args()
+
+    if args.serve_scale_child is not None:
+        _serve_scale_child(args.serve_scale_child)
+        return
 
     fallback = None
     if args.platform == "cpu":
@@ -774,6 +784,125 @@ def _headline_spec(accel: bool) -> dict:
     }
 
 
+def _serve_scale_child(mesh_json: str) -> None:
+    """Child-process half of the `serve_scale` headline: build the given
+    serving mesh over virtual CPU devices (the parent sets
+    XLA_FLAGS=--xla_force_host_platform_device_count), drive one identical
+    request stream through the sharded engine / replica router, print ONE
+    JSON line of stats. A subprocess because the parent has already
+    initialized its backend with a different device count."""
+    import dataclasses
+    import json as _json
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from automodel_tpu.models.llm import decoder
+    from automodel_tpu.models.llm.decoder import TransformerConfig
+    from automodel_tpu.serving import (
+        ReplicaRouter,
+        Request,
+        ServeMeshConfig,
+        ServingConfig,
+    )
+
+    mesh = ServeMeshConfig(**_json.loads(mesh_json))
+    cfg = TransformerConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2,
+        dtype=jnp.float32, remat_policy="none", attn_impl="xla",
+    )
+    serve = ServingConfig(
+        page_size=8, num_pages=64, max_slots=4, pages_per_slot=8,
+        token_budget=16, prefill_chunk=8,
+    )
+    lens, max_new, n_req = (12, 30, 7, 21, 16), 16, 8
+    params = decoder.init(cfg, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    prompts = [
+        [int(t) for t in rng.integers(1, cfg.vocab_size, (lens[i % len(lens)],))]
+        for i in range(n_req)
+    ]
+
+    def reqs():
+        return [
+            Request(prompt=list(p), max_new_tokens=max_new, arrival=i // 2)
+            for i, p in enumerate(prompts)
+        ]
+
+    # every shape goes through the router (replicas=1 is the trivial
+    # routing decision) so p50/p95 are TRUE per-step percentiles for all
+    # mesh shapes — comparing a 1chip mean against a tp2 tail percentile
+    # would understate single-chip tail latency
+    router = ReplicaRouter(params, cfg, serve, mesh)
+    router.serve_batch(reqs())  # warmup: compile outside the window
+    stats = router.serve_batch(reqs())["stats"]
+    per = stats["per_replica"]
+    out = {
+        "decode_tokens_per_sec": stats["decode_tokens_per_sec"],
+        "p50_ms_per_token": [p["p50_ms_per_token"] for p in per],
+        "p95_ms_per_token": [p["p95_ms_per_token"] for p in per],
+        "requests_per_replica": stats["requests_per_replica"],
+        "balance": stats["balance"],
+        "sticky_routed": stats["sticky_routed"],
+    }
+    out.update(
+        compiled_signatures=stats["compiled_signatures"],
+        new_tokens=stats["new_tokens"],
+        mesh=dataclasses.asdict(mesh),
+        devices=len(jax.devices()),
+    )
+    assert stats["compiled_signatures"] == 1, stats
+    print("SERVE_SCALE:" + _json.dumps(out))
+
+
+def _headline_serve_scale(accel: bool) -> dict:
+    """Pod-scale serving: aggregate decode tokens/s + per-replica p50/p95
+    ms/token for the SAME request stream at mesh {1, tp2, dp2×tp2}, plus
+    router balance stats — the scaling-structure headline (the Gemma-on-
+    TPU study's comparison axis). Runs each mesh in a subprocess over
+    virtual CPU devices: the bench process owns the real backend with its
+    own device count, and the scaling story is about collective/routing
+    structure, which the CPU mesh reproduces exactly (the HLO ratchet
+    pins it; on-TPU absolute numbers ride the accelerator probe of the
+    other headlines)."""
+    import os
+    import subprocess
+
+    shapes = {
+        "1chip": {"replicas": 1, "tp": 1},
+        "tp2": {"replicas": 1, "tp": 2},
+        "dp2xtp2": {"replicas": 2, "tp": 2},
+    }
+    out: dict = {"config": {"shapes": shapes, "backend": "cpu-mesh"}}
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    for name, mesh in shapes.items():
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--serve-scale-child", json.dumps(mesh)],
+            capture_output=True, text=True, timeout=900, env=env,
+        )
+        line = next(
+            (l for l in r.stdout.splitlines() if l.startswith("SERVE_SCALE:")),
+            None,
+        )
+        if r.returncode != 0 or line is None:
+            out[name] = {"error": (r.stderr or r.stdout)[-300:]}
+            continue
+        out[name] = json.loads(line[len("SERVE_SCALE:"):])
+    ok = [n for n in shapes if "error" not in out.get(n, {})]
+    if len(ok) >= 2 and "1chip" in ok:
+        base = out["1chip"]["decode_tokens_per_sec"]
+        out["scaling"] = {
+            n: round(out[n]["decode_tokens_per_sec"] / max(base, 1e-9), 3)
+            for n in ok
+        }
+    return out
+
+
 def _headline_resilience(accel: bool) -> dict:
     """Goodput under one injected preemption: a tiny train run is
     SIGTERM'd (via the deterministic fault injector) at mid-run, emergency-
@@ -869,6 +998,7 @@ def _run_headline(accel: bool) -> dict:
         ("decode", _headline_decode),
         ("prefix", _headline_prefix),
         ("spec", _headline_spec),
+        ("serve_scale", _headline_serve_scale),
         ("resilience", _headline_resilience),
     ):
         try:
