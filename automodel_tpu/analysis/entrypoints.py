@@ -11,6 +11,10 @@ collectives GSPMD/shard_map would emit for TPU):
 - ``paged_serve_step``   — the serving engine's single-chip jitted step
 - ``spec_serve_step``    — the same step with speculative draft-then-verify
 - ``sharded_serve_step`` — the tp=2 mesh-sharded serving step
+- ``prefill_step``       — the prefill-class replica's step (disaggregated
+                           serving: wider token budget, no speculation)
+- ``kv_transfer``        — the fused page-copy program of the prefill→
+                           decode KV handoff
 - ``pp_ep_1f1b_grad``    — the flagship PP×EP explicit 1F1B grad
 
 Each builder returns ``(compiled, mesh_axes)``; callers feed both to
@@ -257,6 +261,66 @@ def sharded_serve_step():
     return compiled, dict(ctx.sizes)
 
 
+def prefill_step():
+    """The prefill-class replica's jitted step (disaggregated serving):
+    the SAME step program as paged_serve_step at the prefill-class
+    geometry — a wider token budget (prefill replicas never carry
+    latency-critical decode rows, so they amortize step overhead over
+    wide chunks) and no speculative block (nothing to speculate on while
+    feeding a prompt). Must stay collective-free with the pool donation
+    intact and the paged k/v page gathers alive, exactly like the decode
+    class — disaggregation changes WHERE phases run, never what the step
+    compiles to."""
+    import jax
+    import jax.numpy as jnp
+
+    from automodel_tpu.models.llm import decoder
+    from automodel_tpu.serving.engine import ServingConfig, ServingEngine
+
+    dense, _ = _configs()
+    cfg = dataclasses.replace(dense, pipeline_microbatches=1)
+    params = decoder.init(cfg, jax.random.key(0))
+    eng = ServingEngine(params, cfg, ServingConfig(
+        page_size=4, num_pages=16, max_slots=2, pages_per_slot=4,
+        token_budget=16,
+    ))
+    T, S, P = 16, 2, 4
+    batch = {k: jnp.zeros(T, jnp.int32) for k in ("tok", "slot", "pos", "page", "off")}
+    batch.update(
+        page_tables=jnp.zeros((S, P), jnp.int32),
+        sample_tok=jnp.zeros(S, jnp.int32),
+        temp=jnp.zeros(S, jnp.float32),
+        seed=jnp.zeros(S, jnp.int32),
+        cow_src=jnp.zeros(S, jnp.int32),
+        cow_dst=jnp.zeros(S, jnp.int32),
+    )
+    compiled = eng._step.lower(eng.params, eng.pool, batch).compile()
+    return compiled, None
+
+
+def kv_transfer():
+    """The fused same-device page-copy program of the prefill→decode
+    handoff (serving/kv_transfer.py `apply_transfer`): one gather along
+    the pages axis per pool array and the matching in-place scatter into
+    the DONATED destination pool. Must stay data-movement only — zero
+    collectives (the split cross-slice path hops via device_put outside
+    any program), and the destination donation must survive (a dropped
+    alias would double-buffer the pool on every handoff)."""
+    import jax.numpy as jnp
+
+    from automodel_tpu.serving.kv_pages import init_pool
+    from automodel_tpu.serving.kv_transfer import apply_transfer
+
+    dense, _ = _configs()
+    cfg = dataclasses.replace(dense, pipeline_microbatches=1)
+    src = init_pool(cfg, [cfg.num_layers], 16, 4)
+    dst = init_pool(cfg, [cfg.num_layers], 16, 4)
+    B = 4
+    idx = jnp.zeros(B, jnp.int32)
+    compiled = apply_transfer.lower(dst, src, idx, idx).compile()
+    return compiled, None
+
+
 def pp_ep_1f1b_grad():
     """The flagship PP×EP program: explicit 1F1B grad with the expert A2A
     inside each stage's step. The ppermute ring (fwd + bwd streams) and
@@ -285,6 +349,8 @@ ENTRY_POINTS = {
     "paged_serve_step": paged_serve_step,
     "spec_serve_step": spec_serve_step,
     "sharded_serve_step": sharded_serve_step,
+    "prefill_step": prefill_step,
+    "kv_transfer": kv_transfer,
     "pp_ep_1f1b_grad": pp_ep_1f1b_grad,
 }
 
@@ -329,6 +395,26 @@ STRUCTURAL_INVARIANTS = {
         # paged k/v page gathers PLUS the (S, K+1) verify-row gather —
         # a program below this floor stopped verifying drafted blocks
         "op_floors": {"gather": 3},
+    },
+    "prefill_step": {
+        "floors": {},
+        "zeros": (
+            "all-gather", "all-reduce", "reduce-scatter",
+            "collective-permute", "all-to-all", "ragged-all-to-all",
+        ),
+        "op_floors": {"gather": 2},  # >= the paged k/v page gathers
+    },
+    "kv_transfer": {
+        "floors": {},
+        "zeros": (
+            "all-gather", "all-reduce", "reduce-scatter",
+            "collective-permute", "all-to-all", "ragged-all-to-all",
+        ),
+        # the per-pool-array page gathers — a program below this floor
+        # stopped reading the source pool (scatters ride the fused
+        # gather+set, which HLO folds into dynamic-update-slice forms
+        # the DATA_OPS census does not count, so gather is the pin)
+        "op_floors": {"gather": 1},
     },
     "pp_ep_1f1b_grad": {
         "floors": {"collective-permute": 2, "all-to-all": 2},

@@ -17,6 +17,12 @@ YAML:
         replicas: 1                     # data-parallel engine replicas
         tp: 1                           # tensor parallel per replica
         ep: 1                           # expert parallel per replica (MoE)
+      disaggregation:                   # typed: DisaggConfig
+        enabled: false                  # split prefill/decode replica classes
+        prefill_replicas: 1
+        decode_replicas: 1
+        transfer_pages: 8               # pages per KV-transfer program
+        prefill_token_budget: null      # wider budget for the prefill class
       page_size: 16
       num_pages: 2048
       max_slots: 16
@@ -156,7 +162,24 @@ class ServeRecipe(TrainFinetuneRecipeForNextTokenPrediction):
         serve_logger = MetricLogger(
             os.path.join(cfg.get("run_dir", "."), "serving.jsonl")
         )
-        if serve_mesh.replicas > 1:
+        disagg = self.typed.serving_disaggregation
+        if disagg.enabled:
+            from automodel_tpu.serving import DisaggRouter
+
+            # mesh=None → every replica meshless on the default device
+            # (fused same-device transfers; the hermetic smoke mode). Any
+            # non-trivial serving.mesh carves one tp*ep slice per replica
+            # class member and transfers take the cross-slice split path.
+            mesh_arg = (
+                serve_mesh
+                if serve_mesh.replicas > 1 or serve_mesh.tp > 1
+                or serve_mesh.ep > 1 else None
+            )
+            router = DisaggRouter(
+                params, self.model_cfg, serve_cfg, disagg, mesh=mesh_arg,
+            )
+            res = router.serve_batch(reqs, metric_logger=serve_logger)
+        elif serve_mesh.replicas > 1:
             from automodel_tpu.serving import ReplicaRouter
 
             router = ReplicaRouter(

@@ -171,6 +171,19 @@ class RecipeConfig:
         return self._cache[key]
 
     @property
+    def serving_disaggregation(self):
+        """`serving.disaggregation` section → DisaggConfig (defaults to
+        disabled — the monolithic engine/router path — when absent)."""
+        from automodel_tpu.serving.router import DisaggConfig
+
+        key = ("serving.disaggregation", "DisaggConfig")
+        if key not in self._cache:
+            node = self.raw.get("serving")
+            sub = node.get("disaggregation") if node is not None else None
+            self._cache[key] = dataclass_from_node(DisaggConfig, sub)
+        return self._cache[key]
+
+    @property
     def packing(self) -> Optional[Any]:
         node = self.raw.get("packing")
         if node is None:

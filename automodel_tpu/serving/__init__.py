@@ -9,13 +9,22 @@
 - engine.py:       the jitted fixed-shape step (single-chip or TP/EP-
                    sharded over a mesh slice) + serve_batch() host loop
 - router.py:       data-parallel engine replicas + per-replica admission
-                   (sticky prefix affinity, least-loaded-by-free-pages)
+                   (sticky prefix affinity, least-loaded-by-free-pages),
+                   plus disaggregated prefill/decode replica classes
+- kv_transfer.py:  page-granular KV movement between engine pools — the
+                   device half of the prefill→decode handoff
 - ops/paged_attention.py holds the ragged paged-attention op it runs on.
 """
 
 from automodel_tpu.serving.engine import Request, ServingConfig, ServingEngine
 from automodel_tpu.serving.kv_pages import PageAllocator, pages_for
-from automodel_tpu.serving.router import ReplicaRouter, ServeMeshConfig
+from automodel_tpu.serving.kv_transfer import KVTransfer
+from automodel_tpu.serving.router import (
+    DisaggConfig,
+    DisaggRouter,
+    ReplicaRouter,
+    ServeMeshConfig,
+)
 from automodel_tpu.serving.prefix_cache import (
     PrefixCache,
     PrefixCacheConfig,
@@ -32,8 +41,11 @@ from automodel_tpu.speculative.serve_draft import (
 
 __all__ = [
     "DFlashDraftSource",
+    "DisaggConfig",
+    "DisaggRouter",
     "DraftSource",
     "EagleDraftSource",
+    "KVTransfer",
     "NgramDraftSource",
     "PageAllocator",
     "PrefixCache",

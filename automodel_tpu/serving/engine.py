@@ -86,8 +86,13 @@ from automodel_tpu.ops.paged_attention import (
 from automodel_tpu.ops.norms import rms_norm
 from automodel_tpu.ops.quant import matmul as _mm
 from automodel_tpu.ops.rope import rope_frequencies
-from automodel_tpu.serving.kv_pages import apply_defrag, init_pool, pool_axes
-from automodel_tpu.serving.prefix_cache import PrefixCacheConfig
+from automodel_tpu.serving.kv_pages import (
+    PageAllocator,
+    apply_defrag,
+    init_pool,
+    pool_axes,
+)
+from automodel_tpu.serving.prefix_cache import PrefixCache, PrefixCacheConfig
 from automodel_tpu.serving.scheduler import Request, Scheduler, StepPlan
 from automodel_tpu.speculative.acceptance import (
     greedy_accept_length,
@@ -141,6 +146,40 @@ class ServingConfig:
             assert self.token_budget >= self.speculative.draft_len + 1, (
                 "token_budget must cover draft_len + 1 verify rows"
             )
+
+
+def _percentiles_ms(samples: list) -> tuple:
+    """(p50, p95) of a millisecond sample list, or (None, None)."""
+    if not samples:
+        return None, None
+    return (
+        round(float(np.percentile(samples, 50)), 4),
+        round(float(np.percentile(samples, 95)), 4),
+    )
+
+
+def _stamp_arrivals(requests, step_idx: int, watch: list) -> None:
+    """Mark every request whose arrival window just opened with the wall
+    clock, and put it on the TTFT watch list. Serve-loop helper (the loop
+    owns the wall clock; step indices alone cannot price TTFT)."""
+    now = time.perf_counter()
+    for r in requests:
+        if r.arrival <= step_idx and r.arrived_t < 0:
+            r.arrived_t = now
+            watch.append(r)
+
+
+def _resolve_ttft(watch: list) -> list:
+    """Stamp time-to-first-token on every watched request that committed
+    its first token; returns the still-waiting remainder."""
+    now = time.perf_counter()
+    still = []
+    for r in watch:
+        if r.generated:
+            r.ttft_s = now - r.arrived_t
+        else:
+            still.append(r)
+    return still
 
 
 class ServingEngine:
@@ -244,6 +283,21 @@ class ServingEngine:
             mesh_ctx=self._mesh,
         )
         self._pool_axes = pool_axes(cfg)
+        # ENGINE-LIFETIME prefix cache (SGLang-RadixAttention-style): with
+        # the cache enabled, the refcounted allocator and the radix tree
+        # are created ONCE here and threaded through every scheduler this
+        # engine makes — the device pool above already persists across
+        # serve_batch calls, so a system prompt cached during one call
+        # serves every later call until `reset_prefix_cache()`. Cache off →
+        # each scheduler keeps its private throwaway allocator (per-call
+        # semantics exactly as before).
+        pc = serve_cfg.prefix_cache
+        if pc is not None and pc.enabled:
+            self.alloc = PageAllocator(serve_cfg.num_pages, serve_cfg.page_size)
+            self.prefix = PrefixCache(self.alloc, serve_cfg.page_size, pc)
+        else:
+            self.alloc = None
+            self.prefix = None
         # speculative decoding: a STATIC trace-time choice — the spec and
         # plain engines each compile exactly one step program (the plain
         # program is byte-identical to the non-speculative engine's, so
@@ -708,6 +762,13 @@ class ServingEngine:
 
     def make_scheduler(self) -> Scheduler:
         sc = self.serve_cfg
+        if self.alloc is not None:
+            # a prior serve_batch cut short (max_steps budget) may have
+            # left slot tables behind in the engine-lifetime allocator —
+            # release them so only the radix tree's own references carry
+            # into the fresh scheduler
+            for slot in list(self.alloc._tables):
+                self.alloc.free_slot(slot)
         return Scheduler(
             num_pages=sc.num_pages, page_size=sc.page_size,
             max_slots=sc.max_slots, pages_per_slot=sc.pages_per_slot,
@@ -715,7 +776,14 @@ class ServingEngine:
             prefix_cache=sc.prefix_cache,
             admission_policy=sc.admission_policy,
             spec=self._spec, draft_source=self._draft_source,
+            alloc=self.alloc, prefix=self.prefix,
         )
+
+    def reset_prefix_cache(self) -> int:
+        """Explicitly drop the engine-lifetime radix tree: every cached
+        node releases its page pin (pages held by nobody else return to
+        the free list). Returns nodes evicted; no-op without the cache."""
+        return self.prefix.reset() if self.prefix is not None else 0
 
     def defrag(self, scheduler: Scheduler) -> bool:
         """Compact live pages to a dense pool prefix (kv_pages.defrag_plan);
@@ -748,8 +816,11 @@ class ServingEngine:
         n_sampled = 0
         n_tokens_fed = 0
         n_steps = 0  # this call only (self.steps_run is engine-lifetime)
+        itl_ms: list = []     # per-step ms per committed token
+        ttft_watch: list = []  # arrived requests awaiting their first token
         step_idx = 0
         while sched.has_work and step_idx < budget:
+            _stamp_arrivals(sched.waiting, step_idx, ttft_watch)
             plan = sched.schedule(step_idx)
             if plan is None:
                 if not sched.has_work:
@@ -796,6 +867,10 @@ class ServingEngine:
             if plan.n_samples:
                 decode_s += dt
                 n_sampled += n_new
+                if n_new:
+                    itl_ms.append(dt * 1e3 / n_new)
+            if ttft_watch:
+                ttft_watch = _resolve_ttft(ttft_watch)
             if metric_logger is not None and log_every and (
                 self.steps_run % log_every == 0
             ):
@@ -819,6 +894,12 @@ class ServingEngine:
         elapsed = time.perf_counter() - t_start
         assert not sched.has_work or max_steps is not None, "serve stalled"
         by_rid = sorted(sched.finished, key=lambda r: r.rid)
+        # TTFT per request (requests that never committed a token — timed
+        # out mid-prefill — carry no sample) + per-step inter-token latency
+        ttft_p50, ttft_p95 = _percentiles_ms(
+            [r.ttft_s * 1e3 for r in by_rid if r.ttft_s >= 0]
+        )
+        itl_p50, itl_p95 = _percentiles_ms(itl_ms)
         stats = {
             "steps": n_steps,
             "requests": len(by_rid),
@@ -827,6 +908,10 @@ class ServingEngine:
             "elapsed_s": round(elapsed, 4),
             "decode_tokens_per_sec": round(n_sampled / max(decode_s, 1e-9), 2),
             "ms_per_token": round(1e3 * decode_s / max(n_sampled, 1), 4),
+            "ttft_p50_ms": ttft_p50,
+            "ttft_p95_ms": ttft_p95,
+            "itl_p50_ms": itl_p50,
+            "itl_p95_ms": itl_p95,
             "preemptions": sched.n_preemptions,
             "timed_out": sched.n_timed_out,
             "compiled_signatures": self.step_cache_size(),

@@ -165,6 +165,29 @@ class PrefixCache:
             cow_pending=bool(pages) and fed < len(pages) * ps,
         )
 
+    def match_pages(self, tokens: list) -> list:
+        """Exact full-chunk matches only, as adoptable pages (LRU-ticked —
+        these pages ARE about to be served). The decode-side handoff
+        splice: a transferred request's first `len(result) * page_size`
+        tokens are already cached here, so those pages are adopted instead
+        of copied across replicas. No partial/COW adoption and no
+        feed-point cap: the caller's `fed` is fixed by the handoff, not by
+        the match."""
+        ps = self.page_size
+        t = self._tick()
+        node = self.root
+        pages: list = []
+        i = 0
+        while i + ps <= len(tokens):
+            child = node.children.get(tuple(tokens[i : i + ps]))
+            if child is None:
+                break
+            node = child
+            node.last_used = t
+            pages.append(node.page)
+            i += ps
+        return pages
+
     def peek_match_tokens(self, tokens: list) -> int:
         """Read-only match length: how many leading tokens full cached
         chunks cover, WITHOUT ticking any LRU clock. The ReplicaRouter's
@@ -263,6 +286,28 @@ class PrefixCache:
                     break
                 self._evict_node(victim)
                 freed += 1
+        return freed
+
+    def reset(self) -> int:
+        """Evict EVERY cached node (the engine-lifetime cache's explicit
+        reset): each node drops its allocator reference, so pages held by
+        nobody else return to the free list while pages still listed in a
+        running slot's table merely lose their tree pin. Returns the number
+        of nodes evicted."""
+        freed = 0
+
+        def walk(node):
+            nonlocal freed
+            for child in node.children.values():
+                walk(child)
+            if node is not self.root:
+                self.alloc.decref(node.page)
+                freed += 1
+
+        walk(self.root)
+        self.root.children = {}
+        self._nodes = 0
+        self.n_evicted += freed
         return freed
 
     def reclaimable(self) -> int:

@@ -35,9 +35,16 @@ from __future__ import annotations
 import dataclasses
 import time
 
+import jax
 import numpy as np
 
-from automodel_tpu.serving.engine import ServingConfig, ServingEngine
+from automodel_tpu.serving.engine import (
+    ServingConfig,
+    ServingEngine,
+    _percentiles_ms,
+    _resolve_ttft,
+)
+from automodel_tpu.serving.kv_transfer import KVTransfer
 from automodel_tpu.serving.scheduler import Request
 
 
@@ -167,6 +174,7 @@ class ReplicaRouter:
         n_steps = [0] * n
         tokens_fed = [0] * n
         ms_per_tok: list[list[float]] = [[] for _ in range(n)]
+        ttft_watch: list[Request] = []
         budget = max_steps if max_steps is not None else 10_000_000
         t_start = time.perf_counter()
         step_idx = 0
@@ -175,6 +183,8 @@ class ReplicaRouter:
         ):
             while pending and pending[0].arrival <= step_idx:
                 req = pending.pop(0)
+                req.arrived_t = time.perf_counter()
+                ttft_watch.append(req)
                 r, sticky = self.route(req, scheds)
                 scheds[r].submit(req)
                 routed[r] += 1
@@ -195,6 +205,8 @@ class ReplicaRouter:
                     n_sampled[r] += n_new
                     if n_new:
                         ms_per_tok[r].append(dt * 1e3 / n_new)
+            if ttft_watch:
+                ttft_watch = _resolve_ttft(ttft_watch)
             if progressed:
                 step_idx += 1
                 continue
@@ -235,6 +247,12 @@ class ReplicaRouter:
 
         finished = [r for s in scheds for r in s.finished]
         by_rid = sorted(finished, key=lambda r: r.rid)
+        ttft_p50, ttft_p95 = _percentiles_ms(
+            [r.ttft_s * 1e3 for r in by_rid if r.ttft_s >= 0]
+        )
+        itl_p50, itl_p95 = _percentiles_ms(
+            [s for samples in ms_per_tok for s in samples]
+        )
         per_replica = []
         for r, (eng, sched) in enumerate(zip(self.engines, scheds)):
             samples = ms_per_tok[r]
@@ -270,6 +288,10 @@ class ReplicaRouter:
             "decode_tokens_per_sec": round(sum(
                 ns / max(ds, 1e-9) for ns, ds in zip(n_sampled, decode_s)
             ), 2),
+            "ttft_p50_ms": ttft_p50,
+            "ttft_p95_ms": ttft_p95,
+            "itl_p50_ms": itl_p50,
+            "itl_p95_ms": itl_p95,
             "timed_out": sum(s.n_timed_out for s in scheds),
             "preemptions": sum(s.n_preemptions for s in scheds),
             "compiled_signatures": max(
@@ -294,6 +316,429 @@ class ReplicaRouter:
         if metric_logger is not None:
             metric_logger.log({
                 f"route_{k}": v for k, v in stats.items() if k != "per_replica"
+            })
+        return {
+            "outputs": [list(r.generated) for r in by_rid],
+            "requests": by_rid,
+            "stats": stats,
+        }
+
+
+# ---------------------------------------------------------------------------
+# disaggregated prefill/decode serving
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class DisaggConfig:
+    """Typed `serving.disaggregation` section: split the replica set into a
+    prefill class and a decode class (Mooncake/DistServe-style). Finished
+    prefills hand off as page-granular KV transfers (kv_transfer.py); the
+    two phases stop competing for the same step's token budget, which is
+    what moves decode tail latency under mixed long-prompt + chat load."""
+
+    enabled: bool = False
+    prefill_replicas: int = 1
+    decode_replicas: int = 1
+    #: pages per issued transfer program (fixed-length, trash-padded)
+    transfer_pages: int = 8
+    #: token budget override for the prefill class (None → serve config's);
+    #: prefill replicas usually want a LARGER budget — they never carry
+    #: latency-critical decode rows, so wide chunks amortize step overhead
+    prefill_token_budget: int | None = None
+
+    def __post_init__(self):
+        if self.prefill_replicas < 1 or self.decode_replicas < 1:
+            raise ValueError(f"replica counts must be >= 1: {self}")
+        if self.transfer_pages < 1:
+            raise ValueError("transfer_pages must be >= 1")
+        if (
+            self.prefill_token_budget is not None
+            and self.prefill_token_budget < 1
+        ):
+            raise ValueError("prefill_token_budget must be >= 1 (or None)")
+
+
+@dataclasses.dataclass
+class _Handoff:
+    """One finished prefill in flight to a decode replica. `src_pages` are
+    pinned (incref'd) in the prefill allocator until admitted or expired."""
+
+    req: Request
+    n_tokens: int      # committed tokens whose KV the pages hold (= fed)
+    src_pages: list    # page IDs in the PREFILL replica's pool
+    src: int           # prefill replica index (owns the pins)
+
+
+class DisaggRouter:
+    """Prefill-class + decode-class `ServingEngine` replicas with
+    page-granular KV handoff between them.
+
+    The request lifecycle: arrivals route to a prefill replica (by queue
+    depth x pending prompt tokens); the moment a request samples its first
+    token there, the scheduler pins its committed pages and releases the
+    slot (`extract_handoffs`); the router carries the pinned pages as an
+    in-flight handoff until a decode replica admits it
+    (`try_admit_handoff`: radix-splice pages the decode tree already
+    holds, allocate the rest), the `KVTransfer` pair moves the remaining
+    pages device-side, and the prefill pins drop. The request lands on the
+    decode replica with `fed` already at the divergence point — its first
+    step THERE is a decode row; no re-prefill, no cache-format conversion.
+
+    Phases route independently: prefill by least (depth x pending prompt
+    tokens), decode by free pages with sticky prefix affinity. Each class
+    keeps its own compile-once contract (one step signature per class, one
+    transfer signature per replica pair). `mesh=None` runs every replica
+    meshless on the default device — same code path, fused same-device
+    transfers — which is the hermetic test/smoke mode."""
+
+    def __init__(
+        self,
+        params,
+        cfg,
+        serve_cfg: ServingConfig = ServingConfig(),
+        disagg: DisaggConfig = DisaggConfig(),
+        mesh: ServeMeshConfig | None = None,
+        devices=None,
+        draft_source_factory=None,
+    ):
+        self.disagg = disagg
+        n_p, n_d = disagg.prefill_replicas, disagg.decode_replicas
+        ptb = disagg.prefill_token_budget or serve_cfg.token_budget
+        # prefill-class engines never speculate (nothing to speculate on:
+        # every resident request is still feeding its prompt) — dropping
+        # the speculative section keeps their step the plain program
+        prefill_cfg = dataclasses.replace(
+            serve_cfg,
+            token_budget=ptb,
+            prefill_chunk=min(serve_cfg.prefill_chunk or ptb, ptb),
+            speculative=None,
+        )
+        if mesh is not None:
+            if mesh.replicas not in (1, n_p + n_d):
+                raise ValueError(
+                    f"serving.mesh.replicas={mesh.replicas} must be 1 or "
+                    f"prefill+decode={n_p + n_d} under disaggregation"
+                )
+            ctxs = ServeMeshConfig(
+                replicas=n_p + n_d, tp=mesh.tp, ep=mesh.ep
+            ).build_contexts(devices)
+        else:
+            ctxs = [None] * (n_p + n_d)
+            # meshless engines pin no step shardings — if any input is
+            # committed (chassis-sharded params), the donated pool comes
+            # back committed after step 1 and re-cuts the jit cache.
+            # Commit params to the default device up front (a
+            # single-device engine needs them there anyway); the fresh
+            # pools are committed alongside, below.
+            params = jax.device_put(params, jax.devices()[0])
+        self.prefill = [
+            ServingEngine(params, cfg, prefill_cfg, mesh_ctx=ctxs[i])
+            for i in range(n_p)
+        ]
+        self.decode = [
+            ServingEngine(
+                params, cfg, serve_cfg,
+                draft_source=(
+                    draft_source_factory() if draft_source_factory else None
+                ),
+                mesh_ctx=ctxs[n_p + i],
+            )
+            for i in range(n_d)
+        ]
+        if mesh is None:
+            # commit the fresh (uncommitted) pools too: the jit cache
+            # keys on committed-ness, so an uncommitted pool in step 1
+            # vs the committed donated output in step 2 would cost one
+            # recompile per engine
+            for e in self.prefill + self.decode:
+                e.pool = jax.device_put(e.pool, jax.devices()[0])
+        self.transfers = {
+            (i, j): KVTransfer(
+                self.prefill[i], self.decode[j],
+                batch_pages=disagg.transfer_pages,
+            )
+            for i in range(n_p)
+            for j in range(n_d)
+        }
+
+    # -- routing -------------------------------------------------------------
+    def route_prefill(self, req: Request, schedulers) -> int:
+        """Least-loaded prefill replica by queue depth x pending prompt
+        tokens (what actually bounds time-to-first-token: how many prompt
+        tokens are ahead of you, weighted by how many queues they cross)."""
+        def pending_tokens(s, extra) -> int:
+            t = extra
+            for r in s.waiting:
+                t += max(len(r.prompt) - s.prefix_hit_tokens(r.prompt), 0)
+            for r in s.running.values():
+                t += max(len(r.known) - r.fed, 0)
+            return t
+
+        def score(r: int):
+            s = schedulers[r]
+            mine = max(
+                len(req.prompt) - s.prefix_hit_tokens(req.prompt), 0
+            )
+            depth = len(s.waiting) + len(s.running) + 1
+            return (
+                depth * pending_tokens(s, mine),
+                len(s.waiting) + len(s.running),
+                r,
+            )
+
+        return min(range(len(schedulers)), key=score)
+
+    def _decode_order(self, h: _Handoff, schedulers) -> list:
+        """Decode replicas to try for a handoff, best first: sticky prefix
+        affinity (the transferred prefix is already cached there → pages
+        splice instead of moving), then most free pages. Returns
+        [(replica, sticky?)] so a full sticky replica falls back."""
+        aff = [
+            s.prefix_hit_tokens(h.req.known[: h.n_tokens])
+            for s in schedulers
+        ]
+        order = sorted(
+            range(len(schedulers)),
+            key=lambda r: (
+                aff[r],
+                schedulers[r].alloc.num_free,
+                -(len(schedulers[r].running) + len(schedulers[r].waiting)),
+                -r,
+            ),
+            reverse=True,
+        )
+        return [(r, aff[r] > 0) for r in order]
+
+    # -- offline drive -------------------------------------------------------
+    def serve_batch(
+        self,
+        requests: list[Request],
+        *,
+        metric_logger=None,
+        max_steps: int | None = None,
+    ) -> dict:
+        """Route + drive both replica classes until every request finished.
+        Same result contract as `ReplicaRouter.serve_batch`; stats add the
+        handoff block (counts, pages moved vs spliced, transfer programs)
+        and tag each per_replica entry with its class."""
+        for i, req in enumerate(requests):
+            if req.rid < 0:
+                req.rid = i
+        p_scheds = [eng.make_scheduler() for eng in self.prefill]
+        d_scheds = [eng.make_scheduler() for eng in self.decode]
+        pending = sorted(requests, key=lambda r: (r.arrival, r.rid))
+        inflight: list[_Handoff] = []
+        expired: list[Request] = []
+        ttft_watch: list[Request] = []
+        n_p, n_d = len(self.prefill), len(self.decode)
+        routed_p = [0] * n_p
+        routed_d = [0] * n_d
+        sticky_routed = 0
+        n_expired = 0
+        p_steps, p_fed = [0] * n_p, [0] * n_p
+        p_sampled, p_decode_s = [0] * n_p, [0.0] * n_p
+        p_ms: list[list[float]] = [[] for _ in range(n_p)]
+        d_steps, d_fed = [0] * n_d, [0] * n_d
+        d_sampled, d_decode_s = [0] * n_d, [0.0] * n_d
+        d_ms: list[list[float]] = [[] for _ in range(n_d)]
+        budget = max_steps if max_steps is not None else 10_000_000
+
+        def has_work() -> bool:
+            return bool(pending or inflight) or any(
+                s.has_work for s in p_scheds + d_scheds
+            )
+
+        t_start = time.perf_counter()
+        step_idx = 0
+        while step_idx < budget and has_work():
+            while pending and pending[0].arrival <= step_idx:
+                req = pending.pop(0)
+                req.arrived_t = time.perf_counter()
+                ttft_watch.append(req)
+                r = self.route_prefill(req, p_scheds)
+                p_scheds[r].submit(req)
+                routed_p[r] += 1
+            # deadline-expire handoffs stuck in flight (decode side full):
+            # the prefill pins drop and the request times out — the same
+            # contract deadline eviction gives a queued request
+            for h in list(inflight):
+                if h.req.deadline is not None and step_idx >= h.req.deadline:
+                    inflight.remove(h)
+                    p_scheds[h.src].release_handoff(h.src_pages)
+                    h.req.finish_reason = "timed_out"
+                    h.req.finished_at = step_idx
+                    expired.append(h.req)
+                    n_expired += 1
+            # admit in-flight handoffs FIFO; on success move the non-spliced
+            # pages device-side and drop the prefill-side pins
+            for h in list(inflight):
+                for r, sticky in self._decode_order(h, d_scheds):
+                    pairs = d_scheds[r].try_admit_handoff(
+                        h.req, h.n_tokens, h.src_pages, step_idx
+                    )
+                    if pairs is None:
+                        continue
+                    self.transfers[(h.src, r)].move(pairs)
+                    p_scheds[h.src].release_handoff(h.src_pages)
+                    inflight.remove(h)
+                    sticky_routed += int(sticky)
+                    routed_d[r] += 1
+                    break
+            progressed = False
+            for r, (eng, sched) in enumerate(zip(self.decode, d_scheds)):
+                if not sched.has_work:
+                    continue
+                plan = sched.schedule(step_idx)
+                if plan is None:
+                    continue
+                n_new, dt = eng.run_and_absorb(sched, plan, step_idx)
+                progressed = True
+                d_steps[r] += 1
+                d_fed[r] += plan.n_tokens
+                if plan.n_samples:
+                    d_decode_s[r] += dt
+                    d_sampled[r] += n_new
+                    if n_new:
+                        d_ms[r].append(dt * 1e3 / n_new)
+            for r, (eng, sched) in enumerate(zip(self.prefill, p_scheds)):
+                if not sched.has_work:
+                    continue
+                plan = sched.schedule(step_idx)
+                if plan is None:
+                    continue
+                n_new, dt = eng.run_and_absorb(sched, plan, step_idx)
+                progressed = True
+                p_steps[r] += 1
+                p_fed[r] += plan.n_tokens
+                if plan.n_samples:
+                    p_decode_s[r] += dt
+                    p_sampled[r] += n_new
+                    if n_new:
+                        p_ms[r].append(dt * 1e3 / n_new)
+                for req, n_tok, src in sched.extract_handoffs():
+                    inflight.append(_Handoff(req, n_tok, src, r))
+            if ttft_watch:
+                ttft_watch = _resolve_ttft(ttft_watch)
+            if progressed:
+                step_idx += 1
+                continue
+            # idle fast-forward, mirroring ReplicaRouter — in-flight handoff
+            # deadlines count as events too (expiry frees prefill pins)
+            arrivals = [r.arrival for r in pending if r.arrival > step_idx]
+            for s in p_scheds + d_scheds:
+                arrivals += [
+                    r.arrival for r in s.waiting if r.arrival > step_idx
+                ]
+            deadlines = [
+                s.next_deadline for s in p_scheds + d_scheds
+                if s.next_deadline is not None and s.next_deadline > step_idx
+            ]
+            deadlines += [
+                h.req.deadline for h in inflight
+                if h.req.deadline is not None and h.req.deadline > step_idx
+            ]
+            if deadlines:
+                step_idx = min(deadlines + arrivals)
+                continue
+            if not arrivals:
+                if has_work():
+                    raise RuntimeError(
+                        "disaggregated serving stalled: "
+                        f"{len(inflight)} handoffs in flight, decode free "
+                        f"pages {[s.alloc.num_free for s in d_scheds]}, "
+                        f"prefill waiting "
+                        f"{[len(s.waiting) for s in p_scheds]}"
+                    )
+                break
+            step_idx = min(arrivals)
+        elapsed = time.perf_counter() - t_start
+        assert max_steps is not None or not has_work(), "disagg serve stalled"
+
+        finished = [r for s in p_scheds + d_scheds for r in s.finished]
+        finished += expired
+        by_rid = sorted(finished, key=lambda r: r.rid)
+        ttft_p50, ttft_p95 = _percentiles_ms(
+            [r.ttft_s * 1e3 for r in by_rid if r.ttft_s >= 0]
+        )
+        # decode-class ITL only: that is the latency the phase split buys
+        itl_p50, itl_p95 = _percentiles_ms(
+            [s for samples in d_ms for s in samples]
+        )
+        per_replica = []
+        for klass, engines, scheds, routed, steps, fed, sampled, dec_s, ms in (
+            ("prefill", self.prefill, p_scheds, routed_p, p_steps, p_fed,
+             p_sampled, p_decode_s, p_ms),
+            ("decode", self.decode, d_scheds, routed_d, d_steps, d_fed,
+             d_sampled, d_decode_s, d_ms),
+        ):
+            for r, (eng, sched) in enumerate(zip(engines, scheds)):
+                p50, p95 = _percentiles_ms(ms[r])
+                per_replica.append({
+                    "class": klass,
+                    "requests": routed[r],
+                    "steps": steps[r],
+                    "new_tokens": sampled[r],
+                    "tokens_fed": fed[r],
+                    "decode_tokens_per_sec": round(
+                        sampled[r] / max(dec_s[r], 1e-9), 2
+                    ),
+                    "p50_ms_per_token": p50,
+                    "p95_ms_per_token": p95,
+                    "preemptions": sched.n_preemptions,
+                    "free_pages": sched.alloc.num_free,
+                    "compiled_signatures": eng.step_cache_size(),
+                })
+        stats = {
+            "prefill_replicas": n_p,
+            "decode_replicas": n_d,
+            "requests": len(by_rid),
+            "new_tokens": sum(p_sampled) + sum(d_sampled),
+            "tokens_fed": sum(p_fed) + sum(d_fed),
+            "steps": max(p_steps + d_steps) if (p_steps or d_steps) else 0,
+            "elapsed_s": round(elapsed, 4),
+            "decode_tokens_per_sec": round(sum(
+                ns / max(ds, 1e-9)
+                for ns, ds in zip(d_sampled, d_decode_s)
+            ), 2),
+            "ttft_p50_ms": ttft_p50,
+            "ttft_p95_ms": ttft_p95,
+            "itl_p50_ms": itl_p50,
+            "itl_p95_ms": itl_p95,
+            "handoffs": sum(s.n_handoffs_in for s in d_scheds),
+            "handoff_pages_moved": sum(s.handoff_pages_in for s in d_scheds),
+            "handoff_pages_spliced": sum(
+                s.handoff_pages_spliced for s in d_scheds
+            ),
+            "handoff_expired": n_expired,
+            "transfer_chunks": sum(t.n_chunks for t in self.transfers.values()),
+            "timed_out": (
+                sum(s.n_timed_out for s in p_scheds + d_scheds) + n_expired
+            ),
+            "preemptions": sum(s.n_preemptions for s in p_scheds + d_scheds),
+            "compiled_signatures_prefill": max(
+                eng.step_cache_size() for eng in self.prefill
+            ),
+            "compiled_signatures_decode": max(
+                eng.step_cache_size() for eng in self.decode
+            ),
+            "sticky_routed": sticky_routed,
+            "requests_per_prefill": routed_p,
+            "requests_per_decode": routed_d,
+            "per_replica": per_replica,
+        }
+        scheds_all = p_scheds + d_scheds
+        if any(s.prefix is not None for s in scheds_all):
+            stats["prefix_hits"] = sum(s.n_prefix_hits for s in scheds_all)
+            stats["prefill_skipped_tokens"] = sum(
+                s.prefill_skipped for s in scheds_all
+            )
+        if any(s.spec is not None for s in d_scheds):
+            stats["drafted_tokens"] = sum(s.n_drafted for s in d_scheds)
+            stats["accepted_tokens"] = sum(s.n_accepted for s in d_scheds)
+        if metric_logger is not None:
+            metric_logger.log({
+                f"disagg_{k}": v
+                for k, v in stats.items() if k != "per_replica"
             })
         return {
             "outputs": [list(r.generated) for r in by_rid],

@@ -105,6 +105,11 @@ class Request:
     finish_reason: str | None = None
     prefix_hit_tokens: int = 0  # prefill tokens skipped via the radix cache
     donated_pages: int = 0      # full pages already offered to the tree
+    # wall-clock latency stamps (serve-loop-owned — the loop is the only
+    # layer that knows when a step's arrival window actually opened):
+    # ttft_s stays -1 for requests that never committed a token
+    arrived_t: float = -1.0     # wall time the request became servable
+    ttft_s: float = -1.0        # time to first committed token (seconds)
 
     @property
     def known(self) -> list:
@@ -169,8 +174,17 @@ class Scheduler:
         admission_policy: str = "fifo",
         spec=None,               # SpeculativeConfig (enabled) or None
         draft_source=None,       # speculative.serve_draft.DraftSource
+        alloc: PageAllocator | None = None,
+        prefix: PrefixCache | None = None,
     ):
-        self.alloc = PageAllocator(num_pages, page_size)
+        # `alloc`/`prefix` injection is the ENGINE-LIFETIME cache hook:
+        # ServingEngine owns one allocator + radix tree and threads them
+        # through every scheduler it makes, so cached pages survive across
+        # serve_batch calls. Standalone construction (tests, one-shot runs)
+        # keeps building a private pair — per-call semantics unchanged.
+        self.alloc = (
+            alloc if alloc is not None else PageAllocator(num_pages, page_size)
+        )
         self.page_size = page_size
         self.max_slots = max_slots
         self.pages_per_slot = pages_per_slot
@@ -184,11 +198,14 @@ class Scheduler:
         ):
             raise ValueError("admission_policy='prefix-hit' needs the prefix cache")
         self.admission_policy = admission_policy
-        self.prefix: PrefixCache | None = (
-            PrefixCache(self.alloc, page_size, prefix_cache)
-            if prefix_cache is not None and prefix_cache.enabled
-            else None
-        )
+        if prefix is not None:
+            self.prefix: PrefixCache | None = prefix
+        else:
+            self.prefix = (
+                PrefixCache(self.alloc, page_size, prefix_cache)
+                if prefix_cache is not None and prefix_cache.enabled
+                else None
+            )
         self.spec = spec if (spec is not None and spec.enabled) else None
         self.draft_source = draft_source if self.spec is not None else None
         if self.spec is not None and self.draft_source is None:
@@ -207,6 +224,11 @@ class Scheduler:
         self.n_drafted = 0            # provisional tokens fed for scoring
         self.n_accepted = 0           # drafts the verifier kept
         self.n_spec_steps = 0         # verify blocks with >= 1 draft
+        # disaggregated-handoff counters (serving/router.py DisaggRouter)
+        self.n_handoffs_out = 0       # requests extracted for migration
+        self.n_handoffs_in = 0        # handoffs admitted as pre-filled
+        self.handoff_pages_in = 0     # pages actually copied across pools
+        self.handoff_pages_spliced = 0  # pages served by the local tree
 
     # -- request lifecycle --------------------------------------------------
     def submit(self, req: Request) -> None:
@@ -377,6 +399,108 @@ class Scheduler:
         if self.draft_source is not None:
             self.draft_source.release(req)
         return req
+
+    # -- disaggregated prefill/decode handoff -------------------------------
+    def extract_handoffs(self) -> list:
+        """Pop every running request whose prefill has finished (>= 1
+        committed token — its next step would be a pure decode row) for
+        migration to a decode-class peer. Returns [(request, n_tokens,
+        src_pages)]: the first pages_for(n_tokens) table pages, each PINNED
+        with an extra allocator reference so they outlive the slot release
+        — the caller decrefs via `release_handoff` after the device copy
+        (or on deadline expiry). The release donates full pages to the
+        radix tree as usual, so later prompts on THIS replica still hit;
+        the pin covers the partial tail page the tree never takes."""
+        out = []
+        for slot, req in list(self.running.items()):
+            if not req.generated or req.done:
+                continue
+            n = req.fed
+            src = list(self.alloc.table(slot))[: pages_for(n, self.page_size)]
+            for p in src:
+                self.alloc.incref(p)
+            self._release_slot(slot)
+            self.n_handoffs_out += 1
+            out.append((req, n, src))
+        return out
+
+    def release_handoff(self, src_pages: list) -> None:
+        """Drop the extraction pins once a handoff's pages were copied out
+        (or its request expired in flight)."""
+        for p in src_pages:
+            self.alloc.decref(p)
+
+    def try_admit_handoff(self, req: Request, n_tokens: int, src_pages: list,
+                          step_idx: int):
+        """Admit a migrating request whose first `n_tokens` known tokens
+        already have KV committed on another replica. The handoff arrives
+        as PRE-FILLED pages: `fed` starts at the divergence point, so the
+        request's first step here is already a decode row. Pages the local
+        radix tree already holds are SPLICED (adopted, not copied — a
+        prefill peer's earlier donations become transferable cache hits);
+        the rest get freshly allocated destination pages. Returns the
+        [(src_page, dst_page)] copy plan the caller must execute BEFORE the
+        next engine step, or None when no slot/pages are available yet
+        (the caller retries next step)."""
+        ps = self.page_size
+        P = pages_for(n_tokens, ps)
+        if len(src_pages) != P:
+            raise ValueError(
+                f"handoff carries {len(src_pages)} pages for {n_tokens} "
+                f"tokens (need {P})"
+            )
+        if len(self.running) >= self.max_slots:
+            return None
+        matched = (
+            self.prefix.match_pages(req.known[:n_tokens])
+            if self.prefix is not None else []
+        )
+        k = min(len(matched), P)
+        # same accounting as _admissible: whole sequence + 1 decode page of
+        # slack, minus spliced pages — and splicing a tree-only page PINS
+        # it, so the warm splice only stands when the remainder still fits;
+        # otherwise fall back to a cold (full-copy) admit and leave the
+        # cached pages evictable for the pressure ladder
+        avail = self.alloc.num_free + (
+            self.prefix.reclaimable() if self.prefix is not None else 0
+        )
+        need_total = pages_for(len(req.known) + 1, ps)
+        if k:
+            pinned = sum(
+                1 for p in matched[:k] if self.alloc.refcount(p) == 1
+            )
+            if need_total - k + pinned > avail:
+                k = 0
+        if k == 0 and need_total > avail:
+            return None
+        slot = next(s for s in range(self.max_slots) if s not in self.running)
+        self.running[slot] = req
+        self._admit_order.append(slot)
+        if req.admitted_at < 0:
+            req.admitted_at = step_idx
+        if k:
+            self.alloc.adopt(slot, matched[:k])
+        if not self.alloc.ensure(slot, n_tokens, reclaim=self._reclaim):
+            # belt over the availability check's suspenders: roll the
+            # admission back cleanly and let the caller retry next step
+            self.alloc.free_slot(slot)
+            del self.running[slot]
+            self._admit_order.remove(slot)
+            return None
+        req.fed = n_tokens
+        # spliced pages are the only ones already in THIS replica's tree;
+        # the next _donate offers the transferred full pages too, making
+        # them local cache hits for future prompts (and re-admissions)
+        req.donated_pages = k
+        if k:
+            req.prefix_hit_tokens += k * ps
+            self.n_prefix_hits += 1
+        self.n_handoffs_in += 1
+        self.handoff_pages_spliced += k
+        table = self.alloc.table(slot)
+        pairs = list(zip(src_pages[k:], table[k:P]))
+        self.handoff_pages_in += len(pairs)
+        return pairs
 
     def _preempt_youngest(self, protected) -> bool:
         """Free the youngest running request whose slot is not `protected`

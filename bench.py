@@ -857,6 +857,160 @@ def _serve_scale_child(mesh_json: str) -> None:
     print("SERVE_SCALE:" + _json.dumps(out))
 
 
+def _headline_disagg(accel: bool) -> dict:
+    """Disaggregated serving: decode TTFT/ITL p50/p95 with vs without the
+    prefill/decode phase split on a MIXED load — long ingestion prompts
+    arriving throughout a latency-sensitive chat stream (the interference
+    shape Mooncake/DistServe target: monolithic steps carry prefill
+    chunks whose slots commit nothing, diluting per-step decode output
+    and fattening the ITL tail) — plus the engine-lifetime prefix cache's
+    warm-vs-cold hit ratio across two serve_batch calls on ONE engine."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from automodel_tpu.models.llm import decoder
+    from automodel_tpu.models.llm.decoder import TransformerConfig
+    from automodel_tpu.serving import (
+        DisaggConfig,
+        DisaggRouter,
+        PrefixCacheConfig,
+        Request,
+        ServingConfig,
+        ServingEngine,
+    )
+
+    if accel:
+        cfg = TransformerConfig(
+            vocab_size=32768, hidden_size=1024, intermediate_size=4096,
+            num_layers=8, num_heads=16, num_kv_heads=8,
+            rope_theta=500000.0, dtype=jnp.bfloat16, remat_policy="none",
+            attn_impl="auto",
+        )
+        geo = dict(page_size=16, num_pages=2048, max_slots=8,
+                   pages_per_slot=64)
+        mono_budget = dict(token_budget=32, prefill_chunk=24)
+        # the decode class rightsizes its fixed step shape to its decode
+        # rows (prefill chunks never ride it); the prefill class takes
+        # the wide budget — the phase split's structural win
+        disagg_budget = dict(token_budget=16, prefill_chunk=None)
+        long_len, long_n, chat_len, chat_n = 768, 6, 32, 12
+        long_new, chat_new, chat_stride = 8, 64, 8
+        disagg = DisaggConfig(enabled=True, transfer_pages=8,
+                              prefill_token_budget=64)
+        sys_len = 256
+    else:
+        cfg = TransformerConfig(
+            vocab_size=512, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=4, num_kv_heads=2,
+            dtype=jnp.float32, remat_policy="none", attn_impl="xla",
+        )
+        geo = dict(page_size=4, num_pages=256, max_slots=4,
+                   pages_per_slot=32)
+        mono_budget = dict(token_budget=16, prefill_chunk=8)
+        disagg_budget = dict(token_budget=8, prefill_chunk=None)
+        long_len, long_n, chat_len, chat_n = 96, 4, 8, 8
+        long_new, chat_new, chat_stride = 4, 16, 6
+        disagg = DisaggConfig(enabled=True, transfer_pages=8,
+                              prefill_token_budget=32)
+        sys_len = 24
+    params = decoder.init(cfg, jax.random.key(0))
+    rng = np.random.default_rng(0)
+
+    def reqs():
+        out = []
+        for i in range(long_n):  # batch-ingestion stream: long, few tokens
+            out.append(Request(
+                prompt=[int(t) for t in
+                        rng.integers(1, cfg.vocab_size, (long_len,))],
+                max_new_tokens=long_new,
+                arrival=i * (chat_stride * chat_n // max(long_n, 1)),
+                seed=i,
+            ))
+        for i in range(chat_n):  # chat stream: short, latency-sensitive
+            out.append(Request(
+                prompt=[int(t) for t in
+                        rng.integers(1, cfg.vocab_size, (chat_len,))],
+                max_new_tokens=chat_new, arrival=i * chat_stride,
+                seed=100 + i,
+            ))
+        return out
+
+    warm_req = lambda: [Request(prompt=[1, 2, 3], max_new_tokens=2)]  # noqa: E731
+
+    engine = ServingEngine(params, cfg, ServingConfig(**geo, **mono_budget))
+    engine.serve_batch(warm_req())  # compile outside the timed window
+    mono = engine.serve_batch(reqs())["stats"]
+
+    router = DisaggRouter(
+        params, cfg, ServingConfig(**geo, **disagg_budget), disagg,
+    )
+    router.serve_batch(warm_req())  # compiles both step classes + transfer
+    res = router.serve_batch(reqs())["stats"]
+    assert res["compiled_signatures_prefill"] == 1, res
+    assert res["compiled_signatures_decode"] == 1, res
+
+    # engine-lifetime cache: the SAME engine serves a shared-system-prompt
+    # batch twice — call 2's prefill rides call 1's radix tree
+    system = [int(t) for t in rng.integers(1, cfg.vocab_size, (sys_len,))]
+    pe = ServingEngine(params, cfg, ServingConfig(
+        **geo, **mono_budget, prefix_cache=PrefixCacheConfig(enabled=True),
+    ))
+    pe.serve_batch(warm_req())
+
+    def sys_batch():
+        return [
+            Request(
+                prompt=system + [int(t) for t in
+                                 rng.integers(1, cfg.vocab_size, (4,))],
+                max_new_tokens=chat_new,
+            )
+            for _ in range(3)
+        ]
+
+    cold = pe.serve_batch(sys_batch())["stats"]
+    warm = pe.serve_batch(sys_batch())["stats"]
+    total_prompt = 3 * (sys_len + 4)
+
+    return {
+        "itl_p50_ms": res["itl_p50_ms"],
+        "itl_p95_ms": res["itl_p95_ms"],
+        "itl_p50_ms_monolithic": mono["itl_p50_ms"],
+        "itl_p95_ms_monolithic": mono["itl_p95_ms"],
+        "ttft_p50_ms": res["ttft_p50_ms"],
+        "ttft_p95_ms": res["ttft_p95_ms"],
+        "ttft_p50_ms_monolithic": mono["ttft_p50_ms"],
+        "ttft_p95_ms_monolithic": mono["ttft_p95_ms"],
+        "decode_tokens_per_sec": res["decode_tokens_per_sec"],
+        "decode_tokens_per_sec_monolithic": mono["decode_tokens_per_sec"],
+        "handoffs": res["handoffs"],
+        "handoff_pages_moved": res["handoff_pages_moved"],
+        "transfer_chunks": res["transfer_chunks"],
+        "engine_lifetime": {
+            "cold_hit_ratio": round(
+                cold["prefill_skipped_tokens"] / total_prompt, 4
+            ),
+            "warm_hit_ratio": round(
+                warm["prefill_skipped_tokens"] / total_prompt, 4
+            ),
+            "warm_prefill_skipped_tokens": warm["prefill_skipped_tokens"],
+            "warm_tokens_fed": warm["tokens_fed"],
+            "cold_tokens_fed": cold["tokens_fed"],
+        },
+        "config": {
+            "long": {"n": long_n, "len": long_len, "max_new": long_new},
+            "chat": {"n": chat_n, "len": chat_len, "max_new": chat_new,
+                     "stride": chat_stride},
+            "prefill_token_budget": disagg.prefill_token_budget,
+            "transfer_pages": disagg.transfer_pages,
+            "system_len": sys_len,
+            "monolithic_budget": mono_budget,
+            "disagg_decode_budget": disagg_budget,
+            **geo,
+        },
+    }
+
+
 def _headline_serve_scale(accel: bool) -> dict:
     """Pod-scale serving: aggregate decode tokens/s + per-replica p50/p95
     ms/token for the SAME request stream at mesh {1, tp2, dp2×tp2}, plus
@@ -998,6 +1152,7 @@ def _run_headline(accel: bool) -> dict:
         ("decode", _headline_decode),
         ("prefix", _headline_prefix),
         ("spec", _headline_spec),
+        ("disagg", _headline_disagg),
         ("serve_scale", _headline_serve_scale),
         ("resilience", _headline_resilience),
     ):

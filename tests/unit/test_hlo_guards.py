@@ -64,11 +64,15 @@ def test_hlo_baseline(entry):
     assert entry in STRUCTURAL_INVARIANTS  # registry/invariants stay in sync
 
 
-@pytest.mark.parametrize("entry", ["paged_serve_step", "spec_serve_step"])
+@pytest.mark.parametrize("entry", [
+    "paged_serve_step", "spec_serve_step", "prefill_step", "kv_transfer",
+])
 def test_serve_step_donation_pinned(entry):
     """The serve step's pool donation is part of the compiled contract:
-    losing it silently doubles pool memory — in BOTH the plain and the
-    speculative draft-then-verify step programs. The aliasing table in
+    losing it silently doubles pool memory — in the plain, speculative
+    draft-then-verify, and prefill-class step programs alike, and in the
+    handoff's fused page-copy program (whose destination pool is donated
+    so a transfer never double-buffers). The aliasing table in
     the baseline must stay non-empty (belt to the baseline's suspenders —
     this asserts the INVARIANT, not a count that drifts)."""
     baseline = load_baseline(BASELINES, entry)
