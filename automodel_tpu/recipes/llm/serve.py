@@ -49,7 +49,24 @@ YAML:
         acceptance: greedy            # greedy | sampled
         ngram_max: 3
         ngram_min: 1
+      online:                         # typed: FrontendConfig (+2 recipe keys)
+        enabled: false                # drive the asyncio live frontend
+        deadline_steps: null          # per-request deadline (steps from
+        stream_buffer: 32             #   admission; null → never shed)
+        max_waiting: null
+        shed_deadlines: true
+        shed_safety: 1.0
     max_requests: 64
+
+With `serving.online.enabled`, the SAME request stream is driven through
+the asyncio online frontend (serving/frontend.py) instead of the offline
+`serve_batch` host loop: requests are submitted live paced by the loop's
+own step counter (`arrival_stride` becomes real admission pacing), every
+generation is consumed as a token stream, and deadline-carrying requests
+can be shed at admission. The mode composes with the pod shapes — a
+replicated mesh serves through `OnlineRouter`, a disaggregated one
+through `DisaggOnlineFrontend` (which also activates the elastic prefill
+autoscaler when `disaggregation.autoscale.enabled`).
 """
 
 from __future__ import annotations
@@ -117,6 +134,41 @@ class ServeRecipe(TrainFinetuneRecipeForNextTokenPrediction):
                     return reqs
         return reqs
 
+    def _serve_online(self, frontend, reqs, online_node, serve_logger):
+        """Drive the asyncio frontend over the dataset's request stream:
+        submissions paced by the loop's OWN step counter (each request's
+        `arrival` becomes a wait_step target, so `arrival_stride` turns
+        into live admission pacing), one consumer coroutine per token
+        stream, optional per-request step deadlines. The frontend mutates
+        the same Request objects serve_batch would, so the generations
+        JSONL downstream is mode-agnostic (shed requests land there with
+        finish_reason "shed"/"rejected" and no tokens)."""
+        import asyncio
+
+        deadline = online_node.get("deadline_steps")
+        deadline = int(deadline) if deadline else None
+
+        async def consume(stream):
+            async for _tok in stream:
+                pass
+
+        async def drive():
+            frontend.start()
+            tasks = []
+            for req in reqs:
+                if req.arrival:
+                    await frontend.wait_step(req.arrival)
+                stream = frontend.submit(req, deadline_in=deadline)
+                tasks.append(asyncio.ensure_future(consume(stream)))
+            await asyncio.gather(*tasks)
+            return await frontend.close()
+
+        stats = asyncio.run(drive())
+        serve_logger.log({"metric": "serving_online", **{
+            k: v for k, v in stats.items() if np.isscalar(v)
+        }})
+        return {"requests": reqs, "stats": stats}
+
     def run_train_validation_loop(self) -> None:
         from automodel_tpu.serving import ServingConfig, ServingEngine
 
@@ -163,6 +215,11 @@ class ServeRecipe(TrainFinetuneRecipeForNextTokenPrediction):
             os.path.join(cfg.get("run_dir", "."), "serving.jsonl")
         )
         disagg = self.typed.serving_disaggregation
+        online_node = node.get("online") if node is not None else None
+        online = (
+            bool(online_node.get("enabled", False))
+            if online_node is not None else False
+        )
         if disagg.enabled:
             from automodel_tpu.serving import DisaggRouter
 
@@ -178,22 +235,46 @@ class ServeRecipe(TrainFinetuneRecipeForNextTokenPrediction):
             router = DisaggRouter(
                 params, self.model_cfg, serve_cfg, disagg, mesh=mesh_arg,
             )
-            res = router.serve_batch(reqs, metric_logger=serve_logger)
+            if online:
+                from automodel_tpu.serving import DisaggOnlineFrontend
+
+                res = self._serve_online(
+                    DisaggOnlineFrontend(router, self.typed.serving_online),
+                    reqs, online_node, serve_logger,
+                )
+            else:
+                res = router.serve_batch(reqs, metric_logger=serve_logger)
         elif serve_mesh.replicas > 1:
             from automodel_tpu.serving import ReplicaRouter
 
             router = ReplicaRouter(
                 params, self.model_cfg, serve_cfg, serve_mesh
             )
-            res = router.serve_batch(reqs, metric_logger=serve_logger)
+            if online:
+                from automodel_tpu.serving import OnlineRouter
+
+                res = self._serve_online(
+                    OnlineRouter(router, self.typed.serving_online),
+                    reqs, online_node, serve_logger,
+                )
+            else:
+                res = router.serve_batch(reqs, metric_logger=serve_logger)
         else:
             ctx = serve_mesh.build_contexts()[0]
             engine = ServingEngine(
                 params, self.model_cfg, serve_cfg, mesh_ctx=ctx
             )
-            res = engine.serve_batch(
-                reqs, metric_logger=serve_logger, log_every=16,
-            )
+            if online:
+                from automodel_tpu.serving import OnlineFrontend
+
+                res = self._serve_online(
+                    OnlineFrontend(engine, self.typed.serving_online),
+                    reqs, online_node, serve_logger,
+                )
+            else:
+                res = engine.serve_batch(
+                    reqs, metric_logger=serve_logger, log_every=16,
+                )
         serve_logger.close()
         tokenizer = getattr(self, "_tokenizer", None)
         out_path = os.path.join(cfg.get("run_dir", "."), "generations.jsonl")
@@ -209,7 +290,10 @@ class ServeRecipe(TrainFinetuneRecipeForNextTokenPrediction):
                 if tokenizer is not None:
                     rec["text"] = tokenizer.decode(rec["generated_ids"])
                 f.write(json.dumps(rec) + "\n")
-        summary = {"metric": "serving_decode", **res["stats"]}
+        summary = {
+            "metric": "serving_online" if online else "serving_decode",
+            **res["stats"],
+        }
         print(json.dumps(summary))
         logger.info("wrote %d generations to %s", len(res["requests"]), out_path)
         for t in self.trackers:

@@ -20,7 +20,9 @@ from automodel_tpu.config import ConfigNode
 def dataclass_from_node(cls, node, *, strict: bool = True, allow: tuple = (), **extra):
     """ConfigNode/dict section → dataclass instance. With `strict`, keys the
     dataclass does not declare raise instead of being dropped (`allow` lists
-    section keys the RECIPE reads directly rather than the dataclass)."""
+    section keys the RECIPE reads directly rather than the dataclass).
+    `extra` keys win over the node's raw values — callers use them to hand
+    in already-coerced objects (a jnp dtype, a nested dataclass)."""
     kwargs = dict(extra)
     names = {f.name for f in dataclasses.fields(cls)}
     if node is not None:
@@ -32,7 +34,7 @@ def dataclass_from_node(cls, node, *, strict: bool = True, allow: tuple = (), **
                 f"(valid: {sorted(names)})"
             )
         for f in dataclasses.fields(cls):
-            if f.name in node:
+            if f.name in node and f.name not in kwargs:
                 kwargs[f.name] = node.get(f.name)
     return cls(**kwargs)
 
@@ -180,7 +182,30 @@ class RecipeConfig:
         if key not in self._cache:
             node = self.raw.get("serving")
             sub = node.get("disaggregation") if node is not None else None
-            self._cache[key] = dataclass_from_node(DisaggConfig, sub)
+            extra = {}
+            if sub is not None and sub.get("autoscale") is not None:
+                from automodel_tpu.serving.router import AutoscaleConfig
+
+                extra["autoscale"] = dataclass_from_node(
+                    AutoscaleConfig, sub.get("autoscale")
+                )
+            self._cache[key] = dataclass_from_node(DisaggConfig, sub, **extra)
+        return self._cache[key]
+
+    @property
+    def serving_online(self):
+        """`serving.online` section → FrontendConfig (the asyncio live
+        serve loop's knobs; `enabled` and `deadline_steps` are read by the
+        recipe itself, everything else is the dataclass)."""
+        from automodel_tpu.serving.frontend import FrontendConfig
+
+        key = ("serving.online", "FrontendConfig")
+        if key not in self._cache:
+            node = self.raw.get("serving")
+            sub = node.get("online") if node is not None else None
+            self._cache[key] = dataclass_from_node(
+                FrontendConfig, sub, allow=("enabled", "deadline_steps"),
+            )
         return self._cache[key]
 
     @property

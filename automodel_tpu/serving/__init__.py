@@ -10,18 +10,39 @@
                    sharded over a mesh slice) + serve_batch() host loop
 - router.py:       data-parallel engine replicas + per-replica admission
                    (sticky prefix affinity, least-loaded-by-free-pages),
-                   plus disaggregated prefill/decode replica classes
+                   plus disaggregated prefill/decode replica classes and
+                   the elastic prefill autoscaler
 - kv_transfer.py:  page-granular KV movement between engine pools — the
                    device half of the prefill→decode handoff
+- frontend.py:     online asyncio serve loop — live admission, per-request
+                   token streams with backpressure, deadline load shedding
+- plan_wire.py:    StepPlan wire format + multi-host plan broadcast
+                   (lead process stays single-brained, followers replay)
 - ops/paged_attention.py holds the ragged paged-attention op it runs on.
 """
 
 from automodel_tpu.serving.engine import Request, ServingConfig, ServingEngine
+from automodel_tpu.serving.frontend import (
+    DisaggOnlineFrontend,
+    FrontendConfig,
+    OnlineFrontend,
+    TokenStream,
+)
 from automodel_tpu.serving.kv_pages import PageAllocator, pages_for
 from automodel_tpu.serving.kv_transfer import KVTransfer
+from automodel_tpu.serving.plan_wire import (
+    PlanFollower,
+    make_plan_broadcast,
+    pack_plan,
+    pack_stop,
+    unpack_plan,
+)
 from automodel_tpu.serving.router import (
+    AutoscaleConfig,
     DisaggConfig,
     DisaggRouter,
+    OnlineRouter,
+    QueueAutoscaler,
     ReplicaRouter,
     ServeMeshConfig,
 )
@@ -40,17 +61,24 @@ from automodel_tpu.speculative.serve_draft import (
 )
 
 __all__ = [
+    "AutoscaleConfig",
     "DFlashDraftSource",
     "DisaggConfig",
+    "DisaggOnlineFrontend",
     "DisaggRouter",
     "DraftSource",
     "EagleDraftSource",
+    "FrontendConfig",
     "KVTransfer",
     "NgramDraftSource",
+    "OnlineFrontend",
+    "OnlineRouter",
     "PageAllocator",
+    "PlanFollower",
     "PrefixCache",
     "PrefixCacheConfig",
     "PrefixMatch",
+    "QueueAutoscaler",
     "ReplicaRouter",
     "Request",
     "Scheduler",
@@ -59,5 +87,9 @@ __all__ = [
     "ServingEngine",
     "SpeculativeConfig",
     "StepPlan",
-    "pages_for",
+    "TokenStream",
+    "make_plan_broadcast",
+    "pack_plan",
+    "pack_stop",
+    "unpack_plan",
 ]

@@ -747,20 +747,46 @@ class ServingEngine:
         t0 = time.perf_counter()
         out = self.run_step(plan)
         dt = time.perf_counter() - t0
+        return self.absorb_outputs(sched, plan, out, step_idx), dt
+
+    def absorb_outputs(
+        self, sched: Scheduler, plan: StepPlan, out, step_idx: int,
+    ) -> int:
+        """Feed one run_step output tuple back into the scheduler
+        (speculative outputs unpacked, draft source observed). Split from
+        `run_and_absorb` so the async frontend can run the blocking jitted
+        step in a worker thread while EVERY scheduler mutation stays on
+        the event-loop thread — the scheduler is not thread-safe and never
+        needs to be."""
         if self._spec is not None:
             tokens, _lps, accept, *hid = out
             fh = hid[0] if self._needs_hidden == "frontier" else None
             rh = hid[0] if self._needs_hidden == "rows" else None
-            n_new = sched.update(
+            return sched.update(
                 plan, tokens, step_idx, accept=accept,
                 frontier_hidden=fh, row_hidden=rh,
             )
-        else:
-            tokens, _lps = out
-            n_new = sched.update(plan, tokens, step_idx)
-        return n_new, dt
+        tokens, _lps = out
+        return sched.update(plan, tokens, step_idx)
 
-    def make_scheduler(self) -> Scheduler:
+    def run_one_step(
+        self, sched: Scheduler, step_idx: int,
+    ) -> tuple[StepPlan | None, int, float]:
+        """ONE reentrant serve step: schedule → run → absorb. Returns
+        (plan, tokens committed, device-step seconds) with plan=None when
+        nothing could be packed this step (empty queue, future arrivals,
+        every slot paused, or pool-blocked — the CALLER decides whether to
+        fast-forward, sleep, or shed; this layer never blocks). The shared
+        inner loop of the offline `serve_batch` below and the async online
+        frontend (serving/frontend.py), which drives it from an event loop
+        with live admission between calls."""
+        plan = sched.schedule(step_idx)
+        if plan is None:
+            return None, 0, 0.0
+        n_new, dt = self.run_and_absorb(sched, plan, step_idx)
+        return plan, n_new, dt
+
+    def make_scheduler(self, *, arrival_gating: bool = True) -> Scheduler:
         sc = self.serve_cfg
         if self.alloc is not None:
             # a prior serve_batch cut short (max_steps budget) may have
@@ -777,6 +803,7 @@ class ServingEngine:
             admission_policy=sc.admission_policy,
             spec=self._spec, draft_source=self._draft_source,
             alloc=self.alloc, prefix=self.prefix,
+            arrival_gating=arrival_gating,
         )
 
     def reset_prefix_cache(self) -> int:
@@ -821,7 +848,7 @@ class ServingEngine:
         step_idx = 0
         while sched.has_work and step_idx < budget:
             _stamp_arrivals(sched.waiting, step_idx, ttft_watch)
-            plan = sched.schedule(step_idx)
+            plan, n_new, dt = self.run_one_step(sched, step_idx)
             if plan is None:
                 if not sched.has_work:
                     # deadline expiry inside schedule() drained the last
@@ -861,7 +888,6 @@ class ServingEngine:
                 # just advances; an online server would sleep
                 step_idx += 1
                 continue
-            n_new, dt = self.run_and_absorb(sched, plan, step_idx)
             n_steps += 1
             n_tokens_fed += plan.n_tokens
             if plan.n_samples:

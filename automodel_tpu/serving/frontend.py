@@ -1,0 +1,890 @@
+"""Online serving frontend: async streaming loop, live admission, shedding.
+
+The live-traffic layer above the engine/router tiers (ROADMAP: "turn the
+engine into a service"): everything below this file consumes a pre-sorted
+offline request list in one python loop; this file is the real queue.
+
+One asyncio drive task owns one engine's serve loop:
+
+- **Continuous admission.** `submit()` is callable mid-flight from any
+  coroutine and returns a per-request `TokenStream` immediately; the
+  drive task drains the arrival queue at the top of every engine turn, so
+  a request lands in the scheduler the step after it arrives — no
+  arrival-sorted list, no `Request.arrival` gating (the scheduler runs
+  with `arrival_gating=False`: presence in the queue IS arrival).
+
+- **Streaming with per-stream backpressure.** Tokens are pushed to each
+  request's stream as its slot commits them each step; delivery is
+  decoupled from the jitted step by per-request queues bounded by the
+  PAUSE POLICY: a slot whose consumer has fallen `stream_buffer` tokens
+  behind is withheld from the next plan (`Scheduler.paused`) — its pages
+  stay resident and its deadline keeps ticking, but it costs no step
+  rows, so a stalled consumer back-pressures exactly its own stream and
+  never the step loop or anyone else's tokens. (The queue object itself
+  is unbounded: the bound is enforced BEFORE scheduling, which is what
+  lets the end-of-stream frame always land without blocking the loop.)
+
+- **Deadline-aware load shedding.** Admission control rejects a request
+  whose `Request.deadline` (absolute engine step, PR 11's plumbing) is
+  provably unreachable — the queued prefill backlog alone already eats
+  the budget — and the same check early-expires WAITING requests every
+  turn, so overload turns into fast "shed" rejections instead of
+  requests silently queueing to timeout while holding their place. The
+  decision is a pure function of (step index, queue state, request), so
+  identical arrival traces shed identical sets; the wall-clock ITL EWMA
+  is measured alongside for reporting and for converting step-unit
+  deadlines to seconds, but never enters the decision.
+
+- **Cancellation.** `cancel(rid)` takes effect at the top of the next
+  turn — before the next plan is built — releasing the slot's pages
+  (`Scheduler.cancel`) and, in the disaggregated frontend, any in-flight
+  handoff pins, the same turn. Deferred-to-turn-start is what makes it
+  safe: a plan in flight still references the slot's pages.
+
+- **Multi-host plan broadcast** (`plan_broadcast` given): the lead
+  process packs every StepPlan to one flat int32 frame and broadcasts it
+  (serving/plan_wire.py) before running its own step; follower processes
+  run `PlanFollower` — recv → unpack → the SAME jitted step — so the
+  allocator/scheduler/prefix cache stay single-brained on the lead and a
+  replica's mesh slice can span hosts without the host state knowing.
+
+The jitted step is the only blocking call and runs in a worker thread
+(`run_in_executor`); every scheduler mutation happens on the event-loop
+thread between steps, so the scheduler needs no locks.
+
+`DisaggOnlineFrontend` is the same loop over a `DisaggRouter`'s replica
+classes: arrivals route to prefill replicas, finished prefills migrate as
+page-granular KV handoffs, decode replicas stream — with cancellation
+releasing in-flight handoff pins and shedding fed by the prefill-class
+backlog.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import functools
+import hashlib
+import time
+
+import numpy as np
+
+from automodel_tpu.serving.plan_wire import pack_plan, pack_stop
+from automodel_tpu.serving.scheduler import Request, Scheduler
+
+
+@dataclasses.dataclass(frozen=True)
+class FrontendConfig:
+    """Typed `serving.online` section."""
+
+    #: tokens a consumer may lag before its slot is withheld from plans
+    stream_buffer: int = 32
+    #: hard cap on queued (waiting) requests — beyond it new arrivals shed
+    #: immediately regardless of deadline; None → deadline shedding only
+    max_waiting: int | None = None
+    #: deadline-aware admission control + waiting-queue early expiry
+    shed_deadlines: bool = True
+    #: headroom factor on the steps-to-first-token estimate (shed when
+    #: step + safety * est_steps >= deadline); >1 sheds earlier
+    shed_safety: float = 1.0
+    #: wall-clock inter-token-latency EWMA decay (reporting only)
+    itl_decay: float = 0.9
+    #: event-loop sleep while nothing is runnable
+    idle_sleep_s: float = 0.001
+    #: close(): finish resident work (True) or cancel it (False)
+    drain: bool = True
+
+    def __post_init__(self):
+        if self.stream_buffer < 1:
+            raise ValueError("stream_buffer must be >= 1")
+        if self.max_waiting is not None and self.max_waiting < 1:
+            raise ValueError("max_waiting must be >= 1 (or None)")
+        if self.shed_safety <= 0:
+            raise ValueError("shed_safety must be > 0")
+        if not (0.0 <= self.itl_decay < 1.0):
+            raise ValueError("itl_decay must be in [0, 1)")
+
+
+class TokenStream:
+    """Async iterator over one request's committed tokens, in commit
+    order. Ends (StopAsyncIteration) when the request finishes for ANY
+    reason — `finish_reason` then says which: "eos"/"length" (normal),
+    "timed_out" (deadline eviction), "shed" (admission control),
+    "cancelled" (client disconnect), "rejected" (invalid request)."""
+
+    def __init__(self, req: Request):
+        self.request = req
+        self._q: asyncio.Queue = asyncio.Queue()
+        self._done = False
+
+    @property
+    def rid(self) -> int:
+        return self.request.rid
+
+    @property
+    def finish_reason(self):
+        return self.request.finish_reason
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> int:
+        if self._done:
+            raise StopAsyncIteration
+        tok = await self._q.get()
+        if tok is None:
+            self._done = True
+            raise StopAsyncIteration
+        return tok
+
+    async def collect(self) -> list:
+        """Drain the stream to a plain token list (testing convenience)."""
+        return [t async for t in self]
+
+    # frontend-internal
+    def _push(self, tok: int) -> None:
+        self._q.put_nowait(tok)
+
+    def _end(self) -> None:
+        self._q.put_nowait(None)
+
+    def _lag(self) -> int:
+        """Tokens committed but not yet consumed."""
+        return self._q.qsize()
+
+
+class OnlineFrontend:
+    """Async streaming serve loop over ONE engine (single-chip or a
+    tp/ep-sharded mesh slice). `start()` launches the drive task;
+    `submit()` returns a live TokenStream; `close()` drains and stops.
+
+    `plan_broadcast` (serving/plan_wire.py transport, lead side) turns
+    this into the lead process of a multi-host replica: every plan is
+    broadcast before it runs, and the stop frame is sent on close."""
+
+    #: idle close-drain turns tolerated before stalled work is cancelled
+    CLOSE_STALL_TURNS = 200
+
+    def __init__(
+        self,
+        engine,
+        cfg: FrontendConfig = FrontendConfig(),
+        *,
+        plan_broadcast=None,
+        name: str = "frontend",
+    ):
+        self.engine = engine
+        self.cfg = cfg
+        self.name = name
+        self.sched: Scheduler = engine.make_scheduler(arrival_gating=False)
+        self.plan_broadcast = plan_broadcast
+        self.step_idx = 0
+        self.steps_run = 0
+        self._draft_len = (
+            engine._spec.draft_len if engine._spec is not None else 0
+        )
+        if cfg.stream_buffer <= self._draft_len:
+            raise ValueError(
+                f"stream_buffer={cfg.stream_buffer} must exceed the "
+                f"speculative draft_len={self._draft_len} — a verify block "
+                "commits up to draft_len+1 tokens at once"
+            )
+        #: rid → (Request, TokenStream) for every live (unfinished) request
+        self._active: dict[int, tuple[Request, TokenStream]] = {}
+        self._emitted: dict[int, int] = {}       # rid → tokens pushed
+        self._arrivals: asyncio.Queue = asyncio.Queue()
+        self._cancels: list[int] = []
+        self._next_rid = 0
+        self._closed = False
+        self._task: asyncio.Task | None = None
+        self._step_waiter: asyncio.Event = asyncio.Event()
+        self._idle_close = 0
+        # counters / reporting
+        self.n_submitted = 0
+        self.n_shed = 0
+        self.n_rejected = 0
+        self.itl_ewma_s: float | None = None   # wall ITL (reporting only)
+        self._sha = hashlib.sha1()             # lockstep digest (broadcast)
+
+    # -- client API ---------------------------------------------------------
+    def submit(self, req: Request, *, deadline_in: int | None = None
+               ) -> TokenStream:
+        """Enqueue one request mid-flight; returns its stream immediately.
+        `deadline_in` (engine steps from ADMISSION) is the online-friendly
+        way to set a deadline — absolute step indices are meaningless to a
+        client that cannot see the loop's counter."""
+        if self._closed:
+            raise RuntimeError("frontend is closed")
+        if req.rid < 0:
+            req.rid = self._next_rid
+        self._next_rid = max(self._next_rid, req.rid + 1)
+        stream = TokenStream(req)
+        self.n_submitted += 1
+        self._arrivals.put_nowait((req, stream, deadline_in))
+        return stream
+
+    def cancel(self, rid: int) -> None:
+        """Client disconnect: the request is evicted at the top of the
+        next turn (before the next plan is built — a plan in flight still
+        references its pages), freeing its slot pages the same turn."""
+        self._cancels.append(rid)
+
+    def start(self) -> "OnlineFrontend":
+        if self._task is None:
+            self._task = asyncio.ensure_future(self._drive())
+        return self
+
+    async def close(self) -> dict:
+        """Stop accepting work; drain (or cancel, per cfg.drain) what is
+        resident; stop the drive task. Returns final stats."""
+        self._closed = True
+        if self._task is not None:
+            await self._task
+            self._task = None
+        if self.plan_broadcast is not None:
+            sc = self.engine.serve_cfg
+            self.plan_broadcast.send(pack_stop(
+                sc.token_budget, sc.max_slots, sc.pages_per_slot,
+                self._draft_len or None,
+            ))
+        return self.stats()
+
+    async def __aenter__(self) -> "OnlineFrontend":
+        return self.start()
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    async def wait_step(self, n: int) -> None:
+        """Block until the loop has started turn `n` (trace pacing for
+        tests/harnesses: submit exactly when the counter says so)."""
+        while self.step_idx < n:
+            await self._step_waiter.wait()
+
+    @property
+    def digest(self) -> str:
+        """sha1 over every step's sampled-token output — matches the
+        followers' PlanFollower digest when the broadcast is lockstep."""
+        return self._sha.hexdigest()
+
+    # -- drive loop ---------------------------------------------------------
+    async def _drive(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            self._apply_cancels()
+            self._drain_arrivals()
+            self._shed_waiting()
+            if self._closed:
+                if not self.cfg.drain:
+                    self._abort_resident()
+                if not self.sched.has_work:
+                    break
+            self._apply_backpressure()
+            plan = self.sched.schedule(self.step_idx)
+            if plan is None:
+                # deadline expiry inside schedule() may have evicted work
+                self._emit()
+                self._advance()
+                if self._closed and self.sched.has_work:
+                    # close-drain with nothing runnable: consumers that
+                    # stopped reading (paused slots) or a pool-blocked
+                    # queue would hang the drain forever — give them a
+                    # grace window of idle turns, then cancel stragglers
+                    # (unless a pending deadline will resolve it first)
+                    self._idle_close += 1
+                    if (
+                        self._idle_close > self.CLOSE_STALL_TURNS
+                        and self.sched.next_deadline is None
+                    ):
+                        self._abort_resident()
+                await asyncio.sleep(self.cfg.idle_sleep_s)
+                continue
+            self._idle_close = 0
+            if self.plan_broadcast is not None:
+                self.plan_broadcast.send(pack_plan(
+                    plan,
+                    pages_per_slot=self.engine.serve_cfg.pages_per_slot,
+                    draft_len=self._draft_len or None,
+                ))
+            t0 = time.perf_counter()
+            out = await loop.run_in_executor(
+                None, functools.partial(self.engine.run_step, plan)
+            )
+            dt = time.perf_counter() - t0
+            self._sha.update(np.ascontiguousarray(out[0]).tobytes())
+            n_new = self.engine.absorb_outputs(
+                self.sched, plan, out, self.step_idx
+            )
+            self.steps_run += 1
+            if n_new:
+                itl = dt / n_new
+                d = self.cfg.itl_decay
+                self.itl_ewma_s = (
+                    itl if self.itl_ewma_s is None
+                    else d * self.itl_ewma_s + (1 - d) * itl
+                )
+            self._emit()
+            self._advance()
+
+    def _advance(self) -> None:
+        self.step_idx += 1
+        waiter, self._step_waiter = self._step_waiter, asyncio.Event()
+        waiter.set()
+
+    def _apply_cancels(self) -> None:
+        cancels, self._cancels = self._cancels, []
+        for rid in cancels:
+            self._cancel_now(rid)
+
+    def _cancel_now(self, rid: int) -> None:
+        if self.sched.cancel(rid, self.step_idx):
+            self._finish_stream(rid)
+
+    def _drain_arrivals(self) -> None:
+        while not self._arrivals.empty():
+            req, stream, deadline_in = self._arrivals.get_nowait()
+            self._active[req.rid] = (req, stream)
+            self._emitted[req.rid] = 0
+            req.arrived_t = time.perf_counter()
+            if deadline_in is not None:
+                req.deadline = self.step_idx + deadline_in
+            if self._closed:
+                self._shed_one(req, "shed")
+                continue
+            if (
+                self.cfg.max_waiting is not None
+                and len(self.sched.waiting) >= self.cfg.max_waiting
+            ):
+                self._shed_one(req, "shed")
+                continue
+            if self.cfg.shed_deadlines and not self._reachable(
+                req, self._backlog() + self._waiting_backlog()
+            ):
+                self._shed_one(req, "shed")
+                continue
+            try:
+                self.sched.submit(req)
+            except ValueError:
+                # oversized/invalid request: surface as a rejected stream
+                # instead of crashing the loop every other client shares
+                self._shed_one(req, "rejected")
+
+    def _shed_one(self, req: Request, reason: str) -> None:
+        req.finish_reason = reason
+        req.finished_at = self.step_idx
+        self.sched.finished.append(req)
+        if reason == "rejected":
+            self.n_rejected += 1
+        else:
+            self.n_shed += 1
+        self._finish_stream(req.rid)
+
+    # -- load shedding -------------------------------------------------------
+    def _backlog(self) -> int:
+        """Unfed tokens resident on device (running prefill remainder)."""
+        return sum(
+            max(len(r.known) - r.fed, 0)
+            for r in self.sched.running.values()
+        )
+
+    def _waiting_backlog(self) -> int:
+        return sum(len(r.known) - r.fed for r in self.sched.waiting)
+
+    def _reachable(self, req: Request, backlog: int) -> bool:
+        """Can `req` plausibly commit even ONE token before its deadline?
+        The queued prefill backlog plus its own prompt must flow through
+        the step's token budget first; a request that cannot clear that
+        by its deadline would only occupy pool pages and die, so it sheds
+        at the door. Pure step arithmetic — identical traces shed
+        identical sets (the wall-clock ITL EWMA is reported next to it
+        but never consulted)."""
+        if req.deadline is None:
+            return True
+        pending = len(req.known) - req.fed
+        budget = self.sched.token_budget
+        est = -(-(self.cfg.shed_safety * (backlog + pending)) // budget)
+        return self.step_idx + int(est) < req.deadline
+
+    def _shed_waiting(self) -> None:
+        """Early-expire waiting requests whose deadline became unreachable
+        while they queued (load grew ahead of them) — the 'early-expire'
+        half of shedding: they exit NOW as shed instead of burning pool
+        time later as timed_out."""
+        if not self.cfg.shed_deadlines:
+            return
+        backlog = self._backlog()
+        for req in list(self.sched.waiting):
+            if not self._reachable(req, backlog):
+                self.sched.waiting.remove(req)
+                self._shed_one(req, "shed")
+            else:
+                backlog += len(req.known) - req.fed
+
+    # -- streaming ----------------------------------------------------------
+    def _apply_backpressure(self) -> None:
+        """Withhold any slot whose consumer lacks room for this step's
+        worst-case commit (1 token, +draft_len speculative): its stream
+        queue never exceeds stream_buffer + one verify block, and the
+        step loop never blocks on a slow reader."""
+        self.sched.paused.clear()
+        room_needed = 1 + self._draft_len
+        for slot, req in self.sched.running.items():
+            entry = self._active.get(req.rid)
+            if entry is None:
+                continue
+            if entry[1]._lag() + room_needed > self.cfg.stream_buffer:
+                self.sched.paused.add(slot)
+
+    def _emit(self) -> None:
+        """Push newly committed tokens to their streams, in commit order;
+        end the stream of everything that finished this turn."""
+        for rid, (req, stream) in list(self._active.items()):
+            sent = self._emitted[rid]
+            new = req.generated[sent:]
+            if new:
+                if req.ttft_s < 0 and req.arrived_t >= 0:
+                    req.ttft_s = time.perf_counter() - req.arrived_t
+                for tok in new:
+                    stream._push(tok)
+                self._emitted[rid] = sent + len(new)
+            if req.done:
+                self._finish_stream(rid)
+
+    def _finish_stream(self, rid: int) -> None:
+        entry = self._active.pop(rid, None)
+        self._emitted.pop(rid, None)
+        if entry is not None:
+            entry[1]._end()
+
+    def _abort_resident(self) -> None:
+        for rid in list(self._active):
+            self._cancel_now(rid)
+
+    # -- reporting ----------------------------------------------------------
+    def stats(self) -> dict:
+        s = self.sched
+        return {
+            "steps": self.steps_run,
+            "submitted": self.n_submitted,
+            "finished": len(s.finished),
+            "shed": self.n_shed,
+            "rejected": self.n_rejected,
+            "cancelled": s.n_cancelled,
+            "timed_out": s.n_timed_out,
+            "preemptions": s.n_preemptions,
+            "running": len(s.running),
+            "waiting": len(s.waiting),
+            "paused": len(s.paused),
+            "free_pages": s.alloc.num_free,
+            "itl_ewma_ms": (
+                round(self.itl_ewma_s * 1e3, 4)
+                if self.itl_ewma_s is not None else None
+            ),
+            "compiled_signatures": self.engine.step_cache_size(),
+        }
+
+
+class DisaggOnlineFrontend:
+    """The same live loop over a `DisaggRouter`'s replica classes:
+    arrivals route to a prefill replica, finished prefills migrate to a
+    decode replica as page-granular KV handoffs, decode replicas stream.
+
+    One drive task owns every scheduler (the handoff dance needs a
+    consistent view of both classes each turn); engine steps for all
+    replicas of a turn run back-to-back in the worker thread. Shedding
+    uses the LEAST-LOADED prefill replica's backlog (that is where the
+    request would land); cancellation additionally releases in-flight
+    handoff pins — the one eviction path the offline loop only had for
+    deadline expiry."""
+
+    def __init__(self, router, cfg: FrontendConfig = FrontendConfig()):
+        self.router = router
+        self.cfg = cfg
+        self.p_scheds = [
+            eng.make_scheduler(arrival_gating=False) for eng in router.prefill
+        ]
+        self.d_scheds = [
+            eng.make_scheduler(arrival_gating=False) for eng in router.decode
+        ]
+        #: rids prefill-ROUTED to each borrowed decode replica (autoscale):
+        #: the extract_handoffs(rids=...) guard — only these migrate out,
+        #: the replica's resident decode work is never evacuated
+        self._borrow_rids: dict[int, set] = {}
+        self.inflight: list = []
+        self.step_idx = 0
+        self.steps_run = 0
+        self._draft_len = max(
+            (e._spec.draft_len for e in router.decode if e._spec is not None),
+            default=0,
+        )
+        if cfg.stream_buffer <= self._draft_len:
+            raise ValueError("stream_buffer must exceed draft_len")
+        self._active: dict[int, tuple[Request, TokenStream]] = {}
+        self._emitted: dict[int, int] = {}
+        self._arrivals: asyncio.Queue = asyncio.Queue()
+        self._cancels: list[int] = []
+        self._next_rid = 0
+        self._closed = False
+        self._task: asyncio.Task | None = None
+        self._step_waiter: asyncio.Event = asyncio.Event()
+        self._idle_close = 0
+        self.n_submitted = 0
+        self.n_shed = 0
+        self.n_rejected = 0
+        self.n_cancelled_inflight = 0
+        self.itl_ewma_s: float | None = None
+
+    # -- client API ---------------------------------------------------------
+    def submit(self, req: Request, *, deadline_in: int | None = None
+               ) -> TokenStream:
+        if self._closed:
+            raise RuntimeError("frontend is closed")
+        if req.rid < 0:
+            req.rid = self._next_rid
+        self._next_rid = max(self._next_rid, req.rid + 1)
+        stream = TokenStream(req)
+        self.n_submitted += 1
+        self._arrivals.put_nowait((req, stream, deadline_in))
+        return stream
+
+    def cancel(self, rid: int) -> None:
+        self._cancels.append(rid)
+
+    def start(self) -> "DisaggOnlineFrontend":
+        if self._task is None:
+            self._task = asyncio.ensure_future(self._drive())
+        return self
+
+    async def close(self) -> dict:
+        self._closed = True
+        if self._task is not None:
+            await self._task
+            self._task = None
+        return self.stats()
+
+    async def __aenter__(self) -> "DisaggOnlineFrontend":
+        return self.start()
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    async def wait_step(self, n: int) -> None:
+        while self.step_idx < n:
+            await self._step_waiter.wait()
+
+    # -- drive --------------------------------------------------------------
+    def _all_scheds(self):
+        return self.p_scheds + self.d_scheds
+
+    @property
+    def _has_work(self) -> bool:
+        return bool(self.inflight) or any(
+            s.has_work for s in self._all_scheds()
+        )
+
+    async def _drive(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            self._apply_cancels()
+            self.router.autoscale_tick(
+                self.p_scheds, self.d_scheds, self.step_idx
+            )
+            self._drain_arrivals()
+            self._shed_waiting()
+            if self._closed:
+                if not self.cfg.drain:
+                    self._abort_resident()
+                if not self._has_work:
+                    break
+            self._expire_inflight()
+            self._admit_inflight()
+            self._apply_backpressure()
+            plans = []
+            for sched, eng in zip(
+                self.d_scheds + self.p_scheds,
+                self.router.decode + self.router.prefill,
+            ):
+                if not sched.has_work:
+                    continue
+                plan = sched.schedule(self.step_idx)
+                if plan is not None:
+                    plans.append((eng, sched, plan))
+            if not plans:
+                self._emit()
+                self._advance()
+                if self._closed and self._has_work:
+                    # same stalled-drain escape hatch as OnlineFrontend
+                    self._idle_close += 1
+                    deadlines = [
+                        s.next_deadline for s in self._all_scheds()
+                    ] + [h.req.deadline for h in self.inflight]
+                    if (
+                        self._idle_close > OnlineFrontend.CLOSE_STALL_TURNS
+                        and not any(d is not None for d in deadlines)
+                    ):
+                        self._abort_resident()
+                await asyncio.sleep(self.cfg.idle_sleep_s)
+                continue
+            self._idle_close = 0
+            t0 = time.perf_counter()
+            outs = await loop.run_in_executor(
+                None,
+                functools.partial(
+                    lambda ps: [
+                        (eng, sched, plan, eng.run_step(plan))
+                        for eng, sched, plan in ps
+                    ],
+                    plans,
+                ),
+            )
+            dt = time.perf_counter() - t0
+            n_new = 0
+            for eng, sched, plan, out in outs:
+                n_new += eng.absorb_outputs(sched, plan, out, self.step_idx)
+            # runtime import: router imports this module at its top level
+            from automodel_tpu.serving.router import _Handoff
+
+            for r, sched in enumerate(self.p_scheds):
+                for req, n_tok, src in sched.extract_handoffs():
+                    self.inflight.append(_Handoff(req, n_tok, src, r))
+            # borrowed replicas extract ONLY their prefill-routed rids
+            for j, rids in self._borrow_rids.items():
+                rids.intersection_update(self._active)  # drop finished
+                if not rids:
+                    continue
+                for req, n_tok, src in self.d_scheds[j].extract_handoffs(
+                    rids=rids
+                ):
+                    rids.discard(req.rid)
+                    self.inflight.append(_Handoff(req, n_tok, src, ("d", j)))
+            self.steps_run += 1
+            if n_new:
+                itl = dt / n_new
+                d = self.cfg.itl_decay
+                self.itl_ewma_s = (
+                    itl if self.itl_ewma_s is None
+                    else d * self.itl_ewma_s + (1 - d) * itl
+                )
+            self._emit()
+            self._advance()
+
+    def _advance(self) -> None:
+        self.step_idx += 1
+        waiter, self._step_waiter = self._step_waiter, asyncio.Event()
+        waiter.set()
+
+    # -- admission / shedding ------------------------------------------------
+    def _drain_arrivals(self) -> None:
+        while not self._arrivals.empty():
+            req, stream, deadline_in = self._arrivals.get_nowait()
+            self._active[req.rid] = (req, stream)
+            self._emitted[req.rid] = 0
+            req.arrived_t = time.perf_counter()
+            if deadline_in is not None:
+                req.deadline = self.step_idx + deadline_in
+            if self._closed:
+                self._shed_one(req, "shed")
+                continue
+            # the prefill ROUTING SET: the prefill class plus any decode
+            # replicas the autoscaler has borrowed for it
+            borrowed = sorted(self.router.borrowed)
+            route_scheds = self.p_scheds + [
+                self.d_scheds[j] for j in borrowed
+            ]
+            r = self.router.route_prefill(req, route_scheds)
+            sched = route_scheds[r]
+            borrow_j = (
+                borrowed[r - len(self.p_scheds)]
+                if r >= len(self.p_scheds) else None
+            )
+            if (
+                self.cfg.max_waiting is not None
+                and len(sched.waiting) >= self.cfg.max_waiting
+            ):
+                self._shed_one(req, "shed")
+                continue
+            if self.cfg.shed_deadlines and not self._reachable(
+                req, sched, self._sched_backlog(sched, waiting=True)
+            ):
+                self._shed_one(req, "shed")
+                continue
+            try:
+                sched.submit(req)
+            except ValueError:
+                self._shed_one(req, "rejected")
+                continue
+            if borrow_j is not None:
+                self._borrow_rids.setdefault(borrow_j, set()).add(req.rid)
+
+    def _sched_backlog(self, sched, *, waiting: bool) -> int:
+        b = sum(
+            max(len(r.known) - r.fed, 0) for r in sched.running.values()
+        )
+        if waiting:
+            b += sum(len(r.known) - r.fed for r in sched.waiting)
+        return b
+
+    def _reachable(self, req: Request, sched, backlog: int) -> bool:
+        if req.deadline is None:
+            return True
+        pending = len(req.known) - req.fed
+        est = -(-(self.cfg.shed_safety * (backlog + pending))
+                // sched.token_budget)
+        return self.step_idx + int(est) < req.deadline
+
+    def _shed_waiting(self) -> None:
+        if not self.cfg.shed_deadlines:
+            return
+        for sched in self.p_scheds:
+            backlog = self._sched_backlog(sched, waiting=False)
+            for req in list(sched.waiting):
+                if not self._reachable(req, sched, backlog):
+                    sched.waiting.remove(req)
+                    self._shed_one(req, "shed")
+                else:
+                    backlog += len(req.known) - req.fed
+
+    def _shed_one(self, req: Request, reason: str) -> None:
+        req.finish_reason = reason
+        req.finished_at = self.step_idx
+        self.d_scheds[0].finished.append(req)
+        if reason == "rejected":
+            self.n_rejected += 1
+        else:
+            self.n_shed += 1
+        self._finish_stream(req.rid)
+
+    # -- cancellation --------------------------------------------------------
+    def _apply_cancels(self) -> None:
+        cancels, self._cancels = self._cancels, []
+        for rid in cancels:
+            self._cancel_now(rid)
+
+    def _cancel_now(self, rid: int) -> None:
+        # in-flight handoff: drop the prefill-side page pins THIS turn —
+        # the bugfix half the offline loop only had for deadline expiry
+        for h in list(self.inflight):
+            if h.req.rid == rid:
+                self.inflight.remove(h)
+                self._src_sched(h).release_handoff(h.src_pages)
+                h.req.finish_reason = "cancelled"
+                h.req.finished_at = self.step_idx
+                self.d_scheds[0].finished.append(h.req)
+                self.d_scheds[0].n_cancelled += 1
+                self.n_cancelled_inflight += 1
+                self._finish_stream(rid)
+                return
+        for rids in self._borrow_rids.values():
+            rids.discard(rid)
+        for sched in self._all_scheds():
+            if sched.cancel(rid, self.step_idx):
+                self._finish_stream(rid)
+                return
+
+    # -- handoffs ------------------------------------------------------------
+    def _src_sched(self, h):
+        """Scheduler owning a handoff's page pins: a prefill replica, or a
+        borrowed decode replica (src tagged ("d", j) by the autoscaler)."""
+        if isinstance(h.src, tuple):
+            return self.d_scheds[h.src[1]]
+        return self.p_scheds[h.src]
+
+    def _transfer(self, h, r):
+        if isinstance(h.src, tuple):
+            return self.router.decode_transfer(h.src[1], r)
+        return self.router.transfers[(h.src, r)]
+
+    def _expire_inflight(self) -> None:
+        for h in list(self.inflight):
+            if (
+                h.req.deadline is not None
+                and self.step_idx >= h.req.deadline
+            ):
+                self.inflight.remove(h)
+                self._src_sched(h).release_handoff(h.src_pages)
+                h.req.finish_reason = "timed_out"
+                h.req.finished_at = self.step_idx
+                self.d_scheds[0].finished.append(h.req)
+                self.d_scheds[0].n_timed_out += 1
+                self._finish_stream(h.req.rid)
+
+    def _admit_inflight(self) -> None:
+        for h in list(self.inflight):
+            for r, _sticky in self.router._decode_order(h, self.d_scheds):
+                pairs = self.d_scheds[r].try_admit_handoff(
+                    h.req, h.n_tokens, h.src_pages, self.step_idx
+                )
+                if pairs is None:
+                    continue
+                self._transfer(h, r).move(pairs)
+                self._src_sched(h).release_handoff(h.src_pages)
+                self.inflight.remove(h)
+                break
+
+    # -- streaming ----------------------------------------------------------
+    def _apply_backpressure(self) -> None:
+        for sched in self._all_scheds():
+            sched.paused.clear()
+            room_needed = 1 + self._draft_len
+            for slot, req in sched.running.items():
+                entry = self._active.get(req.rid)
+                if entry is None:
+                    continue
+                if entry[1]._lag() + room_needed > self.cfg.stream_buffer:
+                    sched.paused.add(slot)
+
+    def _emit(self) -> None:
+        for rid, (req, stream) in list(self._active.items()):
+            sent = self._emitted[rid]
+            new = req.generated[sent:]
+            if new:
+                if req.ttft_s < 0 and req.arrived_t >= 0:
+                    req.ttft_s = time.perf_counter() - req.arrived_t
+                for tok in new:
+                    stream._push(tok)
+                self._emitted[rid] = sent + len(new)
+            # a request mid-migration is neither running nor done — only
+            # end the stream once a terminal finish_reason lands
+            if req.done:
+                self._finish_stream(rid)
+
+    def _finish_stream(self, rid: int) -> None:
+        entry = self._active.pop(rid, None)
+        self._emitted.pop(rid, None)
+        if entry is not None:
+            entry[1]._end()
+
+    def _abort_resident(self) -> None:
+        for rid in list(self._active):
+            self._cancel_now(rid)
+
+    # -- reporting ----------------------------------------------------------
+    def stats(self) -> dict:
+        scheds = self._all_scheds()
+        return {
+            "steps": self.steps_run,
+            "submitted": self.n_submitted,
+            "finished": sum(len(s.finished) for s in scheds),
+            "shed": self.n_shed,
+            "rejected": self.n_rejected,
+            "cancelled": sum(s.n_cancelled for s in scheds),
+            "cancelled_inflight": self.n_cancelled_inflight,
+            "timed_out": sum(s.n_timed_out for s in scheds),
+            "inflight_handoffs": len(self.inflight),
+            "handoffs": sum(s.n_handoffs_in for s in self.d_scheds),
+            "borrowed": sorted(self.router.borrowed),
+            "autoscale_borrows": self.router.n_borrows,
+            "autoscale_returns": self.router.n_returns,
+            "waiting": sum(len(s.waiting) for s in scheds),
+            "running": sum(len(s.running) for s in scheds),
+            "itl_ewma_ms": (
+                round(self.itl_ewma_s * 1e3, 4)
+                if self.itl_ewma_s is not None else None
+            ),
+            "compiled_signatures_prefill": max(
+                e.step_cache_size() for e in self.router.prefill
+            ),
+            "compiled_signatures_decode": max(
+                e.step_cache_size() for e in self.router.decode
+            ),
+        }

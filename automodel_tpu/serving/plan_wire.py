@@ -1,0 +1,300 @@
+"""Host-side StepPlan wire format + multi-host broadcast transports.
+
+Multi-host serving keeps the PR-15 contract intact: the allocator,
+scheduler, and prefix cache stay SINGLE-BRAINED on the lead process, and
+every other process in a replica's mesh slice just runs the same jitted
+step on the same plan. The plan is pure host-side numpy (a few KB of
+int32), so the cross-process hop is a byte broadcast, not a distributed
+data structure: the lead packs each `StepPlan` into ONE flat int32 buffer
+(`pack_plan`), broadcasts it, and followers unpack and call
+`ServingEngine.run_step` — under GSPMD the per-process step invocations
+then form one global computation over the multi-host mesh, with the
+sharded pool's pages still globally indexed and the host state none the
+wiser.
+
+The buffer is FIXED-SIZE for a given engine geometry (T, S, P, K): the
+variable-length `scheduled` list pads to S triples and the STOP sentinel
+is a full-size frame with its kind flag cleared. That makes the broadcast
+itself shape-stable — one compiled collective for the whole serving run —
+and lets followers post their receive without negotiating lengths.
+
+Two transports behind one interface:
+
+- `CollectiveBroadcast` — `multihost_utils.broadcast_one_to_all`, the
+  XLA-collective path for real multi-host (TPU) meshes. Every process
+  participates in the same psum, so send/recv are the two faces of one
+  collective call.
+- `KVStoreBroadcast` — the jax.distributed coordination-service
+  key-value store (the same gRPC service that backs barriers and
+  multi-host checkpoint coordination). Works on every backend including
+  multi-process CPU, where XLA cross-process computations are
+  unavailable — this is what the 2-process CI dryrun exercises, and the
+  fallback for plan distribution outside the mesh's own fabric.
+
+`make_plan_broadcast` picks the collective transport when the backend
+can run multi-process computations and the KV store otherwise.
+`PlanFollower` is the whole follower process: recv → unpack → run_step
+until the stop frame, digesting the sampled-token outputs so lockstep
+execution is checkable end-to-end.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+from automodel_tpu.serving.scheduler import StepPlan
+
+_MAGIC = 0x51A7  # "SLAT" — plan-wire frame marker
+_KIND_STOP = 0
+_KIND_PLAN = 1
+
+
+def wire_size(token_budget: int, max_slots: int, pages_per_slot: int,
+              draft_len: int | None = None) -> int:
+    """int32 words per frame for an engine geometry (fixed per run)."""
+    T, S, P = token_budget, max_slots, pages_per_slot
+    n = 7                 # header: magic, kind, T, S, P, K, n_scheduled
+    n += 5 * T            # tok, slot, pos, page, off
+    n += S * P            # page_tables
+    n += 5 * S            # sample_tok, seed, cow_src, cow_dst, temp(bits)
+    if draft_len is not None:
+        n += S * (draft_len + 1) + S   # verify_rows, spec_len
+    n += 3 * S            # scheduled triples (slot, n_tokens, samples)
+    return n
+
+
+def pack_plan(plan: StepPlan, *, pages_per_slot: int,
+              draft_len: int | None = None) -> np.ndarray:
+    """One StepPlan → one flat int32 frame (float temps bit-cast, never
+    rounded). `draft_len` must match the engine's speculative geometry
+    (None when speculation is off) so frames stay fixed-size."""
+    T = plan.tok.shape[0]
+    S, P = plan.page_tables.shape
+    if P != pages_per_slot:
+        raise ValueError(f"plan carries {P} pages/slot, expected "
+                         f"{pages_per_slot}")
+    K = -1 if draft_len is None else draft_len
+    if (plan.spec_len is not None) != (draft_len is not None):
+        raise ValueError("plan speculation does not match draft_len")
+    parts = [
+        np.asarray(
+            [_MAGIC, _KIND_PLAN, T, S, P, K, len(plan.scheduled)], np.int32
+        ),
+        plan.tok, plan.slot, plan.pos, plan.page, plan.off,
+        plan.page_tables.reshape(-1),
+        plan.sample_tok, plan.seed, plan.cow_src, plan.cow_dst,
+        np.asarray(plan.temp, np.float32).view(np.int32),
+    ]
+    if draft_len is not None:
+        parts += [plan.verify_rows.reshape(-1), plan.spec_len]
+    sched = np.full((S, 3), -1, np.int32)
+    sched[:, 1:] = 0
+    for i, (slot, c, samples) in enumerate(plan.scheduled):
+        sched[i] = (slot, c, int(samples))
+    parts.append(sched.reshape(-1))
+    buf = np.concatenate([np.asarray(p, np.int32).reshape(-1)
+                          for p in parts])
+    assert buf.shape[0] == wire_size(T, S, P, draft_len)
+    return buf
+
+
+def pack_stop(token_budget: int, max_slots: int, pages_per_slot: int,
+              draft_len: int | None = None) -> np.ndarray:
+    """Full-size STOP frame (same shape as a plan, kind flag cleared) —
+    collective transports need every broadcast to carry one shape."""
+    buf = np.zeros(
+        wire_size(token_budget, max_slots, pages_per_slot, draft_len),
+        np.int32,
+    )
+    buf[0], buf[1] = _MAGIC, _KIND_STOP
+    return buf
+
+
+def is_stop(buf: np.ndarray) -> bool:
+    if int(buf[0]) != _MAGIC:
+        raise ValueError("not a plan-wire frame (bad magic)")
+    return int(buf[1]) == _KIND_STOP
+
+
+def unpack_plan(buf: np.ndarray) -> StepPlan:
+    """Inverse of pack_plan (scheduled list included — followers only
+    need the arrays, but a lossless round-trip keeps the format honest
+    and testable)."""
+    buf = np.asarray(buf, np.int32)
+    if int(buf[0]) != _MAGIC or int(buf[1]) != _KIND_PLAN:
+        raise ValueError("not a plan frame")
+    T, S, P, K, n_sched = (int(x) for x in buf[2:7])
+    off = 7
+
+    def take(n, shape=None):
+        nonlocal off
+        a = buf[off : off + n].copy()
+        off += n
+        return a if shape is None else a.reshape(shape)
+
+    plan = StepPlan(
+        tok=take(T), slot=take(T), pos=take(T), page=take(T), off=take(T),
+        page_tables=take(S * P, (S, P)),
+        sample_tok=take(S), seed=take(S),
+        cow_src=take(S), cow_dst=take(S),
+        temp=take(S).view(np.float32),
+    )
+    if K >= 0:
+        plan.verify_rows = take(S * (K + 1), (S, K + 1))
+        plan.spec_len = take(S)
+    sched = take(3 * S, (S, 3))
+    plan.scheduled = [
+        (int(s), int(c), bool(x)) for s, c, x in sched[:n_sched]
+    ]
+    assert off == buf.shape[0]
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# broadcast transports
+# ---------------------------------------------------------------------------
+
+class KVStoreBroadcast:
+    """Plan frames over the jax.distributed coordination service's
+    key-value store — backend-agnostic (gRPC to the coordinator, no XLA
+    collectives), so it is the transport multi-process CPU runs use.
+    Keys are sequence-numbered; the lead deletes frames a few steps
+    behind so the coordinator's store stays bounded."""
+
+    #: frames kept behind the head before deletion (followers lag the
+    #: lead by at most the time of one engine step, so a short tail is
+    #: plenty; the slack tolerates a follower still reading seq-1)
+    TRAIL = 4
+
+    def __init__(self, size: int, is_lead: bool, *, prefix: str = "planwire",
+                 timeout_ms: int = 120_000, client=None):
+        if client is None:
+            from jax._src import distributed
+
+            client = distributed.global_state.client
+        if client is None:
+            raise RuntimeError(
+                "KVStoreBroadcast needs jax.distributed.initialize() first"
+            )
+        self._client = client
+        self._size = size
+        self._is_lead = is_lead
+        self._prefix = prefix
+        self._timeout = timeout_ms
+        self._seq = 0
+
+    def _key(self, seq: int) -> str:
+        return f"{self._prefix}/{seq}"
+
+    def send(self, buf: np.ndarray) -> None:
+        assert self._is_lead and buf.shape[0] == self._size
+        self._client.key_value_set_bytes(self._key(self._seq), buf.tobytes())
+        old = self._seq - self.TRAIL
+        if old >= 0:
+            try:
+                self._client.key_value_delete(self._key(old))
+            except Exception:
+                pass  # cleanup is best-effort; the run ends regardless
+        self._seq += 1
+
+    def recv(self) -> np.ndarray:
+        assert not self._is_lead
+        raw = self._client.blocking_key_value_get_bytes(
+            self._key(self._seq), self._timeout
+        )
+        self._seq += 1
+        buf = np.frombuffer(raw, np.int32)
+        assert buf.shape[0] == self._size
+        return buf
+
+    def barrier(self, name: str, timeout_ms: int = 120_000) -> None:
+        self._client.wait_at_barrier(f"{self._prefix}/{name}", timeout_ms)
+
+
+class CollectiveBroadcast:
+    """Plan frames as one XLA collective per step
+    (`multihost_utils.broadcast_one_to_all`): lead and followers meet in
+    the same psum, so `send` and `recv` are the two faces of one call.
+    Requires a backend that runs multi-process computations (TPU pods;
+    NOT multi-process CPU — use KVStoreBroadcast there)."""
+
+    def __init__(self, size: int, is_lead: bool):
+        self._size = size
+        self._is_lead = is_lead
+
+    def send(self, buf: np.ndarray) -> None:
+        from jax.experimental import multihost_utils
+
+        assert self._is_lead and buf.shape[0] == self._size
+        multihost_utils.broadcast_one_to_all(buf, is_source=True)
+
+    def recv(self) -> np.ndarray:
+        from jax.experimental import multihost_utils
+
+        assert not self._is_lead
+        return np.asarray(multihost_utils.broadcast_one_to_all(
+            np.zeros(self._size, np.int32), is_source=False
+        ))
+
+    def barrier(self, name: str, timeout_ms: int = 120_000) -> None:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
+def make_plan_broadcast(size: int, is_lead: bool, *, transport: str = "auto",
+                        **kw):
+    """Pick the plan transport: XLA collectives when the backend can run
+    multi-process computations, the coordination-service KV store
+    otherwise (multi-process CPU — the CI dryrun path)."""
+    if transport == "auto":
+        import jax
+
+        transport = (
+            "kvstore" if jax.default_backend() == "cpu" else "collective"
+        )
+    if transport == "collective":
+        return CollectiveBroadcast(size, is_lead)
+    if transport == "kvstore":
+        return KVStoreBroadcast(size, is_lead, **kw)
+    raise ValueError(f"unknown plan transport {transport!r}")
+
+
+class PlanFollower:
+    """A follower process's whole serve loop: receive packed plans, run
+    the local engine's jitted step on each, stop on the sentinel frame.
+
+    The follower holds NO scheduler/allocator/prefix state — its page
+    tables, admission decisions, and sampling seeds all arrive inside
+    the plan, which is the single-brained-host design: under GSPMD the
+    lead's and followers' step invocations form one global computation,
+    and on CPU dryruns they form two bit-identical replicas. Either
+    way `digest` (sha1 over every step's sampled-token output) must
+    match the lead's, which is how lockstep execution is proven."""
+
+    def __init__(self, engine, broadcast):
+        self.engine = engine
+        self.broadcast = broadcast
+        self.steps = 0
+        self._sha = hashlib.sha1()
+
+    @property
+    def digest(self) -> str:
+        return self._sha.hexdigest()
+
+    def run(self, max_steps: int = 10_000_000) -> dict:
+        while self.steps < max_steps:
+            buf = self.broadcast.recv()
+            if is_stop(buf):
+                break
+            plan = unpack_plan(buf)
+            out = self.engine.run_step(plan)
+            self._sha.update(np.ascontiguousarray(out[0]).tobytes())
+            self.steps += 1
+        return {
+            "steps": self.steps,
+            "digest": self.digest,
+            "compiled_signatures": self.engine.step_cache_size(),
+        }

@@ -44,6 +44,11 @@ from automodel_tpu.serving.engine import (
     _percentiles_ms,
     _resolve_ttft,
 )
+from automodel_tpu.serving.frontend import (
+    FrontendConfig,
+    OnlineFrontend,
+    TokenStream,
+)
 from automodel_tpu.serving.kv_transfer import KVTransfer
 from automodel_tpu.serving.scheduler import Request
 
@@ -329,6 +334,74 @@ class ReplicaRouter:
 # ---------------------------------------------------------------------------
 
 @dataclasses.dataclass(frozen=True)
+class AutoscaleConfig:
+    """Typed `serving.disaggregation.autoscale` section: when the prefill
+    queue outruns the decode class for long enough, the prefill ROUTING
+    SET borrows a decode replica (and returns it when the imbalance
+    clears). Membership is pure routing state — engines are never rebuilt
+    or resharded, so every replica keeps its compile-once contract; a
+    borrowed replica simply starts receiving prompt-phase requests, whose
+    finished prefills hand off like any prefill replica's."""
+
+    enabled: bool = False
+    #: borrow when prefill queue depth >= grow_ratio * (decode depth + 1)
+    grow_ratio: float = 4.0
+    #: return a borrowed replica when depth <= shrink_ratio * (decode+1)
+    shrink_ratio: float = 1.0
+    #: consecutive turns the signal must hold before acting (hysteresis)
+    sustain: int = 8
+    #: turns after any action before the next may fire
+    cooldown: int = 32
+    #: decode replicas that must stay dedicated to decode
+    min_decode: int = 1
+
+    def __post_init__(self):
+        if self.grow_ratio <= self.shrink_ratio:
+            raise ValueError(
+                "autoscale grow_ratio must exceed shrink_ratio "
+                f"(got {self.grow_ratio} <= {self.shrink_ratio})"
+            )
+        if self.sustain < 1 or self.cooldown < 0 or self.min_decode < 1:
+            raise ValueError(f"bad autoscale config: {self}")
+
+
+class QueueAutoscaler:
+    """The autoscale DECISION, isolated from the routing mutation: feed it
+    (prefill queue depth, decode load, step) once per turn and it answers
+    None / "grow" / "shrink" with sustain-and-cooldown hysteresis — a pure
+    function of the observation sequence, so identical traces autoscale
+    identically (and the policy unit-tests without any engines)."""
+
+    def __init__(self, cfg: AutoscaleConfig):
+        self.cfg = cfg
+        self._grow_streak = 0
+        self._shrink_streak = 0
+        self._last_action: int | None = None
+
+    def observe(self, prefill_depth: int, decode_depth: int,
+                step_idx: int) -> str | None:
+        c = self.cfg
+        grow = prefill_depth >= c.grow_ratio * (decode_depth + 1)
+        shrink = prefill_depth <= c.shrink_ratio * (decode_depth + 1)
+        self._grow_streak = self._grow_streak + 1 if grow else 0
+        self._shrink_streak = self._shrink_streak + 1 if shrink else 0
+        if (
+            self._last_action is not None
+            and step_idx - self._last_action < c.cooldown
+        ):
+            return None
+        if self._grow_streak >= c.sustain:
+            self._last_action = step_idx
+            self._grow_streak = 0
+            return "grow"
+        if self._shrink_streak >= c.sustain:
+            self._last_action = step_idx
+            self._shrink_streak = 0
+            return "shrink"
+        return None
+
+
+@dataclasses.dataclass(frozen=True)
 class DisaggConfig:
     """Typed `serving.disaggregation` section: split the replica set into a
     prefill class and a decode class (Mooncake/DistServe-style). Finished
@@ -345,6 +418,8 @@ class DisaggConfig:
     #: prefill replicas usually want a LARGER budget — they never carry
     #: latency-critical decode rows, so wide chunks amortize step overhead
     prefill_token_budget: int | None = None
+    #: elastic prefill routing set (see AutoscaleConfig); off by default
+    autoscale: AutoscaleConfig = AutoscaleConfig()
 
     def __post_init__(self):
         if self.prefill_replicas < 1 or self.decode_replicas < 1:
@@ -460,6 +535,72 @@ class DisaggRouter:
             for i in range(n_p)
             for j in range(n_d)
         }
+        # elastic prefill routing set: decode replica indices currently
+        # borrowed by the prefill class (routing state only — engines and
+        # their compiled steps are untouched)
+        self.borrowed: set[int] = set()
+        self.autoscaler = (
+            QueueAutoscaler(disagg.autoscale)
+            if disagg.autoscale.enabled else None
+        )
+        self.n_borrows = 0
+        self.n_returns = 0
+
+    # -- autoscaling ---------------------------------------------------------
+    def autoscale_tick(self, p_scheds, d_scheds, step_idx) -> str | None:
+        """Once per serve turn: observe the queue imbalance, mutate the
+        borrowed set when the policy fires. Grow borrows the decode
+        replica with the most free pages (never dipping below
+        min_decode dedicated ones); shrink returns the most recent
+        borrow. Returns the action taken (None almost always)."""
+        if self.autoscaler is None:
+            return None
+        p_depth = sum(len(s.waiting) for s in p_scheds) + sum(
+            len(d_scheds[j].waiting) for j in self.borrowed
+        )
+        d_depth = sum(
+            len(s.running) + len(s.waiting)
+            for j, s in enumerate(d_scheds)
+            if j not in self.borrowed
+        )
+        action = self.autoscaler.observe(p_depth, d_depth, step_idx)
+        if action == "grow":
+            dedicated = [
+                j for j in range(len(self.decode)) if j not in self.borrowed
+            ]
+            if len(dedicated) <= self.disagg.autoscale.min_decode:
+                return None
+            j = max(
+                dedicated,
+                key=lambda j: (
+                    d_scheds[j].alloc.num_free,
+                    -len(d_scheds[j].running),
+                    -j,
+                ),
+            )
+            self.borrowed.add(j)
+            self.n_borrows += 1
+            return "grow"
+        if action == "shrink" and self.borrowed:
+            self.borrowed.discard(max(self.borrowed))
+            self.n_returns += 1
+            return "shrink"
+        return None
+
+    def decode_transfer(self, src_j: int, dst_r: int) -> KVTransfer:
+        """Transfer pair for a BORROWED replica's handoffs (decode pool →
+        decode pool), built lazily on first use — one compiled copy
+        program per pair, same as the static prefill→decode grid. The
+        src_j == dst_r pair is legal (the borrowed replica adopts its own
+        radix-donated pages, so the splice path makes it nearly free)."""
+        key = ("d", src_j, dst_r)
+        t = self.transfers.get(key)
+        if t is None:
+            t = self.transfers[key] = KVTransfer(
+                self.decode[src_j], self.decode[dst_r],
+                batch_pages=self.disagg.transfer_pages,
+            )
+        return t
 
     # -- routing -------------------------------------------------------------
     def route_prefill(self, req: Request, schedulers) -> int:
@@ -745,3 +886,93 @@ class DisaggRouter:
             "requests": by_rid,
             "stats": stats,
         }
+
+
+# ---------------------------------------------------------------------------
+# online data-parallel tier
+# ---------------------------------------------------------------------------
+
+class OnlineRouter:
+    """Live-traffic front for the data-parallel tier: one `OnlineFrontend`
+    drive task per replica, with per-request admission decided by the SAME
+    `ReplicaRouter.route` policy the offline loop uses — probed against
+    the frontends' LIVE schedulers, so sticky prefix affinity and
+    free-page load reflect what is resident right now, not a plan.
+
+    `submit()` assigns globally-unique rids (replica frontends must never
+    collide), routes, and delegates — the returned `TokenStream` is the
+    chosen replica's. Each frontend paces itself; there is no cross-
+    replica barrier, which is exactly the pod behavior (replicas step
+    concurrently on their own slices)."""
+
+    def __init__(self, router: ReplicaRouter,
+                 cfg: FrontendConfig = FrontendConfig()):
+        self.router = router
+        self.frontends = [
+            OnlineFrontend(eng, cfg, name=f"replica{r}")
+            for r, eng in enumerate(router.engines)
+        ]
+        self._by_rid: dict[int, int] = {}
+        self._next_rid = 0
+        self.sticky_routed = 0
+
+    def start(self) -> "OnlineRouter":
+        for fe in self.frontends:
+            fe.start()
+        return self
+
+    def submit(self, req: Request, *, deadline_in: int | None = None
+               ) -> TokenStream:
+        if req.rid < 0:
+            req.rid = self._next_rid
+        self._next_rid = max(self._next_rid, req.rid + 1)
+        r, sticky = self.router.route(
+            req, [fe.sched for fe in self.frontends]
+        )
+        self.sticky_routed += int(sticky)
+        self._by_rid[req.rid] = r
+        return self.frontends[r].submit(req, deadline_in=deadline_in)
+
+    def cancel(self, rid: int) -> None:
+        r = self._by_rid.get(rid)
+        if r is not None:
+            self.frontends[r].cancel(rid)
+
+    async def wait_step(self, n: int) -> None:
+        """Until EVERY replica's loop has started turn `n`."""
+        for fe in self.frontends:
+            await fe.wait_step(n)
+
+    async def close(self) -> dict:
+        for fe in self.frontends:
+            await fe.close()
+        return self.stats()
+
+    async def __aenter__(self) -> "OnlineRouter":
+        return self.start()
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    def stats(self) -> dict:
+        per = [fe.stats() for fe in self.frontends]
+        routed = [p["submitted"] for p in per]
+        agg = {
+            "replicas": len(per),
+            "steps": max(p["steps"] for p in per),
+            "submitted": sum(routed),
+            "finished": sum(p["finished"] for p in per),
+            "shed": sum(p["shed"] for p in per),
+            "rejected": sum(p["rejected"] for p in per),
+            "cancelled": sum(p["cancelled"] for p in per),
+            "timed_out": sum(p["timed_out"] for p in per),
+            "preemptions": sum(p["preemptions"] for p in per),
+            "sticky_routed": self.sticky_routed,
+            "requests_per_replica": routed,
+            "balance": round(min(routed) / max(max(routed), 1), 4),
+            "compiled_signatures": max(
+                p["compiled_signatures"] for p in per
+            ),
+            "per_replica": per,
+        }
+        return agg

@@ -110,6 +110,10 @@ class Request:
     # ttft_s stays -1 for requests that never committed a token
     arrived_t: float = -1.0     # wall time the request became servable
     ttft_s: float = -1.0        # time to first committed token (seconds)
+    # adaptive speculation: EWMA of per-block acceptance (accepted/drafted)
+    # for THIS request; starts optimistic so the first blocks draft at full
+    # K and the estimate is earned from real verifier feedback
+    spec_ewma: float = 1.0
 
     @property
     def known(self) -> list:
@@ -176,6 +180,7 @@ class Scheduler:
         draft_source=None,       # speculative.serve_draft.DraftSource
         alloc: PageAllocator | None = None,
         prefix: PrefixCache | None = None,
+        arrival_gating: bool = True,
     ):
         # `alloc`/`prefix` injection is the ENGINE-LIFETIME cache hook:
         # ServingEngine owns one allocator + radix tree and threads them
@@ -210,6 +215,15 @@ class Scheduler:
         self.draft_source = draft_source if self.spec is not None else None
         if self.spec is not None and self.draft_source is None:
             raise ValueError("speculative scheduling needs a draft source")
+        # arrival_gating=False is ONLINE admission: a request's presence in
+        # the queue IS its arrival (the live frontend submits when traffic
+        # actually lands, so `Request.arrival` stops gating and only serves
+        # as trace metadata). The offline serve loops keep gating on.
+        self.arrival_gating = arrival_gating
+        # slots the serve loop has withheld this step (stream backpressure:
+        # a slow consumer pauses ITS OWN slot's rows; pages stay resident,
+        # deadlines keep ticking, nothing else stalls)
+        self.paused: set[int] = set()
         self.waiting: deque[Request] = deque()
         self.running: dict[int, Request] = {}   # slot → request
         self._admit_order: list[int] = []       # slots, oldest admit first
@@ -217,6 +231,7 @@ class Scheduler:
         self._next_rid = 0
         self.n_preemptions = 0
         self.n_timed_out = 0
+        self.n_cancelled = 0
         self.n_cow = 0
         self.n_prefix_hits = 0        # admissions that adopted cached pages
         self.prefill_skipped = 0      # prompt tokens never re-prefilled
@@ -348,7 +363,7 @@ class Scheduler:
             self.prefix.reclaimable() if self.prefix else 0
         )
         head = self.waiting[0]
-        if head.arrival <= step_idx:
+        if not self.arrival_gating or head.arrival <= step_idx:
             match = self._admissible(head, avail)
             if match is not None:
                 return 0, head, match
@@ -358,7 +373,7 @@ class Scheduler:
         # arrived waiter with the highest hit ratio among those that fit
         best = None
         for i, req in enumerate(self.waiting):
-            if req.arrival > step_idx:
+            if self.arrival_gating and req.arrival > step_idx:
                 continue
             match = self._admissible(req, avail)
             if match is None:
@@ -395,13 +410,41 @@ class Scheduler:
             self._donate(slot)
         req = self.running.pop(slot)
         self._admit_order.remove(slot)
+        self.paused.discard(slot)
         self.alloc.free_slot(slot)
         if self.draft_source is not None:
             self.draft_source.release(req)
         return req
 
+    def cancel(self, rid: int, step_idx: int = -1) -> bool:
+        """Evict one request by rid wherever it lives — the mid-stream
+        client-disconnect path. A RUNNING request releases its slot and
+        pages THE SAME CALL (donating completed full pages like any other
+        release, so the allocator identity num_free + cached == num_pages
+        holds the moment this returns); a WAITING one just leaves the
+        queue. Returns False when the rid is unknown (already finished or
+        never submitted) — cancellation of a done request is a no-op, not
+        an error."""
+        for slot, req in list(self.running.items()):
+            if req.rid == rid:
+                req.finish_reason = "cancelled"
+                req.finished_at = step_idx
+                self.finished.append(req)
+                self._release_slot(slot)
+                self.n_cancelled += 1
+                return True
+        for req in self.waiting:
+            if req.rid == rid:
+                self.waiting.remove(req)
+                req.finish_reason = "cancelled"
+                req.finished_at = step_idx
+                self.finished.append(req)
+                self.n_cancelled += 1
+                return True
+        return False
+
     # -- disaggregated prefill/decode handoff -------------------------------
-    def extract_handoffs(self) -> list:
+    def extract_handoffs(self, rids=None) -> list:
         """Pop every running request whose prefill has finished (>= 1
         committed token — its next step would be a pure decode row) for
         migration to a decode-class peer. Returns [(request, n_tokens,
@@ -410,10 +453,17 @@ class Scheduler:
         — the caller decrefs via `release_handoff` after the device copy
         (or on deadline expiry). The release donates full pages to the
         radix tree as usual, so later prompts on THIS replica still hit;
-        the pin covers the partial tail page the tree never takes."""
+        the pin covers the partial tail page the tree never takes.
+
+        `rids` (optional) restricts extraction to those request ids — the
+        autoscaling router's guard: a decode-class replica temporarily
+        serving prefill traffic must hand off ONLY the requests routed to
+        it as prefills, never evacuate its resident decode work."""
         out = []
         for slot, req in list(self.running.items()):
             if not req.generated or req.done:
+                continue
+            if rids is not None and req.rid not in rids:
                 continue
             n = req.fed
             src = list(self.alloc.table(slot))[: pages_for(n, self.page_size)]
@@ -603,8 +653,11 @@ class Scheduler:
         row = 0
         planned = set()
         # decode rows first (pending == 1), then prefill chunks; within each
-        # class oldest admit first
-        order = [s for s in self._admit_order]
+        # class oldest admit first. Paused slots (stream backpressure) get
+        # NO rows this step — they stay resident (page tables below still
+        # carry them) and deadlines keep ticking, but their generation
+        # holds until the serve loop unpauses them.
+        order = [s for s in self._admit_order if s not in self.paused]
         decode = [s for s in order if len(self.running[s].known) - self.running[s].fed == 1]
         prefill = [s for s in order if s not in decode]
         # decode rows not yet handed out: an earlier slot's draft block may
@@ -656,6 +709,21 @@ class Scheduler:
                     req.max_new_tokens - len(req.generated) - 1,
                     self.pages_per_slot * self.page_size - (req.fed + c),
                 )
+                # adaptive draft length (policy-only; the step's fixed
+                # (S, K+1) verify shape is untouched): once a request's
+                # acceptance EWMA falls below the threshold, its block
+                # shrinks proportionally — and collapses to ZERO (plain
+                # decode, no probe blocks) when the estimate decays far
+                # enough, so a hopeless drafter stops burning verify rows
+                # on rollbacks. The collapse is deterministic in the
+                # verifier feedback, so greedy streams stay token-exact.
+                if (
+                    self.spec.adaptive
+                    and req.spec_ewma < self.spec.adaptive_threshold
+                ):
+                    k_cap = min(
+                        k_cap, int(self.spec.draft_len * req.spec_ewma)
+                    )
                 if k_cap > 0:
                     drafts = list(self.draft_source.draft(req, k_cap))[:k_cap]
                 while drafts and not self.alloc.ensure(
@@ -744,6 +812,8 @@ class Scheduler:
                 self.n_drafted += k
                 self.n_accepted += a
                 self.n_spec_steps += 1
+                d = self.spec.adaptive_decay
+                req.spec_ewma = d * req.spec_ewma + (1.0 - d) * (a / k)
             if self.draft_source is not None and not req.done:
                 if frontier_hidden is not None and samples:
                     # the newest committed token + the hidden that produced

@@ -82,6 +82,15 @@ class SpeculativeConfig:
     draft_source: str = "ngram"   # ngram | eagle | dflash
     draft_len: int = 4
     acceptance: str = "greedy"    # greedy | sampled
+    # adaptive draft length (scheduler policy, not engine geometry — the
+    # compiled (S, K+1) verify shape never changes): shrink a slot's block
+    # proportionally once its acceptance EWMA drops below the threshold,
+    # collapsing to plain decode when the estimate decays to nothing. No
+    # probe blocks: a collapsed request stays collapsed (its EWMA freezes),
+    # which is the honest policy for a drafter that has proven useless.
+    adaptive: bool = False
+    adaptive_threshold: float = 0.5   # EWMA below this starts shrinking K
+    adaptive_decay: float = 0.5       # EWMA = decay*old + (1-decay)*(a/k)
     # ngram source: longest/shortest suffix match attempted (prompt lookup)
     ngram_max: int = 3
     ngram_min: int = 1
@@ -102,6 +111,10 @@ class SpeculativeConfig:
             raise ValueError("need 1 <= ngram_min <= ngram_max")
         if self.ngram_window < self.ngram_max + 1:
             raise ValueError("ngram_window must exceed ngram_max")
+        if not (0.0 < self.adaptive_threshold <= 1.0):
+            raise ValueError("adaptive_threshold must be in (0, 1]")
+        if not (0.0 <= self.adaptive_decay < 1.0):
+            raise ValueError("adaptive_decay must be in [0, 1)")
 
 
 class DraftSource:
