@@ -34,6 +34,15 @@ Rule catalog (docs/ANALYSIS.md has the long form):
   it propagate; a bare except defeats that, and an ``except Exception``
   around the retry layer masks ``RetryBudgetExhausted``/``FaultError``
   escalation the resilience tests rely on.
+- **AM106 telemetry-in-jit** — an observability record/span call
+  (``tracer.instant``/``tracer.span``/``obs.observe_step``/
+  ``obs.flight_dump``, or ``registry.counter``/``gauge``/``histogram``)
+  inside a function reachable from a jitted entry point. The observability
+  layer is host-side Python by contract (docs/OBSERVABILITY.md): under
+  trace such a call runs ONCE at compile time, records tracer-level
+  abstract values instead of per-step data, and then silently vanishes
+  from the compiled program — the metric looks wired but never ticks.
+  Record around the jitted step, from the host loop.
 
 Reachability is a package-wide over-approximation: from every jit root
 (decorated ``@jax.jit``/``@partial(jax.jit, ...)``, wrapped
@@ -60,6 +69,7 @@ RULES = {
     "AM103": "recompile-hazard: non-static bool/str-defaulted param on a jitted function",
     "AM104": "missing-donate: step-shaped jit threads large state without donation",
     "AM105": "crash-swallow: except block that can swallow FaultCrash / retry failures",
+    "AM106": "telemetry-in-jit: observability record/span call in a compiled path",
 }
 
 # AM101 tokens
@@ -82,6 +92,13 @@ _STEP_FIRST_PARAMS = {"state", "train_state", "pool", "carry", "opt_state"}
 _RETRY_FUNCS = {"retry_call", "fault_hit", "save_hf_checkpoint"}
 _RETRY_METHODS = {"save", "restore", "wait"}
 _RETRY_RECV = re.compile(r"checkpoint|ckpt|reader|retry", re.IGNORECASE)
+# AM106 telemetry surfaces: span/record method names gated on the receiver
+# looking like a tracer / metrics registry / observability bundle (same
+# receiver-shape heuristic as the AM105 retry surfaces)
+_TELEM_SPAN_METHODS = {"instant", "span", "observe_step", "flight_dump"}
+_TELEM_SPAN_RECV = re.compile(r"trace|obs|telemetry", re.IGNORECASE)
+_TELEM_REG_METHODS = {"counter", "gauge", "histogram"}
+_TELEM_REG_RECV = re.compile(r"registry|metric|obs", re.IGNORECASE)
 
 _SUPPRESS = re.compile(r"#\s*lint-ok:\s*([A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*)")
 
@@ -486,6 +503,30 @@ class Linter:
                 f"`np.random.{f.attr}` inside jit-reachable `{qual}` is "
                 "host RNG baked in at trace time; use jax.random",
             )
+        else:
+            recv = ""
+            if isinstance(v, ast.Name):
+                recv = v.id
+            elif isinstance(v, ast.Attribute):
+                recv = v.attr
+            if f.attr in _TELEM_SPAN_METHODS and _TELEM_SPAN_RECV.search(recv):
+                self._emit(
+                    "AM106", mod, node, qual, f"{recv}.{f.attr}",
+                    f"telemetry call `{recv}.{f.attr}` inside jit-reachable "
+                    f"`{qual}`: tracer/observability calls are host-side "
+                    "Python — under trace they run once at compile time and "
+                    "record nothing per step; record from the host loop "
+                    "around the jitted step",
+                )
+            elif f.attr in _TELEM_REG_METHODS and _TELEM_REG_RECV.search(recv):
+                self._emit(
+                    "AM106", mod, node, qual, f"{recv}.{f.attr}",
+                    f"metrics-registry call `{recv}.{f.attr}` inside "
+                    f"jit-reachable `{qual}`: the registry is host-side — a "
+                    "counter touched under trace increments once at compile "
+                    "time and never again; move the record out of the "
+                    "compiled path",
+                )
 
     # AM103 + AM104: jitted signature checks
     def _check_jit_signature(self, s: _JitSite) -> None:

@@ -56,6 +56,14 @@ YAML:
         max_waiting: null
         shed_deadlines: true
         shed_safety: 1.0
+      observability:                  # typed: ObservabilityConfig
+        enabled: false                # span/event tracing + flight recorder
+        trace_path: null              # export prefix (null → run_dir/serve)
+        flight_recorder_len: 256      # ring dumped on crash/stall
+        profile_window: null          # [start_step, num_steps] jax.profiler
+        itl_spike_ms: null            # ...or capture on a step-time spike
+        profile_dir: null
+        http_port: null               # live /metrics + /healthz (online mode)
     max_requests: 64
 
 With `serving.online.enabled`, the SAME request stream is driven through
@@ -189,6 +197,7 @@ class ServeRecipe(TrainFinetuneRecipeForNextTokenPrediction):
             prefix_cache=self.typed.serving_prefix_cache,
             speculative=self.typed.serving_speculative,
             admission_policy=str(get("admission_policy", "fifo")),
+            observability=self.typed.serving_observability,
         )
         params = self.train_state.params
         if self.peft_cfg is not None:
@@ -235,6 +244,7 @@ class ServeRecipe(TrainFinetuneRecipeForNextTokenPrediction):
             router = DisaggRouter(
                 params, self.model_cfg, serve_cfg, disagg, mesh=mesh_arg,
             )
+            obs = router.obs
             if online:
                 from automodel_tpu.serving import DisaggOnlineFrontend
 
@@ -250,6 +260,7 @@ class ServeRecipe(TrainFinetuneRecipeForNextTokenPrediction):
             router = ReplicaRouter(
                 params, self.model_cfg, serve_cfg, serve_mesh
             )
+            obs = router.obs
             if online:
                 from automodel_tpu.serving import OnlineRouter
 
@@ -264,6 +275,7 @@ class ServeRecipe(TrainFinetuneRecipeForNextTokenPrediction):
             engine = ServingEngine(
                 params, self.model_cfg, serve_cfg, mesh_ctx=ctx
             )
+            obs = engine.obs
             if online:
                 from automodel_tpu.serving import OnlineFrontend
 
@@ -275,6 +287,26 @@ class ServeRecipe(TrainFinetuneRecipeForNextTokenPrediction):
                 res = engine.serve_batch(
                     reqs, metric_logger=serve_logger, log_every=16,
                 )
+        if obs.enabled:
+            # end-of-run exports: Perfetto/JSONL trace, the Prometheus
+            # snapshot, and the TTFT/ITL attribution block (phase
+            # components sum to the measured median TTFT by construction)
+            from automodel_tpu.observability import attribution_summary
+
+            run_dir = cfg.get("run_dir", ".")
+            paths = obs.export(
+                obs.cfg.trace_path or os.path.join(run_dir, "serve")
+            )
+            attr = attribution_summary(list(obs.tracer.events))
+            res["stats"]["latency_attribution"] = attr
+            prom_path = os.path.join(run_dir, "metrics.prom")
+            with open(prom_path, "w") as f:
+                f.write(obs.registry.snapshot_prometheus())
+            serve_logger.log({
+                "metric": "latency_attribution", **attr,
+                "trace_paths": paths, "prometheus": prom_path,
+            })
+            obs.close()
         serve_logger.close()
         tokenizer = getattr(self, "_tokenizer", None)
         out_path = os.path.join(cfg.get("run_dir", "."), "generations.jsonl")

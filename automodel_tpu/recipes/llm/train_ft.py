@@ -162,8 +162,6 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         for t in self.trackers:
             t.log_config(cfg.to_dict(redact=True))
 
-        from automodel_tpu.utils.profiling import ProfilingConfig
-
         self.profiler = self.typed.profiling.build()
 
         seq_len = int(cfg.get("dataset.seq_len", 512))

@@ -112,7 +112,7 @@ class RecipeConfig:
 
     @property
     def profiling(self):
-        from automodel_tpu.utils.profiling import ProfilingConfig
+        from automodel_tpu.observability.profiler import ProfilingConfig
 
         return self._section("profiling", ProfilingConfig)
 
@@ -205,6 +205,25 @@ class RecipeConfig:
             sub = node.get("online") if node is not None else None
             self._cache[key] = dataclass_from_node(
                 FrontendConfig, sub, allow=("enabled", "deadline_steps"),
+            )
+        return self._cache[key]
+
+    @property
+    def serving_observability(self):
+        """`serving.observability` section → ObservabilityConfig (defaults
+        to fully disabled when absent — the serve path is then
+        byte-identical to a build without the observability package)."""
+        from automodel_tpu.observability import ObservabilityConfig
+
+        key = ("serving.observability", "ObservabilityConfig")
+        if key not in self._cache:
+            node = self.raw.get("serving")
+            sub = node.get("observability") if node is not None else None
+            extra = {}
+            if sub is not None and sub.get("profile_window") is not None:
+                extra["profile_window"] = tuple(sub.get("profile_window"))
+            self._cache[key] = dataclass_from_node(
+                ObservabilityConfig, sub, **extra
             )
         return self._cache[key]
 

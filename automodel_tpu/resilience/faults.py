@@ -22,6 +22,9 @@ Named points wired into the codebase:
   the params so the step's gradients are non-finite)
 - ``sigterm``            — train loop, at step k (flag: recipe raises the
   scheduler's SIGTERM flag, exercising the emergency-checkpoint path)
+- ``serve_step``         — serving loop (`ServingEngine.serve_batch`),
+  probed once per loop turn; a ``crash`` here exercises the
+  observability flight recorder's crash dump
 
 Modes: ``error`` raises :class:`FaultError` (a retryable transient),
 ``crash`` raises :class:`FaultCrash` (a BaseException — simulates the

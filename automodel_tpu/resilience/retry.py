@@ -25,6 +25,19 @@ from typing import Callable, Optional
 logger = logging.getLogger(__name__)
 
 
+def _count_retry() -> None:
+    # lazy import: keeps this module import-light until a retry actually
+    # fires; the central registry is how dashboards see retry pressure
+    try:
+        from automodel_tpu.observability.metrics import default_registry
+
+        default_registry().counter(
+            "resilience_retries_total", "I/O retries attempted"
+        ).inc()
+    except Exception:  # pragma: no cover — counting must never break retry
+        pass
+
+
 class RetryBudgetExhausted(RuntimeError):
     """All attempts at a retried operation failed."""
 
@@ -87,6 +100,7 @@ def retry_call(
             raise
         except retry_on as e:  # noqa: PERF203 — retry loop by design
             last = e
+            _count_retry()
             delay = policy.delay(attempt, rng) if attempt < attempts else 0.0
             if on_attempt is not None:
                 on_attempt(point, attempt, e, delay)

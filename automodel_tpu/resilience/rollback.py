@@ -134,7 +134,21 @@ class RollbackManager:
                 "diverged beyond auto-recovery"
             )
         snap_step, host, shardings = self._snap
-        self.stats.wasted_steps += max(0, int(step) - snap_step)
+        wasted = max(0, int(step) - snap_step)
+        self.stats.wasted_steps += wasted
+        try:
+            from automodel_tpu.observability.metrics import default_registry
+
+            reg = default_registry()
+            reg.counter(
+                "resilience_rollbacks_total", "rollback restores performed"
+            ).inc()
+            reg.counter(
+                "resilience_wasted_steps_total",
+                "train steps redone after rollback",
+            ).inc(wasted)
+        except Exception:  # pragma: no cover — counting must never block recovery
+            pass
         logger.warning(
             "rolling back: %s at step %d → restoring snapshot from step %d "
             "(%d update(s) discarded; data window is skipped, the stream "

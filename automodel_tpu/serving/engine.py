@@ -86,6 +86,8 @@ from automodel_tpu.ops.paged_attention import (
 from automodel_tpu.ops.norms import rms_norm
 from automodel_tpu.ops.quant import matmul as _mm
 from automodel_tpu.ops.rope import rope_frequencies
+from automodel_tpu.observability import Observability, ObservabilityConfig
+from automodel_tpu.resilience.faults import fault_hit
 from automodel_tpu.serving.kv_pages import (
     PageAllocator,
     apply_defrag,
@@ -131,6 +133,10 @@ class ServingConfig:
     # raises instead of silently serializing the serve loop (the dryrun
     # stages turn this on; see docs/ANALYSIS.md)
     guard_transfers: bool = False
+    # host-side tracing/metrics/profiling (automodel_tpu/observability/);
+    # None/disabled → null tracer, the jitted step is byte-identical and
+    # the serve loop pays two attribute lookups per probe
+    observability: ObservabilityConfig | None = None
 
     def __post_init__(self):
         assert self.page_size >= 1 and self.num_pages >= 1
@@ -194,6 +200,8 @@ class ServingEngine:
         serve_cfg: ServingConfig = ServingConfig(),
         draft_source=None,
         mesh_ctx=None,
+        obs: Observability | None = None,
+        track: str = "engine",
     ):
         from automodel_tpu.models.moe_lm.het_moe import HetMoEConfig
 
@@ -204,6 +212,14 @@ class ServingEngine:
             )
         self.cfg = cfg
         self.serve_cfg = serve_cfg
+        # observability bundle: routers pass ONE shared bundle to every
+        # engine (distinct track names) so a single tracer/registry sees
+        # the whole request lifecycle across replica classes; standalone
+        # engines build their own from the config
+        self.obs = obs if obs is not None else Observability(
+            serve_cfg.observability
+        )
+        self.track = track
         self.is_moe = getattr(cfg, "moe", None) is not None
         self.is_mla = cfg.attention_type == "mla"
         # tp/ep-sharded step (mesh_ctx set): the paged pool becomes a
@@ -694,11 +710,9 @@ class ServingEngine:
         serving run — the fixed-shape contract)."""
         return self._step._cache_size()
 
-    def run_step(self, plan: StepPlan):
-        """Upload one StepPlan, run the jitted step, return numpy outputs:
-        (tokens (S,), logprobs (S,)) plainly, or — with speculation — the
-        committed-candidate block (tokens (S, K+1), logprobs (S, K+1),
-        accept (S,)[, hidden feedback for the draft source])."""
+    def _plan_batch(self, plan: StepPlan) -> dict:
+        """StepPlan → the jitted step's batch dict (the ONE sanctioned
+        host→device upload per step; replicated under a mesh)."""
         if self._mesh is None:
             up = jnp.asarray
         else:
@@ -722,17 +736,70 @@ class ServingEngine:
         if self._spec is not None:
             batch["verify_rows"] = up(plan.verify_rows)
             batch["spec_len"] = up(plan.spec_len)
-        # the StepPlan upload above is the ONE sanctioned host→device copy
-        # per step; with guard_transfers the step invocation itself runs
-        # under transfer_guard("disallow") so any other transfer raises
-        if self.serve_cfg.guard_transfers:
-            with jax.transfer_guard("disallow"):
+        return batch
+
+    def run_step(self, plan: StepPlan):
+        """Upload one StepPlan, run the jitted step, return numpy outputs:
+        (tokens (S,), logprobs (S,)) plainly, or — with speculation — the
+        committed-candidate block (tokens (S, K+1), logprobs (S, K+1),
+        accept (S,)[, hidden feedback for the draft source]).
+
+        Lockstep observability: the step/plan-token/plan-sample counters
+        increment HERE, so a follower replaying broadcast plans
+        (plan_wire.PlanFollower) mirrors the lead's counters exactly —
+        the multi-host CI dryrun asserts that parity."""
+        reg = self.obs.registry
+        reg.counter("serve_steps_total").inc()
+        reg.counter("serve_plan_tokens_total").inc(plan.n_tokens)
+        reg.counter("serve_plan_samples_total").inc(plan.n_samples)
+        with self.obs.tracer.span(
+            "step.run", track=self.track, step=self.steps_run,
+            n_tokens=plan.n_tokens, n_samples=plan.n_samples,
+        ):
+            batch = self._plan_batch(plan)
+            # the StepPlan upload above is the ONE sanctioned host→device
+            # copy per step; with guard_transfers the step invocation runs
+            # under transfer_guard("disallow") so any other transfer raises
+            if self.serve_cfg.guard_transfers:
+                with jax.transfer_guard("disallow"):
+                    out = self._step(self.params, self.pool, batch)
+            else:
                 out = self._step(self.params, self.pool, batch)
-        else:
-            out = self._step(self.params, self.pool, batch)
-        self.pool = out[0]
-        self.steps_run += 1
-        return tuple(np.asarray(x) for x in out[1:])
+            self.pool = out[0]
+            self.steps_run += 1
+            return tuple(np.asarray(x) for x in out[1:])
+
+    def empty_plan(self) -> StepPlan:
+        """A zero-work StepPlan with the engine's fixed shapes — shape
+        donor for AOT lowering (`lower_step`) and cost analysis."""
+        sc = self.serve_cfg
+        T, S, P = sc.token_budget, sc.max_slots, sc.pages_per_slot
+        plan = StepPlan(
+            tok=np.zeros(T, np.int32),
+            slot=np.full(T, -1, np.int32),
+            pos=np.full(T, -1, np.int32),
+            page=np.zeros(T, np.int32),
+            off=np.zeros(T, np.int32),
+            page_tables=np.zeros((S, P), np.int32),
+            sample_tok=np.full(S, -1, np.int32),
+            temp=np.zeros(S, np.float32),
+            seed=np.zeros(S, np.int32),
+            cow_src=np.zeros(S, np.int32),
+            cow_dst=np.zeros(S, np.int32),
+        )
+        if self._spec is not None:
+            K = self._spec.draft_len
+            plan.verify_rows = np.zeros((S, K + 1), np.int32)
+            plan.spec_len = np.zeros(S, np.int32)
+        return plan
+
+    def lower_step(self, plan: StepPlan | None = None):
+        """AOT-lower the jitted step for `plan`'s shapes (default: the
+        engine's fixed geometry). Lowering/compiling through the AOT path
+        does NOT populate the jit call cache, so `step_cache_size()` —
+        the compile-once contract — is unaffected."""
+        batch = self._plan_batch(plan if plan is not None else self.empty_plan())
+        return self._step.lower(self.params, self.pool, batch)
 
     def run_and_absorb(
         self, sched: Scheduler, plan: StepPlan, step_idx: int,
@@ -747,7 +814,12 @@ class ServingEngine:
         t0 = time.perf_counter()
         out = self.run_step(plan)
         dt = time.perf_counter() - t0
-        return self.absorb_outputs(sched, plan, out, step_idx), dt
+        self.obs.observe_step(self.steps_run, dt * 1e3)
+        with self.obs.tracer.span(
+            "step.absorb", track=self.track, step=self.steps_run
+        ):
+            n_new = self.absorb_outputs(sched, plan, out, step_idx)
+        return n_new, dt
 
     def absorb_outputs(
         self, sched: Scheduler, plan: StepPlan, out, step_idx: int,
@@ -780,11 +852,37 @@ class ServingEngine:
         inner loop of the offline `serve_batch` below and the async online
         frontend (serving/frontend.py), which drives it from an event loop
         with live admission between calls."""
-        plan = sched.schedule(step_idx)
+        with self.obs.tracer.span(
+            "step.plan", track=self.track, step=self.steps_run
+        ):
+            plan = sched.schedule(step_idx)
         if plan is None:
             return None, 0, 0.0
         n_new, dt = self.run_and_absorb(sched, plan, step_idx)
         return plan, n_new, dt
+
+    def _mirror_stats(self, stats: dict, sched: Scheduler) -> None:
+        """Land one serve_batch call's outcome counters on the central
+        registry (per-call deltas — the registry keeps lifetime totals)."""
+        reg = self.obs.registry
+        for name, key in (
+            ("serve_new_tokens_total", "new_tokens"),
+            ("serve_requests_total", "requests"),
+            ("serve_preemptions_total", "preemptions"),
+            ("serve_timed_out_total", "timed_out"),
+            ("serve_prefix_hits_total", "prefix_hits"),
+            ("serve_prefill_skipped_tokens_total", "prefill_skipped_tokens"),
+            ("serve_cow_copies_total", "cow_copies"),
+            ("serve_spec_drafted_total", "drafted_tokens"),
+            ("serve_spec_accepted_total", "accepted_tokens"),
+            ("serve_spec_rolled_back_total", "rolled_back_tokens"),
+            ("serve_spec_steps_total", "spec_steps"),
+        ):
+            if key in stats:
+                reg.counter(name).inc(stats[key])
+        reg.counter("serve_cancelled_total").inc(sched.n_cancelled)
+        reg.gauge("serve_compiled_signatures").set(stats["compiled_signatures"])
+        reg.gauge("serve_free_pages").set(sched.alloc.num_free)
 
     def make_scheduler(self, *, arrival_gating: bool = True) -> Scheduler:
         sc = self.serve_cfg
@@ -804,6 +902,7 @@ class ServingEngine:
             spec=self._spec, draft_source=self._draft_source,
             alloc=self.alloc, prefix=self.prefix,
             arrival_gating=arrival_gating,
+            tracer=self.obs.tracer, track=self.track,
         )
 
     def reset_prefix_cache(self) -> int:
@@ -833,7 +932,34 @@ class ServingEngine:
         """Offline continuous-batching run: drive steps until every request
         finished. Returns {"outputs": [generated ids per request, submission
         order], "requests": finished Request objects, "stats": counters}.
+
+        On any abnormal exit the observability flight recorder dumps its
+        ring of recent trace events (reason "stall" for the pool-deadlock
+        RuntimeError below, "crash" for everything else — including
+        injected FaultCrash, which is a BaseException) before re-raising.
         """
+        try:
+            return self._serve_batch(
+                requests, metric_logger=metric_logger,
+                max_steps=max_steps, log_every=log_every,
+            )
+        except RuntimeError as e:
+            self.obs.flight_dump(
+                "stall" if str(e).startswith("serving stalled") else "crash"
+            )
+            raise
+        except BaseException:
+            self.obs.flight_dump("crash")
+            raise
+
+    def _serve_batch(
+        self,
+        requests: list[Request],
+        *,
+        metric_logger=None,
+        max_steps: int | None = None,
+        log_every: int = 0,
+    ) -> dict:
         sched = self.make_scheduler()
         for r in requests:
             sched.submit(r)
@@ -847,6 +973,10 @@ class ServingEngine:
         ttft_watch: list = []  # arrived requests awaiting their first token
         step_idx = 0
         while sched.has_work and step_idx < budget:
+            # chaos probe (resilience/faults.py "serve_step"): disarmed it
+            # is two dict lookups; an injected crash exercises the flight
+            # recorder's crash dump in serve_batch
+            fault_hit("serve_step", step_idx)
             _stamp_arrivals(sched.waiting, step_idx, ttft_watch)
             plan, n_new, dt = self.run_one_step(sched, step_idx)
             if plan is None:
@@ -967,6 +1097,7 @@ class ServingEngine:
                     / max(sched.n_spec_steps, 1), 4
                 ),
             })
+        self._mirror_stats(stats, sched)
         if metric_logger is not None:
             metric_logger.log({"step": self.steps_run, **{
                 f"serve_{k}": v for k, v in stats.items()

@@ -69,6 +69,7 @@ import time
 
 import numpy as np
 
+from automodel_tpu.observability import NULL_OBSERVABILITY
 from automodel_tpu.serving.plan_wire import pack_plan, pack_stop
 from automodel_tpu.serving.scheduler import Request, Scheduler
 
@@ -153,6 +154,52 @@ class TokenStream:
         return self._q.qsize()
 
 
+def _trace_pause_edges(tracer, track: str, step: int,
+                       prev: set, now: set) -> None:
+    """Emit stream.pause / stream.resume instants only on EDGES of the
+    per-turn paused set — the timeline layer pairs them into intervals
+    to subtract consumer backpressure from TTFT/ITL attribution."""
+    for rid in now - prev:
+        tracer.instant("stream.pause", track=track, step=step, rid=rid)
+    for rid in prev - now:
+        tracer.instant("stream.resume", track=track, step=step, rid=rid)
+
+
+async def _handle_metrics_http(frontend, reader, writer) -> None:
+    """Minimal one-shot HTTP handler: GET /metrics serves the registry's
+    Prometheus text exposition (gauges refreshed via stats() first) and
+    GET /healthz reports liveness. Deliberately tiny — no routing library,
+    no keep-alive — because it shares the serve event loop and must never
+    be able to stall it."""
+    try:
+        request = await reader.readline()
+        while True:  # drain headers; we never need them
+            line = await reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+        parts = request.split()
+        path = parts[1].decode("ascii", "replace") if len(parts) > 1 else "/"
+        if path == "/metrics":
+            frontend.stats()  # refresh gauges before snapshotting
+            body = frontend.obs.registry.snapshot_prometheus().encode()
+            status, ctype = b"200 OK", b"text/plain; version=0.0.4"
+        elif path == "/healthz":
+            body = b"closed\n" if frontend._closed else b"ok\n"
+            status, ctype = b"200 OK", b"text/plain"
+        else:
+            body, status, ctype = b"not found\n", b"404 Not Found", b"text/plain"
+        writer.write(
+            b"HTTP/1.1 " + status + b"\r\nContent-Type: " + ctype
+            + b"\r\nContent-Length: " + str(len(body)).encode()
+            + b"\r\nConnection: close\r\n\r\n" + body
+        )
+        await writer.drain()
+    except Exception:  # pragma: no cover — a bad client must not kill serving
+        pass
+    finally:
+        writer.close()
+
+
 class OnlineFrontend:
     """Async streaming serve loop over ONE engine (single-chip or a
     tp/ep-sharded mesh slice). `start()` launches the drive task;
@@ -205,6 +252,12 @@ class OnlineFrontend:
         self.n_rejected = 0
         self.itl_ewma_s: float | None = None   # wall ITL (reporting only)
         self._sha = hashlib.sha1()             # lockstep digest (broadcast)
+        # observability: share the engine's bundle (same registry/tracer)
+        self.obs = getattr(engine, "obs", None) or NULL_OBSERVABILITY
+        self._paused_rids: set = set()         # pause/resume edge detection
+        self._http_server = None
+        self._http_task: asyncio.Task | None = None
+        self.http_port: int | None = None      # bound /metrics port, once up
 
     # -- client API ---------------------------------------------------------
     def submit(self, req: Request, *, deadline_in: int | None = None
@@ -220,6 +273,14 @@ class OnlineFrontend:
         self._next_rid = max(self._next_rid, req.rid + 1)
         stream = TokenStream(req)
         self.n_submitted += 1
+        self.obs.registry.counter(
+            "frontend_submitted_total", "requests submitted to the frontend"
+        ).inc()
+        self.obs.tracer.instant(
+            "frontend.submit", track=self.name, step=self.step_idx,
+            rid=req.rid, prompt_len=len(req.prompt),
+            max_new=req.max_new_tokens,
+        )
         self._arrivals.put_nowait((req, stream, deadline_in))
         return stream
 
@@ -232,6 +293,8 @@ class OnlineFrontend:
     def start(self) -> "OnlineFrontend":
         if self._task is None:
             self._task = asyncio.ensure_future(self._drive())
+            if self.obs.cfg.http_port is not None:
+                self._http_task = asyncio.ensure_future(self._serve_http())
         return self
 
     async def close(self) -> dict:
@@ -241,6 +304,13 @@ class OnlineFrontend:
         if self._task is not None:
             await self._task
             self._task = None
+        if self._http_task is not None:
+            await self._http_task
+            self._http_task = None
+        if self._http_server is not None:
+            self._http_server.close()
+            await self._http_server.wait_closed()
+            self._http_server = None
         if self.plan_broadcast is not None:
             sc = self.engine.serve_cfg
             self.plan_broadcast.send(pack_stop(
@@ -311,6 +381,7 @@ class OnlineFrontend:
                 None, functools.partial(self.engine.run_step, plan)
             )
             dt = time.perf_counter() - t0
+            self.obs.observe_step(self.step_idx, dt * 1e3)
             self._sha.update(np.ascontiguousarray(out[0]).tobytes())
             n_new = self.engine.absorb_outputs(
                 self.sched, plan, out, self.step_idx
@@ -318,6 +389,9 @@ class OnlineFrontend:
             self.steps_run += 1
             if n_new:
                 itl = dt / n_new
+                self.obs.registry.histogram(
+                    "request_itl_ms", "inter-token latency (ms)"
+                ).observe(itl * 1e3)
                 d = self.cfg.itl_decay
                 self.itl_ewma_s = (
                     itl if self.itl_ewma_s is None
@@ -338,6 +412,9 @@ class OnlineFrontend:
 
     def _cancel_now(self, rid: int) -> None:
         if self.sched.cancel(rid, self.step_idx):
+            self.obs.registry.counter(
+                "frontend_cancelled_total", "streams cancelled by the caller"
+            ).inc()
             self._finish_stream(rid)
 
     def _drain_arrivals(self) -> None:
@@ -349,18 +426,18 @@ class OnlineFrontend:
             if deadline_in is not None:
                 req.deadline = self.step_idx + deadline_in
             if self._closed:
-                self._shed_one(req, "shed")
+                self._shed_one(req, "shed", why="closed")
                 continue
             if (
                 self.cfg.max_waiting is not None
                 and len(self.sched.waiting) >= self.cfg.max_waiting
             ):
-                self._shed_one(req, "shed")
+                self._shed_one(req, "shed", why="queue_full")
                 continue
             if self.cfg.shed_deadlines and not self._reachable(
                 req, self._backlog() + self._waiting_backlog()
             ):
-                self._shed_one(req, "shed")
+                self._shed_one(req, "shed", why="deadline")
                 continue
             try:
                 self.sched.submit(req)
@@ -369,14 +446,26 @@ class OnlineFrontend:
                 # instead of crashing the loop every other client shares
                 self._shed_one(req, "rejected")
 
-    def _shed_one(self, req: Request, reason: str) -> None:
+    def _shed_one(self, req: Request, reason: str,
+                  why: str | None = None) -> None:
         req.finish_reason = reason
         req.finished_at = self.step_idx
         self.sched.finished.append(req)
         if reason == "rejected":
             self.n_rejected += 1
+            self.obs.registry.counter(
+                "frontend_rejected_total", "submissions rejected at admission"
+            ).inc()
         else:
             self.n_shed += 1
+            self.obs.registry.counter(
+                "frontend_shed_total", "requests shed (labeled by reason)",
+                reason=why or reason,
+            ).inc()
+        self.obs.tracer.instant(
+            "request.shed", track=self.name, step=self.step_idx,
+            rid=req.rid, reason=why or reason,
+        )
         self._finish_stream(req.rid)
 
     # -- load shedding -------------------------------------------------------
@@ -416,7 +505,7 @@ class OnlineFrontend:
         for req in list(self.sched.waiting):
             if not self._reachable(req, backlog):
                 self.sched.waiting.remove(req)
-                self._shed_one(req, "shed")
+                self._shed_one(req, "shed", why="deadline")
             else:
                 backlog += len(req.known) - req.fed
 
@@ -428,12 +517,19 @@ class OnlineFrontend:
         step loop never blocks on a slow reader."""
         self.sched.paused.clear()
         room_needed = 1 + self._draft_len
+        now_paused = set()
         for slot, req in self.sched.running.items():
             entry = self._active.get(req.rid)
             if entry is None:
                 continue
             if entry[1]._lag() + room_needed > self.cfg.stream_buffer:
                 self.sched.paused.add(slot)
+                now_paused.add(req.rid)
+        _trace_pause_edges(
+            self.obs.tracer, self.name, self.step_idx,
+            self._paused_rids, now_paused,
+        )
+        self._paused_rids = now_paused
 
     def _emit(self) -> None:
         """Push newly committed tokens to their streams, in commit order;
@@ -444,6 +540,9 @@ class OnlineFrontend:
             if new:
                 if req.ttft_s < 0 and req.arrived_t >= 0:
                     req.ttft_s = time.perf_counter() - req.arrived_t
+                    self.obs.registry.histogram(
+                        "request_ttft_ms", "time to first token (ms)"
+                    ).observe(req.ttft_s * 1e3)
                 for tok in new:
                     stream._push(tok)
                 self._emitted[rid] = sent + len(new)
@@ -455,14 +554,54 @@ class OnlineFrontend:
         self._emitted.pop(rid, None)
         if entry is not None:
             entry[1]._end()
+            self.obs.registry.counter(
+                "frontend_finished_total", "streams finished (any reason)"
+            ).inc()
+            if rid in self._paused_rids:
+                # close the open pause so the timeline's pause intervals pair
+                self._paused_rids.discard(rid)
+                self.obs.tracer.instant(
+                    "stream.resume", track=self.name,
+                    step=self.step_idx, rid=rid,
+                )
 
     def _abort_resident(self) -> None:
         for rid in list(self._active):
             self._cancel_now(rid)
 
+    # -- metrics endpoint ----------------------------------------------------
+    async def _serve_http(self) -> None:
+        self._http_server = await asyncio.start_server(
+            self._handle_http, "127.0.0.1", self.obs.cfg.http_port
+        )
+        self.http_port = self._http_server.sockets[0].getsockname()[1]
+
+    async def http_address(self) -> tuple:
+        """(host, port) of the /metrics endpoint, once it is listening."""
+        if self._http_task is not None:
+            await self._http_task
+        if self.http_port is None:
+            raise RuntimeError("observability.http_port is not configured")
+        return ("127.0.0.1", self.http_port)
+
+    async def _handle_http(self, reader, writer) -> None:
+        await _handle_metrics_http(self, reader, writer)
+
     # -- reporting ----------------------------------------------------------
     def stats(self) -> dict:
         s = self.sched
+        reg = self.obs.registry
+        reg.gauge("frontend_running", "requests resident in slots"
+                  ).set(len(s.running))
+        reg.gauge("frontend_waiting", "requests queued for admission"
+                  ).set(len(s.waiting))
+        reg.gauge("frontend_paused", "slots paused for stream backpressure"
+                  ).set(len(s.paused))
+        if self.itl_ewma_s is not None:
+            reg.gauge(
+                "frontend_itl_ewma_ms",
+                "decayed inter-token latency estimate (ms)",
+            ).set(self.itl_ewma_s * 1e3)
         return {
             "steps": self.steps_run,
             "submitted": self.n_submitted,
@@ -533,6 +672,15 @@ class DisaggOnlineFrontend:
         self.n_rejected = 0
         self.n_cancelled_inflight = 0
         self.itl_ewma_s: float | None = None
+        self.name = "frontend"
+        # router-shared bundle when the router built one; else borrow the
+        # first prefill engine's (every engine owns at least a null bundle)
+        self.obs = (
+            getattr(router, "obs", None)
+            or getattr(router.prefill[0], "obs", None)
+            or NULL_OBSERVABILITY
+        )
+        self._paused_rids: set = set()
 
     # -- client API ---------------------------------------------------------
     def submit(self, req: Request, *, deadline_in: int | None = None
@@ -544,6 +692,14 @@ class DisaggOnlineFrontend:
         self._next_rid = max(self._next_rid, req.rid + 1)
         stream = TokenStream(req)
         self.n_submitted += 1
+        self.obs.registry.counter(
+            "frontend_submitted_total", "requests submitted to the frontend"
+        ).inc()
+        self.obs.tracer.instant(
+            "frontend.submit", track=self.name, step=self.step_idx,
+            rid=req.rid, prompt_len=len(req.prompt),
+            max_new=req.max_new_tokens,
+        )
         self._arrivals.put_nowait((req, stream, deadline_in))
         return stream
 
@@ -638,6 +794,7 @@ class DisaggOnlineFrontend:
                 ),
             )
             dt = time.perf_counter() - t0
+            self.obs.observe_step(self.step_idx, dt * 1e3)
             n_new = 0
             for eng, sched, plan, out in outs:
                 n_new += eng.absorb_outputs(sched, plan, out, self.step_idx)
@@ -660,6 +817,9 @@ class DisaggOnlineFrontend:
             self.steps_run += 1
             if n_new:
                 itl = dt / n_new
+                self.obs.registry.histogram(
+                    "request_itl_ms", "inter-token latency (ms)"
+                ).observe(itl * 1e3)
                 d = self.cfg.itl_decay
                 self.itl_ewma_s = (
                     itl if self.itl_ewma_s is None
@@ -683,7 +843,7 @@ class DisaggOnlineFrontend:
             if deadline_in is not None:
                 req.deadline = self.step_idx + deadline_in
             if self._closed:
-                self._shed_one(req, "shed")
+                self._shed_one(req, "shed", why="closed")
                 continue
             # the prefill ROUTING SET: the prefill class plus any decode
             # replicas the autoscaler has borrowed for it
@@ -701,12 +861,12 @@ class DisaggOnlineFrontend:
                 self.cfg.max_waiting is not None
                 and len(sched.waiting) >= self.cfg.max_waiting
             ):
-                self._shed_one(req, "shed")
+                self._shed_one(req, "shed", why="queue_full")
                 continue
             if self.cfg.shed_deadlines and not self._reachable(
                 req, sched, self._sched_backlog(sched, waiting=True)
             ):
-                self._shed_one(req, "shed")
+                self._shed_one(req, "shed", why="deadline")
                 continue
             try:
                 sched.submit(req)
@@ -740,18 +900,30 @@ class DisaggOnlineFrontend:
             for req in list(sched.waiting):
                 if not self._reachable(req, sched, backlog):
                     sched.waiting.remove(req)
-                    self._shed_one(req, "shed")
+                    self._shed_one(req, "shed", why="deadline")
                 else:
                     backlog += len(req.known) - req.fed
 
-    def _shed_one(self, req: Request, reason: str) -> None:
+    def _shed_one(self, req: Request, reason: str,
+                  why: str | None = None) -> None:
         req.finish_reason = reason
         req.finished_at = self.step_idx
         self.d_scheds[0].finished.append(req)
         if reason == "rejected":
             self.n_rejected += 1
+            self.obs.registry.counter(
+                "frontend_rejected_total", "submissions rejected at admission"
+            ).inc()
         else:
             self.n_shed += 1
+            self.obs.registry.counter(
+                "frontend_shed_total", "requests shed (labeled by reason)",
+                reason=why or reason,
+            ).inc()
+        self.obs.tracer.instant(
+            "request.shed", track=self.name, step=self.step_idx,
+            rid=req.rid, reason=why or reason,
+        )
         self._finish_stream(req.rid)
 
     # -- cancellation --------------------------------------------------------
@@ -772,12 +944,24 @@ class DisaggOnlineFrontend:
                 self.d_scheds[0].finished.append(h.req)
                 self.d_scheds[0].n_cancelled += 1
                 self.n_cancelled_inflight += 1
+                self.obs.registry.counter(
+                    "frontend_cancelled_total",
+                    "streams cancelled by the caller",
+                ).inc()
+                self.obs.tracer.instant(
+                    "request.cancel", track=self.name, step=self.step_idx,
+                    rid=rid, inflight=1,
+                )
                 self._finish_stream(rid)
                 return
         for rids in self._borrow_rids.values():
             rids.discard(rid)
         for sched in self._all_scheds():
             if sched.cancel(rid, self.step_idx):
+                self.obs.registry.counter(
+                    "frontend_cancelled_total",
+                    "streams cancelled by the caller",
+                ).inc()
                 self._finish_stream(rid)
                 return
 
@@ -806,6 +990,14 @@ class DisaggOnlineFrontend:
                 h.req.finished_at = self.step_idx
                 self.d_scheds[0].finished.append(h.req)
                 self.d_scheds[0].n_timed_out += 1
+                self.obs.registry.counter(
+                    "serve_handoff_expired_total",
+                    "handoffs expired before decode admission",
+                ).inc()
+                self.obs.tracer.instant(
+                    "request.expire", track=self.name, step=self.step_idx,
+                    rid=h.req.rid, inflight=1,
+                )
                 self._finish_stream(h.req.rid)
 
     def _admit_inflight(self) -> None:
@@ -816,13 +1008,18 @@ class DisaggOnlineFrontend:
                 )
                 if pairs is None:
                     continue
-                self._transfer(h, r).move(pairs)
+                with self.obs.tracer.span(
+                    "kv_transfer", track=self.name, step=self.step_idx,
+                    rid=h.req.rid, pages=len(pairs),
+                ):
+                    self._transfer(h, r).move(pairs)
                 self._src_sched(h).release_handoff(h.src_pages)
                 self.inflight.remove(h)
                 break
 
     # -- streaming ----------------------------------------------------------
     def _apply_backpressure(self) -> None:
+        now_paused = set()
         for sched in self._all_scheds():
             sched.paused.clear()
             room_needed = 1 + self._draft_len
@@ -832,6 +1029,12 @@ class DisaggOnlineFrontend:
                     continue
                 if entry[1]._lag() + room_needed > self.cfg.stream_buffer:
                     sched.paused.add(slot)
+                    now_paused.add(req.rid)
+        _trace_pause_edges(
+            self.obs.tracer, self.name, self.step_idx,
+            self._paused_rids, now_paused,
+        )
+        self._paused_rids = now_paused
 
     def _emit(self) -> None:
         for rid, (req, stream) in list(self._active.items()):
@@ -840,6 +1043,9 @@ class DisaggOnlineFrontend:
             if new:
                 if req.ttft_s < 0 and req.arrived_t >= 0:
                     req.ttft_s = time.perf_counter() - req.arrived_t
+                    self.obs.registry.histogram(
+                        "request_ttft_ms", "time to first token (ms)"
+                    ).observe(req.ttft_s * 1e3)
                 for tok in new:
                     stream._push(tok)
                 self._emitted[rid] = sent + len(new)
@@ -853,6 +1059,15 @@ class DisaggOnlineFrontend:
         self._emitted.pop(rid, None)
         if entry is not None:
             entry[1]._end()
+            self.obs.registry.counter(
+                "frontend_finished_total", "streams finished (any reason)"
+            ).inc()
+            if rid in self._paused_rids:
+                self._paused_rids.discard(rid)
+                self.obs.tracer.instant(
+                    "stream.resume", track=self.name,
+                    step=self.step_idx, rid=rid,
+                )
 
     def _abort_resident(self) -> None:
         for rid in list(self._active):
@@ -861,6 +1076,20 @@ class DisaggOnlineFrontend:
     # -- reporting ----------------------------------------------------------
     def stats(self) -> dict:
         scheds = self._all_scheds()
+        if hasattr(self.router, "_mirror_transfers"):
+            self.router._mirror_transfers()
+        reg = self.obs.registry
+        reg.gauge("frontend_running", "requests resident in slots"
+                  ).set(sum(len(s.running) for s in scheds))
+        reg.gauge("frontend_waiting", "requests queued for admission"
+                  ).set(sum(len(s.waiting) for s in scheds))
+        reg.gauge("frontend_paused", "slots paused for stream backpressure"
+                  ).set(sum(len(s.paused) for s in scheds))
+        if self.itl_ewma_s is not None:
+            reg.gauge(
+                "frontend_itl_ewma_ms",
+                "decayed inter-token latency estimate (ms)",
+            ).set(self.itl_ewma_s * 1e3)
         return {
             "steps": self.steps_run,
             "submitted": self.n_submitted,

@@ -38,6 +38,7 @@ import time
 import jax
 import numpy as np
 
+from automodel_tpu.observability import Observability
 from automodel_tpu.serving.engine import (
     ServingConfig,
     ServingEngine,
@@ -99,6 +100,42 @@ class ServeMeshConfig:
         ]
 
 
+def _mirror_router_stats(reg, stats: dict) -> None:
+    """Mirror one router serve_batch call's outcome stats onto the central
+    registry. The lockstep step/token counters are incremented inside
+    `ServingEngine.run_step`; these are the per-call outcome counters only
+    the driving loop knows."""
+    for name, key, help_ in (
+        ("serve_new_tokens_total", "new_tokens",
+         "tokens committed to requests"),
+        ("serve_requests_total", "requests",
+         "requests finished by the engine"),
+        ("serve_preemptions_total", "preemptions",
+         "requests preempted and requeued"),
+        ("serve_timed_out_total", "timed_out",
+         "requests expired at their deadline"),
+        ("serve_prefix_hits_total", "prefix_hits",
+         "admissions that matched a cached prefix"),
+        ("serve_prefill_skipped_tokens_total", "prefill_skipped_tokens",
+         "prompt tokens skipped via prefix reuse"),
+        ("serve_handoffs_total", "handoffs",
+         "prefill→decode handoffs admitted"),
+        ("serve_handoff_pages_moved_total", "handoff_pages_moved",
+         "handoff pages moved between pools"),
+        ("serve_handoff_pages_spliced_total", "handoff_pages_spliced",
+         "handoff pages spliced via decode-side prefix match"),
+        ("serve_handoff_expired_total", "handoff_expired",
+         "handoffs expired before decode admission"),
+        ("serve_spec_drafted_total", "drafted_tokens",
+         "draft tokens proposed"),
+        ("serve_spec_accepted_total", "accepted_tokens",
+         "draft tokens accepted"),
+    ):
+        v = stats.get(key)
+        if v:
+            reg.counter(name, help_).inc(v)
+
+
 class ReplicaRouter:
     """N data-parallel `ServingEngine` replicas + per-replica admission."""
 
@@ -118,6 +155,9 @@ class ReplicaRouter:
         must live with the replica that serves the request)."""
         self.mesh = mesh
         ctxs = mesh.build_contexts(devices)
+        # ONE shared observability bundle: replicas interleave on a shared
+        # registry/trace, distinguished by track name
+        self.obs = Observability(serve_cfg.observability)
         self.engines = [
             ServingEngine(
                 params, cfg, serve_cfg,
@@ -125,8 +165,9 @@ class ReplicaRouter:
                     draft_source_factory() if draft_source_factory else None
                 ),
                 mesh_ctx=ctx,
+                obs=self.obs, track=f"replica{r}",
             )
-            for ctx in ctxs
+            for r, ctx in enumerate(ctxs)
         ]
 
     @property
@@ -318,6 +359,7 @@ class ReplicaRouter:
         if any(s.spec is not None for s in scheds):
             stats["drafted_tokens"] = sum(s.n_drafted for s in scheds)
             stats["accepted_tokens"] = sum(s.n_accepted for s in scheds)
+        _mirror_router_stats(self.obs.registry, stats)
         if metric_logger is not None:
             metric_logger.log({
                 f"route_{k}": v for k, v in stats.items() if k != "per_replica"
@@ -506,8 +548,13 @@ class DisaggRouter:
             # single-device engine needs them there anyway); the fresh
             # pools are committed alongside, below.
             params = jax.device_put(params, jax.devices()[0])
+        # ONE shared observability bundle across both replica classes
+        self.obs = Observability(serve_cfg.observability)
         self.prefill = [
-            ServingEngine(params, cfg, prefill_cfg, mesh_ctx=ctxs[i])
+            ServingEngine(
+                params, cfg, prefill_cfg, mesh_ctx=ctxs[i],
+                obs=self.obs, track=f"prefill{i}",
+            )
             for i in range(n_p)
         ]
         self.decode = [
@@ -517,6 +564,7 @@ class DisaggRouter:
                     draft_source_factory() if draft_source_factory else None
                 ),
                 mesh_ctx=ctxs[n_p + i],
+                obs=self.obs, track=f"decode{i}",
             )
             for i in range(n_d)
         ]
@@ -545,6 +593,23 @@ class DisaggRouter:
         )
         self.n_borrows = 0
         self.n_returns = 0
+        # KVTransfer counters are object-lifetime totals; remember what has
+        # already been mirrored so repeated serve calls inc only deltas
+        self._transfer_mirrored = {"chunks": 0, "pages": 0}
+
+    def _mirror_transfers(self) -> None:
+        chunks = sum(t.n_chunks for t in self.transfers.values())
+        pages = sum(t.n_pages for t in self.transfers.values())
+        reg = self.obs.registry
+        reg.counter(
+            "serve_kv_transfer_chunks_total",
+            "fixed-size transfer chunks issued",
+        ).inc(chunks - self._transfer_mirrored["chunks"])
+        reg.counter(
+            "serve_kv_transfer_pages_total",
+            "KV pages shipped by transfers",
+        ).inc(pages - self._transfer_mirrored["pages"])
+        self._transfer_mirrored = {"chunks": chunks, "pages": pages}
 
     # -- autoscaling ---------------------------------------------------------
     def autoscale_tick(self, p_scheds, d_scheds, step_idx) -> str | None:
@@ -710,6 +775,10 @@ class DisaggRouter:
                     h.req.finished_at = step_idx
                     expired.append(h.req)
                     n_expired += 1
+                    self.obs.tracer.instant(
+                        "request.expire", track=f"prefill{h.src}",
+                        step=step_idx, rid=h.req.rid, inflight=1,
+                    )
             # admit in-flight handoffs FIFO; on success move the non-spliced
             # pages device-side and drop the prefill-side pins
             for h in list(inflight):
@@ -719,7 +788,11 @@ class DisaggRouter:
                     )
                     if pairs is None:
                         continue
-                    self.transfers[(h.src, r)].move(pairs)
+                    with self.obs.tracer.span(
+                        "kv_transfer", track=f"prefill{h.src}",
+                        step=step_idx, rid=h.req.rid, pages=len(pairs),
+                    ):
+                        self.transfers[(h.src, r)].move(pairs)
                     p_scheds[h.src].release_handoff(h.src_pages)
                     inflight.remove(h)
                     sticky_routed += int(sticky)
@@ -876,6 +949,8 @@ class DisaggRouter:
         if any(s.spec is not None for s in d_scheds):
             stats["drafted_tokens"] = sum(s.n_drafted for s in d_scheds)
             stats["accepted_tokens"] = sum(s.n_accepted for s in d_scheds)
+        _mirror_router_stats(self.obs.registry, stats)
+        self._mirror_transfers()
         if metric_logger is not None:
             metric_logger.log({
                 f"disagg_{k}": v

@@ -71,6 +71,7 @@ from collections import deque
 
 import numpy as np
 
+from automodel_tpu.observability.trace import NULL_TRACER
 from automodel_tpu.serving.kv_pages import PageAllocator, pages_for
 from automodel_tpu.serving.prefix_cache import (
     PrefixCache,
@@ -181,7 +182,15 @@ class Scheduler:
         alloc: PageAllocator | None = None,
         prefix: PrefixCache | None = None,
         arrival_gating: bool = True,
+        tracer=None,             # observability.trace.Tracer (None → no-op)
+        track: str = "engine",
     ):
+        # lifecycle tracing (observability/trace.py): the null tracer makes
+        # every emit a constant-time no-op, so the untraced hot path is
+        # unchanged. `track` names this scheduler's engine in the exported
+        # timeline (replica0 / prefill1 / decode0 / ...).
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.track = track
         # `alloc`/`prefix` injection is the ENGINE-LIFETIME cache hook:
         # ServingEngine owns one allocator + radix tree and threads them
         # through every scheduler it makes, so cached pages survive across
@@ -268,6 +277,10 @@ class Scheduler:
             req.rid = self._next_rid
         self._next_rid = max(self._next_rid, req.rid + 1)
         self.waiting.append(req)
+        self.tracer.instant(
+            "request.submit", track=self.track, rid=req.rid,
+            prompt_len=len(req.prompt), max_new=req.max_new_tokens,
+        )
 
     @property
     def has_work(self) -> bool:
@@ -327,6 +340,10 @@ class Scheduler:
                 )
                 self.prefill_skipped += match.fed
                 self.n_prefix_hits += 1
+            self.tracer.instant(
+                "request.admit", track=self.track, step=step_idx,
+                rid=req.rid, slot=slot, prefix_fed=match.fed,
+            )
         # FIFO admission (default): if the head doesn't fit, nothing behind
         # it jumps the queue (no starvation of long prompts). Under
         # "prefix-hit", a tight pool admits the best-hit-ratio waiter
@@ -432,6 +449,10 @@ class Scheduler:
                 self.finished.append(req)
                 self._release_slot(slot)
                 self.n_cancelled += 1
+                self.tracer.instant(
+                    "request.cancel", track=self.track, step=step_idx,
+                    rid=rid, resident=1,
+                )
                 return True
         for req in self.waiting:
             if req.rid == rid:
@@ -440,6 +461,10 @@ class Scheduler:
                 req.finished_at = step_idx
                 self.finished.append(req)
                 self.n_cancelled += 1
+                self.tracer.instant(
+                    "request.cancel", track=self.track, step=step_idx,
+                    rid=rid, resident=0,
+                )
                 return True
         return False
 
@@ -471,6 +496,10 @@ class Scheduler:
                 self.alloc.incref(p)
             self._release_slot(slot)
             self.n_handoffs_out += 1
+            self.tracer.instant(
+                "request.handoff_extract", track=self.track, rid=req.rid,
+                n_tokens=n, pages=len(src),
+            )
             out.append((req, n, src))
         return out
 
@@ -550,6 +579,10 @@ class Scheduler:
         table = self.alloc.table(slot)
         pairs = list(zip(src_pages[k:], table[k:P]))
         self.handoff_pages_in += len(pairs)
+        self.tracer.instant(
+            "request.handoff_admit", track=self.track, step=step_idx,
+            rid=req.rid, slot=slot, spliced=k, moved=len(pairs),
+        )
         return pairs
 
     def _preempt_youngest(self, protected) -> bool:
@@ -568,6 +601,10 @@ class Scheduler:
             victim.preemptions += 1
             self.n_preemptions += 1
             self.waiting.appendleft(victim)
+            self.tracer.instant(
+                "request.preempt", track=self.track, rid=victim.rid,
+                preemptions=victim.preemptions,
+            )
             return True
         return False
 
@@ -604,6 +641,10 @@ class Scheduler:
                 self.finished.append(req)
                 self._release_slot(slot)
                 self.n_timed_out += 1
+                self.tracer.instant(
+                    "request.expire", track=self.track, step=step_idx,
+                    rid=req.rid, resident=1,
+                )
         expired = [
             r for r in self.waiting
             if r.deadline is not None and step_idx >= r.deadline
@@ -614,6 +655,10 @@ class Scheduler:
             req.finished_at = step_idx
             self.finished.append(req)
             self.n_timed_out += 1
+            self.tracer.instant(
+                "request.expire", track=self.track, step=step_idx,
+                rid=req.rid, resident=0,
+            )
 
     @property
     def next_deadline(self) -> int | None:
@@ -800,6 +845,16 @@ class Scheduler:
                     req.finish_reason = "length"
                 if req.done:
                     break
+            if n_commit:
+                if len(req.generated) == n_commit:
+                    self.tracer.instant(
+                        "request.first_token", track=self.track,
+                        step=step_idx, rid=req.rid,
+                    )
+                self.tracer.instant(
+                    "request.commit", track=self.track, step=step_idx,
+                    rid=req.rid, n=n_commit,
+                )
             # KV is written for the fed chunk plus the accepted drafts that
             # were actually COMMITTED — an EOS/length cut inside the block
             # discards the tail, whose KV rows roll back with the rejected
@@ -836,6 +891,11 @@ class Scheduler:
                 req.finished_at = step_idx
                 self.finished.append(req)
                 self._release_slot(slot)
+                self.tracer.instant(
+                    "request.done", track=self.track, step=step_idx,
+                    rid=req.rid, reason=req.finish_reason,
+                    n_generated=len(req.generated),
+                )
                 continue
             # donate every newly completed full page while still running, so
             # CONCURRENT requests with the same prefix share immediately

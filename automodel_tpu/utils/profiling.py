@@ -1,65 +1,35 @@
-"""Profiling hooks — the autonvtx analog.
+"""Deprecated shim — profiling moved to `automodel_tpu.observability.profiler`.
 
-The reference wraps modules in NVTX range push/pop hooks
-(reference: nemo_automodel/autonvtx/__init__.py:33-97, enabled by
-`nvtx: true`). The TPU equivalents: `jax.profiler` traces (viewable in
-TensorBoard/XProf/Perfetto) and `jax.named_scope` annotations — plus jit
-already names computations after the jitted function, so a trace of the
-train step decomposes per-op without per-module hooks.
-
-Recipe usage (`profiling:` YAML section):
-
-    profiling: {trace_dir: runs/trace, start_step: 5, num_steps: 3}
+Kept so existing imports (`from automodel_tpu.utils.profiling import
+ProfilingConfig`) and recipe YAML (`profiling:` section) keep working.
+New code should import from `automodel_tpu.observability` directly.
 """
 
 from __future__ import annotations
 
-import dataclasses
-import logging
-from typing import Optional
+import warnings
 
-import jax
+from automodel_tpu.observability.profiler import (  # noqa: F401
+    Profiler,
+    ProfilingConfig,
+    ServeProfiler,
+    annotate,
+    serve_step_cost,
+    step_efficiency,
+)
 
-logger = logging.getLogger(__name__)
+warnings.warn(
+    "automodel_tpu.utils.profiling moved to "
+    "automodel_tpu.observability.profiler; this shim will be removed",
+    DeprecationWarning,
+    stacklevel=2,
+)
 
-
-@dataclasses.dataclass
-class ProfilingConfig:
-    trace_dir: Optional[str] = None
-    start_step: int = 5     # skip compile + warmup steps
-    num_steps: int = 3
-
-    def build(self) -> "Profiler":
-        return Profiler(self)
-
-
-class Profiler:
-    """Step-windowed trace capture; call `step(n)` once per train step."""
-
-    def __init__(self, config: ProfilingConfig):
-        self.config = config
-        self._active = False
-        self.done = False
-
-    def step(self, step_num: int) -> None:
-        c = self.config
-        if c.trace_dir is None or self.done:
-            return
-        if not self._active and step_num >= c.start_step:
-            jax.profiler.start_trace(c.trace_dir)
-            self._active = True
-            logger.info("profiler trace started (step %d) → %s", step_num, c.trace_dir)
-        elif self._active and step_num >= c.start_step + c.num_steps:
-            jax.profiler.stop_trace()
-            self._active = False
-            self.done = True
-            logger.info("profiler trace written to %s", c.trace_dir)
-
-    def close(self) -> None:
-        if self._active:
-            jax.profiler.stop_trace()
-            self._active = False
-            self.done = True
-
-
-annotate = jax.named_scope  # the NVTX-range analog for model code
+__all__ = [
+    "Profiler",
+    "ProfilingConfig",
+    "ServeProfiler",
+    "annotate",
+    "serve_step_cost",
+    "step_efficiency",
+]

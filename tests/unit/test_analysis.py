@@ -209,6 +209,68 @@ def test_am105_plain_except_exception_without_retry_is_clean():
     assert lint_source(src) == []
 
 
+def test_am106_telemetry_in_jit():
+    """Tracer spans and registry records inside a jit-reachable body fire;
+    reachability crosses into helpers like AM101's."""
+    src = textwrap.dedent("""
+        import jax
+        from functools import partial
+
+        def helper(x, registry):
+            registry.counter("serve_steps_total", "steps").inc()
+            return x
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(pool, tracer, registry):
+            tracer.instant("step.begin", step=0)
+            with tracer.span("step.run"):
+                pool = pool * 2
+            return helper(pool, registry)
+    """)
+    fs = lint_source(src)
+    assert _rules(fs) == ["AM106", "AM106", "AM106"]
+    assert {f.token for f in fs} == {
+        "registry.counter", "tracer.instant", "tracer.span",
+    }
+    assert {f.qualname for f in fs} == {"helper", "step"}
+
+
+def test_am106_host_loop_telemetry_is_clean():
+    """The sanctioned pattern — record around the jitted step from the
+    host loop — does not fire, even with obs-shaped receivers in scope."""
+    src = textwrap.dedent("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(pool, tok):
+            return pool + tok
+
+        def run(pool, tok, obs):
+            with obs.tracer.span("step.run", step=1):
+                pool = step(pool, tok)
+            obs.registry.counter("serve_steps_total", "steps").inc()
+            return pool
+    """)
+    assert lint_source(src) == []
+
+
+def test_am106_non_telemetry_receivers_are_clean():
+    """`.span`/`.counter` on receivers that don't look like observability
+    objects (a regex match object's span, a collections.Counter) pass."""
+    src = textwrap.dedent("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(pool, match, bag):
+            a, b = match.span(0)
+            c = bag.counter("x")
+            return pool[a:b] + c
+    """)
+    assert lint_source(src) == []
+
+
 # -- suppression + allowlist --------------------------------------------------
 
 

@@ -1,0 +1,339 @@
+"""The unified observability layer's acceptance contract (docs/OBSERVABILITY.md):
+
+- registry units: counter/gauge/histogram semantics, label series, the
+  kind-conflict tripwire, and the Prometheus text round-trip of every
+  cataloged metric (METRIC_CATALOG ↔ docs table ↔ snapshot_prometheus);
+- tracer units: span nesting validated through the Chrome export, the
+  bounded flight-recorder ring, and the deterministic lifecycle digest
+  (wall clocks / step indices / stream backpressure edges excluded);
+- serving integration: tracing ON changes neither the greedy token stream
+  nor the compile count; two identical online load_test runs produce the
+  SAME digest; disagg TTFT attribution components sum to the measured
+  TTFT exactly; an injected serve-step crash dumps the flight recorder.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu.models.llm import decoder
+from automodel_tpu.models.llm.decoder import TransformerConfig
+from automodel_tpu.observability import (
+    METRIC_CATALOG,
+    NULL_TRACER,
+    MetricsRegistry,
+    Observability,
+    ObservabilityConfig,
+    Tracer,
+    attribute_ttft,
+    attribution_summary,
+    build_timelines,
+    validate_chrome_trace,
+)
+from automodel_tpu.observability.metrics import Counter, Gauge, Histogram
+from automodel_tpu.resilience.faults import FaultCrash, injected
+from automodel_tpu.serving import (
+    DisaggConfig,
+    DisaggRouter,
+    Request,
+    ServingConfig,
+    ServingEngine,
+)
+from automodel_tpu.serving.frontend import FrontendConfig
+from automodel_tpu.serving.load_test import LoadTestConfig, run_load_test
+
+CFG = TransformerConfig(
+    vocab_size=64, hidden_size=32, intermediate_size=48, num_layers=2,
+    num_heads=4, num_kv_heads=2, qk_norm=True, dtype=jnp.float32,
+    remat_policy="none",
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return decoder.init(CFG, jax.random.key(0))
+
+
+def _sc(**kw):
+    return ServingConfig(
+        page_size=4, num_pages=32, max_slots=3, pages_per_slot=6,
+        token_budget=8, prefill_chunk=4, **kw,
+    )
+
+
+def _reqs(lens, seed0=0, max_new=6):
+    return [
+        Request(
+            prompt=[int(t) for t in
+                    np.random.default_rng(seed0 + i).integers(1, 64, (l,))],
+            max_new_tokens=max_new,
+        )
+        for i, l in enumerate(lens)
+    ]
+
+
+# -- registry units ----------------------------------------------------------
+
+
+def test_counter_gauge_histogram_semantics():
+    c = Counter()
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = Gauge()
+    g.set(5)
+    g.inc()
+    g.dec(2)
+    assert g.value == 4.0
+    h = Histogram(bounds=(1.0, 10.0, 100.0))
+    for v in (0.5, 5.0, 50.0, 500.0):
+        h.observe(v)
+    assert h.count == 4 and h.sum == 555.5
+    snap = h.snapshot()
+    assert snap["cumulative"] == [1, 2, 3]  # le-semantics; 500 overflows
+    assert h.percentile(0.5) == 10.0
+    assert h.percentile(1.0) == 100.0  # overflow reports the top bound
+    with pytest.raises(ValueError):
+        Histogram(bounds=(1.0, 1.0))  # must strictly increase
+
+
+def test_registry_kind_conflict_and_label_series():
+    reg = MetricsRegistry()
+    reg.counter("x_total", "things").inc()
+    with pytest.raises(TypeError):
+        reg.gauge("x_total")
+    reg.counter("shed_total", "sheds", reason="deadline").inc(2)
+    reg.counter("shed_total", "sheds", reason="queue_full").inc()
+    snap = reg.snapshot()
+    assert snap['shed_total{reason="deadline"}'] == 2.0
+    assert snap['shed_total{reason="queue_full"}'] == 1.0
+    assert list(snap) == sorted(snap)  # deterministic key order
+
+
+def test_prometheus_exposition_shape():
+    reg = MetricsRegistry()
+    reg.counter("a_total", "a counter").inc(3)
+    reg.histogram("lat_ms", "latency", buckets=(1.0, 10.0)).observe(5.0)
+    text = reg.snapshot_prometheus()
+    assert "# HELP a_total a counter" in text
+    assert "# TYPE a_total counter" in text
+    assert "a_total 3" in text
+    assert 'lat_ms_bucket{le="1"} 0' in text
+    assert 'lat_ms_bucket{le="10"} 1' in text
+    assert 'lat_ms_bucket{le="+Inf"} 1' in text
+    assert "lat_ms_sum 5" in text and "lat_ms_count 1" in text
+
+
+def test_metric_catalog_roundtrips_docs_and_prometheus():
+    """Every cataloged metric appears in docs/OBSERVABILITY.md's catalog
+    table AND in the Prometheus snapshot of a catalog-registered registry;
+    the docs table carries no phantom metrics either."""
+    reg = MetricsRegistry()
+    reg.register_catalog()
+    text = reg.snapshot_prometheus()
+    for name, kind, _help in METRIC_CATALOG:
+        assert f"# TYPE {name} {kind}" in text, name
+    doc = os.path.join(os.path.dirname(__file__), "..", "..", "docs",
+                       "OBSERVABILITY.md")
+    with open(doc, encoding="utf-8") as f:
+        rows = [ln for ln in f if ln.startswith("| `")]
+    documented = {ln.split("`")[1] for ln in rows}
+    assert documented == {name for name, _k, _h in METRIC_CATALOG}
+
+
+# -- tracer units ------------------------------------------------------------
+
+
+def test_tracer_span_nesting_and_exports(tmp_path):
+    tr = Tracer(ring_len=4)
+    with tr.span("step.run", track="engine", step=0):
+        with tr.span("step.absorb", track="engine", step=0):
+            tr.instant("request.commit", track="engine", step=0, rid=1, n=1)
+    tr.instant("request.done", track="other", rid=1, reason="eos")
+    chrome = tmp_path / "t.trace.json"
+    tr.export_chrome(str(chrome))
+    stats = validate_chrome_trace(str(chrome))
+    assert stats == {"events": 6, "spans": 2, "instants": 2, "tracks": 1}
+    jsonl = tmp_path / "t.trace.jsonl"
+    tr.export_jsonl(str(jsonl))
+    lines = [json.loads(ln) for ln in jsonl.read_text().splitlines()]
+    assert len(lines) == 4
+    assert {ln["name"] for ln in lines} == {
+        "step.run", "step.absorb", "request.commit", "request.done",
+    }
+    # the outer span closes after the inner: X events record on exit,
+    # so the inner one appears first but nests by [ts, ts+dur]
+    spans = {ln["name"]: ln for ln in lines if "dur_us" in ln}
+    inner, outer = spans["step.absorb"], spans["step.run"]
+    assert outer["ts_us"] <= inner["ts_us"]
+    assert inner["ts_us"] + inner["dur_us"] <= outer["ts_us"] + outer["dur_us"]
+
+
+def test_flight_ring_is_bounded():
+    tr = Tracer(ring_len=8)
+    for i in range(50):
+        tr.instant("request.commit", rid=i)
+    assert len(tr.events) == 50
+    assert len(tr.ring) == 8
+    assert [e.rid for e in tr.ring] == list(range(42, 50))
+
+
+def test_null_tracer_is_inert():
+    assert NULL_TRACER.events == ()
+    NULL_TRACER.instant("request.submit", rid=0)
+    with NULL_TRACER.span("step.run", step=3):
+        pass
+    assert NULL_TRACER.events == ()
+
+
+def test_digest_excludes_timing_and_stream_edges():
+    def fill(tr, *, shift, with_pause):
+        tr.instant("request.submit", rid=0, step=1 + shift, prompt_len=4)
+        if with_pause:
+            tr.instant("stream.pause", rid=0, step=2 + shift)
+            tr.instant("stream.resume", rid=0, step=3 + shift)
+        tr.instant("request.done", rid=0, step=9 + shift, reason="eos")
+        tr.instant("step.plan", rid=-1)  # rid-less events never count
+
+    a, b = Tracer(), Tracer()
+    fill(a, shift=0, with_pause=True)
+    fill(b, shift=5, with_pause=False)
+    assert a.digest() == b.digest()
+    c = Tracer()
+    c.instant("request.submit", rid=0, step=1, prompt_len=5)  # arg differs
+    c.instant("request.done", rid=0, step=9, reason="eos")
+    assert c.digest() != a.digest()
+
+
+# -- serving integration -----------------------------------------------------
+
+
+def test_tracing_on_off_parity_and_compile_once(params):
+    """The observability contract's heart: switching tracing ON changes
+    neither the greedy token stream nor the number of compiled step
+    signatures, and the trace actually recorded the run."""
+    reqs = lambda: _reqs([5, 9, 3], seed0=10)  # noqa: E731
+    base = ServingEngine(params, CFG, _sc()).serve_batch(reqs())
+    sc = _sc(observability=ObservabilityConfig(enabled=True))
+    eng = ServingEngine(params, CFG, sc)
+    res = eng.serve_batch(reqs())
+    assert res["outputs"] == base["outputs"]
+    assert res["stats"]["compiled_signatures"] == 1
+    assert base["stats"]["compiled_signatures"] == 1
+    names = {e.name for e in eng.obs.tracer.events}
+    assert {"step.plan", "step.run", "step.absorb", "request.submit",
+            "request.admit", "request.first_token", "request.done"} <= names
+    reg = eng.obs.registry.snapshot()
+    assert reg["serve_steps_total"] > 0
+    assert reg["serve_new_tokens_total"] == sum(
+        len(o) for o in res["outputs"]
+    )
+    assert reg["serve_step_ms"]["count"] == reg["serve_steps_total"]
+
+
+def test_digest_stable_across_identical_load_tests(params):
+    """Two fresh engines driving the SAME deterministic online trace
+    produce the same lifecycle digest even though wall-clock timings (and
+    hence idle turns / pause edges) differ run to run."""
+    lt = LoadTestConfig(
+        num_requests=8, prompt_len=(3, 8), max_new_tokens=5,
+        mean_interarrival_steps=0.5, seed=3,
+    )
+    fc = FrontendConfig(idle_sleep_s=0.0002, stream_buffer=64)
+    digests = []
+    for _ in range(2):
+        eng = ServingEngine(
+            params, CFG, _sc(observability=ObservabilityConfig(enabled=True)),
+        )
+        report = run_load_test(eng, lt, fc)
+        assert report["completed"] == 8
+        digests.append(eng.obs.tracer.digest())
+    assert digests[0] == digests[1]
+
+
+def test_disagg_timeline_phases_sum_to_ttft(params):
+    """Disagg run with handoffs: every first-token request's attribution
+    components (queue + prefill + transfer + step + backpressure) sum to
+    its measured TTFT exactly, and the handoff made the transfer phase
+    real (markers present, not zero-width by omission)."""
+    sc = _sc(observability=ObservabilityConfig(enabled=True))
+    dc = DisaggConfig(enabled=True, transfer_pages=4, prefill_token_budget=16)
+    router = DisaggRouter(params, CFG, sc, dc)
+    res = router.serve_batch(_reqs([5, 11, 3, 7], seed0=30))
+    assert res["stats"]["handoffs"] == 4
+    events = list(router.obs.tracer.events)
+    assert any(e.name == "kv_transfer" and e.ph == "X" for e in events)
+    tls = build_timelines(events)
+    spans = sorted(
+        (e.ts, e.ts + e.dur) for e in events
+        if e.ph == "X" and e.name == "step.run"
+    )
+    checked = 0
+    for tl in tls.values():
+        att = attribute_ttft(tl, spans)
+        if att is None:
+            continue
+        total = (att["queue_ms"] + att["prefill_ms"] + att["transfer_ms"]
+                 + att["step_ms"] + att["backpressure_ms"])
+        assert total == pytest.approx(att["ttft_ms"], abs=1e-6)
+        assert tl.t_extract is not None and tl.t_handoff_admit is not None
+        checked += 1
+    assert checked == 4
+    summary = attribution_summary(events)
+    assert summary["with_first_token"] == 4
+    assert summary["ttft_p50"]["transfer_ms"] >= 0.0
+
+
+def test_flight_recorder_dumps_on_injected_crash(params, tmp_path):
+    """An injected serve-step FaultCrash (a BaseException, like a real
+    preemption) escapes serve_batch — but not before the flight recorder
+    writes its ring of the last events before the failure."""
+    dump = tmp_path / "flight.jsonl"
+    sc = _sc(observability=ObservabilityConfig(
+        enabled=True, flight_recorder_len=32,
+        flight_recorder_path=str(dump),
+    ))
+    eng = ServingEngine(params, CFG, sc)
+    with injected({"point": "serve_step", "mode": "crash", "step": 2}):
+        with pytest.raises(FaultCrash):
+            eng.serve_batch(_reqs([5, 7], seed0=50))
+    assert dump.exists()
+    lines = [json.loads(ln) for ln in dump.read_text().splitlines()]
+    assert lines[0]["flight_recorder"] is True
+    assert lines[0]["reason"] == "crash"
+    assert lines[0]["events"] == len(lines) - 1 > 0
+    assert {"step.plan", "step.run"} <= {ln["name"] for ln in lines[1:]}
+    snap = eng.obs.registry.snapshot()
+    assert snap['flight_recorder_dumps_total{reason="crash"}'] == 1.0
+
+
+def test_observability_disabled_is_null_tracer(params):
+    """Default config: the engine gets the null tracer (no events, no
+    ring) while the registry still mirrors the run's stats."""
+    eng = ServingEngine(params, CFG, _sc())
+    res = eng.serve_batch(_reqs([4, 6], seed0=70))
+    assert eng.obs.tracer is NULL_TRACER
+    assert eng.obs.enabled is False
+    assert eng.obs.registry.snapshot()["serve_new_tokens_total"] == sum(
+        len(o) for o in res["outputs"]
+    )
+
+
+def test_observability_export_writes_both_faces(tmp_path):
+    obs = Observability(ObservabilityConfig(
+        enabled=True, trace_path=str(tmp_path / "run" / "serve"),
+    ))
+    with obs.tracer.span("step.run", step=0):
+        obs.tracer.instant("request.commit", rid=0, step=0, n=1)
+    paths = obs.export()
+    assert set(paths) == {"chrome", "jsonl"}
+    assert validate_chrome_trace(paths["chrome"])["spans"] == 1
+    assert len(open(paths["jsonl"]).read().splitlines()) == 2
+    # disabled bundles export nothing
+    assert Observability(None).export(str(tmp_path / "x")) == {}
