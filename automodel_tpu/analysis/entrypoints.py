@@ -15,6 +15,9 @@ collectives GSPMD/shard_map would emit for TPU):
                            serving: wider token budget, no speculation)
 - ``kv_transfer``        — the fused page-copy program of the prefill→
                            decode KV handoff
+- ``quant_serve_step``   — the int8-KV + int8-linears serving step
+- ``quant_kv_transfer``  — the page-copy program over a quantized pool
+                           (int8 payload + scale planes ship natively)
 - ``pp_ep_1f1b_grad``    — the flagship PP×EP explicit 1F1B grad
 
 Each builder returns ``(compiled, mesh_axes)``; callers feed both to
@@ -321,6 +324,66 @@ def kv_transfer():
     return compiled, None
 
 
+def quant_serve_step():
+    """The quantized serving step (kv_cache_dtype=int8 + serve_precision=
+    int8): the SAME single-chip step program as paged_serve_step with the
+    int8 pool — page gathers now pull int8 payload AND the per-page scale
+    rows (so the gather floor RISES: k, v, k_scale, v_scale), the
+    new-token KV quantizes in-jit at scatter time, and the linears run
+    through quantized_matmul. Still collective-free with the pool donation
+    intact, and — the cfg serves in f32 — zero bf16→f32 upcast converts:
+    a quantization path that round-trips through bf16 casts would show up
+    here before it shows up as a tolerance failure."""
+    import jax
+    import jax.numpy as jnp
+
+    from automodel_tpu.models.llm import decoder
+    from automodel_tpu.serving.engine import ServingConfig, ServingEngine
+
+    dense, _ = _configs()
+    cfg = dataclasses.replace(dense, pipeline_microbatches=1)
+    params = decoder.init(cfg, jax.random.key(0))
+    eng = ServingEngine(params, cfg, ServingConfig(
+        page_size=4, num_pages=16, max_slots=2, pages_per_slot=4,
+        token_budget=8,
+        kv_cache_dtype="int8", serve_precision="int8",
+    ))
+    T, S, P = 8, 2, 4
+    batch = {k: jnp.zeros(T, jnp.int32) for k in ("tok", "slot", "pos", "page", "off")}
+    batch.update(
+        page_tables=jnp.zeros((S, P), jnp.int32),
+        sample_tok=jnp.zeros(S, jnp.int32),
+        temp=jnp.zeros(S, jnp.float32),
+        seed=jnp.zeros(S, jnp.int32),
+        cow_src=jnp.zeros(S, jnp.int32),
+        cow_dst=jnp.zeros(S, jnp.int32),
+    )
+    compiled = eng._step.lower(eng.params, eng.pool, batch).compile()
+    return compiled, None
+
+
+def quant_kv_transfer():
+    """The fused page-copy program over a QUANTIZED pool: identical shape
+    to kv_transfer but the pool has four leaves per stack (int8 k/v +
+    f32 scale planes), so the handoff ships the quantized pages natively
+    — the scales ride the same gather/scatter, never a dequant-requant
+    round trip (which would appear as extra convert/multiply traffic and
+    break bit-exact page adoption on the decode side)."""
+    import jax.numpy as jnp
+
+    from automodel_tpu.serving.kv_pages import init_pool
+    from automodel_tpu.serving.kv_transfer import apply_transfer
+
+    dense, _ = _configs()
+    cfg = dataclasses.replace(dense, pipeline_microbatches=1)
+    src = init_pool(cfg, [cfg.num_layers], 16, 4, kv_cache_dtype="int8")
+    dst = init_pool(cfg, [cfg.num_layers], 16, 4, kv_cache_dtype="int8")
+    B = 4
+    idx = jnp.zeros(B, jnp.int32)
+    compiled = apply_transfer.lower(dst, src, idx, idx).compile()
+    return compiled, None
+
+
 def pp_ep_1f1b_grad():
     """The flagship PP×EP program: explicit 1F1B grad with the expert A2A
     inside each stage's step. The ppermute ring (fwd + bwd streams) and
@@ -351,6 +414,8 @@ ENTRY_POINTS = {
     "sharded_serve_step": sharded_serve_step,
     "prefill_step": prefill_step,
     "kv_transfer": kv_transfer,
+    "quant_serve_step": quant_serve_step,
+    "quant_kv_transfer": quant_kv_transfer,
     "pp_ep_1f1b_grad": pp_ep_1f1b_grad,
 }
 
@@ -416,6 +481,31 @@ STRUCTURAL_INVARIANTS = {
         # the DATA_OPS census does not count, so gather is the pin)
         "op_floors": {"gather": 1},
     },
+    "quant_serve_step": {
+        "floors": {},
+        "zeros": (
+            "all-gather", "all-reduce", "reduce-scatter",
+            "collective-permute", "all-to-all", "ragged-all-to-all",
+        ),
+        # int8 k/v page gathers PLUS the per-page scale-row gathers —
+        # below this floor the step stopped fetching scales and is
+        # decoding garbage magnitudes
+        "op_floors": {"gather": 4},
+        # the engine serves in f32 end to end; any bf16→f32 convert is a
+        # quantization path round-tripping through a low-precision cast
+        "max_upcasts": 0,
+    },
+    "quant_kv_transfer": {
+        "floors": {},
+        "zeros": (
+            "all-gather", "all-reduce", "reduce-scatter",
+            "collective-permute", "all-to-all", "ragged-all-to-all",
+        ),
+        # quantized pages ship natively: int8 payload + scale planes ride
+        # the same page gathers, never a dequant-requant round trip
+        "op_floors": {"gather": 1},
+        "max_upcasts": 0,
+    },
     "pp_ep_1f1b_grad": {
         "floors": {"collective-permute": 2, "all-to-all": 2},
         "zeros": ("ragged-all-to-all",),
@@ -462,6 +552,13 @@ def check_invariants(report) -> list[str]:
                 f"the paged access structure degenerated (full ops: "
                 f"{report.ops})"
             )
+    max_up = inv.get("max_upcasts")
+    if max_up is not None and report.convert_upcasts > max_up:
+        out.append(
+            f"{report.entry}: convert_upcasts = {report.convert_upcasts} "
+            f"> max {max_up} — a low-precision cast crept into a path "
+            f"that must stay full-precision"
+        )
     return out
 
 

@@ -276,6 +276,7 @@ METRIC_CATALOG = (
     ("serve_handoff_expired_total", "counter", "handoffs expired before decode admission"),
     ("serve_kv_transfer_pages_total", "counter", "KV pages shipped by transfers"),
     ("serve_kv_transfer_chunks_total", "counter", "fixed-size transfer chunks issued"),
+    ("serve_kv_transfer_bytes_total", "counter", "KV transfer wire bytes (quantized pools ship int8+scales)"),
     # online frontend
     ("frontend_submitted_total", "counter", "requests submitted to the frontend"),
     ("frontend_finished_total", "counter", "streams finished (any reason)"),

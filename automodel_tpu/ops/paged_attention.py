@@ -22,6 +22,11 @@ Two backends, dispatched like ops/attention.py's flash path:
 Layouts (see serving/kv_pages.py for the pool):
 
 - GQA:  k_pages/v_pages (N, ps, Hkv, D); q (T, Hq, D).
+- Quantized pools (serving kv_cache_dtype="int8"): the same page layouts
+  hold int8 plus (N, ps) per-row scale arrays riding alongside; the
+  reference dequantizes the gathered per-token view, the kernel variant
+  dequantizes per page inside the online-softmax loop (the scale rides
+  the same scalar-prefetch page table as the payload).
 - MLA:  c_pages (N, ps, r) rms-normed kv latents, kr_pages (N, ps, dr)
   rotated shared key-rope head; queries come pre-absorbed — q_abs (T, n, r)
   is q_nope folded through the kv up-projection's key half, q_rope (T, n, dr)
@@ -79,8 +84,14 @@ def ragged_paged_attention_xla(
     window=None,               # traced per-layer window; 0/None = global
     soft_cap: float | None = None,
     sinks: jnp.ndarray | None = None,  # (Hq,) learned sink logits
+    k_scales: jnp.ndarray | None = None,  # (N, ps) per-row dequant scales
+    v_scales: jnp.ndarray | None = None,  # (int8 pages; None = fp pages)
 ) -> jnp.ndarray:
-    """Gather-based reference; returns (T, Hq, Dv) with pad rows zeroed."""
+    """Gather-based reference; returns (T, Hq, Dv) with pad rows zeroed.
+    With `k_scales`/`v_scales` the pages are int8: the gather stays on the
+    cheap int8 payload (plus the tiny scale rows) and dequantization runs
+    on the gathered per-token view in f32 — the CPU-testable oracle for
+    the quantized Pallas kernel."""
     T, Hq, D = q.shape
     N, ps, Hkv, _ = k_pages.shape
     P = page_tables.shape[1]
@@ -89,6 +100,15 @@ def ragged_paged_attention_xla(
     # gather each token's pages → a contiguous per-token KV view
     keys = k_pages[page_tables].reshape(T, P * ps, Hkv, D)
     values = v_pages[page_tables].reshape(T, P * ps, Hkv, v_pages.shape[-1])
+    if k_scales is not None:
+        from automodel_tpu.ops.quant import dequantize_kv
+
+        keys = dequantize_kv(
+            keys, k_scales[page_tables].reshape(T, P * ps)
+        ).astype(q.dtype)
+        values = dequantize_kv(
+            values, v_scales[page_tables].reshape(T, P * ps)
+        ).astype(q.dtype)
 
     qg = q.reshape(T, Hkv, G, D)
     s = jnp.einsum("tkgd,tckd->tkgc", qg, keys, preferred_element_type=jnp.float32)
@@ -122,6 +142,8 @@ def ragged_paged_mla_attention_xla(
     *,
     scale: float,
     window=None,
+    c_scales: jnp.ndarray | None = None,   # (N, ps) per-row dequant scales
+    kr_scales: jnp.ndarray | None = None,  # (int8 pages; None = fp pages)
 ) -> jnp.ndarray:
     """Absorbed-MLA reference; returns latent-space outputs (T, n, r)."""
     T, n, r = q_abs.shape
@@ -130,6 +152,15 @@ def ragged_paged_mla_attention_xla(
 
     c = c_pages[page_tables].reshape(T, P * ps, r)
     kr = kr_pages[page_tables].reshape(T, P * ps, kr_pages.shape[-1])
+    if c_scales is not None:
+        from automodel_tpu.ops.quant import dequantize_kv
+
+        c = dequantize_kv(
+            c, c_scales[page_tables].reshape(T, P * ps)
+        ).astype(q_abs.dtype)
+        kr = dequantize_kv(
+            kr, kr_scales[page_tables].reshape(T, P * ps)
+        ).astype(q_abs.dtype)
     s = jnp.einsum("tnr,tcr->tnc", q_abs, c, preferred_element_type=jnp.float32)
     s = s + jnp.einsum("tnd,tcd->tnc", q_rope, kr, preferred_element_type=jnp.float32)
     s = s * scale
@@ -209,21 +240,44 @@ def ragged_paged_attention(
     sinks=None,
     impl: str = "auto",
     mesh_ctx=None,
+    k_scales=None,
+    v_scales=None,
 ):
     """GQA entry. impl: "xla" | "pallas" | "auto" (pallas on TPU, with a
     shape/feature-based fallback to the reference — the flash dispatch
     pattern of ops/attention.py). With a `mesh_ctx` (tp>1) the reference
     path carries head-sharding annotations and the Pallas kernel runs
-    inside a shard_map over the tp axis (rank-local head slices)."""
+    inside a shard_map over the tp axis (rank-local head slices). With
+    `k_scales`/`v_scales` ((N, ps) per-row scales) the pages are int8 and
+    the quantized kernel/reference dequantizes per page."""
     scale = scale if scale is not None else float(q.shape[-1]) ** -0.5
+    quant = k_scales is not None
     resolved = impl
     if impl == "auto":
         resolved = "pallas" if jax.default_backend() == "tpu" else "xla"
     if resolved == "pallas":
         try:
             if _tp_size(mesh_ctx) > 1:
+                if quant:
+                    # scales replicate while heads shard; the quantized
+                    # kernel has no shard_map wrapper yet — the annotated
+                    # XLA reference serves the tp>1 quantized path
+                    raise NotImplementedError(
+                        "tp-sharded quantized paged attention → XLA path"
+                    )
                 return _pallas_gqa_shard_map(mesh_ctx)(
                     q, k_pages, v_pages, page_tables, positions,
+                    scale=scale, soft_cap=soft_cap, window=window,
+                    sinks=sinks,
+                )
+            if quant:
+                from automodel_tpu.ops.pallas.ragged_paged_attention import (
+                    paged_attention_quant_kernel,
+                )
+
+                return paged_attention_quant_kernel(
+                    q, k_pages, v_pages, k_scales, v_scales,
+                    page_tables, positions,
                     scale=scale, soft_cap=soft_cap, window=window,
                     sinks=sinks,
                 )
@@ -244,6 +298,7 @@ def ragged_paged_attention(
         out = ragged_paged_attention_xla(
             q, k_pages, v_pages, page_tables, positions,
             scale=scale, window=window, soft_cap=soft_cap, sinks=sinks,
+            k_scales=k_scales, v_scales=v_scales,
         )
         return _annotate_tp(out, mesh_ctx, 1)
     raise ValueError(f"Unknown paged attention impl '{impl}'")
@@ -256,6 +311,8 @@ def ragged_paged_mla_attention(
     window=None,
     impl: str = "auto",
     mesh_ctx=None,
+    c_scales=None,
+    kr_scales=None,
 ):
     """MLA (absorbed latent-cache) entry; same dispatch contract as the GQA
     one. Returns latent-space outputs (T, n, r). Under tp>1 the latent rank
@@ -266,12 +323,23 @@ def ragged_paged_mla_attention(
     resolved = impl
     if impl == "auto":
         resolved = "pallas" if jax.default_backend() == "tpu" else "xla"
+    quant = c_scales is not None
     if resolved == "pallas":
         try:
             if _tp_size(mesh_ctx) > 1:
                 raise NotImplementedError(
                     "latent-sharded MLA paged attention needs the "
                     "cross-rank score reduction — XLA reference only"
+                )
+            if quant:
+                from automodel_tpu.ops.pallas.ragged_paged_attention import (
+                    paged_mla_attention_quant_kernel,
+                )
+
+                return paged_mla_attention_quant_kernel(
+                    q_abs, q_rope, c_pages, kr_pages, c_scales, kr_scales,
+                    page_tables, positions,
+                    scale=scale, window=window,
                 )
             from automodel_tpu.ops.pallas.ragged_paged_attention import (
                 paged_mla_attention_kernel,
@@ -289,6 +357,7 @@ def ragged_paged_mla_attention(
         out = ragged_paged_mla_attention_xla(
             q_abs, q_rope, c_pages, kr_pages, page_tables, positions,
             scale=scale, window=window,
+            c_scales=c_scales, kr_scales=kr_scales,
         )
         return _annotate_tp(out, mesh_ctx, 2)
     raise ValueError(f"Unknown paged attention impl '{impl}'")

@@ -167,6 +167,151 @@ def paged_attention_kernel(
     return out[..., :Dv]
 
 
+def _gqa_quant_kernel(
+    pt_ref,    # (T, P) scalar-prefetch page table
+    pos_ref,   # (T,)   scalar-prefetch positions (-1 = pad row)
+    q_ref,     # (1, Hq, D)
+    k_ref,     # (1, ps, Hkv, D)  int8
+    v_ref,     # (1, ps, Hkv, Dv) int8
+    ks_ref,    # (1, ps) f32 per-row K scales of THIS page
+    vs_ref,    # (1, ps) f32 per-row V scales
+    out_ref,   # (1, Hq, Dv)
+    m_scr, l_scr, acc_scr,
+    *,
+    scale, soft_cap, page_size, groups,
+):
+    """The int8 variant of `_gqa_kernel`: identical grid/online-softmax
+    machinery, but pages arrive quantized and the per-page scale rows ride
+    the SAME scalar-prefetch page table (`pt[t, j]` indexes payload and
+    scale blocks alike). Dequantization is algebraic per page: the K scale
+    multiplies each kv slot's score column, the V scale folds into the
+    softmax weights before the value product — the big int8 blocks are
+    cast once for the MXU dots, never materialized dequantized in HBM."""
+    t, j = pl.program_id(0), pl.program_id(1)
+    np_ = pl.num_programs(1)
+    pos = pos_ref[t]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    run = jnp.logical_and(pos >= 0, j * page_size <= pos)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]                              # (Hq, D)
+        k = k_ref[0].astype(jnp.float32)          # (ps, Hkv, D)
+        v = v_ref[0].astype(jnp.float32)          # (ps, Hkv, Dv)
+        ks = ks_ref[...].reshape(1, 1, page_size)  # per-slot K scales
+        vs = vs_ref[...].reshape(1, 1, page_size)
+        Hq, D = q.shape
+        ps, Hkv, Dv = v.shape
+        qg = q.reshape(Hkv, groups, D).astype(jnp.float32)
+        # (Hkv, G, ps): contract D, batch over kv heads; the per-row K
+        # scale lands on the score column of its kv slot (before any
+        # soft-cap nonlinearity)
+        s = jax.lax.dot_general(
+            qg, k, (((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        ) * ks * scale
+        if soft_cap is not None:
+            s = soft_cap * jnp.tanh(s / soft_cap)
+        kv_idx = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (Hkv, groups, ps), 2
+        )
+        mask = kv_idx <= pos
+        s = jnp.where(mask, s, NEG_INF)
+        s = s.reshape(Hq, ps)
+
+        m_prev = m_scr[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.where(mask.reshape(Hq, ps), jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = jnp.broadcast_to(
+            l_scr[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True),
+            l_scr.shape,
+        )
+        # V dequant folds into the weights: (p * vs) @ v_int8 == p @ v_fp
+        pv = jax.lax.dot_general(
+            (p.reshape(Hkv, groups, ps) * vs), v,
+            (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scr[:] = acc_scr[:] * alpha + pv.reshape(Hq, Dv)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(j == np_ - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        out = jnp.where(l == 0.0, 0.0, acc_scr[:] / l_safe)
+        out_ref[0] = out.astype(out_ref.dtype)
+
+
+def paged_attention_quant_kernel(
+    q, k_pages, v_pages, k_scales, v_scales, page_tables, positions,
+    *,
+    scale: float,
+    soft_cap: float | None = None,
+    window=None,
+    sinks=None,
+):
+    """GQA ragged paged attention over int8 pages with (N, ps) per-row
+    scales; same contract (and NotImplementedError fallbacks) as
+    `paged_attention_kernel`."""
+    if window is not None:
+        raise NotImplementedError("paged kernel: sliding windows → XLA path")
+    if sinks is not None:
+        raise NotImplementedError("paged kernel: attention sinks → XLA path")
+    T, Hq, D = q.shape
+    N, ps, Hkv, Dv = v_pages.shape
+    if Hq % Hkv != 0:
+        raise NotImplementedError("paged kernel: GQA needs Hq % Hkv == 0")
+    P = page_tables.shape[1]
+    G = Hq // Hkv
+
+    qp = _pad_last(q, LANE)
+    kp = _pad_last(k_pages, LANE)
+    vp = _pad_last(v_pages, LANE)
+    Dp, Dvp = qp.shape[-1], vp.shape[-1]
+
+    kernel = functools.partial(
+        _gqa_quant_kernel,
+        scale=scale, soft_cap=soft_cap, page_size=ps, groups=G,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(T, P),
+        in_specs=[
+            pl.BlockSpec((1, Hq, Dp), lambda t, j, pt, pos: (t, 0, 0)),
+            pl.BlockSpec((1, ps, Hkv, Dp), lambda t, j, pt, pos: (pt[t, j], 0, 0, 0)),
+            pl.BlockSpec((1, ps, Hkv, Dvp), lambda t, j, pt, pos: (pt[t, j], 0, 0, 0)),
+            pl.BlockSpec((1, ps), lambda t, j, pt, pos: (pt[t, j], 0)),
+            pl.BlockSpec((1, ps), lambda t, j, pt, pos: (pt[t, j], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, Hq, Dvp), lambda t, j, pt, pos: (t, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Hq, LANE), jnp.float32),
+            pltpu.VMEM((Hq, LANE), jnp.float32),
+            pltpu.VMEM((Hq, Dvp), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((T, Hq, Dvp), q.dtype),
+        interpret=_interpret(),
+    )(
+        page_tables.astype(jnp.int32), positions.astype(jnp.int32),
+        qp, kp, vp,
+        k_scales.astype(jnp.float32), v_scales.astype(jnp.float32),
+    )
+    return out[..., :Dv]
+
+
 def _mla_kernel(
     pt_ref, pos_ref,
     qa_ref,    # (1, n, r)
@@ -276,5 +421,132 @@ def paged_mla_attention_kernel(
     )(
         page_tables.astype(jnp.int32), positions.astype(jnp.int32),
         qa, qr, cp, krp,
+    )
+    return out[..., :r]
+
+
+def _mla_quant_kernel(
+    pt_ref, pos_ref,
+    qa_ref,    # (1, n, r)
+    qr_ref,    # (1, n, dr)
+    c_ref,     # (1, ps, r)  int8
+    kr_ref,    # (1, ps, dr) int8
+    cs_ref,    # (1, ps) f32 per-row latent scales of THIS page
+    krs_ref,   # (1, ps) f32 per-row rope scales
+    out_ref,   # (1, n, r)
+    m_scr, l_scr, acc_scr,
+    *,
+    scale, page_size,
+):
+    """int8 variant of `_mla_kernel`: the latent and rope score parts
+    carry DIFFERENT per-row scales (two cached quantities, two scale
+    arrays), so each is applied to its dot before the parts sum into the
+    shared accumulator; the latent scale folds into the softmax weights
+    for the value product (values ARE the latent pages)."""
+    t, j = pl.program_id(0), pl.program_id(1)
+    np_ = pl.num_programs(1)
+    pos = pos_ref[t]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    run = jnp.logical_and(pos >= 0, j * page_size <= pos)
+
+    @pl.when(run)
+    def _body():
+        qa = qa_ref[0].astype(jnp.float32)    # (n, r)
+        qr = qr_ref[0].astype(jnp.float32)    # (n, dr)
+        c = c_ref[0].astype(jnp.float32)      # (ps, r)
+        kr = kr_ref[0].astype(jnp.float32)    # (ps, dr)
+        cs = cs_ref[...].reshape(1, page_size)
+        krs = krs_ref[...].reshape(1, page_size)
+        n = qa.shape[0]
+        ps = c.shape[0]
+        s = jax.lax.dot_general(
+            qa, c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * cs
+        s = s + jax.lax.dot_general(
+            qr, kr, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * krs
+        s = s * scale
+        kv_idx = j * page_size + jax.lax.broadcasted_iota(jnp.int32, (n, ps), 1)
+        mask = kv_idx <= pos
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = jnp.broadcast_to(
+            l_scr[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True),
+            l_scr.shape,
+        )
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p * cs, c, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(j == np_ - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        out = jnp.where(l == 0.0, 0.0, acc_scr[:] / l_safe)
+        out_ref[0] = out.astype(out_ref.dtype)
+
+
+def paged_mla_attention_quant_kernel(
+    q_abs, q_rope, c_pages, kr_pages, c_scales, kr_scales,
+    page_tables, positions,
+    *,
+    scale: float,
+    window=None,
+):
+    """Absorbed-MLA ragged paged attention over int8 latent/rope pages
+    with (N, ps) per-row scales; same contract as
+    `paged_mla_attention_kernel`."""
+    if window is not None:
+        raise NotImplementedError("paged MLA kernel: sliding windows → XLA path")
+    T, n, r = q_abs.shape
+    N, ps, _ = c_pages.shape
+    P = page_tables.shape[1]
+
+    qa = _pad_last(q_abs, LANE)
+    qr = _pad_last(q_rope, LANE)
+    cp = _pad_last(c_pages, LANE)
+    krp = _pad_last(kr_pages, LANE)
+    rp, drp = qa.shape[-1], qr.shape[-1]
+
+    kernel = functools.partial(_mla_quant_kernel, scale=scale, page_size=ps)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(T, P),
+        in_specs=[
+            pl.BlockSpec((1, n, rp), lambda t, j, pt, pos: (t, 0, 0)),
+            pl.BlockSpec((1, n, drp), lambda t, j, pt, pos: (t, 0, 0)),
+            pl.BlockSpec((1, ps, rp), lambda t, j, pt, pos: (pt[t, j], 0, 0)),
+            pl.BlockSpec((1, ps, drp), lambda t, j, pt, pos: (pt[t, j], 0, 0)),
+            pl.BlockSpec((1, ps), lambda t, j, pt, pos: (pt[t, j], 0)),
+            pl.BlockSpec((1, ps), lambda t, j, pt, pos: (pt[t, j], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, n, rp), lambda t, j, pt, pos: (t, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((n, LANE), jnp.float32),
+            pltpu.VMEM((n, LANE), jnp.float32),
+            pltpu.VMEM((n, rp), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((T, n, rp), q_abs.dtype),
+        interpret=_interpret(),
+    )(
+        page_tables.astype(jnp.int32), positions.astype(jnp.int32),
+        qa, qr, cp, krp,
+        c_scales.astype(jnp.float32), kr_scales.astype(jnp.float32),
     )
     return out[..., :r]

@@ -38,12 +38,23 @@ def _qparams(precision: str):
 
 
 def _quantize(x, qdtype, qmax, axis=None):
-    """axis=None → per-tensor scale; else per-channel over `axis` reduced."""
-    scale = (
-        jnp.max(jnp.abs(x), axis=axis, keepdims=axis is not None)
-        .astype(jnp.float32) / qmax + 1e-12
+    """axis=None → per-tensor scale; else per-channel over `axis` reduced.
+
+    Every intermediate runs in f32 and the ±qmax clamp is applied IN f32
+    before the low-precision cast: an inf-adjacent input must saturate to
+    the grid edge, not ride inf/inf = NaN (scale picks up the inf) or an
+    out-of-range f32 through the `astype` — float8_e4m3fn has no inf, so
+    an unclamped cast there is free to produce NaN."""
+    xf = jnp.clip(
+        x.astype(jnp.float32),
+        jnp.finfo(jnp.float32).min,
+        jnp.finfo(jnp.float32).max,
     )
-    q = (x.astype(jnp.float32) / scale)
+    scale = (
+        jnp.max(jnp.abs(xf), axis=axis, keepdims=axis is not None) / qmax
+        + 1e-12
+    )
+    q = xf / scale
     if qdtype == jnp.int8:
         q = jnp.round(q)
     q = jnp.clip(q, -qmax, qmax).astype(qdtype)
@@ -90,6 +101,39 @@ def matmul(x, kernel, precision: str | None = None):
     if precision in ("fp8", "int8"):
         return quantized_matmul(x, kernel, precision)
     return x @ kernel
+
+
+# ---------------------------------------------------------------------------
+# Paged-KV quantization (serving/kv_pages.py int8 pools)
+# ---------------------------------------------------------------------------
+def quantize_kv_rows(x):
+    """New-token KV cache rows → (int8 rows, per-row f32 scales).
+
+    `x` is (T, ...) — one cache row per leading index (a GQA (Hkv, D) K or
+    V row, an MLA (r,) latent or (dr,) rope row); everything behind the
+    leading dim shares ONE scale. This is the granularity of the paged
+    pool's per-page scale arrays ((L, N+1, ps): one scalar per page slot,
+    no head dim — so scales replicate under tp while the int8 payload
+    shards its heads exactly like the fp pool). Runs in-jit at scatter
+    time inside the serving step."""
+    xf = jnp.clip(
+        x.astype(jnp.float32),
+        jnp.finfo(jnp.float32).min,
+        jnp.finfo(jnp.float32).max,
+    )
+    red = tuple(range(1, x.ndim))
+    scale = jnp.max(jnp.abs(xf), axis=red) / INT8_MAX + 1e-12
+    sb = scale.reshape(scale.shape + (1,) * (x.ndim - 1))
+    q = jnp.clip(jnp.round(xf / sb), -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q, scale):
+    """Inverse of `quantize_kv_rows` over gathered page views: `scale`'s
+    dims align with `q`'s leading dims and broadcast over the rest.
+    Returns f32 (the attention score math runs there anyway)."""
+    sb = scale.reshape(scale.shape + (1,) * (q.ndim - scale.ndim))
+    return q.astype(jnp.float32) * sb
 
 
 # ---------------------------------------------------------------------------

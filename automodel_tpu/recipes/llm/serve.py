@@ -198,6 +198,8 @@ class ServeRecipe(TrainFinetuneRecipeForNextTokenPrediction):
             speculative=self.typed.serving_speculative,
             admission_policy=str(get("admission_policy", "fifo")),
             observability=self.typed.serving_observability,
+            kv_cache_dtype=(get("kv_cache_dtype", None) or None),
+            serve_precision=(get("serve_precision", None) or None),
         )
         params = self.train_state.params
         if self.peft_cfg is not None:

@@ -598,21 +598,16 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
 
         MoE decoders run the dropless expert dispatch inside each stage's
         step (ep A2A overlapped with other stages' compute); PEFT composes
-        by vjp-ing the LoRA merge around the pipeline's explicit grads. The
-        only remaining fence is QAT, whose straight-through param transform
-        must live inside a differentiated function."""
+        by vjp-ing the LoRA merge around the pipeline's explicit grads, and
+        QAT composes the same way inside make_train_step (vjp of the
+        fake-quant transform around the pipeline grads) — this path fences
+        nothing."""
         if (
             self.mesh_ctx.sizes["pp"] <= 1
             or getattr(self.model_cfg, "pipeline_schedule", "gpipe")
             not in ("1f1b", "interleaved", "zb")
         ):
             return None
-        if self.cfg.get("qat.enabled", False):
-            raise NotImplementedError(
-                f"pipeline_schedule={self.model_cfg.pipeline_schedule} "
-                "does not yet support QAT (the fake-quant param transform "
-                "needs autodiff around it); use the default gpipe schedule"
-            )
         from automodel_tpu.models.llm.decoder import make_pp_1f1b_loss_and_grad
 
         logger.info(

@@ -84,7 +84,7 @@ from automodel_tpu.ops.paged_attention import (
     ragged_paged_mla_attention,
 )
 from automodel_tpu.ops.norms import rms_norm
-from automodel_tpu.ops.quant import matmul as _mm
+from automodel_tpu.ops.quant import matmul as _mm, quantize_kv_rows
 from automodel_tpu.ops.rope import rope_frequencies
 from automodel_tpu.observability import Observability, ObservabilityConfig
 from automodel_tpu.resilience.faults import fault_hit
@@ -128,6 +128,13 @@ class ServingConfig:
     # plain one-token-per-slot decode program exactly
     speculative: SpeculativeConfig | None = None
     admission_policy: str = "fifo"  # "fifo" | "prefix-hit"
+    # quantized serving (docs/SERVING.md §Quantized serving): int8 KV pages
+    # with per-page scale arrays riding the pool pytree, and/or low-precision
+    # serve-step linears via ops/quant.quantized_matmul. None/None → the fp
+    # engine BYTE-identical (both are trace-time choices; the one jitted
+    # step signature, donation and compile-once contract hold either way)
+    kv_cache_dtype: str | None = None   # None (model dtype) | "int8"
+    serve_precision: str | None = None  # None | "int8" | "fp8"
     # debug tripwire: run the jitted step under jax.transfer_guard
     # ("disallow") so an unintended device↔host transfer inside the step
     # raises instead of silently serializing the serve loop (the dryrun
@@ -145,6 +152,10 @@ class ServingConfig:
         if self.prefill_chunk is not None:
             assert 1 <= self.prefill_chunk <= self.token_budget
         assert self.admission_policy in ("fifo", "prefix-hit")
+        assert self.kv_cache_dtype in (None, "int8"), self.kv_cache_dtype
+        assert self.serve_precision in (None, "int8", "fp8"), (
+            self.serve_precision
+        )
         if self.admission_policy == "prefix-hit":
             assert self.prefix_cache is not None and self.prefix_cache.enabled
         if self.speculative is not None and self.speculative.enabled:
@@ -210,8 +221,20 @@ class ServingEngine:
                 "ServingEngine drives the layer-scan decoders; the het "
                 "engine's per-layer python loop needs its own step function"
             )
+        # serve-step linear precision: all decoder/generate linears already
+        # route through ops/quant.matmul(x, kernel, cfg.linear_precision),
+        # so low-precision serving is ONE config replace — the params stay
+        # high precision (dynamic per-channel quantization inside the step)
+        if serve_cfg.serve_precision is not None:
+            cfg = dataclasses.replace(
+                cfg, linear_precision=serve_cfg.serve_precision
+            )
         self.cfg = cfg
         self.serve_cfg = serve_cfg
+        # int8 KV pages + per-page scales (a trace-time choice: the fp and
+        # quantized engines each compile their one program; fp stays
+        # byte-identical to the quantization-unaware engine)
+        self._kv_quant = serve_cfg.kv_cache_dtype is not None
         # observability bundle: routers pass ONE shared bundle to every
         # engine (distinct track names) so a single tracer/registry sees
         # the whole request lifecycle across replica classes; standalone
@@ -296,9 +319,9 @@ class ServingEngine:
         self.pool = init_pool(
             cfg, [L for *_, L in self._stacks],
             serve_cfg.num_pages, serve_cfg.page_size,
-            mesh_ctx=self._mesh,
+            mesh_ctx=self._mesh, kv_cache_dtype=serve_cfg.kv_cache_dtype,
         )
-        self._pool_axes = pool_axes(cfg)
+        self._pool_axes = pool_axes(cfg, serve_cfg.kv_cache_dtype)
         # ENGINE-LIFETIME prefix cache (SGLang-RadixAttention-style): with
         # the cache enabled, the refcounted allocator and the radix tree
         # are created ONCE here and threaded through every scheduler this
@@ -339,7 +362,8 @@ class ServingEngine:
 
             rep = self._mesh.replicated()
             psh = pool_shardings(
-                cfg, [L for *_, L in self._stacks], self._mesh
+                cfg, [L for *_, L in self._stacks], self._mesh,
+                serve_cfg.kv_cache_dtype,
             )
             batch_keys = [
                 "tok", "slot", "pos", "page", "off", "page_tables",
@@ -464,17 +488,18 @@ class ServingEngine:
 
     def _constrain_pool(self, pool):
         """Pin the per-stack pool arrays to their kv_pages.pool_axes layout
-        through the COW block and the layer scan (no-op off-mesh)."""
+        through the COW block and the layer scan (no-op off-mesh). Stacks
+        are tuples of 2 (fp) or 4 (int8 payloads + replicated per-page
+        scale arrays) — the axis tuples line up either way."""
         if self._mesh is None:
             return pool
-        a0, a1 = self._pool_axes
-        s0, s1 = self._mesh.sharding(*a0), self._mesh.sharding(*a1)
+        shs = [self._mesh.sharding(*a) for a in self._pool_axes]
         return [
-            (
-                jax.lax.with_sharding_constraint(p0, s0),
-                jax.lax.with_sharding_constraint(p1, s1),
+            tuple(
+                jax.lax.with_sharding_constraint(p, s)
+                for p, s in zip(stack, shs)
             )
-            for p0, p1 in pool
+            for stack in pool
         ]
 
     def _moe_mlp_ep(self, h, lp, cfg):
@@ -494,9 +519,13 @@ class ServingEngine:
         return h + moe_out
 
     # -- device step --------------------------------------------------------
-    def _attn(self, h, lp, win, pool_k, pool_v, b):
-        """One attention sub-block over the paged pool; returns
-        (post-residual h, written pool_k, pool_v). h is (1, T, H)."""
+    def _attn(self, h, lp, win, cache, b):
+        """One attention sub-block over the paged pool; `cache` is one
+        layer's slice of a stack — (k, v) fp, or (k, v, k_scale, v_scale)
+        with kv_cache_dtype="int8", where new-token rows quantize IN-JIT at
+        scatter time (ops/quant.quantize_kv_rows) and attention dequantizes
+        behind the page gather. Returns (post-residual h, written cache).
+        h is (1, T, H)."""
         cfg = self.cfg
         window = win if self._any_window else None
         freq = self._freq_for_win(win)
@@ -513,12 +542,24 @@ class ServingEngine:
             q_abs, q_rope, c_kv, k_rope, w_uv = mla_absorbed_inputs(
                 x, lp, cfg, positions, freq
             )
-            pool_k = pool_k.at[b["page"], b["off"]].set(
-                c_kv[0].astype(pool_k.dtype)
-            )
-            pool_v = pool_v.at[b["page"], b["off"]].set(
-                k_rope[0].astype(pool_v.dtype)
-            )
+            scales_kw = {}
+            if self._kv_quant:
+                pool_k, pool_v, s_c, s_kr = cache
+                qc, c_rows = quantize_kv_rows(c_kv[0])
+                qkr, kr_rows = quantize_kv_rows(k_rope[0])
+                pool_k = pool_k.at[b["page"], b["off"]].set(qc)
+                pool_v = pool_v.at[b["page"], b["off"]].set(qkr)
+                s_c = s_c.at[b["page"], b["off"]].set(c_rows)
+                s_kr = s_kr.at[b["page"], b["off"]].set(kr_rows)
+                scales_kw = dict(c_scales=s_c, kr_scales=s_kr)
+            else:
+                pool_k, pool_v = cache
+                pool_k = pool_k.at[b["page"], b["off"]].set(
+                    c_kv[0].astype(pool_k.dtype)
+                )
+                pool_v = pool_v.at[b["page"], b["off"]].set(
+                    k_rope[0].astype(pool_v.dtype)
+                )
             scale = (
                 cfg.attn_scale if cfg.attn_scale is not None
                 else (dn + dr) ** -0.5
@@ -527,16 +568,34 @@ class ServingEngine:
                 q_abs[0], q_rope[0], pool_k, pool_v,
                 b["pt_tok"], b["pos"],
                 scale=scale, window=window, impl=self._attn_impl,
-                mesh_ctx=self._mesh,
+                mesh_ctx=self._mesh, **scales_kw,
             )
             attn = jnp.einsum("tnr,rnd->tnd", out_lat, w_uv)
             attn = attn.reshape(1, -1, n * dv)
             h = h + _mm(attn, lp["o_proj"]["kernel"], cfg.linear_precision)
-            return h, pool_k, pool_v
+            if self._kv_quant:
+                return h, (pool_k, pool_v, s_c, s_kr)
+            return h, (pool_k, pool_v)
         # GQA
         q, k, v = project_qkv(x, lp, cfg, positions, freq)
-        pool_k = pool_k.at[b["page"], b["off"]].set(k[0].astype(pool_k.dtype))
-        pool_v = pool_v.at[b["page"], b["off"]].set(v[0].astype(pool_v.dtype))
+        scales_kw = {}
+        if self._kv_quant:
+            pool_k, pool_v, s_k, s_v = cache
+            qk, k_rows = quantize_kv_rows(k[0])
+            qv, v_rows = quantize_kv_rows(v[0])
+            pool_k = pool_k.at[b["page"], b["off"]].set(qk)
+            pool_v = pool_v.at[b["page"], b["off"]].set(qv)
+            s_k = s_k.at[b["page"], b["off"]].set(k_rows)
+            s_v = s_v.at[b["page"], b["off"]].set(v_rows)
+            scales_kw = dict(k_scales=s_k, v_scales=s_v)
+        else:
+            pool_k, pool_v = cache
+            pool_k = pool_k.at[b["page"], b["off"]].set(
+                k[0].astype(pool_k.dtype)
+            )
+            pool_v = pool_v.at[b["page"], b["off"]].set(
+                v[0].astype(pool_v.dtype)
+            )
         scale = (
             cfg.attn_scale if cfg.attn_scale is not None
             else cfg.resolved_head_dim ** -0.5
@@ -545,7 +604,7 @@ class ServingEngine:
             q[0], pool_k, pool_v, b["pt_tok"], b["pos"],
             scale=scale, window=window,
             soft_cap=cfg.attn_soft_cap, sinks=lp.get("sinks"),
-            impl=self._attn_impl, mesh_ctx=self._mesh,
+            impl=self._attn_impl, mesh_ctx=self._mesh, **scales_kw,
         )
         T = attn.shape[0]
         attn = attn.reshape(1, T, cfg.num_heads * attn.shape[-1])
@@ -555,7 +614,9 @@ class ServingEngine:
                 attn_out, lp["post_attn_out_norm"]["scale"],
                 cfg.rms_norm_eps, cfg.zero_centered_norm,
             )
-        return h + attn_out, pool_k, pool_v
+        if self._kv_quant:
+            return h + attn_out, (pool_k, pool_v, s_k, s_v)
+        return h + attn_out, (pool_k, pool_v)
 
     def _step_impl(self, params, pool, b):
         cfg, sc = self.cfg, self.serve_cfg
@@ -577,20 +638,22 @@ class ServingEngine:
         h = self._constrain_rep(h)
 
         new_pool = []
-        for (pkey, mlp_fn, L), (p0, p1), wins in zip(
+        for (pkey, mlp_fn, L), stack, wins in zip(
             self._stacks, pool, self._stack_windows
         ):
             def one_layer(carry, xs, mlp_fn=mlp_fn):
                 (h,) = carry
-                lp, c0, c1, win = xs
-                h, c0, c1 = self._attn(h, lp, win, c0, c1, b)
+                lp, cache, win = xs
+                h, cache = self._attn(h, lp, win, cache, b)
                 h = mlp_fn(h, lp, cfg)
-                return (self._constrain_rep(h),), (c0, c1)
+                return (self._constrain_rep(h),), cache
 
-            (h,), (p0, p1) = jax.lax.scan(
-                one_layer, (h,), (params[pkey], p0, p1, wins)
+            # the stack's cache arrays ((k, v) fp, (k, v, sk, sv) int8)
+            # scan over their shared layer axis alongside the params
+            (h,), stack = jax.lax.scan(
+                one_layer, (h,), (params[pkey], tuple(stack), wins)
             )
-            new_pool.append((p0, p1))
+            new_pool.append(stack)
         new_pool = self._constrain_pool(new_pool)
 
         h = rms_norm(h, params["final_norm"]["scale"], cfg.rms_norm_eps,

@@ -21,6 +21,15 @@ every gather/scatter in bounds without branching):
 - MLA:  c    (L, N+1, ps, r),  kr (L, N+1, ps, dr)   (absorbed decode —
   r+dr cached floats per token instead of n*(dn+dr+dv))
 
+Quantized pools (kv_cache_dtype="int8"): the same layouts hold int8 and
+each stack gains PARALLEL per-page scale arrays (L, N+1, ps) — one f32
+scale per cache row, stored page-major so scales travel with their pages
+through every page-axis pytree op (COW, defrag, prefix-cache adoption,
+truncate, kv_transfer handoff) without the host allocator/scheduler/radix
+tree ever seeing them. Dequantization happens inside the paged attention
+op (ops/paged_attention.py), quantization in-jit at scatter time
+(ops/quant.quantize_kv_rows).
+
 Under a serving mesh (ServingEngine(mesh_ctx=...)) the pool becomes a
 MESH-SHARDED array: pages stay global/replicated while the per-page head
 dim partitions over tp (`pool_axes` — GQA KV heads, MLA kv-latent rank),
@@ -223,29 +232,55 @@ def pool_trash_index(pool) -> int:
     return jax.tree.leaves(pool)[0].shape[1] - 1
 
 
-def init_gqa_pool(cfg, num_layers: int, num_pages: int, page_size: int):
+def _scale_arrays(num_layers: int, num_pages: int, page_size: int):
+    """Two per-page scale arrays (L, N+1, ps) for a quantized stack — one
+    f32 scalar per cache row, rows of a page contiguous so every page-axis
+    operation on the pool pytree (COW copy, defrag gather, transfer
+    gather/scatter) moves a page's scales with its int8 payload for free.
+    Initialized to 1.0 (identity dequant for never-written rows)."""
+    shape = (num_layers, num_pages + 1, page_size)
+    return (jnp.ones(shape, jnp.float32), jnp.ones(shape, jnp.float32))
+
+
+def init_gqa_pool(
+    cfg, num_layers: int, num_pages: int, page_size: int,
+    kv_cache_dtype: str | None = None,
+):
     """(k, v) pool arrays for one GQA stack (dtype/shapes from cfg — the
-    cache-entry shapes of inference/generate.py's `_cache_shapes`)."""
+    cache-entry shapes of inference/generate.py's `_cache_shapes`).
+    kv_cache_dtype="int8" → (k, v, k_scale, v_scale): int8 payloads at the
+    SAME shapes plus the per-page scale arrays."""
     D = cfg.resolved_head_dim
     shape = (num_layers, num_pages + 1, page_size, cfg.num_kv_heads, D)
-    return (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
-
-
-def init_mla_pool(cfg, num_layers: int, num_pages: int, page_size: int):
-    """(c, kr) pool arrays for one MLA stack (absorbed latent cache)."""
+    if kv_cache_dtype is None:
+        return (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+    assert kv_cache_dtype == "int8", kv_cache_dtype
     return (
-        jnp.zeros(
-            (num_layers, num_pages + 1, page_size, cfg.mla_kv_lora_rank),
-            cfg.dtype,
-        ),
-        jnp.zeros(
-            (num_layers, num_pages + 1, page_size, cfg.mla_qk_rope_head_dim),
-            cfg.dtype,
-        ),
+        jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+        *_scale_arrays(num_layers, num_pages, page_size),
     )
 
 
-def pool_axes(cfg) -> tuple:
+def init_mla_pool(
+    cfg, num_layers: int, num_pages: int, page_size: int,
+    kv_cache_dtype: str | None = None,
+):
+    """(c, kr) pool arrays for one MLA stack (absorbed latent cache);
+    kv_cache_dtype="int8" → (c, kr, c_scale, kr_scale)."""
+    c_shape = (num_layers, num_pages + 1, page_size, cfg.mla_kv_lora_rank)
+    kr_shape = (
+        num_layers, num_pages + 1, page_size, cfg.mla_qk_rope_head_dim,
+    )
+    if kv_cache_dtype is None:
+        return (jnp.zeros(c_shape, cfg.dtype), jnp.zeros(kr_shape, cfg.dtype))
+    assert kv_cache_dtype == "int8", kv_cache_dtype
+    return (
+        jnp.zeros(c_shape, jnp.int8), jnp.zeros(kr_shape, jnp.int8),
+        *_scale_arrays(num_layers, num_pages, page_size),
+    )
+
+
+def pool_axes(cfg, kv_cache_dtype: str | None = None) -> tuple:
     """Per-stack mesh-axis tuples for the two pool arrays of one stack
     (feed each through `MeshContext.sharding(*axes)`). Page IDs stay
     GLOBAL — layer and page axes are never sharded, so the host-side
@@ -258,34 +293,54 @@ def pool_axes(cfg) -> tuple:
     - MLA:  the kv latent `c` shards its rank dim r (the big cached
       quantity; heads share one latent, so there is no head dim to cut),
       while the tiny shared rope head `kr` (dr floats/token) replicates.
+
+    With kv_cache_dtype="int8" the int8 payloads keep the fp cuts and the
+    two per-page scale arrays REPLICATE — a scale is one scalar per cache
+    row with no head/latent dim to partition, and every rank needs it to
+    dequantize its local head slice.
     """
     if cfg.attention_type == "mla":
-        return ((None, None, None, "tp"), (None, None, None, None))
-    return ((None, None, None, "tp", None), (None, None, None, "tp", None))
+        data = ((None, None, None, "tp"), (None, None, None, None))
+    else:
+        data = (
+            (None, None, None, "tp", None), (None, None, None, "tp", None),
+        )
+    if kv_cache_dtype is None:
+        return data
+    return data + ((None, None, None), (None, None, None))
 
 
-def pool_shardings(cfg, stack_layers: list[int], mesh_ctx):
+def pool_shardings(
+    cfg, stack_layers: list[int], mesh_ctx, kv_cache_dtype: str | None = None,
+):
     """Per-stack NamedSharding tuples matching `init_pool`'s structure."""
-    a0, a1 = pool_axes(cfg)
+    axes = pool_axes(cfg, kv_cache_dtype)
     return [
-        (mesh_ctx.sharding(*a0), mesh_ctx.sharding(*a1)) for _ in stack_layers
+        tuple(mesh_ctx.sharding(*a) for a in axes) for _ in stack_layers
     ]
 
 
 def init_pool(
     cfg, stack_layers: list[int], num_pages: int, page_size: int,
-    mesh_ctx=None,
+    mesh_ctx=None, kv_cache_dtype: str | None = None,
 ):
     """Per-stack pool tuples for a decoder (dense decoders have one stack;
     MoE decoders a dense prefix + MoE stack — mirrors generate.py). With a
-    `mesh_ctx` the arrays are placed mesh-sharded (`pool_axes`)."""
+    `mesh_ctx` the arrays are placed mesh-sharded (`pool_axes`). With
+    kv_cache_dtype="int8" each stack carries int8 payloads plus per-page
+    scale arrays — same page axis, so COW/defrag/transfer move scales with
+    their pages and the host-side allocator never knows."""
     init = init_mla_pool if cfg.attention_type == "mla" else init_gqa_pool
-    pool = [init(cfg, L, num_pages, page_size) for L in stack_layers]
+    pool = [
+        init(cfg, L, num_pages, page_size, kv_cache_dtype)
+        for L in stack_layers
+    ]
     if mesh_ctx is not None:
         pool = [
             tuple(jax.device_put(a, s) for a, s in zip(stack, shards))
             for stack, shards in zip(
-                pool, pool_shardings(cfg, stack_layers, mesh_ctx)
+                pool,
+                pool_shardings(cfg, stack_layers, mesh_ctx, kv_cache_dtype),
             )
         ]
     return pool

@@ -100,6 +100,9 @@ class KVTransfer:
         )
         self.n_pages = 0    # real (non-pad) pages moved
         self.n_chunks = 0   # device copy programs issued
+        self.n_bytes = 0    # wire bytes for real pages (quantized pools
+                            # ship int8 payload + f32 scales natively, so
+                            # this is ~half the fp equivalent)
 
     def _put_src(self, idx: np.ndarray):
         if self.src._mesh is None:
@@ -144,4 +147,5 @@ class KVTransfer:
                 )
             self.n_chunks += 1
             self.n_pages += len(chunk)
+            self.n_bytes += len(chunk) * self.page_bytes
         return len(pairs)

@@ -595,11 +595,12 @@ class DisaggRouter:
         self.n_returns = 0
         # KVTransfer counters are object-lifetime totals; remember what has
         # already been mirrored so repeated serve calls inc only deltas
-        self._transfer_mirrored = {"chunks": 0, "pages": 0}
+        self._transfer_mirrored = {"chunks": 0, "pages": 0, "bytes": 0}
 
     def _mirror_transfers(self) -> None:
         chunks = sum(t.n_chunks for t in self.transfers.values())
         pages = sum(t.n_pages for t in self.transfers.values())
+        nbytes = sum(t.n_bytes for t in self.transfers.values())
         reg = self.obs.registry
         reg.counter(
             "serve_kv_transfer_chunks_total",
@@ -609,7 +610,11 @@ class DisaggRouter:
             "serve_kv_transfer_pages_total",
             "KV pages shipped by transfers",
         ).inc(pages - self._transfer_mirrored["pages"])
-        self._transfer_mirrored = {"chunks": chunks, "pages": pages}
+        reg.counter(
+            "serve_kv_transfer_bytes_total",
+            "KV transfer wire bytes (quantized pools ship int8+scales)",
+        ).inc(nbytes - self._transfer_mirrored["bytes"])
+        self._transfer_mirrored = {"chunks": chunks, "pages": pages, "bytes": nbytes}
 
     # -- autoscaling ---------------------------------------------------------
     def autoscale_tick(self, p_scheds, d_scheds, step_idx) -> str | None:

@@ -68,14 +68,30 @@ def make_train_step(
     gradients explicitly (the 1F1B pipeline interleaves its own forward and
     backward — decoder.make_pp_1f1b_loss_and_grad); everything downstream
     (accumulation, normalization, clipping, update) is identical.
+
+    `param_transform` (QAT fake-quant) composes with BOTH gradient paths:
+    inside the differentiated function for the autodiff path, and — for an
+    explicit `grad_fn` — by vjp of the transform around the pipeline's
+    grads (d(master) = dtransform^T · d(quantized)), exactly the LoRA
+    merge-vjp composition of the PEFT×PP path. The straight-through
+    estimator means the transform's vjp is (masked) identity, so the
+    pipeline never knows it ran on fake-quantized weights.
     """
     config = config or TrainStepConfig()
-    if grad_fn is not None and param_transform is not None:
-        raise ValueError("param_transform (QAT) does not compose with grad_fn")
 
     def grad_one(params, step, mb, rng, *extra):
         if grad_fn is not None:
-            grads, ce, aux = grad_fn(params, mb, rng, *extra)
+            if param_transform is None:
+                grads, ce, aux = grad_fn(params, mb, rng, *extra)
+            else:
+                qp, q_vjp = jax.vjp(
+                    lambda p: param_transform(p, step), params
+                )
+                grads, ce, aux = grad_fn(qp, mb, rng, *extra)
+                grads = jax.tree.map(
+                    lambda g, p: g.astype(p.dtype), grads, qp
+                )
+                (grads,) = q_vjp(grads)
             if not isinstance(aux, dict):
                 aux = {"num_label_tokens": aux}
             return grads, ce, aux

@@ -500,3 +500,71 @@ def test_interleaved_matches_end_to_end_autodiff():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4, err_msg=path
         )
+
+
+@pytest.mark.parametrize("schedule", ["1f1b", "zb"])
+def test_qat_composes_with_explicit_pp_grads(schedule):
+    """The QAT×PP fence is gone: make_train_step composes the fake-quant
+    param transform with an explicit pipeline grad_fn by vjp of the
+    transform around the pipeline's grads (the straight-through estimator
+    makes that vjp a masked identity). One sgd(1.0) step through the pp2
+    pipeline must land on the same params as autodiff of
+    loss(fake_quant(params)) on a single device."""
+    import dataclasses
+
+    import optax
+
+    from automodel_tpu.loss import fused_linear_cross_entropy
+    from automodel_tpu.ops.quant import QATConfig
+    from automodel_tpu.training import (
+        TrainStepConfig,
+        init_train_state,
+        make_train_step,
+    )
+
+    cfg = dataclasses.replace(CFG, pipeline_schedule=schedule)
+    ctx = MeshConfig(pp=2, dp_shard=4).build()
+    params = decoder.init(cfg, jax.random.key(0))
+    sh = logical_to_shardings(
+        decoder.param_specs(cfg), ctx,
+        shapes=jax.tree.map(lambda p: p.shape, params),
+    )
+    sharded = jax.device_put(params, sh)
+    ids = jax.random.randint(jax.random.key(2), (16, 17), 0, 64)
+    inputs, labels = ids[:, :-1], ids[:, 1:]
+    transform = QATConfig(enabled=True, precision="int8").make_param_transform()
+
+    # single-device reference: autodiff THROUGH the fake-quant transform
+    def ref_loss(p):
+        qp = transform(p, jnp.int32(0))
+        hidden = decoder.forward(qp, cfg, inputs, return_hidden=True)
+        ce, n = fused_linear_cross_entropy(
+            hidden, qp["lm_head"]["kernel"], labels, chunk_size=64
+        )
+        return ce / n, n
+
+    (ref_ce, _), ref_grads = jax.value_and_grad(ref_loss, has_aux=True)(params)
+    expected = jax.tree.map(lambda p, g: p - g, params, ref_grads)
+
+    grad_fn = decoder.make_pp_1f1b_loss_and_grad(cfg, ctx, chunk_size=64)
+    tx = optax.sgd(1.0)
+    step = jax.jit(make_train_step(
+        None, tx, config=TrainStepConfig(max_grad_norm=None),
+        param_transform=transform, grad_fn=grad_fn,
+    ))
+    state = init_train_state(sharded, tx)
+    batch = {
+        "input_ids": jax.device_put(
+            inputs[None], ctx.sharding(None, "batch", None)),
+        "labels": jax.device_put(
+            labels[None], ctx.sharding(None, "batch", None)),
+    }
+    state, metrics = step(state, batch, jax.random.key(0))
+    np.testing.assert_allclose(float(metrics["loss"]), float(ref_ce), rtol=1e-5)
+    for a, b, path in zip(
+        jax.tree.leaves(state.params), jax.tree.leaves(expected),
+        [str(p) for p, _ in jax.tree_util.tree_leaves_with_path(expected)],
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4, err_msg=path
+        )

@@ -288,9 +288,11 @@ def test_moe_pipeline_rejects_capacity_dispatcher():
         _pp_moe_layer_setup(None, cfg, ctx, lambda w: None)
 
 
-def test_grad_fn_fence_is_qat_only():
-    """The _make_grad_fn fence list is down to QAT: MoE and PEFT both build
-    a grad_fn; QAT still raises and names the gpipe workaround."""
+def test_grad_fn_fence_is_empty():
+    """The _make_grad_fn fence list is EMPTY: MoE, PEFT, and QAT all build a
+    grad_fn on the explicit schedules. QAT composes one level up — in
+    make_train_step, by vjp of the fake-quant transform around the pipeline
+    grads — so _make_grad_fn has nothing left to refuse."""
     from types import SimpleNamespace
 
     from automodel_tpu.config import ConfigNode
@@ -309,5 +311,4 @@ def test_grad_fn_fence_is_qat_only():
 
     assert callable(R._make_grad_fn(fake()))  # MoE: lifted
     assert callable(R._make_grad_fn(fake(peft=SimpleNamespace())))  # PEFT: lifted
-    with pytest.raises(NotImplementedError, match="gpipe"):
-        R._make_grad_fn(fake(qat=True))
+    assert callable(R._make_grad_fn(fake(qat=True)))  # QAT: lifted (this PR)

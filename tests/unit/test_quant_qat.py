@@ -161,6 +161,61 @@ def test_qat_with_peft_raises():
         r.setup()
 
 
+def test_quantize_clamps_nonfinite_before_cast():
+    """`_quantize` must clamp in f32 BEFORE the low-precision cast: an inf
+    input makes the amax scale inf, inf/inf = NaN, and float8_e4m3fn has
+    no inf encoding so an unclamped cast of the overflow is NaN too — both
+    quantized products must come back finite."""
+    from automodel_tpu.ops.quant import FP8_MAX, _quantize
+
+    x = jnp.asarray([[np.inf, 1.0, -3.0], [-np.inf, 2.0, 0.5]], jnp.float32)
+    for precision, qdtype, qmax in (
+        ("int8", jnp.int8, 127.0),
+        ("fp8", jnp.float8_e4m3fn, FP8_MAX),
+    ):
+        q, scale = _quantize(x, qdtype, qmax, axis=-1)
+        assert np.all(np.isfinite(np.asarray(scale))), precision
+        assert np.all(np.isfinite(np.asarray(q, np.float32))), precision
+        assert np.all(np.abs(np.asarray(q, np.float32)) <= qmax), precision
+
+
+def test_quantize_near_fp8_max_saturates_not_nan():
+    """Values straddling FP8_MAX (448): after per-axis rescale everything
+    lands on the representable grid — saturation, never NaN — and the
+    dequantized product stays close."""
+    x = jnp.asarray([[447.9, 448.0, 448.1, -448.1, 1e30, -1e30]], jnp.float32)
+    for precision in ("int8", "fp8"):
+        got = quantized_matmul(x, jnp.eye(6, dtype=jnp.float32), precision)
+        a = np.asarray(got, np.float32)
+        assert np.all(np.isfinite(a)), (precision, a)
+    # the finite near-max values survive quantization with small error
+    small = jnp.asarray([[447.9, 400.0, -448.0, 100.0]], jnp.float32)
+    got = quantized_matmul(small, jnp.eye(4, dtype=jnp.float32), "fp8")
+    rel = np.abs(np.asarray(got) - np.asarray(small)) / np.abs(np.asarray(small))
+    assert np.max(rel) < 0.1, rel
+
+
+def test_kv_row_quant_roundtrip():
+    """quantize_kv_rows/dequantize_kv: one f32 scale per leading-dim row,
+    inf-safe, <1% relative error on the dominant row entries."""
+    from automodel_tpu.ops.quant import dequantize_kv, quantize_kv_rows
+
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(size=(6, 2, 16)) * 10.0, jnp.float32)
+    q, scale = quantize_kv_rows(x)
+    assert q.shape == x.shape and q.dtype == jnp.int8
+    assert scale.shape == (6,) and scale.dtype == jnp.float32
+    back = dequantize_kv(q, scale)
+    err = np.abs(np.asarray(back - x))
+    amax = np.abs(np.asarray(x)).max(axis=(1, 2), keepdims=True)
+    assert np.max(err / amax) <= 0.5 / 127.0 + 1e-6
+    # rows with inf quantize to finite saturated payloads
+    bad = x.at[0, 0, 0].set(np.inf)
+    qb, sb = quantize_kv_rows(bad)
+    assert np.isfinite(float(sb[0]))
+    assert np.all(np.isfinite(np.asarray(qb, np.float32)))
+
+
 def test_quantized_matmul_per_channel_accuracy():
     """Per-channel scales keep error small when channels differ in scale
     by orders of magnitude (per-tensor scaling would destroy the small
