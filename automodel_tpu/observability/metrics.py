@@ -289,6 +289,13 @@ METRIC_CATALOG = (
     ("frontend_itl_ewma_ms", "gauge", "decayed inter-token latency estimate (ms)"),
     ("request_ttft_ms", "histogram", "time to first token (ms)"),
     ("request_itl_ms", "histogram", "inter-token latency (ms)"),
+    # serving resilience (serving/resilience.py: health board, recovery,
+    # degraded routing — all host-side)
+    ("serve_replica_failures_total", "counter", "replica deaths observed (labeled by class)"),
+    ("serve_requests_recovered_total", "counter", "requests requeued onto survivors after a replica death"),
+    ("serve_recovery_reprefill_tokens_total", "counter", "known tokens requeued for re-prefill by failure recovery"),
+    ("serve_transfer_retries_total", "counter", "KV transfer / plan-wire send retry attempts"),
+    ("serve_degraded_mode", "gauge", "1 while disagg routing is collapsed to monolithic"),
     # resilience
     ("resilience_retries_total", "counter", "I/O retries attempted"),
     ("resilience_rollbacks_total", "counter", "rollback restores performed"),

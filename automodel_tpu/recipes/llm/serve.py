@@ -56,6 +56,17 @@ YAML:
         max_waiting: null
         shed_deadlines: true
         shed_safety: 1.0
+      resilience:                     # typed: ServeResilienceConfig
+        enabled: true                 # replica failure recovery (health
+        degrade: true                 #   board + evacuate-and-requeue);
+        degraded_failures: 3          #   degrade: disagg collapses to
+        transfer_retry_attempts: 3    #   monolithic when prefill class
+        transfer_retry_base_delay_s: 0.005   # dies (vs failing loudly)
+        transfer_retry_max_delay_s: 0.25
+        transfer_retry_jitter: 0.25
+        retry_seed: 0
+        ack_every_steps: 0            # plan-wire follower acks (0 = off)
+        ack_timeout_ms: 10000
       observability:                  # typed: ObservabilityConfig
         enabled: false                # span/event tracing + flight recorder
         trace_path: null              # export prefix (null → run_dir/serve)
@@ -245,6 +256,7 @@ class ServeRecipe(TrainFinetuneRecipeForNextTokenPrediction):
             )
             router = DisaggRouter(
                 params, self.model_cfg, serve_cfg, disagg, mesh=mesh_arg,
+                resilience=self.typed.serving_resilience,
             )
             obs = router.obs
             if online:
@@ -260,7 +272,8 @@ class ServeRecipe(TrainFinetuneRecipeForNextTokenPrediction):
             from automodel_tpu.serving import ReplicaRouter
 
             router = ReplicaRouter(
-                params, self.model_cfg, serve_cfg, serve_mesh
+                params, self.model_cfg, serve_cfg, serve_mesh,
+                resilience=self.typed.serving_resilience,
             )
             obs = router.obs
             if online:

@@ -209,6 +209,23 @@ class RecipeConfig:
         return self._cache[key]
 
     @property
+    def serving_resilience(self):
+        """`serving.resilience` section → ServeResilienceConfig (the serve
+        tier's failure envelope: health thresholds, transfer retry
+        budgets, disagg degradation switch, plan-wire ack protocol).
+        Defaults to enabled with stock budgets when absent."""
+        from automodel_tpu.serving.resilience import ServeResilienceConfig
+
+        key = ("serving.resilience", "ServeResilienceConfig")
+        if key not in self._cache:
+            node = self.raw.get("serving")
+            sub = node.get("resilience") if node is not None else None
+            self._cache[key] = dataclass_from_node(
+                ServeResilienceConfig, sub
+            )
+        return self._cache[key]
+
+    @property
     def serving_observability(self):
         """`serving.observability` section → ObservabilityConfig (defaults
         to fully disabled when absent — the serve path is then

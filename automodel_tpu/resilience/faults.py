@@ -25,6 +25,19 @@ Named points wired into the codebase:
 - ``serve_step``         — serving loop (`ServingEngine.serve_batch`),
   probed once per loop turn; a ``crash`` here exercises the
   observability flight recorder's crash dump
+- ``serve_step_run``     — `ServingEngine.run_step`, probed before the
+  lockstep counters and the pool rebind; also probed as the
+  track-qualified ``serve_step_run.<track>`` (replica1 / prefill0 /
+  decode2 / ...) so a chaos trace kills ONE router replica
+  deterministically (serving/resilience.py turns the raise into a
+  health-board death + requeue-on-survivors)
+- ``kv_transfer``        — `KVTransfer.move`, before any device copy
+  (whole-plan retryable: page copies are idempotent)
+- ``plan_send`` / ``plan_recv`` — plan-wire broadcast send/recv
+  (`serving/plan_wire.py`), before the coordination-service write/read
+- ``handoff_admit``      — disagg handoff admission
+  (`Scheduler.try_admit_handoff`), before any state mutates — an
+  injected fault delays the handoff one turn
 
 Modes: ``error`` raises :class:`FaultError` (a retryable transient),
 ``crash`` raises :class:`FaultCrash` (a BaseException — simulates the

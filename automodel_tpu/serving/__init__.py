@@ -17,7 +17,12 @@
 - frontend.py:     online asyncio serve loop — live admission, per-request
                    token streams with backpressure, deadline load shedding
 - plan_wire.py:    StepPlan wire format + multi-host plan broadcast
-                   (lead process stays single-brained, followers replay)
+                   (lead process stays single-brained, followers replay;
+                   bounded-timeout follower acks surface a dead follower
+                   as a named ReplicaFailure instead of a silent hang)
+- resilience.py:   serving-tier failure handling — per-replica health
+                   state machine, evacuate-and-requeue recovery, disagg
+                   degraded-mode routing, transfer retry with backoff
 - ops/paged_attention.py holds the ragged paged-attention op it runs on.
 """
 
@@ -51,6 +56,13 @@ from automodel_tpu.serving.prefix_cache import (
     PrefixCacheConfig,
     PrefixMatch,
 )
+from automodel_tpu.serving.resilience import (
+    HealthBoard,
+    ReplicaFailure,
+    ReplicaHealth,
+    ServeResilienceConfig,
+    pool_identity_ok,
+)
 from automodel_tpu.serving.scheduler import Scheduler, StepPlan
 from automodel_tpu.speculative.serve_draft import (
     DFlashDraftSource,
@@ -69,6 +81,7 @@ __all__ = [
     "DraftSource",
     "EagleDraftSource",
     "FrontendConfig",
+    "HealthBoard",
     "KVTransfer",
     "NgramDraftSource",
     "OnlineFrontend",
@@ -79,10 +92,13 @@ __all__ = [
     "PrefixCacheConfig",
     "PrefixMatch",
     "QueueAutoscaler",
+    "ReplicaFailure",
+    "ReplicaHealth",
     "ReplicaRouter",
     "Request",
     "Scheduler",
     "ServeMeshConfig",
+    "ServeResilienceConfig",
     "ServingConfig",
     "ServingEngine",
     "SpeculativeConfig",
@@ -91,5 +107,6 @@ __all__ = [
     "make_plan_broadcast",
     "pack_plan",
     "pack_stop",
+    "pool_identity_ok",
     "unpack_plan",
 ]

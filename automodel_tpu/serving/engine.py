@@ -811,6 +811,14 @@ class ServingEngine:
         increment HERE, so a follower replaying broadcast plans
         (plan_wire.PlanFollower) mirrors the lead's counters exactly —
         the multi-host CI dryrun asserts that parity."""
+        # chaos hooks (serving/resilience.py): probed BEFORE the lockstep
+        # counters and the pool rebind, so an injected replica death leaves
+        # this engine's counters and device state exactly as they were —
+        # lead/follower parity comparisons stay valid across a recovery.
+        # The track-qualified point lets a chaos trace kill ONE replica of
+        # a router deterministically (replica1 / prefill0 / decode2 / ...).
+        fault_hit("serve_step_run", self.steps_run)
+        fault_hit(f"serve_step_run.{self.track}", self.steps_run)
         reg = self.obs.registry
         reg.counter("serve_steps_total").inc()
         reg.counter("serve_plan_tokens_total").inc(plan.n_tokens)

@@ -48,6 +48,16 @@ One asyncio drive task owns one engine's serve loop:
   allocator/scheduler/prefix cache stay single-brained on the lead and a
   replica's mesh slice can span hosts without the host state knowing.
 
+- **Failure recovery** (serving/resilience.py): a replica whose jitted
+  step raises RuntimeError mid-loop is marked dead on the router's
+  health board and its live streams are ADOPTED by a survivor — the
+  `TokenStream` object never changes hands from the client's view; only
+  the compute moves (requeue with `fed = 0`, re-prefill riding the
+  prefix cache). Greedy continuations depend only on `known`, so a
+  recovered stream is token-for-token identical to an undisturbed run.
+  `drain()`/`quiesce()` are the rolling-restart half: stop admitting,
+  finish or hand off residents, flush streams, keep the loop alive.
+
 The jitted step is the only blocking call and runs in a worker thread
 (`run_in_executor`); every scheduler mutation happens on the event-loop
 thread between steps, so the scheduler needs no locks.
@@ -70,7 +80,12 @@ import time
 import numpy as np
 
 from automodel_tpu.observability import NULL_OBSERVABILITY
+from automodel_tpu.resilience.faults import FaultError
 from automodel_tpu.serving.plan_wire import pack_plan, pack_stop
+from automodel_tpu.serving.resilience import (
+    ReplicaFailure,
+    RetryBudgetExhausted,
+)
 from automodel_tpu.serving.scheduler import Request, Scheduler
 
 
@@ -110,8 +125,12 @@ class TokenStream:
     """Async iterator over one request's committed tokens, in commit
     order. Ends (StopAsyncIteration) when the request finishes for ANY
     reason — `finish_reason` then says which: "eos"/"length" (normal),
-    "timed_out" (deadline eviction), "shed" (admission control),
-    "cancelled" (client disconnect), "rejected" (invalid request)."""
+    "timed_out" (deadline eviction), "shed" (admission control — the
+    shed counter's `reason` label subdivides: deadline / queue_full /
+    draining / no_replica / closed), "cancelled" (client disconnect),
+    "rejected" (invalid request). A stream that survived a replica death
+    finishes with its NORMAL reason — `recovered` > 0 is the
+    failed-and-recovered marker (tokens are never lost or duplicated)."""
 
     def __init__(self, req: Request):
         self.request = req
@@ -125,6 +144,13 @@ class TokenStream:
     @property
     def finish_reason(self):
         return self.request.finish_reason
+
+    @property
+    def recovered(self) -> int:
+        """Times this stream's compute was evacuated off a dead replica
+        and requeued onto a survivor (recovery is invisible to a greedy
+        consumer except as latency)."""
+        return self.request.recovered
 
     def __aiter__(self):
         return self
@@ -241,8 +267,17 @@ class OnlineFrontend:
         self._emitted: dict[int, int] = {}       # rid → tokens pushed
         self._arrivals: asyncio.Queue = asyncio.Queue()
         self._cancels: list[int] = []
+        #: (req, stream, emitted) evacuated off a DEAD replica, buffered by
+        #: `adopt()` until the top of the next turn (drained before fresh
+        #: arrivals, in adoption order — deterministic requeue)
+        self._adopted: list = []
+        #: router-installed replica-death handler (serving/resilience.py):
+        #: called with (self, exc) when the jitted step raises; None →
+        #: the error propagates out of the drive task unchanged
+        self.on_failure = None
         self._next_rid = 0
         self._closed = False
+        self._draining = False                   # rolling-restart admission stop
         self._task: asyncio.Task | None = None
         self._step_waiter: asyncio.Event = asyncio.Event()
         self._idle_close = 0
@@ -250,6 +285,7 @@ class OnlineFrontend:
         self.n_submitted = 0
         self.n_shed = 0
         self.n_rejected = 0
+        self.n_recovered = 0                     # adopted-and-requeued here
         self.itl_ewma_s: float | None = None   # wall ITL (reporting only)
         self._sha = hashlib.sha1()             # lockstep digest (broadcast)
         # observability: share the engine's bundle (same registry/tracer)
@@ -377,9 +413,27 @@ class OnlineFrontend:
                     draft_len=self._draft_len or None,
                 ))
             t0 = time.perf_counter()
-            out = await loop.run_in_executor(
-                None, functools.partial(self.engine.run_step, plan)
-            )
+            try:
+                out = await loop.run_in_executor(
+                    None, functools.partial(self.engine.run_step, plan)
+                )
+            except RuntimeError as e:
+                # replica death (injected serve_step_run fault or a real
+                # runtime failure; FaultCrash is a BaseException and still
+                # propagates): dump the flight recorder and hand the wreck
+                # to the router's handler, which evacuates this scheduler
+                # and re-adopts the live streams onto survivors. This loop
+                # is done either way.
+                if self.on_failure is None:
+                    raise
+                self._closed = True
+                self.obs.tracer.instant(
+                    "replica.death", track=self.name, step=self.step_idx,
+                    reason=type(e).__name__,
+                )
+                self.obs.flight_dump("replica_death")
+                self.on_failure(self, e)
+                return
             dt = time.perf_counter() - t0
             self.obs.observe_step(self.step_idx, dt * 1e3)
             self._sha.update(np.ascontiguousarray(out[0]).tobytes())
@@ -411,6 +465,23 @@ class OnlineFrontend:
             self._cancel_now(rid)
 
     def _cancel_now(self, rid: int) -> None:
+        # adopted-but-not-yet-requeued (mid-recovery) cancels land here
+        for entry in list(self._adopted):
+            if entry[0].rid == rid:
+                self._adopted.remove(entry)
+                req = entry[0]
+                req.finish_reason = "cancelled"
+                req.finished_at = self.step_idx
+                self.sched.finished.append(req)
+                self.sched.n_cancelled += 1
+                self.obs.registry.counter(
+                    "frontend_cancelled_total",
+                    "streams cancelled by the caller",
+                ).inc()
+                self._active.setdefault(rid, (req, entry[1]))
+                self._emitted.setdefault(rid, entry[2])
+                self._finish_stream(rid)
+                return
         if self.sched.cancel(rid, self.step_idx):
             self.obs.registry.counter(
                 "frontend_cancelled_total", "streams cancelled by the caller"
@@ -418,6 +489,7 @@ class OnlineFrontend:
             self._finish_stream(rid)
 
     def _drain_arrivals(self) -> None:
+        self._drain_adopted()
         while not self._arrivals.empty():
             req, stream, deadline_in = self._arrivals.get_nowait()
             self._active[req.rid] = (req, stream)
@@ -425,8 +497,11 @@ class OnlineFrontend:
             req.arrived_t = time.perf_counter()
             if deadline_in is not None:
                 req.deadline = self.step_idx + deadline_in
-            if self._closed:
-                self._shed_one(req, "shed", why="closed")
+            if self._closed or self._draining:
+                self._shed_one(
+                    req, "shed",
+                    why="closed" if self._closed else "draining",
+                )
                 continue
             if (
                 self.cfg.max_waiting is not None
@@ -436,6 +511,7 @@ class OnlineFrontend:
                 continue
             if self.cfg.shed_deadlines and not self._reachable(
                 req, self._backlog() + self._waiting_backlog()
+                + self._recovery_backlog()
             ):
                 self._shed_one(req, "shed", why="deadline")
                 continue
@@ -445,6 +521,82 @@ class OnlineFrontend:
                 # oversized/invalid request: surface as a rejected stream
                 # instead of crashing the loop every other client shares
                 self._shed_one(req, "rejected")
+
+    # -- failure recovery ----------------------------------------------------
+    def adopt(self, req: Request, stream: TokenStream, emitted: int) -> None:
+        """Take over a live stream evacuated off a DEAD replica (router's
+        failure handler): buffered, then requeued at the top of this
+        loop's next turn — before fresh arrivals, in adoption order, so
+        identical chaos traces build identical queues. `emitted` preserves
+        the token count the dead frontend already pushed: re-prefill
+        regenerates the full `known` sequence but the stream only ever
+        sees the continuation."""
+        self._adopted.append((req, stream, emitted))
+
+    def _drain_adopted(self) -> None:
+        while self._adopted:
+            req, stream, emitted = self._adopted.pop(0)
+            self._active[req.rid] = (req, stream)
+            self._emitted[req.rid] = emitted
+            self._next_rid = max(self._next_rid, req.rid + 1)
+            # deadline re-check against the SURVIVOR's queues PLUS the
+            # adopted-but-not-yet-queued recovery backlog: a recovered
+            # request re-prefills its whole `known`, and the old formula
+            # (device + waiting backlog only) under-counted exactly that,
+            # admitting mid-recovery work that could no longer make its
+            # deadline. Shed stays a pure function of queue state, so the
+            # shed set is pinned across identical chaos traces.
+            if self.cfg.shed_deadlines and not self._reachable(
+                req, self._backlog() + self._waiting_backlog()
+                + self._recovery_backlog()
+            ):
+                self._shed_one(req, "shed", why="deadline")
+                continue
+            try:
+                self.sched.submit(req)
+            except ValueError:
+                self._shed_one(req, "rejected")
+                continue
+            self.n_recovered += 1
+            self.obs.registry.counter(
+                "serve_requests_recovered_total",
+                "requests requeued onto survivors after a replica death",
+            ).inc()
+            self.obs.registry.counter(
+                "serve_recovery_reprefill_tokens_total",
+                "known tokens requeued for re-prefill by failure recovery",
+            ).inc(len(req.known))
+            self.obs.tracer.instant(
+                "request.adopt", track=self.name, step=self.step_idx,
+                rid=req.rid, known=len(req.known), emitted=emitted,
+            )
+
+    def _recovery_backlog(self) -> int:
+        """Re-prefill tokens adopted but not yet queued anywhere — the
+        term mid-recovery shed arithmetic must price in."""
+        return sum(len(r.known) - r.fed for r, _s, _e in self._adopted)
+
+    # -- rolling restart -----------------------------------------------------
+    def drain(self) -> None:
+        """Stop ADMITTING (new arrivals shed as "draining") while the
+        loop keeps running and resident requests finish and flush their
+        streams — the first half of a rolling restart. Unlike `close()`,
+        the frontend stays alive; `resume_admission()` reopens it."""
+        self._draining = True
+
+    def resume_admission(self) -> None:
+        self._draining = False
+
+    async def quiesce(self) -> None:
+        """`drain()` and block until nothing is resident (requests
+        finished, streams flushed, queues empty): the point where the
+        process behind this replica can restart without dropping work."""
+        self.drain()
+        while (
+            self.sched.has_work or not self._arrivals.empty()
+            or self._adopted
+        ):
+            await self.wait_step(self.step_idx + 1)
 
     def _shed_one(self, req: Request, reason: str,
                   why: str | None = None) -> None:
@@ -501,7 +653,7 @@ class OnlineFrontend:
         time later as timed_out."""
         if not self.cfg.shed_deadlines:
             return
-        backlog = self._backlog()
+        backlog = self._backlog() + self._recovery_backlog()
         for req in list(self.sched.waiting):
             if not self._reachable(req, backlog):
                 self.sched.waiting.remove(req)
@@ -602,12 +754,18 @@ class OnlineFrontend:
                 "frontend_itl_ewma_ms",
                 "decayed inter-token latency estimate (ms)",
             ).set(self.itl_ewma_s * 1e3)
+        reasons: dict = {}
+        for r in s.finished:
+            reasons[r.finish_reason] = reasons.get(r.finish_reason, 0) + 1
         return {
             "steps": self.steps_run,
             "submitted": self.n_submitted,
             "finished": len(s.finished),
+            "finish_reasons": reasons,
             "shed": self.n_shed,
             "rejected": self.n_rejected,
+            "recovered": self.n_recovered,
+            "draining": self._draining,
             "cancelled": s.n_cancelled,
             "timed_out": s.n_timed_out,
             "preemptions": s.n_preemptions,
@@ -662,14 +820,20 @@ class DisaggOnlineFrontend:
         self._emitted: dict[int, int] = {}
         self._arrivals: asyncio.Queue = asyncio.Queue()
         self._cancels: list[int] = []
+        #: requests evacuated off a dead replica (or rolled back from an
+        #: exhausted transfer), requeued at the top of the next turn —
+        #: before fresh arrivals, in evacuation order (deterministic)
+        self._requeued: list = []
         self._next_rid = 0
         self._closed = False
+        self._draining = False
         self._task: asyncio.Task | None = None
         self._step_waiter: asyncio.Event = asyncio.Event()
         self._idle_close = 0
         self.n_submitted = 0
         self.n_shed = 0
         self.n_rejected = 0
+        self.n_recovered = 0
         self.n_cancelled_inflight = 0
         self.itl_ewma_s: float | None = None
         self.name = "frontend"
@@ -734,7 +898,7 @@ class DisaggOnlineFrontend:
 
     @property
     def _has_work(self) -> bool:
-        return bool(self.inflight) or any(
+        return bool(self.inflight) or bool(self._requeued) or any(
             s.has_work for s in self._all_scheds()
         )
 
@@ -760,6 +924,8 @@ class DisaggOnlineFrontend:
                 self.d_scheds + self.p_scheds,
                 self.router.decode + self.router.prefill,
             ):
+                if not self.router.health.alive(eng.track):
+                    continue
                 if not sched.has_work:
                     continue
                 plan = sched.schedule(self.step_idx)
@@ -784,20 +950,27 @@ class DisaggOnlineFrontend:
             self._idle_close = 0
             t0 = time.perf_counter()
             outs = await loop.run_in_executor(
-                None,
-                functools.partial(
-                    lambda ps: [
-                        (eng, sched, plan, eng.run_step(plan))
-                        for eng, sched, plan in ps
-                    ],
-                    plans,
-                ),
+                None, functools.partial(self._run_plans, plans)
             )
             dt = time.perf_counter() - t0
             self.obs.observe_step(self.step_idx, dt * 1e3)
             n_new = 0
-            for eng, sched, plan, out in outs:
-                n_new += eng.absorb_outputs(sched, plan, out, self.step_idx)
+            for eng, sched, plan, out, exc in outs:
+                if exc is None:
+                    n_new += eng.absorb_outputs(
+                        sched, plan, out, self.step_idx
+                    )
+            # replica deaths AFTER the survivors' outputs are absorbed
+            # (their tokens this turn are real and must land)
+            for eng, sched, plan, out, exc in outs:
+                if exc is None:
+                    continue
+                if not self.router.resilience.enabled:
+                    raise exc
+                if sched in self.p_scheds:
+                    self._recover_replica("p", self.p_scheds.index(sched), exc)
+                else:
+                    self._recover_replica("d", self.d_scheds.index(sched), exc)
             # runtime import: router imports this module at its top level
             from automodel_tpu.serving.router import _Handoff
 
@@ -833,8 +1006,55 @@ class DisaggOnlineFrontend:
         waiter, self._step_waiter = self._step_waiter, asyncio.Event()
         waiter.set()
 
+    @staticmethod
+    def _run_plans(plans):
+        """Executor body: every replica's step back-to-back, capturing
+        per-replica RuntimeErrors (injected `serve_step_run` deaths, real
+        XLA failures) so one dead replica cannot mask the survivors'
+        outputs for the turn. FaultCrash — a BaseException simulating the
+        whole PROCESS dying — still propagates and kills the loop."""
+        outs = []
+        for eng, sched, plan in plans:
+            try:
+                outs.append((eng, sched, plan, eng.run_step(plan), None))
+            except RuntimeError as e:
+                outs.append((eng, sched, plan, None, e))
+        return outs
+
     # -- admission / shedding ------------------------------------------------
+    def _route_scheds(self):
+        """The prefill ROUTING SET, health-aware: admittable prefill
+        replicas plus any autoscaler-borrowed decode replicas — or, when
+        the whole prefill class is gone and degradation is on, the
+        admittable decode replicas taking prefill chunks directly
+        (monolithic collapse: the request completes in place, no handoff
+        and no borrow-rid registration, so nothing is extracted).
+        Returns (schedulers, tag-per-entry) — tag None for a prefill
+        replica, int j for borrowed decode j, "mono" for degraded — or
+        None when nothing can admit."""
+        h = self.router.health
+        scheds: list = []
+        tags: list = []
+        for i, s in enumerate(self.p_scheds):
+            if h.admittable(self.router.prefill[i].track):
+                scheds.append(s)
+                tags.append(None)
+        for j in sorted(self.router.borrowed):
+            if h.admittable(self.router.decode[j].track):
+                scheds.append(self.d_scheds[j])
+                tags.append(j)
+        if scheds:
+            return scheds, tags
+        if not self.router.degraded:
+            return None
+        for j, s in enumerate(self.d_scheds):
+            if h.admittable(self.router.decode[j].track):
+                scheds.append(s)
+                tags.append("mono")
+        return (scheds, tags) if scheds else None
+
     def _drain_arrivals(self) -> None:
+        self._drain_requeued()
         while not self._arrivals.empty():
             req, stream, deadline_in = self._arrivals.get_nowait()
             self._active[req.rid] = (req, stream)
@@ -842,21 +1062,21 @@ class DisaggOnlineFrontend:
             req.arrived_t = time.perf_counter()
             if deadline_in is not None:
                 req.deadline = self.step_idx + deadline_in
-            if self._closed:
-                self._shed_one(req, "shed", why="closed")
+            if self._closed or self._draining:
+                self._shed_one(
+                    req, "shed",
+                    why="closed" if self._closed else "draining",
+                )
                 continue
-            # the prefill ROUTING SET: the prefill class plus any decode
-            # replicas the autoscaler has borrowed for it
-            borrowed = sorted(self.router.borrowed)
-            route_scheds = self.p_scheds + [
-                self.d_scheds[j] for j in borrowed
-            ]
+            route = self._route_scheds()
+            if route is None:
+                # nothing can admit and degradation is off/exhausted —
+                # shed loudly-labeled rather than queueing into a wedge
+                self._shed_one(req, "shed", why="no_replica")
+                continue
+            route_scheds, tags = route
             r = self.router.route_prefill(req, route_scheds)
             sched = route_scheds[r]
-            borrow_j = (
-                borrowed[r - len(self.p_scheds)]
-                if r >= len(self.p_scheds) else None
-            )
             if (
                 self.cfg.max_waiting is not None
                 and len(sched.waiting) >= self.cfg.max_waiting
@@ -864,7 +1084,9 @@ class DisaggOnlineFrontend:
                 self._shed_one(req, "shed", why="queue_full")
                 continue
             if self.cfg.shed_deadlines and not self._reachable(
-                req, sched, self._sched_backlog(sched, waiting=True)
+                req, sched,
+                self._sched_backlog(sched, waiting=True)
+                + self._recovery_backlog(),
             ):
                 self._shed_one(req, "shed", why="deadline")
                 continue
@@ -873,8 +1095,134 @@ class DisaggOnlineFrontend:
             except ValueError:
                 self._shed_one(req, "rejected")
                 continue
-            if borrow_j is not None:
-                self._borrow_rids.setdefault(borrow_j, set()).add(req.rid)
+            if isinstance(tags[r], int):
+                self._borrow_rids.setdefault(tags[r], set()).add(req.rid)
+
+    def _drain_requeued(self) -> None:
+        """Requeue evacuated requests BEFORE fresh arrivals, re-running
+        the deadline check against the survivor's backlog plus the
+        still-buffered recovery backlog (`_recovery_backlog`) — the
+        re-prefill cost the pre-resilience shed formula missed."""
+        while self._requeued:
+            req = self._requeued.pop(0)
+            route = self._route_scheds()
+            if route is None:
+                self._shed_one(req, "shed", why="no_replica")
+                continue
+            route_scheds, tags = route
+            r = self.router.route_prefill(req, route_scheds)
+            sched = route_scheds[r]
+            if self.cfg.shed_deadlines and not self._reachable(
+                req, sched,
+                self._sched_backlog(sched, waiting=True)
+                + self._recovery_backlog(),
+            ):
+                self._shed_one(req, "shed", why="deadline")
+                continue
+            try:
+                sched.submit(req)
+            except ValueError:
+                self._shed_one(req, "rejected")
+                continue
+            if isinstance(tags[r], int):
+                self._borrow_rids.setdefault(tags[r], set()).add(req.rid)
+            self.n_recovered += 1
+            self.obs.registry.counter(
+                "serve_requests_recovered_total",
+                "requests requeued onto survivors after a replica death",
+            ).inc()
+            self.obs.registry.counter(
+                "serve_recovery_reprefill_tokens_total",
+                "known tokens requeued for re-prefill by failure recovery",
+            ).inc(len(req.known))
+            self.obs.tracer.instant(
+                "request.adopt", track=self.name, step=self.step_idx,
+                rid=req.rid, known=len(req.known),
+            )
+
+    def _recovery_backlog(self) -> int:
+        return sum(len(r.known) - r.fed for r in self._requeued)
+
+    # -- rolling restart -----------------------------------------------------
+    def drain(self) -> None:
+        """Stop ADMITTING (arrivals shed as "draining"); resident work,
+        in-flight handoffs, and streams keep flowing to completion."""
+        self._draining = True
+
+    def resume_admission(self) -> None:
+        self._draining = False
+
+    async def quiesce(self) -> None:
+        """`drain()` and block until nothing is resident across either
+        replica class (handoffs landed, streams flushed)."""
+        self.drain()
+        while self._has_work or not self._arrivals.empty():
+            await self.wait_step(self.step_idx + 1)
+
+    # -- failure recovery ----------------------------------------------------
+    def _recover_replica(self, klass: str, r: int, exc) -> None:
+        """Replica death in the live loop: health-board death + flight
+        dump, evacuate the scheduler, drop handoff pins rooted there, and
+        requeue everything onto survivors at the top of the next turn.
+        Streams stay attached throughout — a greedy client sees recovery
+        only as latency. Decode extinction is the one unabsorbable loss
+        and raises `ReplicaFailure` out of the drive task."""
+        engines = self.router.prefill if klass == "p" else self.router.decode
+        scheds = self.p_scheds if klass == "p" else self.d_scheds
+        name = engines[r].track
+        if self.router.health.alive(name):
+            self.router.health.mark_dead(name, self.step_idx, repr(exc))
+        self.obs.tracer.instant(
+            "replica.death", track=name, step=self.step_idx,
+            reason=type(exc).__name__,
+        )
+        self.obs.flight_dump("replica_death")
+        evac = scheds[r].evacuate()
+        src = r if klass == "p" else ("d", r)
+        for h in list(self.inflight):
+            if h.src == src:
+                self.inflight.remove(h)
+                scheds[r].release_handoff(h.src_pages)
+                h.req.fed = 0
+                h.req.donated_pages = 0
+                evac.append(h.req)
+        if klass == "d":
+            # a dead decode replica can no longer be a borrowed prefill
+            self._borrow_rids.pop(r, None)
+            self.router.borrowed.discard(r)
+        self.router._tick_degraded_gauge(self.step_idx)
+        if not any(
+            self.router.health.admittable(e.track)
+            for e in self.router.decode
+        ):
+            raise ReplicaFailure(
+                "decode", "no decode-class replicas left alive"
+            ) from exc
+        for q in evac:
+            q.recovered += 1
+            self._requeued.append(q)
+
+    def _transfer_exhausted(self, h, r: int, exc) -> None:
+        """The retry budget around this handoff's KV page transfer ran
+        dry: escalate to the health board (degraded, dead after
+        `degraded_failures` strikes), roll the decode admission back
+        WITHOUT donating (the pages may hold a partial copy), drop the
+        source pins, and requeue for a full re-prefill."""
+        name = self.router.decode[r].track
+        state = self.router.health.mark_exhausted(
+            name, self.step_idx, str(exc)
+        )
+        self.d_scheds[r].evict_for_recovery(h.req.rid)
+        self._src_sched(h).release_handoff(h.src_pages)
+        self.inflight.remove(h)
+        h.req.recovered += 1
+        self._requeued.append(h.req)
+        self.obs.tracer.instant(
+            "transfer.exhausted", track=name, step=self.step_idx,
+            rid=h.req.rid, state=state,
+        )
+        if state == "dead":
+            self._recover_replica("d", r, exc)
 
     def _sched_backlog(self, sched, *, waiting: bool) -> int:
         b = sum(
@@ -896,7 +1244,10 @@ class DisaggOnlineFrontend:
         if not self.cfg.shed_deadlines:
             return
         for sched in self.p_scheds:
-            backlog = self._sched_backlog(sched, waiting=False)
+            backlog = (
+                self._sched_backlog(sched, waiting=False)
+                + self._recovery_backlog()
+            )
             for req in list(sched.waiting):
                 if not self._reachable(req, sched, backlog):
                     sched.waiting.remove(req)
@@ -933,6 +1284,20 @@ class DisaggOnlineFrontend:
             self._cancel_now(rid)
 
     def _cancel_now(self, rid: int) -> None:
+        # evacuated-but-not-yet-requeued (mid-recovery) cancels land here
+        for q in list(self._requeued):
+            if q.rid == rid:
+                self._requeued.remove(q)
+                q.finish_reason = "cancelled"
+                q.finished_at = self.step_idx
+                self.d_scheds[0].finished.append(q)
+                self.d_scheds[0].n_cancelled += 1
+                self.obs.registry.counter(
+                    "frontend_cancelled_total",
+                    "streams cancelled by the caller",
+                ).inc()
+                self._finish_stream(rid)
+                return
         # in-flight handoff: drop the prefill-side page pins THIS turn —
         # the bugfix half the offline loop only had for deadline expiry
         for h in list(self.inflight):
@@ -1003,16 +1368,29 @@ class DisaggOnlineFrontend:
     def _admit_inflight(self) -> None:
         for h in list(self.inflight):
             for r, _sticky in self.router._decode_order(h, self.d_scheds):
-                pairs = self.d_scheds[r].try_admit_handoff(
-                    h.req, h.n_tokens, h.src_pages, self.step_idx
-                )
+                if not self.router.health.admittable(
+                    self.router.decode[r].track
+                ):
+                    continue
+                try:
+                    pairs = self.d_scheds[r].try_admit_handoff(
+                        h.req, h.n_tokens, h.src_pages, self.step_idx
+                    )
+                except FaultError:
+                    # injected admission fault fires BEFORE any state
+                    # mutates — the handoff just waits one more turn
+                    pairs = None
                 if pairs is None:
                     continue
-                with self.obs.tracer.span(
-                    "kv_transfer", track=self.name, step=self.step_idx,
-                    rid=h.req.rid, pages=len(pairs),
-                ):
-                    self._transfer(h, r).move(pairs)
+                try:
+                    with self.obs.tracer.span(
+                        "kv_transfer", track=self.name, step=self.step_idx,
+                        rid=h.req.rid, pages=len(pairs),
+                    ):
+                        self.router._transfer_move(self._transfer(h, r), pairs)
+                except RetryBudgetExhausted as e:
+                    self._transfer_exhausted(h, r, e)
+                    break
                 self._src_sched(h).release_handoff(h.src_pages)
                 self.inflight.remove(h)
                 break
@@ -1090,12 +1468,21 @@ class DisaggOnlineFrontend:
                 "frontend_itl_ewma_ms",
                 "decayed inter-token latency estimate (ms)",
             ).set(self.itl_ewma_s * 1e3)
+        reasons: dict = {}
+        for s in scheds:
+            for r in s.finished:
+                reasons[r.finish_reason] = reasons.get(r.finish_reason, 0) + 1
         return {
             "steps": self.steps_run,
             "submitted": self.n_submitted,
             "finished": sum(len(s.finished) for s in scheds),
+            "finish_reasons": reasons,
             "shed": self.n_shed,
             "rejected": self.n_rejected,
+            "recovered": self.n_recovered,
+            "draining": self._draining,
+            "replica_health": self.router.health.snapshot(),
+            "degraded": self.router.degraded,
             "cancelled": sum(s.n_cancelled for s in scheds),
             "cancelled_inflight": self.n_cancelled_inflight,
             "timed_out": sum(s.n_timed_out for s in scheds),

@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from automodel_tpu.resilience.faults import fault_hit
 from automodel_tpu.serving.kv_pages import pool_trash_index
 
 
@@ -121,6 +122,11 @@ class KVTransfer:
         Returns the number of pages moved."""
         if not pairs:
             return 0
+        # chaos hook, BEFORE any device copy: a failed move retries as a
+        # whole (page copies are idempotent — re-copying is a self-
+        # overwrite), so the retry wrapper in serving/resilience.py can
+        # re-call this safely after an injected transfer fault
+        fault_hit("kv_transfer", None)
         B = self.batch_pages
         for i in range(0, len(pairs), B):
             chunk = pairs[i : i + B]

@@ -116,6 +116,10 @@ async def drive_load(frontend, cfg: LoadTestConfig) -> dict:
         if r.finish_reason in ("eos", "length")
     ]
     shed = [r for r in submitted if r.finish_reason in ("shed", "rejected")]
+    recovered = [r for r in submitted if r.recovered > 0]
+    reasons: dict = {}
+    for r in submitted:
+        reasons[r.finish_reason] = reasons.get(r.finish_reason, 0) + 1
     ttft = [r.ttft_s * 1e3 for r in ok if r.ttft_s >= 0]
     gaps = []
     for toks, stamps, _reason in records.values():
@@ -132,6 +136,16 @@ async def drive_load(frontend, cfg: LoadTestConfig) -> dict:
         "completed": len(ok),
         "shed": len(shed),
         "shed_rate": round(len(shed) / max(len(submitted), 1), 4),
+        # terminal status per stream (the TokenStream finish_reason
+        # taxonomy) + how many streams survived a replica death
+        "finish_reasons": reasons,
+        "recovered": len(recovered),
+        # recovered-request TTFT penalty: how much the re-prefill detour
+        # costs the affected streams vs the undisturbed population
+        "ttft_p50_recovered_ms": pct(
+            [r.ttft_s * 1e3 for r in recovered
+             if r.finish_reason in ("eos", "length") and r.ttft_s >= 0], 50
+        ),
         "new_tokens": new_tokens,
         "elapsed_s": round(elapsed, 4),
         # deadline-respecting completions per second: the serving number

@@ -36,6 +36,15 @@ can run multi-process computations and the KV store otherwise.
 `PlanFollower` is the whole follower process: recv → unpack → run_step
 until the stop frame, digesting the sampled-token outputs so lockstep
 execution is checkable end-to-end.
+
+Follower-loss detection (KV-store path): with ``ack_every > 0`` each
+follower writes an ack key back to the coordination service every N
+frames it receives, and the lead blocks (bounded by ``ack_timeout_ms``)
+on those keys right after the matching send. A SIGKILLed follower stops
+acking, so the lead surfaces a NAMED `ReplicaFailure` within ~N steps
+instead of broadcasting into the void forever — the silent-hang failure
+mode the 2-process kill-the-follower dryrun pins. The collective path
+needs no ack: a lost process fails the collective itself.
 """
 
 from __future__ import annotations
@@ -44,6 +53,8 @@ import hashlib
 
 import numpy as np
 
+from automodel_tpu.resilience.faults import fault_hit
+from automodel_tpu.serving.resilience import ReplicaFailure
 from automodel_tpu.serving.scheduler import StepPlan
 
 _MAGIC = 0x51A7  # "SLAT" — plan-wire frame marker
@@ -169,7 +180,10 @@ class KVStoreBroadcast:
     TRAIL = 4
 
     def __init__(self, size: int, is_lead: bool, *, prefix: str = "planwire",
-                 timeout_ms: int = 120_000, client=None):
+                 timeout_ms: int = 120_000, client=None,
+                 ack_every: int = 0, ack_timeout_ms: int = 10_000,
+                 num_followers: int | None = None,
+                 follower_id: int | None = None):
         if client is None:
             from jax._src import distributed
 
@@ -184,12 +198,36 @@ class KVStoreBroadcast:
         self._prefix = prefix
         self._timeout = timeout_ms
         self._seq = 0
+        # follower-loss detection: both sides must be constructed with the
+        # SAME ack_every (make_plan_broadcast passes the kwargs through).
+        # num_followers / follower_id default from the jax.distributed
+        # world; explicit values keep fake-client unit tests hermetic.
+        self._ack_every = int(ack_every)
+        self._ack_timeout = int(ack_timeout_ms)
+        if num_followers is None and is_lead and ack_every > 0:
+            # resolve the world size NOW, while the cluster is healthy:
+            # jax.process_count() can trigger backend initialization, and
+            # backend init blocks on a cross-process topology exchange —
+            # paying that inside await_acks() after a peer died would
+            # stall the very detection path that names the dead follower
+            import jax
+
+            num_followers = jax.process_count() - 1
+        self._num_followers = num_followers
+        self._follower_id = follower_id
 
     def _key(self, seq: int) -> str:
         return f"{self._prefix}/{seq}"
 
+    def _ack_key(self, fid: int, seq: int) -> str:
+        return f"{self._prefix}/ack/{fid}/{seq}"
+
+    def _ack_due(self, seq: int) -> bool:
+        return self._ack_every > 0 and (seq + 1) % self._ack_every == 0
+
     def send(self, buf: np.ndarray) -> None:
         assert self._is_lead and buf.shape[0] == self._size
+        fault_hit("plan_send", self._seq)
         self._client.key_value_set_bytes(self._key(self._seq), buf.tobytes())
         old = self._seq - self.TRAIL
         if old >= 0:
@@ -197,13 +235,52 @@ class KVStoreBroadcast:
                 self._client.key_value_delete(self._key(old))
             except Exception:
                 pass  # cleanup is best-effort; the run ends regardless
+        if self._ack_due(self._seq):
+            self.await_acks(self._seq)
         self._seq += 1
+
+    def await_acks(self, seq: int) -> None:
+        """Block (bounded) until every follower has acked frame `seq`; a
+        missing ack names the dead follower via `ReplicaFailure`. The wait
+        bound is the follower's recv turnaround — it acks on RECEIPT,
+        before running the step — so a healthy-but-slow step never trips
+        this, only a process that stopped reading the wire."""
+        if self._num_followers is None:
+            import jax
+
+            self._num_followers = jax.process_count() - 1
+        for fid in range(1, self._num_followers + 1):
+            try:
+                self._client.blocking_key_value_get_bytes(
+                    self._ack_key(fid, seq), self._ack_timeout
+                )
+            except Exception as e:
+                raise ReplicaFailure(
+                    f"follower{fid}",
+                    f"no plan-wire ack for seq {seq} within "
+                    f"{self._ack_timeout}ms ({e})",
+                ) from e
+            old = seq - self._ack_every * self.TRAIL
+            if old >= 0:
+                try:
+                    self._client.key_value_delete(self._ack_key(fid, old))
+                except Exception:
+                    pass
 
     def recv(self) -> np.ndarray:
         assert not self._is_lead
+        fault_hit("plan_recv", self._seq)
         raw = self._client.blocking_key_value_get_bytes(
             self._key(self._seq), self._timeout
         )
+        if self._ack_due(self._seq):
+            if self._follower_id is None:
+                import jax
+
+                self._follower_id = jax.process_index()
+            self._client.key_value_set_bytes(
+                self._ack_key(self._follower_id, self._seq), b"1"
+            )
         self._seq += 1
         buf = np.frombuffer(raw, np.int32)
         assert buf.shape[0] == self._size
@@ -228,12 +305,14 @@ class CollectiveBroadcast:
         from jax.experimental import multihost_utils
 
         assert self._is_lead and buf.shape[0] == self._size
+        fault_hit("plan_send", None)
         multihost_utils.broadcast_one_to_all(buf, is_source=True)
 
     def recv(self) -> np.ndarray:
         from jax.experimental import multihost_utils
 
         assert not self._is_lead
+        fault_hit("plan_recv", None)
         return np.asarray(multihost_utils.broadcast_one_to_all(
             np.zeros(self._size, np.int32), is_source=False
         ))

@@ -1,0 +1,310 @@
+"""Serving-tier failure handling: replica health, recovery, degraded routing.
+
+PR 11 made *training* survivable; this module is the same contract for the
+serving arc (ROADMAP north star: a service that degrades, not dies). The
+pieces, all HOST-side — the jitted step programs are untouched, so every
+serve-step HLO baseline stays byte-identical:
+
+- :class:`ReplicaHealth` / :class:`HealthBoard` — a per-replica state
+  machine (healthy → degraded → draining → dead) in the mold of
+  `QueueAutoscaler`: a pure function of the observation sequence, owned by
+  `ReplicaRouter`/`DisaggRouter`, unit-testable without engines. A replica
+  whose jitted step raises (or whose injected ``serve_step_run`` fault
+  fires) goes straight to ``dead``; retry-budget exhaustion on its KV
+  transfers degrades it first and kills it after
+  ``degraded_failures`` strikes; ``draining`` is the rolling-restart
+  state (`OnlineFrontend.drain()`): stop admitting, finish residents.
+
+- **Recovery** (router-side, built on `Scheduler.evacuate`): a dead
+  replica's resident + queued requests requeue onto survivors with pages
+  released, handoff pins dropped, and ``fed`` reset — the preemption
+  pattern, so re-prefill rides the engine-lifetime prefix cache and the
+  recovery cost is the divergence suffix, not the full prompt. Greedy
+  streams recover token-for-token (the continuation depends only on
+  ``known``), which the chaos parity test pins.
+
+- **Degraded routing** — when the last prefill-class replica dies,
+  `DisaggRouter` collapses to monolithic routing (decode replicas accept
+  prefill chunks again; requests complete in place, no handoff) instead
+  of wedging the queue, and returns to disagg on `restore()`. The
+  ``serve_degraded_mode`` gauge tracks the collapse.
+
+- :func:`transfer_with_retry` — `resilience/retry.py` backoff (same
+  deterministic per-point jitter) around KV page transfers and plan-wire
+  sends; every failed attempt lands on ``serve_transfer_retries_total``,
+  and budget exhaustion escalates to the health board instead of raising
+  into the serve loop.
+
+- :class:`ReplicaFailure` — the loud, NAMED failure: a lost plan-wire
+  follower (bounded-timeout ack in `plan_wire.KVStoreBroadcast`) or a
+  serve tier with no survivors left surfaces as this exception instead
+  of a silent hang.
+
+Chaos runs replay deterministically: death is injected through the
+`resilience/faults.py` points (``serve_step_run[.<track>]``,
+``kv_transfer``, ``plan_send``/``plan_recv``, ``handoff_admit``), firing
+is a pure function of (point, hit count, step), and retry jitter is
+seeded per point — identical traces fail, recover, and shed identically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from automodel_tpu.resilience.faults import FaultError
+from automodel_tpu.resilience.retry import (
+    RetryBudgetExhausted,
+    RetryPolicy,
+    retry_call,
+)
+
+#: replica health states (the full lifecycle; restore() re-enters healthy)
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+DRAINING = "draining"
+DEAD = "dead"
+
+
+class ReplicaFailure(RuntimeError):
+    """A NAMED replica (or plan-wire follower process) is gone and the
+    serve tier cannot absorb the loss silently: a follower that missed
+    its ack deadline, or a replica class with no survivors. Carries the
+    replica name so operators see *which* slice died, not just that
+    something did."""
+
+    def __init__(self, replica: str, reason: str):
+        super().__init__(f"replica failure: {replica}: {reason}")
+        self.replica = replica
+        self.reason = reason
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeResilienceConfig:
+    """Typed ``serving.resilience`` section: the serve tier's failure
+    envelope (health thresholds, retry budgets, degradation switch).
+    Distinct from the training-side `resilience:` section — a serving
+    replica's failure unit is a routing event, not a checkpoint."""
+
+    #: master switch — off restores the pre-resilience behavior exactly
+    #: (a replica's step error propagates out of the serve loop)
+    enabled: bool = True
+    #: disagg graceful degradation: collapse to monolithic routing when
+    #: the last prefill-class replica dies (off → fail loudly instead)
+    degrade: bool = True
+    #: retry-budget exhaustions a replica absorbs (degraded) before it is
+    #: declared dead — a step error always kills in one strike
+    degraded_failures: int = 3
+    #: retry budget around KV page transfers and plan-wire sends
+    transfer_retry_attempts: int = 3
+    transfer_retry_base_delay_s: float = 0.005
+    transfer_retry_max_delay_s: float = 0.25
+    transfer_retry_jitter: float = 0.25
+    #: deterministic jitter seed (resilience/retry.py `rng_for`)
+    retry_seed: int = 0
+    #: plan-wire follower liveness: every N broadcast plans the lead
+    #: blocks (bounded) for follower acks; 0 disables the ack protocol
+    ack_every_steps: int = 0
+    #: how long the lead waits for one follower ack before declaring it
+    #: dead (`ReplicaFailure`) — bounds detection to ~ack_every steps
+    ack_timeout_ms: int = 10_000
+
+    def __post_init__(self):
+        if self.degraded_failures < 1:
+            raise ValueError("degraded_failures must be >= 1")
+        if self.transfer_retry_attempts < 1:
+            raise ValueError("transfer_retry_attempts must be >= 1")
+        if self.ack_every_steps < 0 or self.ack_timeout_ms < 1:
+            raise ValueError(f"bad ack config: {self}")
+
+    def transfer_policy(self) -> RetryPolicy | None:
+        """The retry policy for transfer/send surfaces (None when the
+        layer is disabled → one bare attempt, errors propagate)."""
+        if not self.enabled:
+            return None
+        return RetryPolicy(
+            max_attempts=self.transfer_retry_attempts,
+            base_delay_s=self.transfer_retry_base_delay_s,
+            max_delay_s=self.transfer_retry_max_delay_s,
+            jitter=self.transfer_retry_jitter,
+            seed=self.retry_seed,
+        )
+
+
+class ReplicaHealth:
+    """One replica's health lifecycle — pure state, no engine references.
+
+    Transitions (anything → dead is absorbing until `restore()`):
+
+    - ``mark_dead``     : any state → dead (a step raised; one strike)
+    - ``mark_exhausted``: healthy/draining → degraded; degraded → dead
+      after `degraded_failures` total exhaustions (retry budgets kept
+      failing — the replica's transfers/links are rotten, stop feeding it)
+    - ``mark_draining`` : healthy/degraded → draining (rolling restart:
+      no new admissions, resident work finishes)
+    - ``restore``       : dead/draining → healthy (operator brought the
+      slice back; counters reset so old strikes don't linger)
+    """
+
+    def __init__(self, name: str, degraded_failures: int = 3):
+        self.name = name
+        self.degraded_failures = degraded_failures
+        self.state = HEALTHY
+        self.reason: str | None = None
+        self.since_step = -1
+        self.exhaustions = 0
+
+    @property
+    def alive(self) -> bool:
+        return self.state != DEAD
+
+    @property
+    def admittable(self) -> bool:
+        """May NEW work be routed here? Draining and dead replicas stop
+        admitting; a degraded one still serves (its step is fine — only
+        its transfer surfaces are flaky)."""
+        return self.state in (HEALTHY, DEGRADED)
+
+    def mark_dead(self, step: int, reason: str) -> str:
+        self.state = DEAD
+        self.reason = reason
+        self.since_step = step
+        return self.state
+
+    def mark_exhausted(self, step: int, reason: str) -> str:
+        self.exhaustions += 1
+        if self.state == DEAD:
+            return self.state
+        if self.exhaustions >= self.degraded_failures:
+            return self.mark_dead(step, reason)
+        self.state = DEGRADED
+        self.reason = reason
+        self.since_step = step
+        return self.state
+
+    def mark_draining(self, step: int = -1) -> str:
+        if self.state != DEAD:
+            self.state = DRAINING
+            self.since_step = step
+        return self.state
+
+    def restore(self) -> str:
+        self.state = HEALTHY
+        self.reason = None
+        self.since_step = -1
+        self.exhaustions = 0
+        return self.state
+
+
+def _replica_class(name: str) -> str:
+    """'prefill1' → 'prefill', 'replica0' → 'replica' — the metric label
+    groups failures by replica class, not individual index."""
+    return name.rstrip("0123456789") or name
+
+
+class HealthBoard:
+    """The router's view over every replica's `ReplicaHealth`, plus the
+    failure counters ('serve_replica_failures_total{class}') that land on
+    the shared registry at each death. Registry optional so the state
+    machine stays unit-testable bare."""
+
+    def __init__(self, names, cfg: ServeResilienceConfig | None = None,
+                 registry=None):
+        cfg = cfg or ServeResilienceConfig()
+        self.cfg = cfg
+        self.registry = registry
+        self.replicas = {
+            n: ReplicaHealth(n, cfg.degraded_failures) for n in names
+        }
+
+    def __getitem__(self, name: str) -> ReplicaHealth:
+        return self.replicas[name]
+
+    def alive(self, name: str) -> bool:
+        return self.replicas[name].alive
+
+    def admittable(self, name: str) -> bool:
+        return self.replicas[name].admittable
+
+    def any_alive(self, names) -> bool:
+        return any(self.replicas[n].alive for n in names)
+
+    def n_dead(self) -> int:
+        return sum(1 for h in self.replicas.values() if not h.alive)
+
+    def _count_failure(self, name: str) -> None:
+        if self.registry is not None:
+            self.registry.counter(
+                "serve_replica_failures_total",
+                "replica deaths observed (labeled by class)",
+                **{"class": _replica_class(name)},
+            ).inc()
+
+    def mark_dead(self, name: str, step: int, reason: str) -> str:
+        h = self.replicas[name]
+        was_alive = h.alive
+        state = h.mark_dead(step, reason)
+        if was_alive:
+            self._count_failure(name)
+        return state
+
+    def mark_exhausted(self, name: str, step: int, reason: str) -> str:
+        h = self.replicas[name]
+        was_alive = h.alive
+        state = h.mark_exhausted(step, reason)
+        if was_alive and state == DEAD:
+            self._count_failure(name)
+        return state
+
+    def restore(self, name: str) -> str:
+        return self.replicas[name].restore()
+
+    def snapshot(self) -> dict:
+        """{name: state} — stats/reporting."""
+        return {n: h.state for n, h in self.replicas.items()}
+
+
+def transfer_with_retry(fn, *args, cfg: ServeResilienceConfig, registry,
+                        point: str, **kwargs):
+    """`retry_call` specialized for the serve tier's transfer surfaces
+    (KV page moves, plan-wire sends): deterministic per-point jitter from
+    the config's seed, every FAILED attempt counted on
+    ``serve_transfer_retries_total``, and `RetryBudgetExhausted` left for
+    the caller to escalate to the health board (never into the serve
+    loop). FaultCrash — a simulated process death — propagates untouched,
+    as everywhere."""
+
+    def on_attempt(p, attempt, exc, delay):
+        registry.counter(
+            "serve_transfer_retries_total",
+            "KV transfer / plan-wire send retry attempts",
+        ).inc()
+
+    return retry_call(
+        fn, *args,
+        policy=cfg.transfer_policy(), point=point, on_attempt=on_attempt,
+        retry_on=(FaultError, OSError), **kwargs,
+    )
+
+
+def pool_identity_ok(sched) -> bool:
+    """The post-recovery allocator identity, checkable the moment a pool
+    is quiescent (no resident slots, no handoff pins): every page is
+    either free or held by the prefix tree — `num_free + cached_pages ==
+    num_pages`. A leak through the evacuate/requeue path breaks this."""
+    cached = sched.prefix.cached_pages if sched.prefix is not None else 0
+    return sched.alloc.num_free + cached == sched.alloc.num_pages
+
+
+__all__ = [
+    "DEAD",
+    "DEGRADED",
+    "DRAINING",
+    "HEALTHY",
+    "HealthBoard",
+    "ReplicaFailure",
+    "ReplicaHealth",
+    "RetryBudgetExhausted",
+    "ServeResilienceConfig",
+    "pool_identity_ok",
+    "transfer_with_retry",
+]

@@ -39,6 +39,7 @@ import jax
 import numpy as np
 
 from automodel_tpu.observability import Observability
+from automodel_tpu.resilience.faults import FaultError
 from automodel_tpu.serving.engine import (
     ServingConfig,
     ServingEngine,
@@ -51,6 +52,14 @@ from automodel_tpu.serving.frontend import (
     TokenStream,
 )
 from automodel_tpu.serving.kv_transfer import KVTransfer
+from automodel_tpu.serving.resilience import (
+    HealthBoard,
+    ReplicaFailure,
+    RetryBudgetExhausted,
+    ServeResilienceConfig,
+    pool_identity_ok,
+    transfer_with_retry,
+)
 from automodel_tpu.serving.scheduler import Request
 
 
@@ -147,6 +156,7 @@ class ReplicaRouter:
         mesh: ServeMeshConfig = ServeMeshConfig(),
         devices=None,
         draft_source_factory=None,
+        resilience: ServeResilienceConfig | None = None,
     ):
         """`params` may carry any placement (chassis-sharded arrays flow
         straight in); each replica re-shards them onto its own slice.
@@ -169,31 +179,89 @@ class ReplicaRouter:
             )
             for r, ctx in enumerate(ctxs)
         ]
+        # per-replica health (serving/resilience.py): engine-lifetime like
+        # the prefix cache — a replica that died stays dead across
+        # serve_batch calls until restore()
+        self.resilience = resilience or ServeResilienceConfig()
+        self.health = HealthBoard(
+            [e.track for e in self.engines], self.resilience,
+            registry=self.obs.registry,
+        )
 
     @property
     def num_replicas(self) -> int:
         return len(self.engines)
 
+    def _admittable(self) -> list[int]:
+        return [
+            r for r, e in enumerate(self.engines)
+            if self.health.admittable(e.track)
+        ]
+
+    def restore(self, replica: int) -> None:
+        """Bring a dead/draining replica back into the routing set (the
+        operator restarted or re-provisioned its slice)."""
+        self.health.restore(self.engines[replica].track)
+
     # -- admission ----------------------------------------------------------
-    def route(self, req: Request, schedulers) -> tuple[int, bool]:
+    def route(self, req: Request, schedulers, alive=None) -> tuple[int, bool]:
         """(replica index, sticky?) for one arriving request: best
         prefix-cache affinity first, else most-free-pages (ties → fewest
-        resident requests, then lowest index)."""
+        resident requests, then lowest index). `alive` (optional) narrows
+        the candidate indices — the health board's admittable set."""
+        cand = list(alive) if alive is not None else range(len(schedulers))
         best_aff, best_r = 0, None
-        for r, s in enumerate(schedulers):
-            aff = s.prefix_hit_tokens(req.prompt)
+        for r in cand:
+            aff = schedulers[r].prefix_hit_tokens(req.prompt)
             if aff > best_aff:
                 best_aff, best_r = aff, r
         if best_r is not None:
             return best_r, True
         return max(
-            range(len(schedulers)),
+            cand,
             key=lambda r: (
                 schedulers[r].alloc.num_free,
                 -(len(schedulers[r].running) + len(schedulers[r].waiting)),
                 -r,
             ),
         ), False
+
+    # -- failure recovery ----------------------------------------------------
+    def _recover_replica(self, r: int, scheds, exc, step_idx: int) -> int:
+        """A replica's step raised: mark it dead, evacuate every resident
+        and queued request, and requeue them onto surviving replicas with
+        pages released and `fed` reset — re-prefill rides each survivor's
+        prefix cache, so the cost is the divergence suffix. Raises the
+        NAMED `ReplicaFailure` when no survivors remain. Returns the
+        number of requests recovered."""
+        name = self.engines[r].track
+        self.health.mark_dead(name, step_idx, repr(exc))
+        self.obs.tracer.instant(
+            "replica.death", track=name, step=step_idx,
+            reason=type(exc).__name__,
+        )
+        # reason-labeled post-mortem: ring buffers + registry snapshot
+        self.obs.flight_dump("replica_death")
+        evac = scheds[r].evacuate()
+        alive = self._admittable()
+        if not alive:
+            raise ReplicaFailure(
+                name, f"last replica died with {len(evac)} requests resident"
+            ) from exc
+        reg = self.obs.registry
+        reg.counter(
+            "serve_requests_recovered_total",
+            "requests requeued onto survivors after a replica death",
+        ).inc(len(evac))
+        reg.counter(
+            "serve_recovery_reprefill_tokens_total",
+            "known tokens requeued for re-prefill by failure recovery",
+        ).inc(sum(len(q.known) for q in evac))
+        for q in evac:
+            q.recovered += 1
+            i, _ = self.route(q, scheds, alive=alive)
+            scheds[i].submit(q)
+        return len(evac)
 
     # -- offline drive ------------------------------------------------------
     def serve_batch(
@@ -231,18 +299,30 @@ class ReplicaRouter:
                 req = pending.pop(0)
                 req.arrived_t = time.perf_counter()
                 ttft_watch.append(req)
-                r, sticky = self.route(req, scheds)
+                r, sticky = self.route(req, scheds, alive=self._admittable())
                 scheds[r].submit(req)
                 routed[r] += 1
                 sticky_routed += int(sticky)
             progressed = False
             for r, (eng, sched) in enumerate(zip(self.engines, scheds)):
-                if not sched.has_work:
+                if not self.health.alive(eng.track) or not sched.has_work:
                     continue
                 plan = sched.schedule(step_idx)
                 if plan is None:
                     continue
-                n_new, dt = eng.run_and_absorb(sched, plan, step_idx)
+                try:
+                    n_new, dt = eng.run_and_absorb(sched, plan, step_idx)
+                except RuntimeError as e:
+                    # replica death (injected serve_step_run fault or a
+                    # real step failure — FaultCrash, a BaseException,
+                    # still propagates): recover onto survivors and keep
+                    # serving. The failed step never rebound the pool, so
+                    # survivors and the health board see a clean cut.
+                    if not self.resilience.enabled:
+                        raise
+                    self._recover_replica(r, scheds, e, step_idx)
+                    progressed = True
+                    continue
                 progressed = True
                 n_steps[r] += 1
                 tokens_fed[r] += plan.n_tokens
@@ -290,6 +370,16 @@ class ReplicaRouter:
         assert max_steps is not None or (
             not pending and not any(s.has_work for s in scheds)
         ), "routed serve stalled"
+        if max_steps is None and self.health.n_dead():
+            # post-recovery allocator identity on every SURVIVING pool:
+            # drained means every page is free or prefix-cached — a leak
+            # through evacuate/requeue would surface right here
+            for r in self._admittable():
+                assert pool_identity_ok(scheds[r]), (
+                    f"allocator identity broken on replica{r} after "
+                    f"recovery: free={scheds[r].alloc.num_free} "
+                    f"pages={scheds[r].alloc.num_pages}"
+                )
 
         finished = [r for s in scheds for r in s.finished]
         by_rid = sorted(finished, key=lambda r: r.rid)
@@ -350,6 +440,10 @@ class ReplicaRouter:
                 min(routed) / max(max(routed), 1), 4
             ),
             "per_replica": per_replica,
+            "replica_health": self.health.snapshot(),
+            "requests_recovered": sum(
+                1 for r in by_rid if r.recovered > 0
+            ),
         }
         if any(s.prefix is not None for s in scheds):
             stats["prefix_hits"] = sum(s.n_prefix_hits for s in scheds)
@@ -517,8 +611,10 @@ class DisaggRouter:
         mesh: ServeMeshConfig | None = None,
         devices=None,
         draft_source_factory=None,
+        resilience: ServeResilienceConfig | None = None,
     ):
         self.disagg = disagg
+        self.resilience = resilience or ServeResilienceConfig()
         n_p, n_d = disagg.prefill_replicas, disagg.decode_replicas
         ptb = disagg.prefill_token_budget or serve_cfg.token_budget
         # prefill-class engines never speculate (nothing to speculate on:
@@ -596,6 +692,60 @@ class DisaggRouter:
         # KVTransfer counters are object-lifetime totals; remember what has
         # already been mirrored so repeated serve calls inc only deltas
         self._transfer_mirrored = {"chunks": 0, "pages": 0, "bytes": 0}
+        # per-replica health across BOTH classes (engine-lifetime, like the
+        # prefix cache); degraded mode is DERIVED state — no alive prefill
+        # replica — so restore() flips the router back to disagg routing
+        # with no further bookkeeping
+        self.health = HealthBoard(
+            [e.track for e in self.prefill + self.decode], self.resilience,
+            registry=self.obs.registry,
+        )
+        self._was_degraded = False
+
+    # -- health / degraded mode ----------------------------------------------
+    def _admittable_prefill(self) -> list[int]:
+        return [
+            i for i, e in enumerate(self.prefill)
+            if self.health.admittable(e.track)
+        ]
+
+    def _admittable_decode(self) -> list[int]:
+        return [
+            j for j, e in enumerate(self.decode)
+            if self.health.admittable(e.track)
+        ]
+
+    @property
+    def degraded(self) -> bool:
+        """Monolithic-fallback routing is in force: the prefill class has
+        no admittable replica left, so decode replicas accept prefill
+        chunks again (requests complete in place, no handoff). Derived
+        from the health board — `restore()` on any prefill replica exits
+        degraded mode the same turn."""
+        return (
+            self.resilience.enabled
+            and self.resilience.degrade
+            and not self._admittable_prefill()
+        )
+
+    def restore(self, track: str) -> None:
+        """Bring a named replica (e.g. 'prefill0') back into the routing
+        set — exits degraded mode when it re-staffs the prefill class."""
+        self.health.restore(track)
+        self._tick_degraded_gauge(-1)
+
+    def _tick_degraded_gauge(self, step_idx: int) -> None:
+        d = self.degraded
+        if d != self._was_degraded:
+            self._was_degraded = d
+            self.obs.registry.gauge(
+                "serve_degraded_mode",
+                "1 while disagg routing is collapsed to monolithic",
+            ).set(1.0 if d else 0.0)
+            self.obs.tracer.instant(
+                "router.degraded" if d else "router.restored",
+                track="router", step=step_idx,
+            )
 
     def _mirror_transfers(self) -> None:
         chunks = sum(t.n_chunks for t in self.transfers.values())
@@ -720,6 +870,90 @@ class DisaggRouter:
         )
         return [(r, aff[r] > 0) for r in order]
 
+    # -- failure recovery ----------------------------------------------------
+    def _route_arrival(self, req: Request, p_scheds, d_scheds,
+                       routed_p, routed_d) -> tuple[str, int]:
+        """Submit one prefill-phase request (fresh arrival or recovery
+        requeue) to the CURRENT routing set: admittable prefill replicas
+        normally; under degraded mode the admittable decode replicas take
+        prefill chunks directly and the request completes in place (no
+        handoff). Raises the named `ReplicaFailure` when neither class
+        can take it (prefill gone and degradation off, or decode gone)."""
+        alive_p = self._admittable_prefill()
+        if alive_p:
+            idx = self.route_prefill(req, [p_scheds[i] for i in alive_p])
+            r = alive_p[idx]
+            p_scheds[r].submit(req)
+            routed_p[r] += 1
+            return ("p", r)
+        alive_d = self._admittable_decode()
+        if self.degraded and alive_d:
+            idx = self.route_prefill(req, [d_scheds[j] for j in alive_d])
+            j = alive_d[idx]
+            d_scheds[j].submit(req)
+            routed_d[j] += 1
+            return ("d", j)
+        raise ReplicaFailure(
+            "prefill" if alive_d else "decode",
+            "no admittable replica can take prefill work "
+            f"(degrade={self.resilience.degrade})",
+        )
+
+    def _transfer_move(self, t: KVTransfer, pairs) -> None:
+        """KV page copy with retry-and-backoff (deterministic jitter);
+        `RetryBudgetExhausted` escalates to the caller's health handling,
+        never into the serve loop."""
+        transfer_with_retry(
+            t.move, pairs, cfg=self.resilience,
+            registry=self.obs.registry, point="kv_transfer",
+        )
+
+    def _recover_disagg_replica(self, klass: str, r: int, p_scheds, d_scheds,
+                                inflight, routed_p, routed_d, exc,
+                                step_idx: int) -> int:
+        """A replica of either class died: evacuate its scheduler, drop
+        any in-flight handoff pinned on a dead prefill pool, and requeue
+        everything for full re-prefill through the (possibly degraded)
+        routing set. Decode-class extinction is unservable → the named
+        `ReplicaFailure` propagates."""
+        engines = self.prefill if klass == "p" else self.decode
+        scheds = p_scheds if klass == "p" else d_scheds
+        name = engines[r].track
+        if self.health.alive(name):
+            self.health.mark_dead(name, step_idx, repr(exc))
+        self.obs.tracer.instant(
+            "replica.death", track=name, step=step_idx,
+            reason=type(exc).__name__,
+        )
+        self.obs.flight_dump("replica_death")
+        evac = scheds[r].evacuate()
+        if klass == "p":
+            for h in list(inflight):
+                if h.src == r:
+                    inflight.remove(h)
+                    scheds[r].release_handoff(h.src_pages)
+                    h.req.fed = 0
+                    h.req.donated_pages = 0
+                    evac.append(h.req)
+        self._tick_degraded_gauge(step_idx)
+        if not self._admittable_decode():
+            raise ReplicaFailure(
+                "decode", "no decode-class replicas left alive"
+            ) from exc
+        reg = self.obs.registry
+        reg.counter(
+            "serve_requests_recovered_total",
+            "requests requeued onto survivors after a replica death",
+        ).inc(len(evac))
+        reg.counter(
+            "serve_recovery_reprefill_tokens_total",
+            "known tokens requeued for re-prefill by failure recovery",
+        ).inc(sum(len(q.known) for q in evac))
+        for q in evac:
+            q.recovered += 1
+            self._route_arrival(q, p_scheds, d_scheds, routed_p, routed_d)
+        return len(evac)
+
     # -- offline drive -------------------------------------------------------
     def serve_batch(
         self,
@@ -766,9 +1000,8 @@ class DisaggRouter:
                 req = pending.pop(0)
                 req.arrived_t = time.perf_counter()
                 ttft_watch.append(req)
-                r = self.route_prefill(req, p_scheds)
-                p_scheds[r].submit(req)
-                routed_p[r] += 1
+                self._route_arrival(req, p_scheds, d_scheds,
+                                    routed_p, routed_d)
             # deadline-expire handoffs stuck in flight (decode side full):
             # the prefill pins drop and the request times out — the same
             # contract deadline eviction gives a queued request
@@ -788,16 +1021,58 @@ class DisaggRouter:
             # pages device-side and drop the prefill-side pins
             for h in list(inflight):
                 for r, sticky in self._decode_order(h, d_scheds):
-                    pairs = d_scheds[r].try_admit_handoff(
-                        h.req, h.n_tokens, h.src_pages, step_idx
-                    )
+                    if not self.health.admittable(self.decode[r].track):
+                        continue
+                    try:
+                        pairs = d_scheds[r].try_admit_handoff(
+                            h.req, h.n_tokens, h.src_pages, step_idx
+                        )
+                    except FaultError:
+                        # injected handoff_admit fault: nothing mutated —
+                        # leave the handoff in flight and retry next turn
+                        pairs = None
                     if pairs is None:
                         continue
-                    with self.obs.tracer.span(
-                        "kv_transfer", track=f"prefill{h.src}",
-                        step=step_idx, rid=h.req.rid, pages=len(pairs),
-                    ):
-                        self.transfers[(h.src, r)].move(pairs)
+                    try:
+                        with self.obs.tracer.span(
+                            "kv_transfer", track=f"prefill{h.src}",
+                            step=step_idx, rid=h.req.rid, pages=len(pairs),
+                        ):
+                            self._transfer_move(
+                                self.transfers[(h.src, r)], pairs
+                            )
+                    except RetryBudgetExhausted as e:
+                        # retry budget gone → the HEALTH machine, not the
+                        # serve loop: roll the admission back (no donation
+                        # — pages may be half-copied), drop the pins, and
+                        # re-prefill from scratch on the routing set
+                        state = self.health.mark_exhausted(
+                            self.decode[r].track, step_idx, str(e)
+                        )
+                        d_scheds[r].evict_for_recovery(h.req.rid)
+                        p_scheds[h.src].release_handoff(h.src_pages)
+                        inflight.remove(h)
+                        reg = self.obs.registry
+                        reg.counter(
+                            "serve_requests_recovered_total",
+                            "requests requeued onto survivors after a "
+                            "replica death",
+                        ).inc()
+                        reg.counter(
+                            "serve_recovery_reprefill_tokens_total",
+                            "known tokens requeued for re-prefill by "
+                            "failure recovery",
+                        ).inc(len(h.req.known))
+                        h.req.recovered += 1
+                        self._route_arrival(
+                            h.req, p_scheds, d_scheds, routed_p, routed_d
+                        )
+                        if state == "dead":
+                            self._recover_disagg_replica(
+                                "d", r, p_scheds, d_scheds, inflight,
+                                routed_p, routed_d, e, step_idx,
+                            )
+                        break
                     p_scheds[h.src].release_handoff(h.src_pages)
                     inflight.remove(h)
                     sticky_routed += int(sticky)
@@ -805,12 +1080,22 @@ class DisaggRouter:
                     break
             progressed = False
             for r, (eng, sched) in enumerate(zip(self.decode, d_scheds)):
-                if not sched.has_work:
+                if not self.health.alive(eng.track) or not sched.has_work:
                     continue
                 plan = sched.schedule(step_idx)
                 if plan is None:
                     continue
-                n_new, dt = eng.run_and_absorb(sched, plan, step_idx)
+                try:
+                    n_new, dt = eng.run_and_absorb(sched, plan, step_idx)
+                except RuntimeError as e:
+                    if not self.resilience.enabled:
+                        raise
+                    self._recover_disagg_replica(
+                        "d", r, p_scheds, d_scheds, inflight,
+                        routed_p, routed_d, e, step_idx,
+                    )
+                    progressed = True
+                    continue
                 progressed = True
                 d_steps[r] += 1
                 d_fed[r] += plan.n_tokens
@@ -820,12 +1105,22 @@ class DisaggRouter:
                     if n_new:
                         d_ms[r].append(dt * 1e3 / n_new)
             for r, (eng, sched) in enumerate(zip(self.prefill, p_scheds)):
-                if not sched.has_work:
+                if not self.health.alive(eng.track) or not sched.has_work:
                     continue
                 plan = sched.schedule(step_idx)
                 if plan is None:
                     continue
-                n_new, dt = eng.run_and_absorb(sched, plan, step_idx)
+                try:
+                    n_new, dt = eng.run_and_absorb(sched, plan, step_idx)
+                except RuntimeError as e:
+                    if not self.resilience.enabled:
+                        raise
+                    self._recover_disagg_replica(
+                        "p", r, p_scheds, d_scheds, inflight,
+                        routed_p, routed_d, e, step_idx,
+                    )
+                    progressed = True
+                    continue
                 progressed = True
                 p_steps[r] += 1
                 p_fed[r] += plan.n_tokens
@@ -872,6 +1167,20 @@ class DisaggRouter:
             step_idx = min(arrivals)
         elapsed = time.perf_counter() - t_start
         assert max_steps is not None or not has_work(), "disagg serve stalled"
+        if max_steps is None and self.health.n_dead():
+            # post-recovery allocator identity on every surviving pool of
+            # BOTH classes (drained → free + prefix-cached == num_pages;
+            # a leaked handoff pin or evacuation page shows up here)
+            for engines, scheds in (
+                (self.prefill, p_scheds), (self.decode, d_scheds)
+            ):
+                for eng, s in zip(engines, scheds):
+                    if self.health.alive(eng.track):
+                        assert pool_identity_ok(s), (
+                            f"allocator identity broken on {eng.track} "
+                            f"after recovery: free={s.alloc.num_free} "
+                            f"pages={s.alloc.num_pages}"
+                        )
 
         finished = [r for s in p_scheds + d_scheds for r in s.finished]
         finished += expired
@@ -944,6 +1253,11 @@ class DisaggRouter:
             "requests_per_prefill": routed_p,
             "requests_per_decode": routed_d,
             "per_replica": per_replica,
+            "replica_health": self.health.snapshot(),
+            "degraded": self.degraded,
+            "requests_recovered": sum(
+                1 for r in by_rid if r.recovered > 0
+            ),
         }
         scheds_all = p_scheds + d_scheds
         if any(s.prefix is not None for s in scheds_all):
@@ -983,7 +1297,15 @@ class OnlineRouter:
     collide), routes, and delegates — the returned `TokenStream` is the
     chosen replica's. Each frontend paces itself; there is no cross-
     replica barrier, which is exactly the pod behavior (replicas step
-    concurrently on their own slices)."""
+    concurrently on their own slices).
+
+    Failure recovery rides the shared health board: a frontend whose
+    step raises calls back into `_handle_failure`, which marks the
+    replica dead, evacuates its scheduler, and re-ADOPTS every live
+    stream onto a survivor (`OnlineFrontend.adopt`) — the client's
+    `TokenStream` object never changes, and greedy recovery is
+    token-exact. `drain(r)`/`quiesce(r)`/`restore(r)` are the rolling-
+    restart API."""
 
     def __init__(self, router: ReplicaRouter,
                  cfg: FrontendConfig = FrontendConfig()):
@@ -992,6 +1314,8 @@ class OnlineRouter:
             OnlineFrontend(eng, cfg, name=f"replica{r}")
             for r, eng in enumerate(router.engines)
         ]
+        for fe in self.frontends:
+            fe.on_failure = self._handle_failure
         self._by_rid: dict[int, int] = {}
         self._next_rid = 0
         self.sticky_routed = 0
@@ -1001,13 +1325,24 @@ class OnlineRouter:
             fe.start()
         return self
 
+    def _admittable(self) -> list[int]:
+        return [
+            r for r, fe in enumerate(self.frontends)
+            if self.router.health.admittable(fe.engine.track)
+        ]
+
     def submit(self, req: Request, *, deadline_in: int | None = None
                ) -> TokenStream:
         if req.rid < 0:
             req.rid = self._next_rid
         self._next_rid = max(self._next_rid, req.rid + 1)
+        alive = self._admittable()
+        if not alive:
+            raise ReplicaFailure(
+                "replica", "no admittable replica to take a submission"
+            )
         r, sticky = self.router.route(
-            req, [fe.sched for fe in self.frontends]
+            req, [fe.sched for fe in self.frontends], alive=alive
         )
         self.sticky_routed += int(sticky)
         self._by_rid[req.rid] = r
@@ -1017,6 +1352,59 @@ class OnlineRouter:
         r = self._by_rid.get(rid)
         if r is not None:
             self.frontends[r].cancel(rid)
+
+    # -- failure recovery ----------------------------------------------------
+    def _handle_failure(self, fe: OnlineFrontend, exc: BaseException) -> None:
+        """Callback from a dying frontend's drive task (its step raised;
+        the flight recorder already dumped): mark the replica dead,
+        evacuate its scheduler, and re-adopt every live stream onto a
+        survivor — clients keep their `TokenStream`, tokens are never
+        lost or duplicated (greedy continuation depends only on `known`).
+        No survivors → the loud, NAMED `ReplicaFailure`."""
+        r = self.frontends.index(fe)
+        name = fe.engine.track
+        self.router.health.mark_dead(name, fe.step_idx, repr(exc))
+        evac = fe.sched.evacuate()
+        alive = self._admittable()
+        if not alive:
+            raise ReplicaFailure(
+                name, f"last replica died with {len(evac)} live streams"
+            ) from exc
+        scheds = [f.sched for f in self.frontends]
+        for req in evac:
+            entry = fe._active.pop(req.rid, None)
+            emitted = fe._emitted.pop(req.rid, 0)
+            if entry is None:
+                continue  # finished this very turn; stream already ended
+            req.recovered += 1
+            i, _ = self.router.route(req, scheds, alive=alive)
+            self._by_rid[req.rid] = i
+            self.frontends[i].adopt(req, entry[1], emitted)
+        # anything still attached has no compute left anywhere — end it
+        # so no client awaits a dead replica's stream forever
+        for rid in list(fe._active):
+            fe._active[rid][0].finish_reason = (
+                fe._active[rid][0].finish_reason or "cancelled"
+            )
+            fe._finish_stream(rid)
+
+    # -- rolling restart -----------------------------------------------------
+    def drain(self, r: int) -> None:
+        """Rolling restart, step 1 for replica `r`: health → draining (no
+        new routing) and the frontend stops admitting."""
+        self.router.health[self.frontends[r].engine.track].mark_draining(
+            self.frontends[r].step_idx
+        )
+        self.frontends[r].drain()
+
+    async def quiesce(self, r: int) -> None:
+        """Step 2: wait until replica `r` holds no work (streams flushed)."""
+        await self.frontends[r].quiesce()
+
+    def restore(self, r: int) -> None:
+        """Step 3: the slice is back — rejoin the routing set."""
+        self.router.health.restore(self.frontends[r].engine.track)
+        self.frontends[r].resume_admission()
 
     async def wait_step(self, n: int) -> None:
         """Until EVERY replica's loop has started turn `n`."""
@@ -1047,6 +1435,8 @@ class OnlineRouter:
             "cancelled": sum(p["cancelled"] for p in per),
             "timed_out": sum(p["timed_out"] for p in per),
             "preemptions": sum(p["preemptions"] for p in per),
+            "recovered": sum(p["recovered"] for p in per),
+            "replica_health": self.router.health.snapshot(),
             "sticky_routed": self.sticky_routed,
             "requests_per_replica": routed,
             "balance": round(min(routed) / max(max(routed), 1), 4),

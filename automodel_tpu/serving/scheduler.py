@@ -72,6 +72,7 @@ from collections import deque
 import numpy as np
 
 from automodel_tpu.observability.trace import NULL_TRACER
+from automodel_tpu.resilience.faults import fault_hit
 from automodel_tpu.serving.kv_pages import PageAllocator, pages_for
 from automodel_tpu.serving.prefix_cache import (
     PrefixCache,
@@ -115,6 +116,10 @@ class Request:
     # for THIS request; starts optimistic so the first blocks draft at full
     # K and the estimate is earned from real verifier feedback
     spec_ewma: float = 1.0
+    # failure recovery (serving/resilience.py): times this request was
+    # evacuated off a dead replica and requeued onto a survivor — lets
+    # stream consumers distinguish failed-and-recovered from undisturbed
+    recovered: int = 0
 
     @property
     def known(self) -> list:
@@ -521,6 +526,10 @@ class Scheduler:
         [(src_page, dst_page)] copy plan the caller must execute BEFORE the
         next engine step, or None when no slot/pages are available yet
         (the caller retries next step)."""
+        # chaos hook for the disagg handoff path — probed BEFORE any state
+        # mutates, so an injected admission fault just delays the handoff a
+        # turn (the caller's retry-next-step path, same as a full pool)
+        fault_hit("handoff_admit", step_idx)
         ps = self.page_size
         P = pages_for(n_tokens, ps)
         if len(src_pages) != P:
@@ -584,6 +593,51 @@ class Scheduler:
             rid=req.rid, slot=slot, spliced=k, moved=len(pairs),
         )
         return pairs
+
+    def evacuate(self) -> list:
+        """Pop EVERY resident and queued request for requeue on another
+        replica — the failure-recovery half of preempt-and-requeue
+        (serving/resilience.py). Running requests release their slots
+        WITHOUT donating (this pool is dead; seeding its radix tree would
+        just hide leaks from the allocator identity), waiting ones leave
+        the queue; every request resets to the preemption state (`fed = 0`,
+        `donated_pages = 0`) so its re-prefill on a survivor rides THAT
+        replica's prefix cache from the divergence point. Returns requests
+        in deterministic order: residents oldest-admit-first, then the
+        waiting queue — chaos traces requeue identically every run."""
+        out = []
+        for slot in list(self._admit_order):
+            out.append(self._release_slot(slot, donate=False))
+        out.extend(self.waiting)
+        self.waiting.clear()
+        for req in out:
+            req.fed = 0
+            req.donated_pages = 0
+            self.tracer.instant(
+                "request.evacuate", track=self.track, rid=req.rid,
+                known=len(req.known),
+            )
+        return out
+
+    def evict_for_recovery(self, rid: int):
+        """Pull ONE request back out for requeue elsewhere — the failed-
+        transfer path: its freshly admitted slot may hold a partial page
+        copy, so the release must NOT donate (garbage pages in the radix
+        tree would poison future admissions). Resets to the preemption
+        state; returns the Request, or None when the rid is not here."""
+        for slot, req in list(self.running.items()):
+            if req.rid == rid:
+                self._release_slot(slot, donate=False)
+                req.fed = 0
+                req.donated_pages = 0
+                return req
+        for req in list(self.waiting):
+            if req.rid == rid:
+                self.waiting.remove(req)
+                req.fed = 0
+                req.donated_pages = 0
+                return req
+        return None
 
     def _preempt_youngest(self, protected) -> bool:
         """Free the youngest running request whose slot is not `protected`

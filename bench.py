@@ -194,6 +194,12 @@ def main() -> None:
         "print a SERVE_SCALE: JSON line",
     )
     ap.add_argument(
+        "--serve-chaos-child", action="store_true",
+        help="internal: run the serve_chaos scenario in this process (the "
+        "parent forces 2 virtual CPU devices via env) and print a "
+        "SERVE_CHAOS: JSON line",
+    )
+    ap.add_argument(
         "--no-headline", action="store_true",
         help="emit only the llama-MFU metric (skip the flash-vs-XLA, MoE "
         "dropless, long-context CP, serving-decode, prefix-cache, "
@@ -204,6 +210,9 @@ def main() -> None:
 
     if args.serve_scale_child is not None:
         _serve_scale_child(args.serve_scale_child)
+        return
+    if args.serve_chaos_child:
+        _serve_chaos_child()
         return
 
     fallback = None
@@ -894,6 +903,180 @@ def _serve_scale_child(mesh_json: str) -> None:
     print("SERVE_SCALE:" + _json.dumps(out))
 
 
+def _serve_chaos_child() -> None:
+    """Child-process half of the `serve_chaos` headline: 256 live streams
+    through a 2-replica `OnlineRouter` over virtual CPU devices, one
+    deterministic replica death injected mid-trace, and the same trace
+    re-run clean. Reports goodput fraction under the death vs clean, the
+    recovered-request TTFT penalty (the re-prefill detour's cost), and
+    token-for-token offline parity for every completed stream — the
+    recovery must be invisible in the sampled tokens. Prints ONE
+    SERVE_CHAOS: JSON line."""
+    import asyncio
+    import json as _json
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from automodel_tpu.models.llm import decoder
+    from automodel_tpu.models.llm.decoder import TransformerConfig
+    from automodel_tpu.resilience import FaultSpec, injected
+    from automodel_tpu.serving import (
+        FrontendConfig,
+        OnlineRouter,
+        ReplicaRouter,
+        Request,
+        ServeMeshConfig,
+        ServingConfig,
+        ServingEngine,
+        pool_identity_ok,
+    )
+    from automodel_tpu.serving.load_test import (
+        LoadTestConfig,
+        _consume,
+        make_trace,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2,
+        dtype=jnp.float32, remat_policy="none", attn_impl="xla",
+    )
+    serve = ServingConfig(
+        page_size=8, num_pages=96, max_slots=4, pages_per_slot=8,
+        token_budget=16, prefill_chunk=8,
+    )
+    lt = LoadTestConfig(
+        num_requests=256, prompt_len=(3, 12), max_new_tokens=8,
+        mean_interarrival_steps=0.25, deadline_in=160,
+        deadline_fraction=0.25, vocab=cfg.vocab_size,
+    )
+    params = decoder.init(cfg, jax.random.key(0))
+    trace = make_trace(lt)
+
+    async def drive(router):
+        # arrival pacing against the SURVIVOR's step counter (replica0 —
+        # the injected death targets replica1): the router's wait_step
+        # awaits every replica, and a dead replica's counter freezes
+        orouter = OnlineRouter(
+            router, FrontendConfig(idle_sleep_s=0.0002)
+        ).start()
+        records: dict = {}
+        consumers, submitted = [], []
+        for arrival, prompt, dl in trace:
+            if arrival:
+                await orouter.frontends[0].wait_step(arrival)
+            req = Request(prompt=list(prompt),
+                          max_new_tokens=lt.max_new_tokens)
+            submitted.append(req)
+            s = orouter.submit(req, deadline_in=dl)
+            consumers.append(asyncio.ensure_future(_consume(s, records)))
+        await asyncio.gather(*consumers)
+        stats = await orouter.close()
+        return orouter, stats, submitted, records
+
+    def run(spec=None):
+        router = ReplicaRouter(
+            params, cfg, serve, ServeMeshConfig(replicas=2, tp=1)
+        )
+        if spec is None:
+            return asyncio.run(drive(router))
+        with injected(spec):
+            return asyncio.run(drive(router))
+
+    def pct(xs, q):
+        return round(float(np.percentile(xs, q)), 4) if xs else None
+
+    def summarize(stats, submitted) -> dict:
+        ok = [r for r in submitted if r.finish_reason in ("eos", "length")]
+        recovered = [r for r in ok if r.recovered > 0]
+        undisturbed = [r for r in ok if r.recovered == 0]
+        ttft = lambda rs: [r.ttft_s * 1e3 for r in rs if r.ttft_s >= 0]  # noqa: E731
+        return {
+            "completed": len(ok),
+            "shed": stats["shed"],
+            "timed_out": stats["timed_out"],
+            "recovered": len(recovered),
+            "goodput_fraction": round(len(ok) / max(len(submitted), 1), 4),
+            "ttft_p50_ms": pct(ttft(undisturbed), 50),
+            "ttft_p50_recovered_ms": pct(ttft(recovered), 50),
+        }
+
+    _, clean_stats, clean_sub, _ = run()
+    orouter, chaos_stats, chaos_sub, chaos_rec = run(
+        FaultSpec(point="serve_step_run.replica1", call=30)
+    )
+    clean = summarize(clean_stats, clean_sub)
+    chaos = summarize(chaos_stats, chaos_sub)
+    assert chaos_stats["replica_health"]["replica1"] == "dead", chaos_stats
+    assert chaos["recovered"] >= 1, chaos
+    assert chaos_stats["per_replica"][0]["compiled_signatures"] == 1
+    assert pool_identity_ok(orouter.frontends[0].sched)
+    # recovered-request TTFT penalty: what the re-prefill detour costs
+    # the adopted streams vs the undisturbed completed population
+    penalty = None
+    if chaos["ttft_p50_recovered_ms"] and chaos["ttft_p50_ms"]:
+        penalty = round(
+            chaos["ttft_p50_recovered_ms"] - chaos["ttft_p50_ms"], 4
+        )
+    # offline parity on survivors: every completed chaos stream must be
+    # the greedy continuation a fresh single engine produces — recovery
+    # (evacuate → route → re-prefill on a survivor) is host-side only
+    done = [r for r in chaos_sub if r.finish_reason in ("eos", "length")]
+    offline = ServingEngine(params, cfg, serve).serve_batch([
+        Request(prompt=list(r.prompt), max_new_tokens=lt.max_new_tokens)
+        for r in done
+    ])
+    for r, want in zip(done, offline["outputs"]):
+        got = chaos_rec[r.rid][0]
+        assert got == want, (
+            f"chaos stream rid={r.rid} (recovered={r.recovered}) diverged "
+            f"from offline serve_batch: {got} vs {want}"
+        )
+    print("SERVE_CHAOS:" + _json.dumps({
+        "requests": len(chaos_sub),
+        "clean": clean,
+        "chaos": chaos,
+        "goodput_retention": round(
+            chaos["goodput_fraction"]
+            / max(clean["goodput_fraction"], 1e-9), 4
+        ),
+        "recovered_ttft_penalty_ms": penalty,
+        "parity_checked": len(done),
+        "replica_health": chaos_stats["replica_health"],
+        "devices": len(jax.devices()),
+    }))
+
+
+def _headline_serve_chaos(accel: bool) -> dict:
+    """Serving resilience under live traffic: one injected replica death
+    at 256 live streams — goodput fraction retained vs a clean run of the
+    same trace, the recovered-request TTFT penalty, and offline parity on
+    every completed stream. Runs in a subprocess over virtual CPU devices
+    for the same reason as serve_scale: the recovery structure (health
+    machine, evacuation, re-prefill routing) is host-side and backend-
+    independent, and the chaos parity contract is pinned by the tier-1
+    suite on the identical CPU mesh."""
+    import os
+    import subprocess
+
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--serve-chaos-child"],
+        capture_output=True, text=True, timeout=900, env=env,
+    )
+    line = next(
+        (l for l in r.stdout.splitlines() if l.startswith("SERVE_CHAOS:")),
+        None,
+    )
+    if r.returncode != 0 or line is None:
+        return {"error": (r.stderr or r.stdout)[-300:]}
+    return json.loads(line[len("SERVE_CHAOS:"):])
+
+
 def _headline_disagg(accel: bool) -> dict:
     """Disaggregated serving: decode TTFT/ITL p50/p95 with vs without the
     prefill/decode phase split on a MIXED load — long ingestion prompts
@@ -1452,6 +1635,7 @@ def _run_headline(accel: bool) -> dict:
         ("disagg", _headline_disagg),
         ("serve_scale", _headline_serve_scale),
         ("serve_online", _headline_serve_online),
+        ("serve_chaos", _headline_serve_chaos),
         ("kv_quant", _headline_kv_quant),
         ("resilience", _headline_resilience),
     ):
